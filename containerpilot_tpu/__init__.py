@@ -1,0 +1,30 @@
+"""containerpilot_tpu — a TPU-host-native application lifecycle supervisor.
+
+A ground-up re-implementation of the capability set of a container
+init/supervisor (reference: TritonDataCenter/containerpilot v3.6.x, Go)
+re-designed for TPU VM pods: it supervises per-host JAX training and
+serving processes, reaps zombies as PID 1, runs health checks, registers
+services in a discovery catalog (Consul or a TPU-pod file catalog),
+watches the catalog for upstream changes, exposes Prometheus telemetry,
+and serves an HTTP control plane on a unix socket.
+
+Layer map (bottom-up; see SURVEY.md §1 for the reference layout):
+
+    sup/         PID-1 zombie reaper + signal passthrough (C++ native, Python fallback)
+    commands/    process execution with process groups and timeouts
+    events/      in-process actor event bus, timers
+    discovery/   service catalog backends (Consul HTTP, TPU-pod file catalog, noop)
+    jobs/        the job state machine (when/restarts/health/stop-dependencies)
+    watches/     upstream-change pollers
+    telemetry/   Prometheus /metrics + /status server
+    control/     unix-socket HTTP control plane; client/ is its SDK
+    config/      JSON5 + template config pipeline
+    core/        the App generation loop, signals, CLI flags
+    models/ ops/ parallel/ workload/   the TPU workload half: a JAX/pjit
+                 training harness (flagship transformer, sharding rules,
+                 pallas-ready op library) run *under* the supervisor.
+"""
+from .version import GIT_HASH, VERSION
+
+__version__ = VERSION
+__all__ = ["VERSION", "GIT_HASH", "__version__"]

@@ -1,0 +1,159 @@
+"""The in-process event bus: synchronous fan-out pub/sub.
+
+Capability parity with the reference supervisor's bus
+(reference: events/bus.go). Semantics preserved:
+
+- ``publish`` fans an event out to every subscriber synchronously,
+  under a lock, in subscription order (reference: events/bus.go:125-140).
+- Actors ``register`` before starting their loop and ``unregister`` when
+  done; the app's lifetime is ``await bus.wait()``, which completes when
+  the registered-actor count drops to zero and returns the reload flag
+  (reference: events/bus.go:97-122,150-170).
+- A small ring buffer of recent events supports event-sequence
+  assertions in tests (reference: events/bus.go:34-54,75).
+- ``shutdown`` publishes GLOBAL_SHUTDOWN; ``set_reload_flag`` marks the
+  next ``wait`` return as a reload rather than a stop.
+
+Design note (TPU-host idiom): the supervisor runs a single asyncio event
+loop — the analogue of the reference pinning itself to one OS thread so
+it never contends with the supervised JAX workload for host cores. The
+lock is kept because command-waiter callbacks and the control server may
+publish from other threads in embedding scenarios.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from collections import deque
+from typing import TYPE_CHECKING, Deque, List, Optional
+
+from .events import GLOBAL_SHUTDOWN, Event
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .subscriber import Subscriber
+
+log = logging.getLogger("containerpilot.events")
+
+# Ring-buffer size for DebugEvents-style assertions
+# (reference: events/bus.go:75).
+DEBUG_RING_SIZE = 10
+
+try:  # metrics are optional at import time so the bus has no hard deps
+    from prometheus_client import Counter, REGISTRY
+
+    def _make_event_counter() -> Optional["Counter"]:
+        try:
+            return Counter(
+                "containerpilot_events",
+                "Total events published to the supervisor bus",
+                ["code", "source"],
+            )
+        except ValueError:  # re-registration in the same process (reloads)
+            collector = REGISTRY._names_to_collectors.get(  # noqa: SLF001
+                "containerpilot_events"
+            )
+            return collector  # type: ignore[return-value]
+
+    _EVENT_COUNTER = _make_event_counter()
+except Exception:  # pragma: no cover - prometheus always present in-tree
+    _EVENT_COUNTER = None
+
+
+class EventBus:
+    """Synchronous fan-out pub/sub with actor-lifetime tracking."""
+
+    def __init__(self, ring_size: int = DEBUG_RING_SIZE) -> None:
+        self._lock = threading.RLock()
+        self._subscribers: List["Subscriber"] = []
+        self._registered: int = 0
+        self._done = asyncio.Event()
+        self._done.set()  # nothing registered yet
+        self._reload_flag = False
+        self._shutdown = False
+        self._ring: Deque[Event] = deque(maxlen=ring_size)
+
+    # -- subscription ---------------------------------------------------
+
+    def subscribe(self, subscriber: "Subscriber") -> None:
+        with self._lock:
+            self._subscribers.append(subscriber)
+
+    def unsubscribe(self, subscriber: "Subscriber") -> None:
+        with self._lock:
+            try:
+                self._subscribers.remove(subscriber)
+            except ValueError:
+                pass
+
+    # -- actor lifetime (the WaitGroup analogue) ------------------------
+
+    def register(self, _actor: object = None) -> None:
+        """Count an actor into this bus generation's lifetime."""
+        with self._lock:
+            self._registered += 1
+            self._done.clear()
+
+    def unregister(self, _actor: object = None) -> None:
+        with self._lock:
+            self._registered -= 1
+            if self._registered <= 0:
+                self._registered = 0
+                self._done.set()
+
+    async def wait(self) -> bool:
+        """Block until every registered actor has unregistered.
+
+        Returns True when the generation ended because of a reload
+        request, False for a plain shutdown
+        (reference: events/bus.go:164-170 + core/app.go:146).
+        """
+        await self._done.wait()
+        with self._lock:
+            return self._reload_flag
+
+    # -- publishing -----------------------------------------------------
+
+    def publish(self, event: Event) -> None:
+        """Fan the event out to all subscribers, synchronously, in order.
+
+        A subscriber with a full mailbox gets the event dropped with an
+        error log rather than wedging the entire bus (the reference
+        blocks in that case, which is a documented deadlock hazard —
+        reference: events/bus.go:125-140, jobs/jobs.go:23).
+        """
+        with self._lock:
+            log.debug("event: %s", event)
+            self._ring.append(event)
+            if _EVENT_COUNTER is not None:
+                try:
+                    _EVENT_COUNTER.labels(
+                        code=event.code.value, source=event.source
+                    ).inc()
+                except Exception:  # pragma: no cover
+                    pass
+            for sub in list(self._subscribers):
+                sub.receive(event)
+
+    def shutdown(self) -> None:
+        """Broadcast GLOBAL_SHUTDOWN (reference: events/bus.go:156-160)."""
+        with self._lock:
+            self._shutdown = True
+        self.publish(GLOBAL_SHUTDOWN)
+
+    # -- reload flag ----------------------------------------------------
+
+    def set_reload_flag(self) -> None:
+        with self._lock:
+            self._reload_flag = True
+
+    def get_reload_flag(self) -> bool:
+        with self._lock:
+            return self._reload_flag
+
+    # -- test/debug support ---------------------------------------------
+
+    def debug_events(self) -> List[Event]:
+        """Most-recent events, oldest first (reference: events/bus.go:34-54)."""
+        with self._lock:
+            return list(self._ring)

@@ -1,0 +1,68 @@
+"""Timers that inject TIMER_EXPIRED events onto the bus.
+
+Capability parity with the reference's timer helpers
+(reference: events/timer.go):
+
+- ``event_timeout``: one-shot — after ``delay`` seconds publish
+  ``{TIMER_EXPIRED, name}`` once (reference: events/timer.go:12-34).
+- ``event_timer``: ticker — publish ``{TIMER_EXPIRED, name}`` every
+  ``interval`` seconds until cancelled (reference: events/timer.go:40-68).
+
+Both are asyncio tasks bound to a context; cancelling the context (or
+the returned task) stops them. Publishing after the bus generation has
+torn down is harmless — the reference handles the analogous
+send-on-closed-channel race with a recover() (events/timer.go:26-30,49-54);
+here a cancelled task simply stops ticking.
+
+The reference silences debug logging for the internal heartbeat timer
+(GH-556); we keep that behavior via the logger's level only.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from .bus import EventBus
+from .events import Event, EventCode
+
+
+def event_timeout(
+    bus: EventBus, delay: float, name: str
+) -> "asyncio.Task[None]":
+    """One-shot timer: publish {TIMER_EXPIRED, name} after delay seconds."""
+
+    async def _fire() -> None:
+        try:
+            await asyncio.sleep(delay)
+            bus.publish(Event(EventCode.TIMER_EXPIRED, name))
+        except asyncio.CancelledError:
+            pass
+
+    return asyncio.get_event_loop().create_task(_fire(), name=f"timeout:{name}")
+
+
+def event_timer(
+    bus: EventBus, interval: float, name: str, *, immediate: bool = False
+) -> "asyncio.Task[None]":
+    """Ticker: publish {TIMER_EXPIRED, name} every interval seconds.
+
+    ``immediate=True`` fires once right away before settling into the
+    interval cadence (used by watches so the first poll isn't delayed).
+    """
+
+    async def _tick() -> None:
+        try:
+            if immediate:
+                bus.publish(Event(EventCode.TIMER_EXPIRED, name))
+            while True:
+                await asyncio.sleep(interval)
+                bus.publish(Event(EventCode.TIMER_EXPIRED, name))
+        except asyncio.CancelledError:
+            pass
+
+    return asyncio.get_event_loop().create_task(_tick(), name=f"timer:{name}")
+
+
+def cancel_timer(task: Optional["asyncio.Task[None]"]) -> None:
+    if task is not None and not task.done():
+        task.cancel()

@@ -1,0 +1,30 @@
+"""Shared test configuration.
+
+Supervisor tests are pure-host and need no accelerator. Workload tests
+exercise multi-chip sharding on a virtual 8-device CPU mesh, so the JAX
+platform must be pinned *before* jax is first imported anywhere.
+"""
+import asyncio
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+
+@pytest.fixture
+def run():
+    """Run a coroutine to completion on a fresh event loop."""
+
+    def _run(coro, timeout=30.0):
+        return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+    return _run

@@ -1,0 +1,171 @@
+"""Event system tests: bus fan-out, actor lifetime, reload flag, ring
+buffer, timers. Mirrors the reference's event-loop test conventions
+(reference: events/bus_test.go, events/timer_test.go; SURVEY.md §4)."""
+import asyncio
+
+import pytest
+
+from containerpilot_tpu.events import (
+    DEBUG_RING_SIZE,
+    Event,
+    EventBus,
+    EventCode,
+    EventHandler,
+    GLOBAL_SHUTDOWN,
+    GLOBAL_STARTUP,
+    QUIT_BY_TEST,
+    cancel_timer,
+    code_from_string,
+    event_timeout,
+    event_timer,
+)
+
+
+def test_event_equality_and_parse():
+    assert Event(EventCode.STARTUP, "global") == GLOBAL_STARTUP
+    assert Event(EventCode.EXIT_SUCCESS, "a") != Event(EventCode.EXIT_SUCCESS, "b")
+    assert code_from_string("exitSuccess") is EventCode.EXIT_SUCCESS
+    assert code_from_string("EXIT_SUCCESS") is EventCode.EXIT_SUCCESS
+    with pytest.raises(ValueError):
+        code_from_string("nope")
+
+
+class CollectingActor(EventHandler):
+    """Minimal actor: records every event, quits on QUIT/SHUTDOWN."""
+
+    def __init__(self, name="actor"):
+        super().__init__()
+        self.name = name
+        self.seen = []
+
+    async def run(self):
+        while True:
+            ev = await self.next_event()
+            self.seen.append(ev)
+            if ev.code in (EventCode.QUIT, EventCode.SHUTDOWN):
+                break
+        self.unsubscribe()
+        self.unregister()
+
+
+def test_bus_fanout_and_wait(run):
+    async def scenario():
+        bus = EventBus()
+        a, b = CollectingActor("a"), CollectingActor("b")
+        for actor in (a, b):
+            actor.subscribe(bus)
+            actor.register(bus)
+        ta = asyncio.ensure_future(a.run())
+        tb = asyncio.ensure_future(b.run())
+        bus.publish(GLOBAL_STARTUP)
+        bus.publish(Event(EventCode.EXIT_SUCCESS, "job1"))
+        bus.shutdown()
+        reload = await bus.wait()
+        await asyncio.gather(ta, tb)
+        return bus, a, b, reload
+
+    bus, a, b, reload = run(scenario())
+    expected = [
+        GLOBAL_STARTUP,
+        Event(EventCode.EXIT_SUCCESS, "job1"),
+        GLOBAL_SHUTDOWN,
+    ]
+    assert a.seen == expected
+    assert b.seen == expected
+    assert reload is False
+    assert bus.debug_events() == expected
+
+
+def test_bus_reload_flag(run):
+    async def scenario():
+        bus = EventBus()
+        actor = CollectingActor()
+        actor.subscribe(bus)
+        actor.register(bus)
+        t = asyncio.ensure_future(actor.run())
+        bus.set_reload_flag()
+        bus.shutdown()
+        reload = await bus.wait()
+        await t
+        return reload
+
+    assert run(scenario()) is True
+
+
+def test_bus_wait_empty_returns_immediately(run):
+    async def scenario():
+        bus = EventBus()
+        return await bus.wait()
+
+    assert run(scenario()) is False
+
+
+def test_quit_by_test_stops_single_actor(run):
+    async def scenario():
+        bus = EventBus()
+        a, b = CollectingActor("a"), CollectingActor("b")
+        a.subscribe(bus)
+        a.register(bus)
+        t = asyncio.ensure_future(a.run())
+        # b never subscribes; publishing QUIT_BY_TEST only reaches a
+        bus.publish(QUIT_BY_TEST)
+        reload = await bus.wait()
+        await t
+        return a, b, reload
+
+    a, b, reload = run(scenario())
+    assert a.seen == [QUIT_BY_TEST]
+    assert b.seen == []
+    assert reload is False
+
+
+def test_debug_ring_bounded(run):
+    async def scenario():
+        bus = EventBus()
+        for i in range(25):
+            bus.publish(Event(EventCode.METRIC, f"m{i}"))
+        return bus.debug_events()
+
+    ring = run(scenario())
+    assert len(ring) == DEBUG_RING_SIZE
+    assert ring[-1] == Event(EventCode.METRIC, "m24")
+    assert ring[0] == Event(EventCode.METRIC, f"m{25 - DEBUG_RING_SIZE}")
+
+
+def test_one_shot_timeout(run):
+    async def scenario():
+        bus = EventBus()
+        event_timeout(bus, 0.02, "myjob.wait")
+        await asyncio.sleep(0.1)
+        return bus.debug_events()
+
+    ring = run(scenario())
+    assert ring == [Event(EventCode.TIMER_EXPIRED, "myjob.wait")]
+
+
+def test_ticker_fires_repeatedly_until_cancelled(run):
+    async def scenario():
+        bus = EventBus()
+        t = event_timer(bus, 0.02, "myjob.heartbeat")
+        await asyncio.sleep(0.09)
+        cancel_timer(t)
+        count_at_cancel = len(bus.debug_events())
+        await asyncio.sleep(0.05)
+        return count_at_cancel, len(bus.debug_events())
+
+    at_cancel, after = run(scenario())
+    assert at_cancel >= 2
+    assert after == at_cancel  # no ticks after cancellation
+
+
+def test_mailbox_overflow_drops_not_deadlocks(run):
+    async def scenario():
+        bus = EventBus()
+        actor = CollectingActor()
+        actor.subscribe(bus)
+        # never drain the mailbox; overflow must not wedge publish
+        for i in range(1100):
+            bus.publish(Event(EventCode.METRIC, "x"))
+        return actor.rx.qsize()
+
+    assert run(scenario()) == 1000
