@@ -1,0 +1,5 @@
+"""Process execution layer (reference: commands/ package)."""
+from .args import ArgsError, parse_args
+from .commands import Command
+
+__all__ = ["Command", "parse_args", "ArgsError"]

@@ -1,0 +1,19 @@
+"""Service discovery: catalog backends and per-job registration state
+(reference: discovery/ package)."""
+from .backend import (
+    Backend,
+    DiscoveryError,
+    ServiceInstance,
+    ServiceRegistration,
+)
+from .noop import NoopBackend
+from .service import ServiceDefinition
+
+__all__ = [
+    "Backend",
+    "DiscoveryError",
+    "ServiceInstance",
+    "ServiceRegistration",
+    "ServiceDefinition",
+    "NoopBackend",
+]

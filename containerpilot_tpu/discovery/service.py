@@ -1,0 +1,88 @@
+"""ServiceDefinition: how one job talks to the discovery catalog.
+
+Capability parity with the reference (reference: discovery/service.go):
+lazy registration on first heartbeat, TTL refresh writes, initial-status
+registration, deregistration on stop, and maintenance = deregister.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from .backend import Backend, DiscoveryError, ServiceRegistration
+
+log = logging.getLogger("containerpilot.discovery")
+
+HEALTH_PASSING = "passing"
+HEALTH_WARNING = "warning"
+HEALTH_CRITICAL = "critical"
+
+
+class ServiceDefinition:
+    """A job's live registration state against a Backend."""
+
+    def __init__(self, registration: ServiceRegistration, backend: Backend) -> None:
+        self.registration = registration
+        self.backend = backend
+        self.was_registered = False
+
+    @property
+    def id(self) -> str:
+        return self.registration.id
+
+    @property
+    def name(self) -> str:
+        return self.registration.name
+
+    @property
+    def initial_status(self) -> str:
+        return self.registration.initial_status
+
+    def send_heartbeat(self) -> None:
+        """Lazy-register then refresh the TTL check
+        (reference: discovery/service.go:41-51)."""
+        self._register(HEALTH_PASSING)
+        check_id = f"service:{self.id}"
+        try:
+            self.backend.update_ttl(check_id, "ok", "pass")
+        except DiscoveryError as exc:
+            log.warning("service update TTL failed: %s", exc)
+
+    def register_with_initial_status(self) -> None:
+        """Register once with the configured initial status
+        (reference: discovery/service.go:54-76)."""
+        if self.was_registered:
+            return
+        status = {
+            "passing": HEALTH_PASSING,
+            "warning": HEALTH_WARNING,
+            "critical": HEALTH_CRITICAL,
+        }.get(self.initial_status, "")
+        log.info(
+            "registering service %s with initial status %r", self.name, status
+        )
+        self._register(status)
+
+    def _register(self, status: str) -> None:
+        if self.was_registered:
+            return
+        try:
+            self.backend.service_register(self.registration, status)
+        except DiscoveryError as exc:
+            log.warning("service registration failed: %s", exc)
+            return
+        log.info("service registered: %s", self.name)
+        self.was_registered = True
+
+    def deregister(self) -> None:
+        """Remove from the catalog (reference: discovery/service.go:28-33)."""
+        log.debug("deregistering: %s", self.id)
+        try:
+            self.backend.service_deregister(self.id)
+        except DiscoveryError as exc:
+            log.info("deregistering failed: %s", exc)
+
+    def mark_for_maintenance(self) -> None:
+        """Maintenance mode = drop out of the catalog
+        (reference: discovery/service.go:36-38)."""
+        self.deregister()

@@ -1,0 +1,125 @@
+"""Command execution tests (reference: commands/commands_test.go,
+args_test.go — behavior parity, not translation)."""
+import asyncio
+import os
+import signal
+
+import pytest
+
+from containerpilot_tpu.commands import ArgsError, Command, parse_args
+from containerpilot_tpu.events import Event, EventBus, EventCode
+
+
+def test_parse_args_string_and_list():
+    assert parse_args("/bin/echo hi there") == ("/bin/echo", ["hi", "there"])
+    assert parse_args(["/bin/echo", "one two"]) == ("/bin/echo", ["one two"])
+    assert parse_args("lone") == ("lone", [])
+    for bad in ("", [], None, 42):
+        with pytest.raises(ArgsError):
+            parse_args(bad)
+
+
+def test_env_name():
+    assert Command("/bin/to-db.sh", name="/bin/to-db.sh").env_name() == "TO_DB"
+    assert Command("x", name="my job.1").env_name() == "MY_JOB"
+    assert Command("x", name="app").env_name() == "APP"
+
+
+def test_run_success_publishes_exit_success(run):
+    async def scenario():
+        bus = EventBus()
+        cmd = Command.from_config("true", name="ok")
+        rc = await cmd.run(bus)
+        return rc, bus.debug_events()
+
+    rc, ring = run(scenario())
+    assert rc == 0
+    assert ring == [Event(EventCode.EXIT_SUCCESS, "ok")]
+
+
+def test_run_failure_publishes_exit_failed_and_error(run):
+    async def scenario():
+        bus = EventBus()
+        cmd = Command.from_config("false", name="bad")
+        rc = await cmd.run(bus)
+        return rc, bus.debug_events()
+
+    rc, ring = run(scenario())
+    assert rc == 1
+    assert ring[0] == Event(EventCode.EXIT_FAILED, "bad")
+    assert ring[1].code == EventCode.ERROR
+
+
+def test_spawn_failure_publishes_events(run):
+    async def scenario():
+        bus = EventBus()
+        cmd = Command.from_config("/no/such/binary", name="ghost")
+        rc = await cmd.run(bus)
+        return rc, bus.debug_events()
+
+    rc, ring = run(scenario())
+    assert rc is None
+    assert ring[0] == Event(EventCode.EXIT_FAILED, "ghost")
+    assert ring[1].code == EventCode.ERROR
+
+
+def test_timeout_kills_process_group(run):
+    async def scenario():
+        bus = EventBus()
+        cmd = Command.from_config("sleep 10", timeout=0.1, name="sleepy")
+        rc = await cmd.run(bus)
+        return rc, bus.debug_events()
+
+    rc, ring = run(scenario(), timeout=5)
+    assert rc == -signal.SIGKILL
+    assert ring[0] == Event(EventCode.EXIT_FAILED, "sleepy")
+
+
+def test_term_signals_group(run):
+    async def scenario():
+        bus = EventBus()
+        cmd = Command.from_config("sleep 10", name="victim")
+        task = cmd.run(bus)
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if cmd.running:
+                break
+        cmd.term()
+        rc = await task
+        return rc, bus.debug_events()
+
+    rc, ring = run(scenario(), timeout=5)
+    assert rc == -signal.SIGTERM
+    assert ring[0] == Event(EventCode.EXIT_FAILED, "victim")
+
+
+def test_pid_env_exported_during_run(run):
+    async def scenario():
+        bus = EventBus()
+        cmd = Command.from_config(
+            ["/bin/sh", "-c", 'echo "pid=$CONTAINERPILOT_PROBE_PID"'],
+            fields={"job": "probe"},
+            name="probe",
+        )
+        rc = await cmd.run(bus)
+        # env cleaned up after exit
+        return rc, os.environ.get("CONTAINERPILOT_PROBE_PID")
+
+    rc, leftover = run(scenario())
+    assert rc == 0
+    assert leftover is None
+
+
+def test_captured_logging_vs_raw(run, caplog):
+    async def scenario():
+        bus = EventBus()
+        cmd = Command.from_config(
+            "echo hello-from-child", fields={"job": "echoer"}, name="echoer"
+        )
+        await cmd.run(bus)
+
+    import logging
+
+    with caplog.at_level(logging.INFO, logger="containerpilot.job.echoer"):
+        run(scenario())
+    assert any("hello-from-child" in r.message for r in caplog.records)

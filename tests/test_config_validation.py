@@ -1,0 +1,266 @@
+"""Config-validation tables: durations, IP selection DSL, job configs.
+Mirrors the reference's per-package config_test.go conventions
+(reference: jobs/config_test.go, config/timing/*_test.go,
+config/services/*_test.go)."""
+import ipaddress
+
+import pytest
+
+from containerpilot_tpu.config import (
+    DurationError,
+    InterfaceIP,
+    get_ip,
+    get_timeout,
+    parse_duration,
+    validate_name,
+)
+from containerpilot_tpu.discovery import NoopBackend
+from containerpilot_tpu.events import EventCode, GLOBAL_STARTUP
+from containerpilot_tpu.jobs import (
+    UNLIMITED,
+    JobConfig,
+    JobConfigError,
+    new_job_configs,
+)
+
+
+# --- durations -------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "raw,expected",
+    [
+        (5, 5.0),
+        ("5", 5.0),
+        ("500ms", 0.5),
+        ("1.5s", 1.5),
+        ("1m", 60.0),
+        ("1h2m3s", 3723.0),
+        ("100us", 0.0001),
+        (0.25, 0.25),
+    ],
+)
+def test_parse_duration_ok(raw, expected):
+    assert parse_duration(raw) == pytest.approx(expected)
+
+
+@pytest.mark.parametrize("raw", ["nope", "5x", "", None, True, [1]])
+def test_parse_duration_bad(raw):
+    with pytest.raises(DurationError):
+        parse_duration(raw)
+
+
+def test_get_timeout_empty_is_zero():
+    assert get_timeout("") == 0.0
+    assert get_timeout(None) == 0.0
+    assert get_timeout("10ms") == pytest.approx(0.01)
+
+
+# --- names -----------------------------------------------------------------
+
+def test_validate_name():
+    validate_name("my-service2")
+    for bad in ("", "Big", "2fast", "under_score", "x"):
+        with pytest.raises(ValueError):
+            validate_name(bad)
+
+
+# --- interface/IP DSL ------------------------------------------------------
+
+FAKE_IPS = [
+    InterfaceIP("eth0", ipaddress.IPv4Address("10.2.0.5")),
+    InterfaceIP("eth0", ipaddress.IPv4Address("192.168.1.4")),
+    InterfaceIP("eth1", ipaddress.IPv4Address("172.16.0.7")),
+    InterfaceIP("eth1", ipaddress.IPv6Address("fdc6:238c:c4bc::1")),
+    InterfaceIP("lo", ipaddress.IPv4Address("127.0.0.1")),
+]
+
+
+@pytest.mark.parametrize(
+    "specs,expected",
+    [
+        (["eth0"], "10.2.0.5"),
+        (["eth0[1]"], "192.168.1.4"),
+        (["eth1"], "172.16.0.7"),
+        (["eth1:inet6"], "fdc6:238c:c4bc::1"),
+        (["inet"], "10.2.0.5"),
+        (["inet6"], "fdc6:238c:c4bc::1"),
+        (["192.168.0.0/16"], "192.168.1.4"),
+        (["static:203.0.113.5"], "203.0.113.5"),
+        (["bogus0", "eth1"], "172.16.0.7"),  # ordered fallback
+    ],
+)
+def test_get_ip_specs(specs, expected):
+    assert get_ip(specs, interface_ips=FAKE_IPS) == expected
+
+
+def test_get_ip_no_match_raises():
+    with pytest.raises(ValueError):
+        get_ip(["bogus0"], interface_ips=FAKE_IPS)
+
+
+def test_get_ip_bad_spec():
+    with pytest.raises(ValueError):
+        get_ip(["static:notanip"], interface_ips=FAKE_IPS)
+    with pytest.raises(ValueError):
+        get_ip(["eth0[x]"], interface_ips=FAKE_IPS)
+
+
+# --- job configs -----------------------------------------------------------
+
+def test_when_defaults_to_global_startup():
+    cfg = JobConfig({"name": "app", "exec": "true"}).validate(None)
+    assert cfg.when_event == GLOBAL_STARTUP
+    assert cfg.when_starts_limit == 1
+    assert cfg.restart_limit == 0
+
+
+def test_when_mutual_exclusion():
+    with pytest.raises(JobConfigError):
+        JobConfig(
+            {
+                "name": "app",
+                "exec": "true",
+                "when": {"interval": "5s", "once": "healthy"},
+            }
+        ).validate(None)
+
+
+def test_interval_too_small():
+    with pytest.raises(JobConfigError):
+        JobConfig(
+            {"name": "app", "exec": "true", "when": {"interval": "100us"}}
+        ).validate(None)
+
+
+def test_interval_defaults():
+    cfg = JobConfig(
+        {"name": "app", "exec": "true", "when": {"interval": "5s"}}
+    ).validate(None)
+    assert cfg.restart_limit == UNLIMITED  # interval jobs restart forever
+    assert cfg.exec_timeout == pytest.approx(5.0)  # timeout = interval
+
+
+def test_each_unlimited_restarts_forbidden():
+    with pytest.raises(JobConfigError):
+        JobConfig(
+            {
+                "name": "app",
+                "exec": "true",
+                "restarts": "unlimited",
+                "when": {"each": "changed", "source": "watch.backend"},
+            }
+        ).validate(None)
+
+
+@pytest.mark.parametrize(
+    "restarts,expected",
+    [("never", 0), ("unlimited", UNLIMITED), (3, 3), ("3", 3), (1.2, 1)],
+)
+def test_restarts_parsing(restarts, expected):
+    cfg = JobConfig(
+        {"name": "app", "exec": "true", "restarts": restarts}
+    ).validate(None)
+    assert cfg.restart_limit == expected
+
+
+@pytest.mark.parametrize("restarts", ["sometimes", -1, True, []])
+def test_restarts_invalid(restarts):
+    with pytest.raises(JobConfigError):
+        JobConfig(
+            {"name": "app", "exec": "true", "restarts": restarts}
+        ).validate(None)
+
+
+def test_signal_source_forces_unlimited_starts():
+    cfg = JobConfig(
+        {"name": "app", "exec": "true", "when": {"source": "SIGHUP"}}
+    ).validate(None)
+    assert cfg.when_event.code == EventCode.SIGNAL
+    assert cfg.when_starts_limit == UNLIMITED
+
+
+def test_port_requires_health():
+    with pytest.raises(JobConfigError):
+        JobConfig({"name": "app", "exec": "true", "port": 80}).validate(
+            NoopBackend()
+        )
+
+
+def test_health_requires_interval_and_ttl():
+    for health in ({"exec": "true", "ttl": 5}, {"exec": "true", "interval": 5}):
+        with pytest.raises(JobConfigError):
+            JobConfig(
+                {"name": "app", "exec": "true", "port": 80, "health": health}
+            ).validate(NoopBackend())
+
+
+def test_advertised_job_builds_service_definition():
+    cfg = JobConfig(
+        {
+            "name": "web-app",
+            "exec": "true",
+            "port": 8080,
+            "tags": ["v1"],
+            "interfaces": ["static:203.0.113.5"],
+            "health": {"exec": "true", "interval": 5, "ttl": 15},
+        }
+    ).validate(NoopBackend())
+    svc = cfg.service_definition
+    assert svc is not None
+    assert svc.registration.address == "203.0.113.5"
+    assert svc.registration.ttl == 15
+    assert svc.registration.id.startswith("web-app-")
+
+
+def test_bad_service_name_rejected():
+    with pytest.raises(JobConfigError):
+        JobConfig(
+            {
+                "name": "Bad_Name",
+                "exec": "true",
+                "port": 80,
+                "interfaces": ["static:10.0.0.1"],
+                "health": {"exec": "true", "interval": 5, "ttl": 15},
+            }
+        ).validate(NoopBackend())
+
+
+def test_unknown_keys_rejected():
+    with pytest.raises(JobConfigError):
+        JobConfig({"name": "app", "exec": "true", "bogus": 1})
+
+
+def test_name_defaults_to_exec():
+    cfg = JobConfig({"exec": "/bin/true --flag"}).validate(None)
+    assert cfg.name == "/bin/true"
+
+
+def test_stop_dependency_wiring():
+    configs = new_job_configs(
+        [
+            {"name": "main", "exec": "sleep 1"},
+            {
+                "name": "prestop",
+                "exec": "true",
+                "when": {"once": "stopping", "source": "main"},
+            },
+        ],
+        None,
+    )
+    main = next(c for c in configs if c.name == "main")
+    assert main.stopping_wait_event.code == EventCode.STOPPED
+    assert main.stopping_wait_event.source == "prestop"
+
+
+def test_initial_status_validation():
+    with pytest.raises(JobConfigError):
+        JobConfig(
+            {
+                "name": "app",
+                "exec": "true",
+                "port": 80,
+                "initial_status": "bogus",
+                "interfaces": ["static:10.0.0.1"],
+                "health": {"exec": "true", "interval": 5, "ttl": 15},
+            }
+        ).validate(NoopBackend())

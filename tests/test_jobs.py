@@ -1,0 +1,261 @@
+"""Job state-machine tests: event-sequence assertions against the real
+bus, mirroring the reference's harness (reference: jobs/jobs_test.go —
+TestJobRunSafeClose, TestJobRunStartupTimeout, restart/interval/
+stop-dependency/maintenance coverage; SURVEY.md §4)."""
+import asyncio
+
+import pytest
+
+from containerpilot_tpu.discovery import NoopBackend
+from containerpilot_tpu.events import (
+    Event,
+    EventBus,
+    EventCode,
+    GLOBAL_ENTER_MAINTENANCE,
+    GLOBAL_SHUTDOWN,
+    GLOBAL_STARTUP,
+)
+from containerpilot_tpu.jobs import Job, JobConfig, new_job_configs
+
+
+def make_job(raw, disc=None):
+    cfg = JobConfig(raw)
+    cfg.validate(disc)
+    return Job(cfg)
+
+
+async def start_jobs(bus, *jobs):
+    tasks = []
+    for job in jobs:
+        job.subscribe(bus)
+        job.register(bus)
+    for job in jobs:
+        tasks.append(job.run())
+    return tasks
+
+
+def test_job_run_safe_close(run):
+    """One-shot job: startup -> exec -> exit -> stopping/stopped."""
+
+    async def scenario():
+        bus = EventBus()
+        job = make_job({"name": "myjob", "exec": "true"})
+        tasks = await start_jobs(bus, job)
+        bus.publish(GLOBAL_STARTUP)
+        await bus.wait()
+        await asyncio.gather(*tasks)
+        return bus.debug_events(), job
+
+    ring, job = run(scenario())
+    assert ring == [
+        GLOBAL_STARTUP,
+        Event(EventCode.EXIT_SUCCESS, "myjob"),
+        Event(EventCode.STOPPING, "myjob"),
+        Event(EventCode.STOPPED, "myjob"),
+    ]
+    assert job.is_complete
+
+
+def test_job_startup_timeout(run):
+    """A when-event that never arrives: the wait-timeout quits the job
+    (reference: jobs_test.go TestJobRunStartupTimeout)."""
+
+    async def scenario():
+        bus = EventBus()
+        job = make_job(
+            {
+                "name": "myjob",
+                "exec": "true",
+                "when": {"once": "startup", "source": "never", "timeout": "100ms"},
+            }
+        )
+        tasks = await start_jobs(bus, job)
+        bus.publish(GLOBAL_STARTUP)
+        await bus.wait()
+        await asyncio.gather(*tasks)
+        return bus.debug_events()
+
+    ring = run(scenario())
+    assert ring == [
+        GLOBAL_STARTUP,
+        Event(EventCode.TIMER_EXPIRED, "myjob"),
+        Event(EventCode.STOPPING, "myjob"),
+        Event(EventCode.STOPPED, "myjob"),
+    ]
+
+
+def test_restart_budget_consumed(run):
+    """restarts: 2 -> exec runs exactly 3 times then the job halts."""
+
+    async def scenario():
+        bus = EventBus()
+        job = make_job({"name": "flaky", "exec": "false", "restarts": 2})
+        tasks = await start_jobs(bus, job)
+        bus.publish(GLOBAL_STARTUP)
+        await bus.wait()
+        await asyncio.gather(*tasks)
+        return bus.debug_events()
+
+    ring = run(scenario())
+    exits = [e for e in ring if e == Event(EventCode.EXIT_FAILED, "flaky")]
+    assert len(exits) == 3  # initial run + 2 restarts
+
+
+def test_interval_job_runs_repeatedly(run):
+    """when.interval drives periodic runs; exits don't halt it."""
+
+    async def scenario():
+        bus = EventBus()
+        job = make_job(
+            {"name": "cron", "exec": "true", "when": {"interval": "50ms"}}
+        )
+        tasks = await start_jobs(bus, job)
+        bus.publish(GLOBAL_STARTUP)
+        await asyncio.sleep(0.3)
+        bus.shutdown()
+        await bus.wait()
+        await asyncio.gather(*tasks)
+        return bus.debug_events()
+
+    ring = run(scenario())
+    runs = [e for e in ring if e == Event(EventCode.EXIT_SUCCESS, "cron")]
+    assert len(runs) >= 2
+
+
+def test_stop_dependency_handshake(run):
+    """main's cleanup waits for the pre-stop job's STOPPED before
+    publishing its own STOPPED (reference: jobs.go:295-312,388-416)."""
+
+    async def scenario():
+        bus = EventBus()
+        configs = new_job_configs(
+            [
+                {"name": "main", "exec": "sleep 10", "stopTimeout": "2s"},
+                {
+                    "name": "prestop",
+                    "exec": ["/bin/sh", "-c", "echo bye"],
+                    "when": {"once": "stopping", "source": "main"},
+                },
+            ],
+            None,
+        )
+        jobs = [Job(c) for c in configs]
+        tasks = await start_jobs(bus, *jobs)
+        bus.publish(GLOBAL_STARTUP)
+        await asyncio.sleep(0.1)
+        bus.shutdown()
+        await bus.wait()
+        await asyncio.gather(*tasks)
+        jobs[0].kill()  # reap the sleep
+        await asyncio.sleep(0.1)  # let the exec waiter task finish
+        return bus.debug_events()
+
+    ring = run(scenario(), timeout=15)
+    # main STOPPED must come after prestop STOPPED
+    idx_prestop = ring.index(Event(EventCode.STOPPED, "prestop"))
+    idx_main = ring.index(Event(EventCode.STOPPED, "main"))
+    assert idx_prestop < idx_main
+
+
+def test_health_check_drives_status_and_heartbeat(run):
+    """Heartbeat timer -> health exec -> StatusHealthy + catalog TTL."""
+
+    async def scenario():
+        disc = NoopBackend()
+        bus = EventBus()
+        job = make_job(
+            {
+                "name": "web",
+                "exec": "sleep 10",
+                "port": 8000,
+                "interfaces": ["static:10.0.0.1"],
+                "health": {"exec": "true", "interval": 1, "ttl": 5},
+            },
+            disc,
+        )
+        job.heartbeat = 0.05  # speed up the tick for the test
+        tasks = await start_jobs(bus, job)
+        bus.publish(GLOBAL_STARTUP)
+        await asyncio.sleep(0.3)
+        healthy_seen = Event(EventCode.STATUS_HEALTHY, "web") in bus.debug_events()
+        bus.shutdown()
+        await bus.wait()
+        await asyncio.gather(*tasks)
+        job.kill()
+        await asyncio.sleep(0.1)  # let the exec waiter task finish
+        return disc, healthy_seen
+
+    disc, healthy_seen = run(scenario(), timeout=15)
+    assert healthy_seen
+    assert disc.ttl_updates  # TTL refreshed at least once
+    assert disc.registered == {}  # deregistered during cleanup
+
+
+def test_maintenance_deregisters_and_mutes_checks(run):
+    async def scenario():
+        disc = NoopBackend()
+        bus = EventBus()
+        job = make_job(
+            {
+                "name": "web",
+                "exec": "sleep 10",
+                "port": 8000,
+                "interfaces": ["static:10.0.0.1"],
+                "health": {"exec": "true", "interval": 1, "ttl": 5},
+            },
+            disc,
+        )
+        job.heartbeat = 0.05
+        tasks = await start_jobs(bus, job)
+        bus.publish(GLOBAL_STARTUP)
+        await asyncio.sleep(0.15)  # get registered via a passing check
+        registered_before = dict(disc.registered)
+        bus.publish(GLOBAL_ENTER_MAINTENANCE)
+        await asyncio.sleep(0.05)
+        ttl_count = len(disc.ttl_updates)
+        await asyncio.sleep(0.15)  # heartbeats during maintenance: none
+        ttl_after = len(disc.ttl_updates)
+        status = job.get_status()
+        bus.shutdown()
+        await bus.wait()
+        await asyncio.gather(*tasks)
+        job.kill()
+        await asyncio.sleep(0.1)  # let the exec waiter task finish
+        return registered_before, ttl_count, ttl_after, status
+
+    registered_before, ttl_count, ttl_after, status = run(scenario(), timeout=15)
+    assert registered_before  # was registered before maintenance
+    assert ttl_after == ttl_count  # no TTL refresh while in maintenance
+    assert str(status) == "maintenance"
+
+
+def test_sighup_triggered_job(run):
+    """when.source: SIGHUP runs the exec on each Signal event
+    (reference: jobs.go:226-228,351-357; core/signals.go:24-27)."""
+
+    async def scenario():
+        bus = EventBus()
+        job = make_job(
+            {"name": "reloader", "exec": "true", "when": {"source": "SIGHUP"}}
+        )
+        tasks = await start_jobs(bus, job)
+        bus.publish(GLOBAL_STARTUP)
+        await asyncio.sleep(0.05)
+        bus.publish(Event(EventCode.SIGNAL, "SIGHUP"))
+        await asyncio.sleep(0.2)
+        ran_once = Event(EventCode.EXIT_SUCCESS, "reloader") in bus.debug_events()
+        bus.publish(Event(EventCode.SIGNAL, "SIGHUP"))
+        await asyncio.sleep(0.2)
+        runs = [
+            e
+            for e in bus.debug_events()
+            if e == Event(EventCode.EXIT_SUCCESS, "reloader")
+        ]
+        bus.shutdown()
+        await bus.wait()
+        await asyncio.gather(*tasks)
+        return ran_once, len(runs)
+
+    ran_once, total = run(scenario(), timeout=15)
+    assert ran_once
+    assert total >= 2
