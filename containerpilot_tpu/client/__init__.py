@@ -1,0 +1,4 @@
+"""Control-plane client SDK (reference: client/ package)."""
+from .client import ControlClient, ControlClientError
+
+__all__ = ["ControlClient", "ControlClientError"]

@@ -1,0 +1,77 @@
+"""HTTP client for the control plane's unix socket.
+
+Capability parity with the reference (reference: client/client.go):
+one verb per control endpoint, used by the CLI subcommands and usable
+as an SDK by supervised workloads (e.g. a JAX training loop POSTing
+step-rate metrics).
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+from typing import Any, Dict, Optional
+
+
+class ControlClientError(RuntimeError):
+    pass
+
+
+class _UnixHTTPConnection(http.client.HTTPConnection):
+    def __init__(self, socket_path: str, timeout: float = 10.0) -> None:
+        super().__init__("localhost", timeout=timeout)
+        self._socket_path = socket_path
+
+    def connect(self) -> None:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self.timeout)
+        sock.connect(self._socket_path)
+        self.sock = sock
+
+
+class ControlClient:
+    def __init__(self, socket_path: str, timeout: float = 10.0) -> None:
+        self.socket_path = socket_path
+        self.timeout = timeout
+
+    def _request(
+        self, method: str, path: str, body: Optional[Dict[str, Any]] = None
+    ) -> str:
+        conn = _UnixHTTPConnection(self.socket_path, self.timeout)
+        try:
+            payload = json.dumps(body) if body is not None else None
+            headers = {"Content-Type": "application/json"} if payload else {}
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read().decode("utf-8", "replace")
+            if resp.status != 200:
+                raise ControlClientError(
+                    f"{method} {path}: HTTP {resp.status}: {data.strip()}"
+                )
+            return data
+        except (OSError, http.client.HTTPException) as exc:
+            raise ControlClientError(f"{method} {path}: {exc}") from None
+        finally:
+            conn.close()
+
+    def reload(self) -> None:
+        """POST /v3/reload (reference: client.go:45-52)."""
+        self._request("POST", "/v3/reload")
+
+    def set_maintenance(self, enable: bool) -> None:
+        """POST /v3/maintenance/{enable,disable} (reference: client.go:56-68)."""
+        verb = "enable" if enable else "disable"
+        self._request("POST", f"/v3/maintenance/{verb}")
+
+    def put_env(self, env: Dict[str, str]) -> None:
+        """POST /v3/environ (reference: client.go:72-84)."""
+        self._request("POST", "/v3/environ", env)
+
+    def put_metric(self, metrics: Dict[str, Any]) -> None:
+        """POST /v3/metric (reference: client.go:88-100)."""
+        self._request("POST", "/v3/metric", metrics)
+
+    def get_ping(self) -> bool:
+        """GET /v3/ping (reference: client.go:104-115)."""
+        self._request("GET", "/v3/ping")
+        return True

@@ -61,6 +61,10 @@ class Command:
         self._proc: Optional[asyncio.subprocess.Process] = None
         self._lock = asyncio.Lock()
         self._reader_tasks: List["asyncio.Task[None]"] = []
+        # a term/kill that arrives before the spawn task has actually
+        # started the child is remembered and delivered right after
+        # spawn, so teardown can't race the (fire-and-forget) run task
+        self._pending_signal: Optional[signal.Signals] = None
 
     @classmethod
     def from_config(
@@ -117,6 +121,9 @@ class Command:
             log.debug("%s.run start", self.name)
             started = time.monotonic()
             capture = self.fields is not None
+            # drop the previous run's handle so a term/kill arriving
+            # mid-spawn queues instead of hitting the dead process
+            self._proc = None
             try:
                 self._proc = await asyncio.create_subprocess_exec(
                     self.exec,
@@ -131,6 +138,15 @@ class Command:
                 bus.publish(Event(EventCode.ERROR, str(exc)))
                 return None
             proc = self._proc
+            if self._pending_signal is not None:
+                sig, self._pending_signal = self._pending_signal, None
+                log.debug(
+                    "%s: delivering %s queued before spawn", self.name, sig.name
+                )
+                try:
+                    os.killpg(proc.pid, sig)
+                except ProcessLookupError:
+                    pass
             env_key = f"CONTAINERPILOT_{self.env_name()}_PID"
             os.environ[env_key] = str(proc.pid)
             if capture:
@@ -211,7 +227,11 @@ class Command:
     # -- signalling (whole process group) -------------------------------
 
     def _signal_group(self, sig: signal.Signals) -> None:
-        if self._proc is None or self._proc.returncode is not None:
+        if self._proc is None:
+            # spawn task created but child not started yet: queue it
+            self._pending_signal = sig
+            return
+        if self._proc.returncode is not None:
             return
         pid = self._proc.pid
         log.debug("%s: signalling group %d with %s", self.name, pid, sig.name)

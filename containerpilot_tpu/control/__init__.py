@@ -1,0 +1,5 @@
+"""Control plane: unix-socket HTTP server (reference: control/ package)."""
+from .config import ControlConfig, ControlConfigError, DEFAULT_SOCKET
+from .control import ControlServer
+
+__all__ = ["ControlConfig", "ControlConfigError", "ControlServer", "DEFAULT_SOCKET"]

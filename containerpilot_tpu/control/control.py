@@ -1,0 +1,176 @@
+"""The control plane: HTTP over a unix domain socket.
+
+Capability parity with the reference (reference: control/control.go,
+control/endpoints.go). Endpoints (all under /v3):
+
+- ``POST /v3/environ``              set env vars for future execs/reloads
+- ``POST /v3/reload``               set reload flag + shut down generation
+- ``POST /v3/metric``               publish {METRIC, "name|value"} events
+- ``POST /v3/maintenance/enable``   publish GlobalEnterMaintenance
+- ``POST /v3/maintenance/disable``  publish GlobalExitMaintenance
+- ``GET  /v3/ping``                 liveness of the socket
+
+Binding retries while a prior generation's socket file lingers
+(reference: control/control.go:125-140). A Prometheus counter tracks
+request statuses (reference: control/control.go:27-33).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+from typing import Optional
+
+from ..events import (
+    Event,
+    EventBus,
+    EventCode,
+    GLOBAL_ENTER_MAINTENANCE,
+    GLOBAL_EXIT_MAINTENANCE,
+)
+from ..utils.http import HTTPServer, Request, Response
+from .config import ControlConfig
+
+log = logging.getLogger("containerpilot.control")
+
+BIND_RETRIES = 10
+BIND_RETRY_DELAY = 1.0  # reference: control/control.go:130-137
+
+try:
+    from prometheus_client import Counter, REGISTRY
+
+    def _make_counter() -> Optional["Counter"]:
+        try:
+            return Counter(
+                "containerpilot_control_http_requests",
+                "Control-plane HTTP requests by status and path",
+                ["status", "path"],
+            )
+        except ValueError:
+            return REGISTRY._names_to_collectors.get(  # noqa: SLF001
+                "containerpilot_control_http_requests"
+            )
+
+    _REQUEST_COUNTER = _make_counter()
+except Exception:  # pragma: no cover
+    _REQUEST_COUNTER = None
+
+
+class ControlServer:
+    """One generation's control server (reference: control/control.go:36-93)."""
+
+    def __init__(self, cfg: ControlConfig) -> None:
+        self.cfg = cfg
+        self.bus: Optional[EventBus] = None
+        self._server = HTTPServer()
+        self._server.route("GET", "/v3/ping", self._ping)
+        self._server.route("POST", "/v3/environ", self._put_environ)
+        self._server.route("POST", "/v3/reload", self._post_reload)
+        self._server.route("POST", "/v3/metric", self._post_metric)
+        self._server.route(
+            "POST", "/v3/maintenance/enable", self._post_maintenance_enable
+        )
+        self._server.route(
+            "POST", "/v3/maintenance/disable", self._post_maintenance_disable
+        )
+
+    # -- lifecycle ------------------------------------------------------
+
+    async def run(self, bus: EventBus) -> None:
+        self.bus = bus
+        await self._listen_with_retry()
+
+    async def _listen_with_retry(self) -> None:
+        for attempt in range(BIND_RETRIES):
+            try:
+                self._try_unlink_stale_socket()
+                await self._server.start_unix(self.cfg.socket)
+                os.chmod(self.cfg.socket, 0o660)
+                log.debug("control: serving at %s", self.cfg.socket)
+                return
+            except OSError as exc:
+                if attempt == BIND_RETRIES - 1:
+                    raise
+                log.warning(
+                    "control: error listening to socket at %s: %s",
+                    self.cfg.socket,
+                    exc,
+                )
+                await asyncio.sleep(BIND_RETRY_DELAY)
+
+    def _try_unlink_stale_socket(self) -> None:
+        """A previous generation (or crashed supervisor) may have left
+        the socket file behind; a fresh bind needs it gone
+        (reference: control/control.go:125-140)."""
+        if os.path.exists(self.cfg.socket):
+            try:
+                os.unlink(self.cfg.socket)
+            except OSError:
+                pass
+
+    async def stop(self) -> None:
+        await self._server.stop()
+        self._try_unlink_stale_socket()
+
+    # -- endpoint helpers -----------------------------------------------
+
+    def _count(self, status: int, path: str) -> None:
+        if _REQUEST_COUNTER is not None:
+            try:
+                _REQUEST_COUNTER.labels(status=str(status), path=path).inc()
+            except Exception:  # pragma: no cover
+                pass
+
+    def _respond(self, status: int, path: str, body: bytes = b"\n") -> Response:
+        self._count(status, path)
+        return Response(status, body)
+
+    # -- endpoints ------------------------------------------------------
+
+    async def _ping(self, req: Request) -> Response:
+        return self._respond(200, req.path)
+
+    async def _put_environ(self, req: Request) -> Response:
+        """Set env vars in the supervisor process so reloads and future
+        execs observe them (reference: endpoints.go:57-72); '-putenv'
+        persistence across reloads comes from this process surviving
+        generations."""
+        try:
+            env = json.loads(req.body.decode() or "null")
+            if not isinstance(env, dict):
+                raise ValueError("not an object")
+            for key, value in env.items():
+                os.environ[str(key)] = str(value)
+        except (ValueError, UnicodeDecodeError):
+            return self._respond(422, req.path)
+        return self._respond(200, req.path)
+
+    async def _post_reload(self, req: Request) -> Response:
+        log.debug("control: reloading app via control plane")
+        assert self.bus is not None
+        self.bus.set_reload_flag()
+        self.bus.shutdown()
+        return self._respond(200, req.path)
+
+    async def _post_metric(self, req: Request) -> Response:
+        assert self.bus is not None
+        try:
+            metrics = json.loads(req.body.decode() or "null")
+            if not isinstance(metrics, dict):
+                raise ValueError("not an object")
+        except (ValueError, UnicodeDecodeError):
+            return self._respond(422, req.path)
+        for key, value in metrics.items():
+            self.bus.publish(Event(EventCode.METRIC, f"{key}|{value}"))
+        return self._respond(200, req.path)
+
+    async def _post_maintenance_enable(self, req: Request) -> Response:
+        assert self.bus is not None
+        self.bus.publish(GLOBAL_ENTER_MAINTENANCE)
+        return self._respond(200, req.path)
+
+    async def _post_maintenance_disable(self, req: Request) -> Response:
+        assert self.bus is not None
+        self.bus.publish(GLOBAL_EXIT_MAINTENANCE)
+        return self._respond(200, req.path)

@@ -1,0 +1,6 @@
+"""Core runtime: the App generation loop, signals, CLI flags
+(reference: core/ package)."""
+from .app import App
+from .flags import get_args
+
+__all__ = ["App", "get_args"]

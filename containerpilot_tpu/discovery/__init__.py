@@ -6,14 +6,21 @@ from .backend import (
     ServiceInstance,
     ServiceRegistration,
 )
+from .consul import ConsulBackend
+from .factory import DiscoveryConfigError, new_backend
+from .filecatalog import FileCatalogBackend
 from .noop import NoopBackend
 from .service import ServiceDefinition
 
 __all__ = [
     "Backend",
+    "ConsulBackend",
+    "DiscoveryConfigError",
     "DiscoveryError",
+    "FileCatalogBackend",
+    "NoopBackend",
+    "ServiceDefinition",
     "ServiceInstance",
     "ServiceRegistration",
-    "ServiceDefinition",
-    "NoopBackend",
+    "new_backend",
 ]

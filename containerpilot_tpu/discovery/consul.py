@@ -1,0 +1,206 @@
+"""Consul discovery backend over the raw HTTP API.
+
+Capability parity with the reference's Consul backend
+(reference: discovery/consul.go, discovery/config.go) without the
+vendored client library: the four agent/health endpoints the supervisor
+needs, URI/map config with ``CONSUL_HTTP_ADDR`` / ``CONSUL_HTTP_SSL`` /
+``CONSUL_HTTP_TOKEN`` environment overrides
+(reference: discovery/config.go:29-61), per-watch caching of the
+last-seen instance list with compare-for-change
+(reference: discovery/consul.go:102-125), and a Prometheus gauge of
+watched instance counts (reference: discovery/consul.go:16-22).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+from .backend import (
+    Backend,
+    DiscoveryError,
+    ServiceInstance,
+    ServiceRegistration,
+)
+
+log = logging.getLogger("containerpilot.discovery")
+
+try:
+    from prometheus_client import Gauge, REGISTRY
+
+    def _make_gauge() -> Optional["Gauge"]:
+        try:
+            return Gauge(
+                "containerpilot_watch_instances",
+                "Count of instances seen for each watched service",
+                ["service"],
+            )
+        except ValueError:
+            return REGISTRY._names_to_collectors.get(  # noqa: SLF001
+                "containerpilot_watch_instances"
+            )
+
+    _INSTANCE_GAUGE = _make_gauge()
+except Exception:  # pragma: no cover
+    _INSTANCE_GAUGE = None
+
+
+class ConsulBackend(Backend):
+    def __init__(
+        self,
+        address: str = "localhost:8500",
+        scheme: str = "http",
+        token: str = "",
+        timeout: float = 10.0,
+    ) -> None:
+        self.address = address
+        self.scheme = scheme
+        self.token = token
+        self.timeout = timeout
+        self._last_seen: Dict[str, List[ServiceInstance]] = {}
+
+    # -- construction ---------------------------------------------------
+
+    @classmethod
+    def from_uri(cls, uri: str) -> "ConsulBackend":
+        scheme = "http"
+        address = uri
+        if "://" in uri:
+            scheme, address = uri.split("://", 1)
+        return cls._with_env_overrides(address=address, scheme=scheme)
+
+    @classmethod
+    def from_map(cls, raw: Dict[str, Any]) -> "ConsulBackend":
+        return cls._with_env_overrides(
+            address=str(raw.get("address", "localhost:8500")),
+            scheme=str(raw.get("scheme", "http")),
+            token=str(raw.get("token", "")),
+        )
+
+    @classmethod
+    def _with_env_overrides(
+        cls, address: str, scheme: str, token: str = ""
+    ) -> "ConsulBackend":
+        address = os.environ.get("CONSUL_HTTP_ADDR", address)
+        if os.environ.get("CONSUL_HTTP_SSL", "").lower() in ("1", "true"):
+            scheme = "https"
+        token = os.environ.get("CONSUL_HTTP_TOKEN", token)
+        if "://" in address:
+            scheme, address = address.split("://", 1)
+        return cls(address=address, scheme=scheme, token=token)
+
+    # -- HTTP plumbing --------------------------------------------------
+
+    def _request(
+        self, method: str, path: str, body: Optional[Dict[str, Any]] = None
+    ) -> Any:
+        url = f"{self.scheme}://{self.address}{path}"
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("X-Consul-Token", self.token)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as exc:
+            raise DiscoveryError(
+                f"consul {method} {path}: {exc.code} {exc.read()[:200]!r}"
+            ) from None
+        except (urllib.error.URLError, OSError) as exc:
+            raise DiscoveryError(f"consul {method} {path}: {exc}") from None
+        if not payload:
+            return None
+        try:
+            return json.loads(payload)
+        except ValueError:
+            return None
+
+    # -- Backend interface ----------------------------------------------
+
+    def service_register(
+        self, registration: ServiceRegistration, status: str = ""
+    ) -> None:
+        body: Dict[str, Any] = {
+            "ID": registration.id,
+            "Name": registration.name,
+            "Tags": registration.tags,
+            "Port": registration.port,
+            "Address": registration.address,
+            "EnableTagOverride": registration.enable_tag_override,
+            "Check": {
+                "TTL": f"{registration.ttl}s",
+                "Notes": f"TTL for {registration.name} set by containerpilot",
+            },
+        }
+        if status:
+            body["Check"]["Status"] = status
+        if registration.deregister_critical_service_after:
+            body["Check"]["DeregisterCriticalServiceAfter"] = (
+                registration.deregister_critical_service_after
+            )
+        self._request("PUT", "/v1/agent/service/register", body)
+
+    def service_deregister(self, service_id: str) -> None:
+        self._request("PUT", f"/v1/agent/service/deregister/{service_id}")
+
+    def update_ttl(self, check_id: str, output: str, status: str) -> None:
+        self._request(
+            "PUT",
+            f"/v1/agent/check/update/{check_id}",
+            {"Output": output, "Status": "passing" if status == "pass" else status},
+        )
+
+    def _health_service(
+        self, service_name: str, tag: str, dc: str
+    ) -> List[ServiceInstance]:
+        path = f"/v1/health/service/{service_name}?passing=1"
+        if tag:
+            path += f"&tag={tag}"
+        if dc:
+            path += f"&dc={dc}"
+        entries = self._request("GET", path) or []
+        out: List[ServiceInstance] = []
+        for entry in entries:
+            svc = entry.get("Service", {})
+            node = entry.get("Node", {})
+            out.append(
+                ServiceInstance(
+                    id=svc.get("ID", ""),
+                    name=svc.get("Service", service_name),
+                    address=svc.get("Address") or node.get("Address", ""),
+                    port=int(svc.get("Port") or 0),
+                )
+            )
+        out.sort(key=lambda i: (i.id, i.address, i.port))
+        return out
+
+    def check_for_upstream_changes(
+        self, service_name: str, tag: str = "", dc: str = ""
+    ) -> Tuple[bool, bool]:
+        """Poll + compare-for-change (reference: discovery/consul.go:87-125)."""
+        try:
+            instances = self._health_service(service_name, tag, dc)
+        except DiscoveryError as exc:
+            log.warning("failed to query %s: %s", service_name, exc)
+            return False, False
+        if _INSTANCE_GAUGE is not None:
+            try:
+                _INSTANCE_GAUGE.labels(service=service_name).set(len(instances))
+            except Exception:  # pragma: no cover
+                pass
+        last = self._last_seen.get(service_name)
+        did_change = (last is not None and last != instances) or (
+            last is None and bool(instances)
+        )
+        self._last_seen[service_name] = instances
+        return did_change, bool(instances)
+
+    def instances(self, service_name: str, tag: str = "") -> List[ServiceInstance]:
+        try:
+            return self._health_service(service_name, tag, "")
+        except DiscoveryError:
+            return []

@@ -75,12 +75,21 @@ class ServiceDefinition:
         self.was_registered = True
 
     def deregister(self) -> None:
-        """Remove from the catalog (reference: discovery/service.go:28-33)."""
+        """Remove from the catalog (reference: discovery/service.go:28-33).
+
+        Deviation from the reference: ``was_registered`` resets here so
+        the next heartbeat lazily re-registers. The reference leaves the
+        flag set, so a service that exits maintenance mode keeps writing
+        TTL updates against a check it deleted — it never reappears in
+        the catalog until a config reload.
+        """
         log.debug("deregistering: %s", self.id)
         try:
             self.backend.service_deregister(self.id)
         except DiscoveryError as exc:
             log.info("deregistering failed: %s", exc)
+        finally:
+            self.was_registered = False
 
     def mark_for_maintenance(self) -> None:
         """Maintenance mode = drop out of the catalog

@@ -319,6 +319,14 @@ class Job(EventHandler):
         for timer in self._timers:
             cancel_timer(timer)
         self._timers = []
+        # the reference cancels the job-scoped context here, which
+        # SIGTERMs any still-running exec/health-check process groups
+        # (reference: jobs/jobs.go:408 + commands/commands.go:114-121);
+        # the app's stopTimeout then bounds stragglers with SIGKILL
+        if self.exec is not None:
+            self.exec.term()
+        if self.health_check_exec is not None:
+            self.health_check_exec.term()
         if self.service is not None:
             self.service.deregister()
         self.unsubscribe()

@@ -1,0 +1,5 @@
+"""PID-1 supervisor: fork the worker, pass signals, reap zombies
+(reference: sup/ package)."""
+from .sup import run as run_sup
+
+__all__ = ["run_sup"]

@@ -1,0 +1,13 @@
+"""Telemetry: Prometheus metrics + status server
+(reference: telemetry/ package)."""
+from .config import MetricConfig, TelemetryConfig, TelemetryConfigError
+from .metrics import Metric
+from .telemetry import Telemetry
+
+__all__ = [
+    "Metric",
+    "MetricConfig",
+    "Telemetry",
+    "TelemetryConfig",
+    "TelemetryConfigError",
+]

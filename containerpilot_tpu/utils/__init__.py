@@ -1,0 +1,1 @@
+"""Shared utilities (asyncio HTTP plumbing, helpers)."""

@@ -1,0 +1,146 @@
+"""Minimal asyncio HTTP/1.1 server.
+
+Shared plumbing for the two in-process servers the supervisor runs —
+the telemetry endpoint on TCP (reference: telemetry/telemetry.go) and
+the control plane on a unix domain socket (reference: control/control.go).
+Requests are tiny and local, so this deliberately supports only what
+those servers need: one request per connection, optional content-length
+bodies, no keep-alive, no chunked encoding.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+log = logging.getLogger("containerpilot.http")
+
+MAX_BODY = 4 * 1024 * 1024
+
+
+class Request:
+    def __init__(
+        self,
+        method: str,
+        path: str,
+        query: Dict[str, list],
+        headers: Dict[str, str],
+        body: bytes,
+    ) -> None:
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self.body = body
+
+
+class Response:
+    def __init__(
+        self,
+        status: int = 200,
+        body: bytes = b"",
+        content_type: str = "text/plain; charset=utf-8",
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.status = status
+        self.body = body
+        self.content_type = content_type
+        self.headers = headers or {}
+
+
+_REASONS = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    422: "Unprocessable Entity",
+    500: "Internal Server Error",
+}
+
+Handler = Callable[[Request], Awaitable[Response]]
+
+
+class HTTPServer:
+    """Route-table HTTP server over asyncio streams; bind via
+    ``start_tcp`` or ``start_unix``."""
+
+    def __init__(self) -> None:
+        self.routes: Dict[Tuple[str, str], Handler] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    def route(self, method: str, path: str, handler: Handler) -> None:
+        self.routes[(method.upper(), path)] = handler
+
+    async def start_tcp(self, host: str, port: int) -> None:
+        self._server = await asyncio.start_server(self._handle, host, port)
+
+    async def start_unix(self, path: str) -> None:
+        self._server = await asyncio.start_unix_server(self._handle, path)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            response = await self._process(reader)
+        except Exception:
+            log.exception("request handling failed")
+            response = Response(500, b"internal server error\n")
+        try:
+            reason = _REASONS.get(response.status, "Unknown")
+            headers = {
+                "Content-Type": response.content_type,
+                "Content-Length": str(len(response.body)),
+                "Connection": "close",
+                **response.headers,
+            }
+            head = f"HTTP/1.1 {response.status} {reason}\r\n" + "".join(
+                f"{k}: {v}\r\n" for k, v in headers.items()
+            )
+            writer.write(head.encode() + b"\r\n" + response.body)
+            await writer.drain()
+        except (ConnectionError, BrokenPipeError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _process(self, reader: asyncio.StreamReader) -> Response:
+        request_line = await reader.readline()
+        if not request_line:
+            return Response(400, b"empty request\n")
+        try:
+            method, target, _version = request_line.decode().split(None, 2)
+        except ValueError:
+            return Response(400, b"malformed request line\n")
+        headers: Dict[str, str] = {}
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            if b":" in line:
+                key, _, value = line.decode().partition(":")
+                headers[key.strip().lower()] = value.strip()
+        length = int(headers.get("content-length", "0") or "0")
+        if length > MAX_BODY:
+            return Response(400, b"body too large\n")
+        body = await reader.readexactly(length) if length else b""
+        parts = urlsplit(target)
+        request = Request(
+            method.upper(), parts.path, parse_qs(parts.query), headers, body
+        )
+        handler = self.routes.get((request.method, request.path))
+        if handler is None:
+            if any(p == request.path for (_m, p) in self.routes):
+                return Response(405, b"method not allowed\n")
+            return Response(404, b"not found\n")
+        return await handler(request)

@@ -1,0 +1,10 @@
+"""Watches: upstream-change pollers (reference: watches/ package)."""
+from .watches import Watch, WatchConfig, WatchConfigError, from_configs, new_watch_configs
+
+__all__ = [
+    "Watch",
+    "WatchConfig",
+    "WatchConfigError",
+    "from_configs",
+    "new_watch_configs",
+]

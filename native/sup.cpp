@@ -1,0 +1,105 @@
+// cpsup — a minimal container init for the TPU supervisor.
+//
+// Native-equivalent of the reference's PID-1 layer (reference:
+// sup/sup.go): fork the worker command, forward
+// SIGINT/SIGTERM/SIGHUP/SIGUSR1/SIGUSR2 to it, and reap every orphan
+// that gets reparented onto PID 1 via a waitpid(-1) loop on SIGCHLD —
+// without stealing the worker's own child waits (the worker runs in its
+// own process; we only ever wait in *this* process, so its internal
+// waits are unaffected).
+//
+// Usage:  cpsup <worker-command> [args...]
+// Typical container entrypoint:
+//   ENTRYPOINT ["cpsup", "python", "-m", "containerpilot_tpu",
+//               "-config", "/etc/containerpilot.json5"]
+//
+// Exit code: the worker's exit code, or 128+signal if it was killed.
+
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+namespace {
+
+volatile sig_atomic_t g_worker_pid = 0;
+volatile sig_atomic_t g_pending_signal = 0;
+
+void forward_handler(int signum) {
+  // async-signal-safe: just record; the main loop forwards
+  g_pending_signal = signum;
+  if (g_worker_pid > 0) {
+    kill(g_worker_pid, signum);
+  }
+}
+
+void install_forwarding() {
+  const int signals[] = {SIGINT, SIGTERM, SIGHUP, SIGUSR1, SIGUSR2};
+  struct sigaction sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_handler = forward_handler;
+  sigemptyset(&sa.sa_mask);
+  sa.sa_flags = SA_RESTART;
+  for (int sig : signals) {
+    sigaction(sig, &sa, nullptr);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <command> [args...]\n", argv[0]);
+    return 2;
+  }
+
+  pid_t worker = fork();
+  if (worker < 0) {
+    perror("cpsup: fork");
+    return 1;
+  }
+  if (worker == 0) {
+    // child: become the worker
+    execvp(argv[1], &argv[1]);
+    fprintf(stderr, "cpsup: exec %s: %s\n", argv[1], strerror(errno));
+    _exit(127);
+  }
+
+  g_worker_pid = worker;
+  install_forwarding();
+
+  // reap loop (reference: sup/sup.go:61-92): a blocking wait on -1
+  // collects both our worker and any orphans reparented to us as init.
+  int exit_code = 0;
+  for (;;) {
+    int status = 0;
+    pid_t pid = waitpid(-1, &status, 0);
+    if (pid < 0) {
+      if (errno == EINTR) continue;
+      if (errno == ECHILD) break;  // no children left at all
+      perror("cpsup: waitpid");
+      break;
+    }
+    if (pid == worker) {
+      if (WIFEXITED(status)) {
+        exit_code = WEXITSTATUS(status);
+      } else if (WIFSIGNALED(status)) {
+        exit_code = 128 + WTERMSIG(status);
+      }
+      break;
+    }
+    // else: an orphan zombie — reaped, nothing more to do
+  }
+
+  // final sweep: reap whatever is left without blocking forever
+  for (;;) {
+    int status = 0;
+    pid_t pid = waitpid(-1, &status, WNOHANG);
+    if (pid <= 0) break;
+  }
+  return exit_code;
+}

@@ -17,7 +17,24 @@ if "--xla_force_host_platform_device_count" not in _flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import logging
+
 import pytest
+
+
+@pytest.fixture(autouse=True)
+def restore_containerpilot_logger():
+    """LogConfig.init() mutates the shared 'containerpilot' logger
+    (handlers, level, propagate); snapshot/restore per test so App
+    tests can't break caplog-based tests elsewhere."""
+    logger = logging.getLogger("containerpilot")
+    saved = (list(logger.handlers), logger.level, logger.propagate)
+    yield
+    logger.handlers, logger.level, logger.propagate = (
+        saved[0],
+        saved[1],
+        saved[2],
+    )
 
 
 @pytest.fixture

@@ -1,0 +1,128 @@
+"""End-to-end App tests: real config files, real processes, the whole
+generation loop (reference: core/app_test.go smoke tests plus the
+integration-test scenarios' key assertions, SURVEY.md §4)."""
+import asyncio
+import os
+
+import pytest
+
+from containerpilot_tpu.client import ControlClient
+from containerpilot_tpu.core import App
+from containerpilot_tpu.core.flags import get_args
+
+
+def write_config(tmp_path, text):
+    path = tmp_path / "containerpilot.json5"
+    path.write_text(text)
+    return str(path)
+
+
+def test_app_from_bad_config_raises(tmp_path):
+    path = write_config(tmp_path, "{ bogus: true }")
+    with pytest.raises(Exception):
+        App.from_config_path(path)
+
+
+def test_app_runs_jobs_to_completion(run, tmp_path):
+    """All jobs complete -> the supervisor exits on its own
+    (reference: core/app.go:110-140 escape hatch; the supervisor is
+    not a server)."""
+    marker = tmp_path / "ran.txt"
+    path = write_config(
+        tmp_path,
+        """
+        {
+          stopTimeout: "1ms",
+          jobs: [
+            { name: "preStart", exec: "touch %s" },
+            {
+              name: "main",
+              exec: ["/bin/sh", "-c", "exit 0"],
+              when: { once: "exitSuccess", source: "preStart" },
+            },
+          ],
+        }
+        """
+        % marker,
+    )
+    app = App.from_config_path(path)
+    run(app.run(), timeout=20)
+    assert marker.exists()
+    assert all(j.is_complete for j in app.jobs)
+
+
+def test_app_reload_via_control_socket(run, tmp_path):
+    """-reload across the control socket swaps in a new generation with
+    a fresh restart budget (reference: §3.5; integration
+    test_config_reload / test_coprocess restart-budget reset)."""
+    socket_path = str(tmp_path / "cp.socket")
+    config = """
+    {
+      stopTimeout: "1ms",
+      control: { socket: "%s" },
+      jobs: [
+        { name: "app", exec: "sleep 60" },
+      ],
+    }
+    """ % socket_path
+    path = write_config(tmp_path, config)
+
+    async def scenario():
+        app = App.from_config_path(path)
+        run_task = asyncio.get_event_loop().create_task(app.run())
+        await asyncio.sleep(0.3)
+        gen1_bus = app.bus
+        client = ControlClient(socket_path)
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(None, client.reload)
+        await asyncio.sleep(0.5)
+        gen2_bus = app.bus
+        assert gen2_bus is not gen1_bus, "reload must build a fresh bus"
+        app.terminate()  # now the SIGTERM path ends generation 2
+        await asyncio.wait_for(run_task, timeout=20)
+        return True
+
+    assert run(scenario(), timeout=30)
+
+
+def test_app_terminate_runs_prestop_first(run, tmp_path):
+    """SIGTERM: preStop runs during shutdown, before main's stopped
+    (integration test_sigterm assertions)."""
+    log_file = tmp_path / "order.log"
+    path = write_config(
+        tmp_path,
+        """
+        {
+          stopTimeout: "1ms",
+          jobs: [
+            { name: "main", exec: "sleep 60", stopTimeout: "3s" },
+            {
+              name: "preStop",
+              exec: ["/bin/sh", "-c", "echo prestop >> %s"],
+              when: { once: "stopping", source: "main" },
+            },
+          ],
+        }
+        """
+        % log_file,
+    )
+
+    async def scenario():
+        app = App.from_config_path(path)
+        run_task = asyncio.get_event_loop().create_task(app.run())
+        await asyncio.sleep(0.3)
+        app.terminate()
+        await asyncio.wait_for(run_task, timeout=20)
+        return log_file.read_text()
+
+    assert "prestop" in run(scenario(), timeout=30)
+
+
+def test_flags_dispatch():
+    handler, params = get_args(["-version"])
+    assert handler is not None
+    handler2, params2 = get_args(["-config", "/tmp/x.json5"])
+    assert handler2 is None
+    assert params2["config_path"] == "/tmp/x.json5"
+    handler3, _p = get_args(["-ping", "-config", "/tmp/x.json5"])
+    assert handler3 is not None

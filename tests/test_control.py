@@ -1,0 +1,101 @@
+"""Control-plane tests: unix-socket HTTP server + client SDK
+(reference: control/control_test.go, client/client_test.go)."""
+import asyncio
+import os
+
+import pytest
+
+from containerpilot_tpu.client import ControlClient, ControlClientError
+from containerpilot_tpu.control import ControlConfig, ControlServer
+from containerpilot_tpu.events import (
+    Event,
+    EventBus,
+    EventCode,
+    GLOBAL_ENTER_MAINTENANCE,
+    GLOBAL_EXIT_MAINTENANCE,
+)
+
+
+@pytest.fixture
+def socket_path(tmp_path):
+    return str(tmp_path / "cp.socket")
+
+
+def drive(run, socket_path, fn):
+    """Start a control server, run fn(client) in a thread, return the
+    bus ring + fn result."""
+
+    async def scenario():
+        bus = EventBus()
+        server = ControlServer(ControlConfig({"socket": socket_path}))
+        await server.run(bus)
+        client = ControlClient(socket_path)
+        result = await asyncio.get_event_loop().run_in_executor(
+            None, fn, client
+        )
+        await server.stop()
+        return bus, result
+
+    return run(scenario())
+
+
+def test_ping(run, socket_path):
+    bus, result = drive(run, socket_path, lambda c: c.get_ping())
+    assert result is True
+
+
+def test_putenv_sets_supervisor_environ(run, socket_path):
+    drive(run, socket_path, lambda c: c.put_env({"CP_TEST_ENVVAR": "42"}))
+    assert os.environ.pop("CP_TEST_ENVVAR") == "42"
+
+
+def test_putmetric_publishes_metric_events(run, socket_path):
+    bus, _ = drive(
+        run, socket_path, lambda c: c.put_metric({"zz_sensor": 1.5})
+    )
+    assert Event(EventCode.METRIC, "zz_sensor|1.5") in bus.debug_events()
+
+
+def test_maintenance_events(run, socket_path):
+    def toggle(c):
+        c.set_maintenance(True)
+        c.set_maintenance(False)
+
+    bus, _ = drive(run, socket_path, toggle)
+    ring = bus.debug_events()
+    assert GLOBAL_ENTER_MAINTENANCE in ring
+    assert GLOBAL_EXIT_MAINTENANCE in ring
+
+
+def test_reload_sets_flag_and_shuts_down(run, socket_path):
+    bus, _ = drive(run, socket_path, lambda c: c.reload())
+    assert bus.get_reload_flag() is True
+    assert Event(EventCode.SHUTDOWN, "global") in bus.debug_events()
+
+
+def test_stale_socket_rebind(run, socket_path):
+    """A lingering socket file from a dead generation must not block a
+    new bind (reference: control/control.go:125-140)."""
+    with open(socket_path, "w") as f:
+        f.write("")  # stale plain file at the socket path
+
+    bus, result = drive(run, socket_path, lambda c: c.get_ping())
+    assert result is True
+
+
+def test_client_error_when_no_server(socket_path):
+    client = ControlClient(socket_path, timeout=0.5)
+    with pytest.raises(ControlClientError):
+        client.get_ping()
+
+
+def test_bad_body_is_422(run, socket_path):
+    def post_bad(c):
+        try:
+            c.put_env(["not", "a", "dict"])  # type: ignore[arg-type]
+        except ControlClientError as exc:
+            return str(exc)
+        return None
+
+    _bus, err = drive(run, socket_path, post_bad)
+    assert err is not None and "422" in err

@@ -1,0 +1,114 @@
+"""Config loader tests (reference: config/config_test.go conventions)."""
+import pytest
+
+from containerpilot_tpu.config.loader import (
+    ConfigError,
+    load_config,
+    new_config,
+    parse_config,
+)
+from containerpilot_tpu.discovery import FileCatalogBackend, NoopBackend
+
+
+GOOD_CONFIG = """
+{
+  // JSON5: comments and trailing commas are fine
+  consul: "none",
+  logging: { level: "DEBUG", format: "default", output: "stdout" },
+  stopTimeout: "2s",
+  jobs: [
+    {
+      name: "app",
+      exec: "sleep 1",
+      restarts: 1,
+    },
+    {
+      name: "web-svc",
+      exec: "sleep 1",
+      port: 8080,
+      interfaces: ["static:203.0.113.9"],
+      health: { exec: "true", interval: 5, ttl: 15 },
+    },
+  ],
+  watches: [
+    { name: "upstream", interval: 5 },
+  ],
+  telemetry: {
+    port: 9099,
+    interfaces: ["static:127.0.0.1"],
+    metrics: [
+      { name: "zz_loader_sensor", help: "a sensor", type: "gauge" },
+    ],
+  },
+}
+"""
+
+
+def test_full_config_parses_and_validates():
+    cfg = new_config(parse_config(GOOD_CONFIG))
+    assert isinstance(cfg.discovery, NoopBackend)
+    assert cfg.stop_timeout == pytest.approx(2.0)
+    names = [j.name for j in cfg.jobs]
+    # telemetry synthesizes its self-advertising job
+    assert names == ["app", "web-svc", "containerpilot"]
+    assert cfg.watches[0].name == "watch.upstream"
+    assert cfg.telemetry.port == 9099
+    tele_job = cfg.jobs[-1]
+    assert tele_job.port == 9099
+    assert tele_job.heartbeat_interval == 5
+    assert tele_job.ttl == 15
+
+
+def test_unknown_top_level_key_rejected():
+    with pytest.raises(ConfigError, match="unknown configuration keys"):
+        parse_config('{ bogus: 1, jobs: [] }')
+
+
+def test_stop_timeout_default():
+    cfg = new_config(parse_config('{ jobs: [{name: "a", exec: "true"}] }'))
+    assert cfg.stop_timeout == pytest.approx(5.0)
+
+
+def test_parse_error_has_line_context():
+    bad = '{\n  jobs: [\n    { name: }\n  ]\n}'
+    with pytest.raises(ConfigError, match="parse error"):
+        parse_config(bad)
+
+
+def test_template_renders_before_parse(monkeypatch):
+    monkeypatch.setenv("APP_EXEC", "sleep 9")
+    cfg = new_config(
+        parse_config('{ jobs: [{ name: "app", exec: "{{ .APP_EXEC }}" }] }')
+    )
+    assert cfg.jobs[0].exec.exec == "sleep"
+    assert cfg.jobs[0].exec.args == ["9"]
+
+
+def test_file_catalog_backend_from_uri(tmp_path):
+    cfg = new_config(
+        parse_config(
+            '{ consul: "file:%s", jobs: [{name: "a", exec: "true"}] }'
+            % tmp_path
+        )
+    )
+    assert isinstance(cfg.discovery, FileCatalogBackend)
+
+
+def test_load_config_from_file(tmp_path):
+    path = tmp_path / "containerpilot.json5"
+    path.write_text(GOOD_CONFIG)
+    cfg = load_config(str(path))
+    assert cfg.config_path == str(path)
+
+
+def test_load_config_missing_path():
+    with pytest.raises(ConfigError, match="-config flag is required"):
+        load_config("")
+
+
+def test_load_config_env_fallback(tmp_path, monkeypatch):
+    path = tmp_path / "cp.json5"
+    path.write_text('{ jobs: [{name: "a", exec: "true"}] }')
+    monkeypatch.setenv("CONTAINERPILOT", str(path))
+    cfg = load_config(None)
+    assert cfg.jobs[0].name == "a"

@@ -1,0 +1,109 @@
+"""Telemetry tests: metric actors + /metrics + /status server
+(reference: telemetry/*_test.go)."""
+import asyncio
+import json
+import urllib.request
+
+import pytest
+
+from containerpilot_tpu.events import Event, EventBus, EventCode
+from containerpilot_tpu.jobs import Job, JobConfig
+from containerpilot_tpu.telemetry import Metric, Telemetry, TelemetryConfig
+from containerpilot_tpu.telemetry.config import TelemetryConfigError
+
+
+def test_telemetry_config_defaults():
+    cfg = TelemetryConfig({"interfaces": ["static:127.0.0.1"]})
+    assert cfg.port == 9090
+    assert cfg.address == "127.0.0.1"
+    raw = cfg.to_job_config_raw()
+    assert raw["name"] == "containerpilot"
+    assert raw["health"] == {"interval": 5, "ttl": 15}
+
+
+def test_metric_config_validation():
+    with pytest.raises(TelemetryConfigError):
+        TelemetryConfig(
+            {
+                "interfaces": ["static:127.0.0.1"],
+                "metrics": [{"name": "x", "type": "bogus"}],
+            }
+        )
+
+
+def test_metric_actor_records(run):
+    async def scenario():
+        cfg = TelemetryConfig(
+            {
+                "interfaces": ["static:127.0.0.1"],
+                "metrics": [
+                    {
+                        "namespace": "zz",
+                        "subsystem": "app",
+                        "name": "connections",
+                        "type": "gauge",
+                        "help": "connection count",
+                    }
+                ],
+            }
+        )
+        bus = EventBus()
+        metric = Metric(cfg.metrics[0])
+        metric.run(bus)
+        bus.publish(Event(EventCode.METRIC, "zz_app_connections|42"))
+        bus.publish(Event(EventCode.METRIC, "other_metric|1"))  # ignored
+        bus.publish(Event(EventCode.METRIC, "garbage-no-pipe"))  # ignored
+        await asyncio.sleep(0.05)
+        metric.stop()
+        await bus.wait()
+        return cfg.metrics[0].collector
+
+    collector = run(scenario())
+    assert collector._value.get() == 42.0  # noqa: SLF001
+
+
+def test_server_metrics_and_status(run):
+    async def scenario():
+        cfg = TelemetryConfig(
+            {
+                "port": 19091,
+                "interfaces": ["static:127.0.0.1"],
+                "metrics": [
+                    {"name": "zz_requests_total", "type": "counter",
+                     "help": "requests"},
+                ],
+            }
+        )
+        telemetry = Telemetry(cfg)
+        bus = EventBus()
+        for m in telemetry.metrics:
+            m.run(bus)
+        job = Job(
+            JobConfig({"name": "app", "exec": "sleep 1"}).validate(None)
+        )
+        telemetry.monitor_jobs([job])
+        await telemetry.run()
+        bus.publish(Event(EventCode.METRIC, "zz_requests_total|3"))
+        await asyncio.sleep(0.05)
+
+        def fetch(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:19091{path}", timeout=5
+            ) as resp:
+                return resp.read().decode()
+
+        loop = asyncio.get_event_loop()
+        metrics_body = await loop.run_in_executor(None, fetch, "/metrics")
+        status_body = await loop.run_in_executor(None, fetch, "/status")
+        for m in telemetry.metrics:
+            m.stop()
+        await telemetry.stop()
+        await bus.wait()
+        return metrics_body, status_body
+
+    metrics_body, status_body = run(scenario())
+    assert "zz_requests_total" in metrics_body
+    assert "containerpilot_events_total" in metrics_body  # built-in
+    status = json.loads(status_body)
+    assert status["Jobs"] == [{"Name": "app", "Status": "unknown"}]
+    assert "Version" in status

@@ -1,0 +1,91 @@
+"""Template-rendering tests (reference: config/template/template_test.go
+behavior parity)."""
+import pytest
+
+from containerpilot_tpu.config.template import TemplateError, apply_template
+
+
+ENV = {
+    "NAME": "world",
+    "EMPTY": "",
+    "CSV": "a,b,c",
+    "HOST": "10.0.0.5:8080",
+    "COUNT": "3",
+}
+
+
+def render(src, env=ENV):
+    return apply_template(src, env)
+
+
+def test_plain_text_passthrough():
+    assert render("no actions here { } ") == "no actions here { } "
+
+
+def test_variable_substitution():
+    assert render("hello {{ .NAME }}!") == "hello world!"
+
+
+def test_missing_variable_renders_empty():
+    assert render("[{{ .NOPE }}]") == "[]"
+
+
+def test_default_pipeline():
+    assert render('{{ .NOPE | default "fallback" }}') == "fallback"
+    assert render('{{ .NAME | default "fallback" }}') == "world"
+    assert render('{{ .EMPTY | default "fallback" }}') == "fallback"
+
+
+def test_default_direct_call():
+    assert render('{{ default "fb" .NOPE }}') == "fb"
+
+
+def test_env_function(monkeypatch):
+    monkeypatch.setenv("SOME_ENV_VAR", "from-env")
+    assert render('{{ env "SOME_ENV_VAR" }}') == "from-env"
+
+
+def test_split_and_join():
+    assert render('{{ .CSV | split "," | join ";" }}') == "a;b;c"
+
+
+def test_replace_all():
+    assert render('{{ .HOST | replaceAll ":8080" "" }}') == "10.0.0.5"
+
+
+def test_regex_replace_all():
+    assert render('{{ .HOST | regexReplaceAll ":[0-9]+$" "" }}') == "10.0.0.5"
+    assert (
+        render('{{ .HOST | regexReplaceAll "([0-9.]+):.*" "$1" }}')
+        == "10.0.0.5"
+    )
+
+
+def test_loop_range():
+    assert render("{{ range loop 3 }}x{{ end }}") == "xxx"
+    assert render("{{ range loop 1 4 }}{{ . }} {{ end }}") == "1 2 3 "
+    assert render("{{ range loop 3 1 }}{{ . }}{{ end }}") == "32"
+
+
+def test_loop_env_var_count():
+    assert render("{{ range loop 0 .COUNT }}y{{ end }}") == "yyy"
+
+
+def test_if_else():
+    assert render("{{ if .NAME }}yes{{ else }}no{{ end }}") == "yes"
+    assert render("{{ if .EMPTY }}yes{{ else }}no{{ end }}") == "no"
+    assert render("{{ if .NOPE }}yes{{ end }}") == ""
+
+
+def test_nested_parens():
+    assert render('{{ join "," (split "," .CSV) }}') == "a,b,c"
+
+
+def test_unknown_function_raises():
+    with pytest.raises(TemplateError):
+        render("{{ bogus 1 }}")
+
+
+def test_unclosed_block_raises():
+    with pytest.raises(TemplateError):
+        render("{{ if .NAME }}never closed")

@@ -1,0 +1,85 @@
+"""Watch tests: poll-driven change events (reference: watches/watches_test.go)."""
+import asyncio
+
+import pytest
+
+from containerpilot_tpu.discovery import (
+    FileCatalogBackend,
+    NoopBackend,
+    ServiceRegistration,
+)
+from containerpilot_tpu.events import Event, EventBus, EventCode
+from containerpilot_tpu.watches import Watch, WatchConfig, WatchConfigError
+
+
+def test_watch_config_prefixes_name():
+    cfg = WatchConfig({"name": "backend", "interval": 5}).validate(NoopBackend())
+    assert cfg.name == "watch.backend"
+    assert cfg.service_name == "backend"
+
+
+def test_watch_config_requires_interval():
+    with pytest.raises(WatchConfigError):
+        WatchConfig({"name": "backend"}).validate(NoopBackend())
+
+
+def test_watch_config_rejects_unknown_keys():
+    with pytest.raises(WatchConfigError):
+        WatchConfig({"name": "b", "interval": 1, "poll": 2})
+
+
+def test_watch_publishes_on_change(run):
+    async def scenario():
+        disc = NoopBackend()
+        bus = EventBus()
+        cfg = WatchConfig({"name": "backend", "interval": 1}).validate(disc)
+        watch = Watch(cfg)
+        watch.poll = 0.03  # speed up
+        watch.run(bus)
+        await asyncio.sleep(0.1)  # several polls, no change
+        quiet = list(bus.debug_events())
+        disc.val = True  # upstream becomes healthy
+        await asyncio.sleep(0.1)
+        after_up = list(bus.debug_events())
+        disc.val = False  # upstream goes away
+        await asyncio.sleep(0.1)
+        after_down = list(bus.debug_events())
+        watch.stop()
+        await bus.wait()
+        return quiet, after_up, after_down
+
+    quiet, after_up, after_down = run(scenario())
+    assert quiet == []  # no change -> no events
+    assert Event(EventCode.STATUS_CHANGED, "watch.backend") in after_up
+    assert Event(EventCode.STATUS_HEALTHY, "watch.backend") in after_up
+    assert Event(EventCode.STATUS_UNHEALTHY, "watch.backend") in after_down
+
+
+def test_watch_against_file_catalog(run, tmp_path):
+    """A watch sees another host's registration appear in the shared
+    file catalog — the TPU-pod cross-host discovery path."""
+
+    async def scenario():
+        catalog = FileCatalogBackend(str(tmp_path))
+        other_host = FileCatalogBackend(str(tmp_path))  # same shared dir
+        bus = EventBus()
+        cfg = WatchConfig({"name": "trainer", "interval": 1}).validate(catalog)
+        watch = Watch(cfg)
+        watch.poll = 0.03
+        watch.run(bus)
+        await asyncio.sleep(0.08)
+        # "another host" registers + heartbeats its trainer
+        reg = ServiceRegistration(
+            id="trainer-host7", name="trainer", port=4000,
+            address="10.0.0.7", ttl=10,
+        )
+        other_host.service_register(reg, status="passing")
+        await asyncio.sleep(0.1)
+        events = list(bus.debug_events())
+        watch.stop()
+        await bus.wait()
+        return events
+
+    events = run(scenario())
+    assert Event(EventCode.STATUS_CHANGED, "watch.trainer") in events
+    assert Event(EventCode.STATUS_HEALTHY, "watch.trainer") in events
