@@ -1,0 +1,68 @@
+"""Benchmark: supervisor job-dispatch latency.
+
+The reference supervisor publishes no benchmarks; its documented perf
+contract is the expected 20-50ms fork/exec round trip on commodity
+container hosts (BASELINE.md; reference docs/30-configuration/
+34-jobs.md:126,137,207). This bench measures our equivalent end-to-end
+number through the REAL stack: per cycle, a one-shot job is built,
+subscribed to a fresh bus, its event loop started, GLOBAL_STARTUP
+published, the child process spawned, its exit observed, and the
+stopping/stopped cleanup completed.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": <median ms>, "unit": "ms", "vs_baseline": r}
+vs_baseline = 35ms (the documented expectation's midpoint) / measured —
+above 1.0 means faster dispatch than the reference's stated envelope.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import statistics
+import time
+
+logging.disable(logging.CRITICAL)
+
+from containerpilot_tpu.events import EventBus, GLOBAL_STARTUP  # noqa: E402
+from containerpilot_tpu.jobs import Job, JobConfig  # noqa: E402
+
+BASELINE_MS = 35.0  # midpoint of the reference's documented 20-50ms
+CYCLES = 60
+WARMUP = 5
+
+
+async def one_cycle() -> float:
+    bus = EventBus()
+    job = Job(JobConfig({"name": "bench", "exec": "/bin/true"}).validate(None))
+    job.subscribe(bus)
+    job.register(bus)
+    task = job.run()
+    start = time.perf_counter()
+    bus.publish(GLOBAL_STARTUP)
+    await bus.wait()  # full lifecycle: spawn -> exit -> cleanup
+    await task
+    return (time.perf_counter() - start) * 1e3
+
+
+async def main() -> None:
+    samples = []
+    for i in range(CYCLES + WARMUP):
+        ms = await one_cycle()
+        if i >= WARMUP:
+            samples.append(ms)
+    median = statistics.median(samples)
+    print(
+        json.dumps(
+            {
+                "metric": "supervisor_job_dispatch_latency_p50",
+                "value": round(median, 3),
+                "unit": "ms",
+                "vs_baseline": round(BASELINE_MS / median, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,5 +1,5 @@
 """Process execution layer (reference: commands/ package)."""
 from .args import ArgsError, parse_args
-from .commands import Command
+from .commands import Command, env_name
 
-__all__ = ["Command", "parse_args", "ArgsError"]
+__all__ = ["Command", "env_name", "parse_args", "ArgsError"]

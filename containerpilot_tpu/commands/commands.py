@@ -40,6 +40,22 @@ _NON_ALNUM = re.compile(r"[^A-Za-z0-9]+")
 _MULTI_SCORE = re.compile(r"__+")
 
 
+def env_name(name: str) -> str:
+    """Format a job name for env-var use — CONTAINERPILOT_<NAME>_PID /
+    _IP (reference: commands/commands.go:59-81): basename, extension
+    stripped, non-alphanumerics collapsed to single underscores,
+    uppercased."""
+    if not name:
+        return name
+    base = os.path.basename(name)
+    root, ext = os.path.splitext(base)
+    if ext:
+        base = root
+    base = _NON_ALNUM.sub("_", base)
+    base = _MULTI_SCORE.sub("_", base)
+    return base.upper()
+
+
 class Command:
     """A runnable child-process specification plus its live handle."""
 
@@ -81,17 +97,8 @@ class Command:
     # -- naming ---------------------------------------------------------
 
     def env_name(self) -> str:
-        """Format the name for the CONTAINERPILOT_<NAME>_PID env var
-        (reference: commands/commands.go:59-81)."""
-        if not self.name:
-            return self.name
-        base = os.path.basename(self.name)
-        root, ext = os.path.splitext(base)
-        if ext:
-            base = root
-        base = _NON_ALNUM.sub("_", base)
-        base = _MULTI_SCORE.sub("_", base)
-        return base.upper()
+        """Format the name for the CONTAINERPILOT_<NAME>_PID env var."""
+        return env_name(self.name)
 
     # -- state ----------------------------------------------------------
 

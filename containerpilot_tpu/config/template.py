@@ -197,12 +197,15 @@ class _ExprParser:
     def parse_term(self) -> Any:
         kind, val = self.next()
         if kind == "string":
+            # single left-to-right pass so \\n decodes to backslash+n,
+            # not to a newline
+            escapes = {'"': '"', "n": "\n", "t": "\t", "\\": "\\"}
             return StringLit(
-                val[1:-1]
-                .replace('\\"', '"')
-                .replace("\\n", "\n")
-                .replace("\\t", "\t")
-                .replace("\\\\", "\\")
+                re.sub(
+                    r"\\(.)",
+                    lambda m: escapes.get(m.group(1), "\\" + m.group(1)),
+                    val[1:-1],
+                )
             )
         if kind == "number":
             return NumberLit(float(val) if "." in val else int(val))

@@ -27,7 +27,7 @@ import os
 import signal
 from typing import List, Optional
 
-from ..commands.commands import Command
+from ..commands import env_name
 from ..config.loader import AppConfig, load_config
 from ..config.logger import reopen_log_file
 from ..control import ControlServer
@@ -66,8 +66,7 @@ class App:
         (reference: core/app.go:81-97)."""
         for job in self.jobs:
             if job.service is not None:
-                env_name = Command("x", name=job.name).env_name()
-                os.environ[f"CONTAINERPILOT_{env_name}_IP"] = (
+                os.environ[f"CONTAINERPILOT_{env_name(job.name)}_IP"] = (
                     job.service.registration.address
                 )
 

@@ -3,11 +3,22 @@
 Capability parity with the reference (reference: discovery/service.go):
 lazy registration on first heartbeat, TTL refresh writes, initial-status
 registration, deregistration on stop, and maintenance = deregister.
+
+Catalog I/O runs on a small shared thread pool, never on the
+supervisor's event loop: the reference runs each actor in its own
+goroutine so a slow Consul call only stalls that actor — here a
+blocking HTTP call on the single asyncio loop would stall *every*
+actor's timers and the control socket, so backend calls are submitted
+to the pool (with in-flight dedup so a hung catalog can't queue an
+unbounded backlog). ``deregister`` returns a future; async callers
+(job cleanup) await it so the stopped event still orders after
+deregistration.
 """
 from __future__ import annotations
 
 import logging
-from typing import Optional
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Optional
 
 from .backend import Backend, DiscoveryError, ServiceRegistration
 
@@ -17,6 +28,9 @@ HEALTH_PASSING = "passing"
 HEALTH_WARNING = "warning"
 HEALTH_CRITICAL = "critical"
 
+# shared across all services; catalog calls are tiny and infrequent
+_EXECUTOR = ThreadPoolExecutor(max_workers=2, thread_name_prefix="discovery")
+
 
 class ServiceDefinition:
     """A job's live registration state against a Backend."""
@@ -25,6 +39,7 @@ class ServiceDefinition:
         self.registration = registration
         self.backend = backend
         self.was_registered = False
+        self._inflight: Optional[Future] = None
 
     @property
     def id(self) -> str:
@@ -38,32 +53,55 @@ class ServiceDefinition:
     def initial_status(self) -> str:
         return self.registration.initial_status
 
-    def send_heartbeat(self) -> None:
-        """Lazy-register then refresh the TTL check
-        (reference: discovery/service.go:41-51)."""
-        self._register(HEALTH_PASSING)
-        check_id = f"service:{self.id}"
-        try:
-            self.backend.update_ttl(check_id, "ok", "pass")
-        except DiscoveryError as exc:
-            log.warning("service update TTL failed: %s", exc)
+    # -- threading plumbing ----------------------------------------------
 
-    def register_with_initial_status(self) -> None:
+    def _submit(self, fn: Callable[[], None]) -> Optional[Future]:
+        """Run a catalog call off-loop; skip if the previous one is
+        still in flight (a hung catalog must not queue a backlog)."""
+        if self._inflight is not None and not self._inflight.done():
+            log.debug("%s: catalog call still in flight, skipping", self.id)
+            return None
+        future = _EXECUTOR.submit(fn)
+        self._inflight = future
+        return future
+
+    # -- operations --------------------------------------------------------
+
+    def send_heartbeat(self) -> Optional[Future]:
+        """Lazy-register then refresh the TTL check, off-loop
+        (reference: discovery/service.go:41-51)."""
+
+        def work() -> None:
+            self._register_sync(HEALTH_PASSING)
+            try:
+                self.backend.update_ttl(f"service:{self.id}", "ok", "pass")
+            except DiscoveryError as exc:
+                log.warning("service update TTL failed: %s", exc)
+
+        return self._submit(work)
+
+    def register_with_initial_status(self) -> Optional[Future]:
         """Register once with the configured initial status
         (reference: discovery/service.go:54-76)."""
         if self.was_registered:
-            return
+            return None
         status = {
             "passing": HEALTH_PASSING,
             "warning": HEALTH_WARNING,
             "critical": HEALTH_CRITICAL,
         }.get(self.initial_status, "")
-        log.info(
-            "registering service %s with initial status %r", self.name, status
-        )
-        self._register(status)
 
-    def _register(self, status: str) -> None:
+        def work() -> None:
+            log.info(
+                "registering service %s with initial status %r",
+                self.name,
+                status,
+            )
+            self._register_sync(status)
+
+        return self._submit(work)
+
+    def _register_sync(self, status: str) -> None:
         if self.was_registered:
             return
         try:
@@ -74,22 +112,31 @@ class ServiceDefinition:
         log.info("service registered: %s", self.name)
         self.was_registered = True
 
-    def deregister(self) -> None:
+    def deregister(self) -> Optional[Future]:
         """Remove from the catalog (reference: discovery/service.go:28-33).
 
-        Deviation from the reference: ``was_registered`` resets here so
-        the next heartbeat lazily re-registers. The reference leaves the
-        flag set, so a service that exits maintenance mode keeps writing
-        TTL updates against a check it deleted — it never reappears in
+        Deviation from the reference: ``was_registered`` resets so the
+        next heartbeat lazily re-registers — the reference leaves the
+        flag set, so a service exiting maintenance mode keeps writing
+        TTL updates against a check it deleted and never reappears in
         the catalog until a config reload.
         """
-        log.debug("deregistering: %s", self.id)
-        try:
-            self.backend.service_deregister(self.id)
-        except DiscoveryError as exc:
-            log.info("deregistering failed: %s", exc)
-        finally:
-            self.was_registered = False
+        # flip the flag immediately so a concurrently-queued heartbeat
+        # can't observe stale registration state
+        self.was_registered = False
+
+        def work() -> None:
+            log.debug("deregistering: %s", self.id)
+            try:
+                self.backend.service_deregister(self.id)
+            except DiscoveryError as exc:
+                log.info("deregistering failed: %s", exc)
+
+        # never dedup-skipped: cleanup must always deregister, even if
+        # a heartbeat is mid-flight
+        future = _EXECUTOR.submit(work)
+        self._inflight = future
+        return future
 
     def mark_for_maintenance(self) -> None:
         """Maintenance mode = drop out of the catalog

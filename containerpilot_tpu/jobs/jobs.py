@@ -328,7 +328,15 @@ class Job(EventHandler):
         if self.health_check_exec is not None:
             self.health_check_exec.term()
         if self.service is not None:
-            self.service.deregister()
+            future = self.service.deregister()
+            if future is not None:
+                # keep ordering: our stopped event follows deregistration
+                try:
+                    await asyncio.wait_for(
+                        asyncio.wrap_future(future), timeout=10.0
+                    )
+                except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+                    pass
         self.unsubscribe()
         self.unregister()
         self.is_complete = True

@@ -1,0 +1,11 @@
+"""Model zoo for the supervised TPU workload. Flagship: a decoder-only
+transformer designed around the MXU (bf16 matmuls, static shapes,
+scan-friendly layers, tensor-parallel head/hidden sharding)."""
+from .transformer import (
+    TransformerConfig,
+    forward,
+    init_params,
+    loss_fn,
+)
+
+__all__ = ["TransformerConfig", "init_params", "forward", "loss_fn"]

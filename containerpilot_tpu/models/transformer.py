@@ -1,0 +1,165 @@
+"""Flagship model: a decoder-only transformer, TPU-first.
+
+Design choices for the MXU/XLA (not a port of anything):
+
+- all matmuls run in bfloat16 with float32 accumulation
+  (``preferred_element_type``), params kept in float32;
+- static shapes everywhere; the layer stack is a ``lax.scan`` over
+  stacked per-layer parameters, so XLA compiles ONE layer body
+  regardless of depth (fast compiles, perfect for pjit);
+- RMSNorm + rotary embeddings + SwiGLU — all bandwidth-light
+  elementwise ops that XLA fuses into the surrounding matmuls;
+- head dim and hidden dims sized to multiples of 128 (lane width);
+- attention is causal with an optional pallas flash kernel
+  (ops/attention.py) for long sequences.
+
+Parameters are a plain pytree (dict), so sharding rules are just
+PartitionSpecs over the tree (parallel/sharding.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import causal_attention
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 1408  # SwiGLU hidden (multiple of 128)
+    max_seq_len: int = 2048
+    rope_theta: float = 10_000.0
+    dtype: Any = jnp.bfloat16  # compute dtype
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+Params = Dict[str, Any]
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
+    """Initialize parameters as stacked-per-layer arrays (leading axis =
+    layer), ready for the scan-based forward."""
+    k_emb, k_attn, k_mlp, k_out = jax.random.split(rng, 4)
+    d, h, hd, f, L = (
+        cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers,
+    )
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5))
+
+    ks = jax.random.split(k_attn, 4)
+    km = jax.random.split(k_mlp, 3)
+    return {
+        "embed": jax.random.normal(k_emb, (cfg.vocab_size, d), jnp.float32)
+        * 0.02,
+        "layers": {
+            # attention projections, stacked over layers
+            "wq": dense(ks[0], (L, d, h, hd), d),
+            "wk": dense(ks[1], (L, d, h, hd), d),
+            "wv": dense(ks[2], (L, d, h, hd), d),
+            "wo": dense(ks[3], (L, h, hd, d), h * hd),
+            # SwiGLU
+            "w_gate": dense(km[0], (L, d, f), d),
+            "w_up": dense(km[1], (L, d, f), d),
+            "w_down": dense(km[2], (L, f, d), f),
+            "norm_attn": jnp.ones((L, d), jnp.float32),
+            "norm_mlp": jnp.ones((L, d), jnp.float32),
+        },
+        "norm_out": jnp.ones((d,), jnp.float32),
+        "unembed": dense(k_out, (d, cfg.vocab_size), d),
+    }
+
+
+def _rms_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale.astype(x.dtype)
+
+
+def _rope(x: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding over the last (head_dim) axis.
+    x: [batch, seq, heads, head_dim]."""
+    b, s, h, hd = x.shape
+    half = hd // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _layer(
+    x: jax.Array, layer_params: Dict[str, jax.Array], cfg: TransformerConfig
+) -> jax.Array:
+    """One transformer block. x: [batch, seq, d_model] in compute dtype."""
+    dt = cfg.dtype
+    # -- attention --
+    h = _rms_norm(x, layer_params["norm_attn"])
+    q = jnp.einsum("bsd,dhk->bshk", h, layer_params["wq"].astype(dt),
+                   preferred_element_type=jnp.float32).astype(dt)
+    k = jnp.einsum("bsd,dhk->bshk", h, layer_params["wk"].astype(dt),
+                   preferred_element_type=jnp.float32).astype(dt)
+    v = jnp.einsum("bsd,dhk->bshk", h, layer_params["wv"].astype(dt),
+                   preferred_element_type=jnp.float32).astype(dt)
+    q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
+    attn = causal_attention(q, k, v)
+    attn_out = jnp.einsum("bshk,hkd->bsd", attn,
+                          layer_params["wo"].astype(dt),
+                          preferred_element_type=jnp.float32).astype(dt)
+    x = x + attn_out
+    # -- SwiGLU MLP --
+    h = _rms_norm(x, layer_params["norm_mlp"])
+    gate = jnp.einsum("bsd,df->bsf", h, layer_params["w_gate"].astype(dt),
+                      preferred_element_type=jnp.float32)
+    up = jnp.einsum("bsd,df->bsf", h, layer_params["w_up"].astype(dt),
+                    preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(dt)
+    down = jnp.einsum("bsf,fd->bsd", act, layer_params["w_down"].astype(dt),
+                      preferred_element_type=jnp.float32).astype(dt)
+    return x + down
+
+
+def forward(
+    params: Params, tokens: jax.Array, cfg: TransformerConfig
+) -> jax.Array:
+    """tokens: [batch, seq] int32 -> logits [batch, seq, vocab] float32.
+
+    The layer stack is a lax.scan over stacked layer params: one
+    compiled block body, L iterations, rematerialization-friendly.
+    """
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    def body(carry, layer_params):
+        return _layer(carry, layer_params, cfg), None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = _rms_norm(x, params["norm_out"])
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["unembed"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits
+
+
+def loss_fn(
+    params: Params, tokens: jax.Array, cfg: TransformerConfig
+) -> jax.Array:
+    """Next-token cross-entropy over [batch, seq]."""
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)

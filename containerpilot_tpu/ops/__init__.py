@@ -1,0 +1,4 @@
+"""Op library for the TPU workload: attention (XLA + pallas flash)."""
+from .attention import causal_attention, flash_attention_forward
+
+__all__ = ["causal_attention", "flash_attention_forward"]

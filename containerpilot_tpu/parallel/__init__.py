@@ -1,0 +1,22 @@
+"""Parallelism: device meshes, sharding rules, distributed train step.
+
+This is the TPU-native "distributed communication backend" of the
+framework's workload half. Where the reference supervisor coordinates
+*processes* through a catalog (reference: discovery/), the workload it
+supervises coordinates *chips* through jax.sharding: pick a Mesh,
+annotate shardings, and let XLA insert the collectives over ICI/DCN
+(SURVEY.md §5 distributed-backend mapping).
+"""
+from .mesh import MeshPlan, make_mesh
+from .sharding import param_sharding_rules, shard_params
+from .train import TrainState, make_train_step, init_train_state
+
+__all__ = [
+    "MeshPlan",
+    "make_mesh",
+    "param_sharding_rules",
+    "shard_params",
+    "TrainState",
+    "make_train_step",
+    "init_train_state",
+]

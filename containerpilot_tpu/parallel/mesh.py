@@ -1,0 +1,62 @@
+"""Device-mesh construction.
+
+The workload's scaling axes:
+
+- ``data``  — data parallelism (batch sharding; gradient psum rides ICI)
+- ``model`` — tensor parallelism (attention heads + MLP hidden sharding)
+
+The factorization favors keeping ``model`` small (tensor parallelism is
+ICI-bandwidth hungry) and pushing the rest onto ``data``; multi-host
+deployments put ``data`` on the outer (DCN-crossing) axis, which is the
+standard TPU recipe (scaling-book: pick mesh, annotate, let XLA insert
+collectives).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """A named factorization of the device count."""
+
+    data: int
+    model: int
+
+    @property
+    def n_devices(self) -> int:
+        return self.data * self.model
+
+
+def _factor(n: int, max_model: int) -> MeshPlan:
+    """Largest power-of-two model axis up to max_model that divides n."""
+    model = 1
+    m = 2
+    while m <= max_model and n % m == 0:
+        model = m
+        m *= 2
+    return MeshPlan(data=n // model, model=model)
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    plan: Optional[MeshPlan] = None,
+    max_model: int = 4,
+) -> Mesh:
+    """Build a ("data", "model") mesh over the given (or all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if plan is None:
+        plan = _factor(n, max_model)
+    if plan.n_devices != n:
+        raise ValueError(
+            f"mesh plan {plan} does not cover {n} devices"
+        )
+    grid = np.asarray(devices).reshape(plan.data, plan.model)
+    return Mesh(grid, axis_names=("data", "model"))

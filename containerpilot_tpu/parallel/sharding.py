@@ -1,0 +1,62 @@
+"""Sharding rules for the flagship transformer.
+
+Megatron-style tensor parallelism expressed as PartitionSpecs (XLA
+inserts the collectives):
+
+- attention: heads sharded over ``model`` — q/k/v projections split
+  column-wise by head, the output projection row-wise, so one
+  all-reduce per attention block rides ICI;
+- SwiGLU: gate/up sharded column-wise on the hidden axis, down
+  row-wise — one all-reduce per MLP block;
+- embed/unembed: vocab sharded over ``model``;
+- activations: batch over ``data`` (gradient psum over ``data`` is the
+  data-parallel all-reduce).
+
+These are *rules over the param pytree*, so new models get sharding by
+writing specs, not by rewriting layers.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def param_sharding_rules() -> Dict[str, Any]:
+    """PartitionSpec pytree matching models.transformer.init_params."""
+    return {
+        "embed": P("model", None),  # vocab sharded
+        "layers": {
+            # [L, d, heads, head_dim]: shard heads over model axis
+            "wq": P(None, None, "model", None),
+            "wk": P(None, None, "model", None),
+            "wv": P(None, None, "model", None),
+            # [L, heads, head_dim, d]: row-parallel output projection
+            "wo": P(None, "model", None, None),
+            # [L, d, ff]: column-parallel
+            "w_gate": P(None, None, "model"),
+            "w_up": P(None, None, "model"),
+            # [L, ff, d]: row-parallel
+            "w_down": P(None, "model", None),
+            "norm_attn": P(None, None),  # replicated
+            "norm_mlp": P(None, None),
+        },
+        "norm_out": P(None),
+        "unembed": P(None, "model"),
+    }
+
+
+def batch_spec() -> P:
+    """Activations/tokens: batch over the data axis."""
+    return P("data", None)
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Place a param pytree onto the mesh per the rules."""
+    rules = param_sharding_rules()
+    return jax.tree_util.tree_map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        params,
+        rules,
+    )

@@ -106,7 +106,13 @@ class Watch(EventHandler):
         subscription — their only input is the private poll timer)."""
         self.register(bus)
         timer_source = f"{self.name}.poll"
-        self._timer = event_timer(self.receive, self.poll, timer_source)
+        # immediate=True: the first poll happens right away rather than
+        # one full interval after startup (improvement over the
+        # reference, whose dependents see no upstream state until the
+        # first tick)
+        self._timer = event_timer(
+            self.receive, self.poll, timer_source, immediate=True
+        )
         self._task = asyncio.get_event_loop().create_task(
             self._loop(timer_source), name=f"watch:{self.name}"
         )
@@ -125,7 +131,14 @@ class Watch(EventHandler):
                     return
                 if event == Event(EventCode.TIMER_EXPIRED, timer_source):
                     try:
-                        did_change, is_healthy = self.check_for_upstream_changes()
+                        # catalog polls are blocking HTTP/file I/O: run
+                        # off-loop so a slow catalog stalls only this
+                        # watch, not every actor's timers
+                        did_change, is_healthy = (
+                            await asyncio.get_event_loop().run_in_executor(
+                                None, self.check_for_upstream_changes
+                            )
+                        )
                     except Exception as exc:  # a flaky catalog isn't fatal
                         log.warning("%s: poll failed: %s", self.name, exc)
                         continue

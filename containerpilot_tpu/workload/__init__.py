@@ -1,0 +1,3 @@
+"""The supervised workload: a runnable JAX training process designed to
+live under the supervisor (health-checked via a progress file, metrics
+posted to the control socket)."""

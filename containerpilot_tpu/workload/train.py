@@ -1,0 +1,95 @@
+"""A supervised training process: the demo workload.
+
+This is what a job's ``exec`` points at in a TPU deployment — one
+training process per host, supervised by containerpilot-tpu:
+
+- writes a progress file every step (``--progress-file``), which the
+  job's health check probes (e.g. ``exec: "find /run/progress -newermt
+  '-30 seconds'"``) so a hung training loop goes catalog-critical;
+- posts step/loss metrics to the supervisor's control socket
+  (``--control-socket``) for the Prometheus endpoint;
+- trains the flagship transformer on synthetic data over the local
+  (data, model) mesh.
+
+Run it stand-alone:
+    python -m containerpilot_tpu.workload.train --steps 20
+or under the supervisor (see examples/training-pod.json5).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=256)
+    parser.add_argument("--d-model", type=int, default=256)
+    parser.add_argument("--n-layers", type=int, default=2)
+    parser.add_argument("--n-heads", type=int, default=4)
+    parser.add_argument("--vocab", type=int, default=1024)
+    parser.add_argument("--progress-file", default="")
+    parser.add_argument("--control-socket", default="")
+    parser.add_argument("--learning-rate", type=float, default=3e-4)
+    args = parser.parse_args()
+
+    from ..models.transformer import TransformerConfig
+    from ..parallel import init_train_state, make_mesh, make_train_step
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab,
+        d_model=args.d_model,
+        n_heads=args.n_heads,
+        n_layers=args.n_layers,
+        d_ff=args.d_model * 3 // 128 * 128 or 128,
+        max_seq_len=args.seq_len,
+    )
+    mesh = make_mesh()
+    print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} "
+          f"on {jax.default_backend()}")
+    rng = jax.random.PRNGKey(0)
+    state = init_train_state(rng, cfg, mesh, args.learning_rate)
+    train_step = make_train_step(cfg, mesh, args.learning_rate)
+
+    client = None
+    if args.control_socket:
+        from ..client import ControlClient
+
+        client = ControlClient(args.control_socket)
+
+    data_rng = jax.random.PRNGKey(1)
+    t0 = time.monotonic()
+    for step in range(args.steps):
+        data_rng, k = jax.random.split(data_rng)
+        tokens = jax.random.randint(
+            k, (args.batch, args.seq_len + 1), 0, cfg.vocab_size, jnp.int32
+        )
+        state, loss = train_step(state, tokens)
+        if args.progress_file:
+            tmp = args.progress_file + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"step": step + 1, "loss": float(loss),
+                           "time": time.time()}, f)
+            os.replace(tmp, args.progress_file)
+        if client is not None and (step + 1) % 10 == 0:
+            try:
+                client.put_metric({"training_steps_total": 10,
+                                   "training_loss": float(loss)})
+            except Exception:
+                pass  # the supervisor may be reloading; never die for this
+        if (step + 1) % 10 == 0 or step == 0:
+            rate = (step + 1) / (time.monotonic() - t0)
+            print(f"step {step + 1}: loss={float(loss):.4f} "
+                  f"({rate:.1f} steps/s)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -8,7 +8,7 @@ import asyncio
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,6 +16,14 @@ if "--xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# This image's sitecustomize registers a TPU PJRT plugin in every
+# interpreter and pins jax_platforms to it, overriding the env var; the
+# config update below (post-import, pre-first-use) is what actually
+# lands the tests on the 8-device virtual CPU mesh.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import logging
 

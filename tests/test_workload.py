@@ -1,0 +1,129 @@
+"""TPU-workload tests on the virtual 8-device CPU mesh: model numerics,
+pallas kernel parity, sharded train step, graft entry points."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from containerpilot_tpu.models.transformer import (
+    TransformerConfig,
+    forward,
+    init_params,
+    loss_fn,
+)
+from containerpilot_tpu.ops.attention import (
+    causal_attention,
+    flash_attention_forward,
+)
+from containerpilot_tpu.parallel import (
+    MeshPlan,
+    init_train_state,
+    make_mesh,
+    make_train_step,
+)
+
+
+CFG = TransformerConfig(
+    vocab_size=128, d_model=64, n_heads=2, n_layers=2, d_ff=128,
+    max_seq_len=64,
+)
+
+
+def test_forward_shapes_and_finiteness():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 16), 0, CFG.vocab_size, jnp.int32
+    )
+    logits = jax.jit(lambda p, t: forward(p, t, CFG))(params, tokens)
+    assert logits.shape == (2, 16, CFG.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_loss_decreases_under_training():
+    """Overfit a single tiny batch: loss must drop substantially."""
+    mesh = make_mesh(jax.devices()[:1], plan=MeshPlan(1, 1))
+    state = init_train_state(jax.random.PRNGKey(0), CFG, mesh,
+                             learning_rate=1e-2)
+    step = make_train_step(CFG, mesh, learning_rate=1e-2)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(2), (2, 33), 0, CFG.vocab_size, jnp.int32
+    )
+    first = None
+    for _ in range(10):
+        state, loss = step(state, tokens)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.8, (first, float(loss))
+
+
+def test_causality():
+    """Changing future tokens must not change past logits."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    t1 = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, 128, jnp.int32)
+    t2 = t1.at[0, 10:].set((t1[0, 10:] + 1) % 128)
+    l1 = forward(params, t1, CFG)
+    l2 = forward(params, t2, CFG)
+    np.testing.assert_allclose(
+        np.asarray(l1[0, :10]), np.asarray(l2[0, :10]), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_flash_attention_matches_xla():
+    """The pallas kernel (interpret mode on CPU) must match the einsum
+    reference."""
+    rng = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(rng, 3)
+    shape = (2, 256, 2, 64)  # [batch, seq, heads, head_dim]
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+    ref = causal_attention(q, k, v)
+    flash = flash_attention_forward(q, k, v, block_q=128, block_k=128)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(flash), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_flash_attention_rejects_ragged_seq():
+    q = jnp.zeros((1, 100, 2, 64))
+    with pytest.raises(ValueError, match="not a multiple"):
+        flash_attention_forward(q, q, q)
+
+
+def test_mesh_factorization():
+    mesh = make_mesh(jax.devices()[:8])
+    assert mesh.axis_names == ("data", "model")
+    assert mesh.devices.shape == (2, 4)
+    mesh1 = make_mesh(jax.devices()[:1])
+    assert mesh1.devices.shape == (1, 1)
+    with pytest.raises(ValueError):
+        make_mesh(jax.devices()[:8], plan=MeshPlan(3, 2))
+
+
+def test_sharded_train_step_8_devices():
+    """The full tp x dp train step over the virtual 8-device mesh."""
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq_len=64,
+    )  # heads/ff/vocab divisible by the 4-way model axis
+    mesh = make_mesh(jax.devices()[:8])
+    state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (4, 33), 0, cfg.vocab_size, jnp.int32
+    )
+    state, loss = step(state, tokens)
+    assert bool(jnp.isfinite(loss))
+    assert int(state.step) == 1
+    # params actually sharded: wq's model axis split over 4 devices
+    wq_sharding = state.params["layers"]["wq"].sharding
+    assert len(wq_sharding.device_set) == 8
+
+
+def test_graft_entry_points():
+    import __graft_entry__ as graft
+
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[-1] == 256
+    graft.dryrun_multichip(8)
