@@ -1,0 +1,28 @@
+# Build/test entry points (reference: makefile — build, lint, test,
+# integration tiers).
+
+PYTHON ?= python
+
+.PHONY: all build test integration bench lint clean
+
+all: build test
+
+build:
+	$(MAKE) -C native
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+# the integration-grade scenarios only (real CLI, real processes)
+integration: build
+	$(PYTHON) -m pytest tests/test_integration.py tests/test_app.py -q
+
+bench:
+	$(PYTHON) bench.py
+
+lint:
+	$(PYTHON) -m compileall -q containerpilot_tpu
+
+clean:
+	$(MAKE) -C native clean
+	rm -rf bin __pycache__ */__pycache__

@@ -66,6 +66,12 @@ def build_parser() -> argparse.ArgumentParser:
         "-ping", dest="ping", action="store_true",
         help="Check that the control socket is up.",
     )
+    parser.add_argument(
+        "-catalog-server", dest="catalog_server", default="",
+        metavar="HOST:PORT",
+        help="Run the Consul-API-compatible catalog server for pods "
+        "without an external catalog (e.g. '0.0.0.0:8500').",
+    )
     return parser
 
 
@@ -97,4 +103,7 @@ def get_args(
         return subcommands.put_metrics_handler, params
     if args.ping:
         return subcommands.ping_handler, params
+    if args.catalog_server:
+        params["catalog_addr"] = args.catalog_server
+        return subcommands.catalog_server_handler, params
     return None, params

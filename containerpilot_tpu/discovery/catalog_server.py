@@ -1,0 +1,233 @@
+"""cp-catalogd: a Consul-API-compatible catalog server for TPU pods.
+
+The reference delegates cross-host coordination entirely to an external
+Consul cluster (reference: discovery/consul.go). TPU pods usually don't
+run one — so this framework ships its own catalog daemon speaking the
+same agent-API subset the supervisor (and anything else using that API)
+needs:
+
+    PUT /v1/agent/service/register          body: AgentServiceRegistration
+    PUT /v1/agent/service/deregister/<id>
+    PUT /v1/agent/check/update/<check-id>   body: {Status, Output}
+    GET /v1/health/service/<name>?passing=1[&tag=][&dc=]
+
+One host in the pod (or a CPU VM) runs:
+
+    python -m containerpilot_tpu -catalog-server 0.0.0.0:8500
+
+and every host's supervisor points ``consul: "<leader>:8500"`` at it
+over DCN. TTL semantics match Consul: a check that misses its TTL goes
+critical and drops out of passing health queries;
+``DeregisterCriticalServiceAfter`` reaps long-critical services.
+
+State is in-memory per generation — exactly as ephemeral as the
+services it tracks (a catalog restart just means one TTL round of
+re-registration, since supervisors lazily re-register on heartbeat).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..config.timing import DurationError, parse_duration
+from ..utils.http import HTTPServer, Request, Response
+
+log = logging.getLogger("containerpilot.catalog")
+
+
+@dataclass
+class _Entry:
+    id: str
+    name: str
+    address: str
+    port: int
+    tags: List[str]
+    ttl: float
+    status: str = "critical"
+    expires: float = 0.0  # 0 = never passed yet
+    dereg_after: float = 0.0  # seconds critical before reaping; 0 = never
+    critical_since: float = 0.0
+    enable_tag_override: bool = False
+
+    def effective_status(self, now: float) -> str:
+        if self.status == "passing" and self.ttl > 0 and now > self.expires:
+            return "critical"
+        return self.status
+
+
+class CatalogServer:
+    """In-memory Consul-compatible catalog."""
+
+    def __init__(
+        self, host: str = "0.0.0.0", port: int = 8500, dc: str = "dc1"
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.dc = dc  # health queries for another dc return empty
+        self._entries: Dict[str, _Entry] = {}  # by instance id
+        self._server = HTTPServer()
+        self._reaper: Optional["asyncio.Task[None]"] = None
+        # routes with path params are matched manually
+        self._server.route(
+            "PUT", "/v1/agent/service/register", self._register
+        )
+        self._server.fallback = self._dispatch_dynamic
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def run(self) -> None:
+        await self._server.start_tcp(self.host, self.port)
+        self._reaper = asyncio.get_event_loop().create_task(self._reap_loop())
+        log.info("catalog: serving Consul-compatible API on %s:%d",
+                 self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._reaper is not None:
+            self._reaper.cancel()
+        await self._server.stop()
+
+    async def _reap_loop(self) -> None:
+        """Reap services critical longer than DeregisterCriticalServiceAfter."""
+        try:
+            while True:
+                await asyncio.sleep(1.0)
+                now = time.time()
+                for entry in list(self._entries.values()):
+                    status = entry.effective_status(now)
+                    if status == "critical":
+                        if entry.critical_since == 0.0:
+                            entry.critical_since = now
+                        elif (
+                            entry.dereg_after > 0
+                            and now - entry.critical_since > entry.dereg_after
+                        ):
+                            log.info(
+                                "catalog: reaping %s (critical > %.0fs)",
+                                entry.id,
+                                entry.dereg_after,
+                            )
+                            self._entries.pop(entry.id, None)
+                    else:
+                        entry.critical_since = 0.0
+        except asyncio.CancelledError:
+            pass
+
+    # -- handlers -----------------------------------------------------------
+
+    async def _register(self, req: Request) -> Response:
+        try:
+            body = json.loads(req.body.decode() or "{}")
+        except ValueError:
+            return Response(400, b"bad json\n")
+        check = body.get("Check") or {}
+        ttl = 0.0
+        if check.get("TTL"):
+            try:
+                ttl = parse_duration(check["TTL"])
+            except DurationError:
+                return Response(400, b"bad TTL\n")
+        dereg_after = 0.0
+        if check.get("DeregisterCriticalServiceAfter"):
+            try:
+                dereg_after = parse_duration(
+                    check["DeregisterCriticalServiceAfter"]
+                )
+            except DurationError:
+                return Response(400, b"bad DeregisterCriticalServiceAfter\n")
+        try:
+            port = int(body.get("Port") or 0)
+        except (TypeError, ValueError):
+            return Response(400, b"bad Port\n")
+        entry = _Entry(
+            id=body.get("ID") or body.get("Name", ""),
+            name=body.get("Name", ""),
+            address=body.get("Address", ""),
+            port=port,
+            tags=list(body.get("Tags") or []),
+            ttl=ttl,
+            status=check.get("Status") or "critical",
+            dereg_after=dereg_after,
+            enable_tag_override=bool(body.get("EnableTagOverride", False)),
+        )
+        if not entry.id or not entry.name:
+            return Response(400, b"service needs ID and Name\n")
+        if entry.status == "passing" and entry.ttl > 0:
+            entry.expires = time.time() + entry.ttl
+        self._entries[entry.id] = entry
+        log.debug("catalog: registered %s (%s)", entry.id, entry.status)
+        return Response(200, b"")
+
+    async def _dispatch_dynamic(self, req: Request) -> Optional[Response]:
+        if req.method == "PUT" and req.path.startswith(
+            "/v1/agent/service/deregister/"
+        ):
+            service_id = req.path.rsplit("/", 1)[-1]
+            self._entries.pop(service_id, None)
+            log.debug("catalog: deregistered %s", service_id)
+            return Response(200, b"")
+        if req.method == "PUT" and req.path.startswith(
+            "/v1/agent/check/update/"
+        ):
+            check_id = req.path.rsplit("/", 1)[-1]
+            # check ids are "service:<instance-id>"
+            instance_id = check_id.split(":", 1)[-1]
+            entry = self._entries.get(instance_id)
+            if entry is None:
+                return Response(404, b"unknown check\n")
+            try:
+                body = json.loads(req.body.decode() or "{}")
+            except ValueError:
+                return Response(400, b"bad json\n")
+            status = body.get("Status", "passing")
+            entry.status = "passing" if status in ("pass", "passing") else (
+                "warning" if status in ("warn", "warning") else "critical"
+            )
+            if entry.status == "passing" and entry.ttl > 0:
+                entry.expires = time.time() + entry.ttl
+            return Response(200, b"")
+        if req.method == "GET" and req.path.startswith("/v1/health/service/"):
+            name = req.path.rsplit("/", 1)[-1]
+            passing_only = req.query.get("passing", ["0"])[0] not in ("0", "")
+            tag = req.query.get("tag", [""])[0]
+            dc = req.query.get("dc", [""])[0]
+            if dc and dc != self.dc:
+                # this catalog serves exactly one datacenter
+                return Response(
+                    200, b"[]", content_type="application/json"
+                )
+            now = time.time()
+            out: List[Dict[str, Any]] = []
+            for entry in sorted(self._entries.values(), key=lambda e: e.id):
+                if entry.name != name:
+                    continue
+                status = entry.effective_status(now)
+                if passing_only and status != "passing":
+                    continue
+                if tag and tag not in entry.tags:
+                    continue
+                out.append(
+                    {
+                        "Node": {"Node": "catalog", "Address": entry.address},
+                        "Service": {
+                            "ID": entry.id,
+                            "Service": entry.name,
+                            "Address": entry.address,
+                            "Port": entry.port,
+                            "Tags": entry.tags,
+                        },
+                        "Checks": [
+                            {
+                                "CheckID": f"service:{entry.id}",
+                                "Status": status,
+                            }
+                        ],
+                    }
+                )
+            return Response(
+                200, json.dumps(out).encode(), content_type="application/json"
+            )
+        return None

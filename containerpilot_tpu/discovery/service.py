@@ -8,17 +8,22 @@ Catalog I/O runs on a small shared thread pool, never on the
 supervisor's event loop: the reference runs each actor in its own
 goroutine so a slow Consul call only stalls that actor — here a
 blocking HTTP call on the single asyncio loop would stall *every*
-actor's timers and the control socket, so backend calls are submitted
-to the pool (with in-flight dedup so a hung catalog can't queue an
-unbounded backlog). ``deregister`` returns a future; async callers
-(job cleanup) await it so the stopped event still orders after
+actor's timers and the control socket. Per-service operations execute
+in strict submission (FIFO) order through a private drain queue, so a
+heartbeat submitted before a deregister can never re-register the
+service afterwards, regardless of pool scheduling. Heartbeats dedup
+against a non-empty queue (a hung catalog can't build a backlog);
+``deregister`` always enqueues and returns a future that async callers
+(job cleanup) await so the stopped event still orders after
 deregistration.
 """
 from __future__ import annotations
 
 import logging
+import threading
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
-from typing import Callable, Optional
+from typing import Callable, Deque, Optional, Tuple
 
 from .backend import Backend, DiscoveryError, ServiceRegistration
 
@@ -39,7 +44,9 @@ class ServiceDefinition:
         self.registration = registration
         self.backend = backend
         self.was_registered = False
-        self._inflight: Optional[Future] = None
+        self._lock = threading.Lock()
+        self._pending: Deque[Tuple[Callable[[], None], Future]] = deque()
+        self._draining = False
 
     @property
     def id(self) -> str:
@@ -53,22 +60,45 @@ class ServiceDefinition:
     def initial_status(self) -> str:
         return self.registration.initial_status
 
-    # -- threading plumbing ----------------------------------------------
+    # -- FIFO off-loop execution ------------------------------------------
 
-    def _submit(self, fn: Callable[[], None]) -> Optional[Future]:
-        """Run a catalog call off-loop; skip if the previous one is
-        still in flight (a hung catalog must not queue a backlog)."""
-        if self._inflight is not None and not self._inflight.done():
-            log.debug("%s: catalog call still in flight, skipping", self.id)
-            return None
-        future = _EXECUTOR.submit(fn)
-        self._inflight = future
+    def _enqueue(
+        self, fn: Callable[[], None], *, dedup: bool
+    ) -> Optional[Future]:
+        """Queue a catalog op; per-service ops run in submission order.
+
+        ``dedup=True`` skips the submit when ops are already queued or
+        running (heartbeats must not pile up behind a hung catalog).
+        """
+        with self._lock:
+            if dedup and (self._pending or self._draining):
+                log.debug("%s: catalog op in flight, skipping", self.id)
+                return None
+            future: Future = Future()
+            self._pending.append((fn, future))
+            if not self._draining:
+                self._draining = True
+                _EXECUTOR.submit(self._drain)
         return future
+
+    def _drain(self) -> None:
+        while True:
+            with self._lock:
+                if not self._pending:
+                    self._draining = False
+                    return
+                fn, future = self._pending.popleft()
+            try:
+                fn()
+                future.set_result(None)
+            except Exception as exc:  # noqa: BLE001 - surfaced via future
+                log.warning("%s: catalog op failed: %s", self.id, exc)
+                future.set_exception(exc)
 
     # -- operations --------------------------------------------------------
 
     def send_heartbeat(self) -> Optional[Future]:
-        """Lazy-register then refresh the TTL check, off-loop
+        """Lazy-register then refresh the TTL check
         (reference: discovery/service.go:41-51)."""
 
         def work() -> None:
@@ -78,7 +108,7 @@ class ServiceDefinition:
             except DiscoveryError as exc:
                 log.warning("service update TTL failed: %s", exc)
 
-        return self._submit(work)
+        return self._enqueue(work, dedup=True)
 
     def register_with_initial_status(self) -> Optional[Future]:
         """Register once with the configured initial status
@@ -99,7 +129,7 @@ class ServiceDefinition:
             )
             self._register_sync(status)
 
-        return self._submit(work)
+        return self._enqueue(work, dedup=True)
 
     def _register_sync(self, status: str) -> None:
         if self.was_registered:
@@ -121,22 +151,17 @@ class ServiceDefinition:
         TTL updates against a check it deleted and never reappears in
         the catalog until a config reload.
         """
-        # flip the flag immediately so a concurrently-queued heartbeat
-        # can't observe stale registration state
-        self.was_registered = False
 
         def work() -> None:
+            self.was_registered = False
             log.debug("deregistering: %s", self.id)
             try:
                 self.backend.service_deregister(self.id)
             except DiscoveryError as exc:
                 log.info("deregistering failed: %s", exc)
 
-        # never dedup-skipped: cleanup must always deregister, even if
-        # a heartbeat is mid-flight
-        future = _EXECUTOR.submit(work)
-        self._inflight = future
-        return future
+        # never dedup-skipped: cleanup must always deregister
+        return self._enqueue(work, dedup=False)
 
     def mark_for_maintenance(self) -> None:
         """Maintenance mode = drop out of the catalog

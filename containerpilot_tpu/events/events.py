@@ -43,6 +43,15 @@ class EventCode(enum.Enum):
 _CODE_BY_NAME = {c.value: c for c in EventCode}
 # Accept the enum's symbolic names too (e.g. "EXIT_SUCCESS").
 _CODE_BY_NAME.update({c.name: c for c in EventCode})
+# Config-facing aliases (reference: events/events.go:52-84 — FromString
+# maps "healthy"/"unhealthy"/"changed" onto the status codes).
+_CODE_BY_NAME.update(
+    {
+        "healthy": EventCode.STATUS_HEALTHY,
+        "unhealthy": EventCode.STATUS_UNHEALTHY,
+        "changed": EventCode.STATUS_CHANGED,
+    }
+)
 
 
 def code_from_string(name: str) -> EventCode:

@@ -330,12 +330,16 @@ class Job(EventHandler):
         if self.service is not None:
             future = self.service.deregister()
             if future is not None:
-                # keep ordering: our stopped event follows deregistration
+                # keep ordering: our stopped event follows deregistration.
+                # shield so a timeout gives up *waiting* without
+                # cancelling the queued deregister itself — it must
+                # still run once the catalog unwedges
                 try:
                     await asyncio.wait_for(
-                        asyncio.wrap_future(future), timeout=10.0
+                        asyncio.shield(asyncio.wrap_future(future)),
+                        timeout=10.0,
                     )
-                except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+                except Exception:  # noqa: BLE001 - cleanup never raises
                     pass
         self.unsubscribe()
         self.unregister()

@@ -97,6 +97,37 @@ def put_metrics_handler(params: dict) -> int:
         return 1
 
 
+def catalog_server_handler(params: dict) -> int:
+    """Run the Consul-compatible catalog daemon until SIGTERM/SIGINT."""
+    import asyncio
+    import signal as signal_mod
+
+    from ..discovery.catalog_server import CatalogServer
+
+    addr = params.get("catalog_addr", "0.0.0.0:8500")
+    host, _, port_str = addr.rpartition(":")
+    host = host or "0.0.0.0"
+    try:
+        port = int(port_str)
+    except ValueError:
+        print(f"-catalog-server expects HOST:PORT, got {addr!r}",
+              file=sys.stderr)
+        return 1
+
+    async def serve() -> None:
+        server = CatalogServer(host, port)
+        await server.run()
+        stop = asyncio.Event()
+        loop = asyncio.get_event_loop()
+        for sig in (signal_mod.SIGTERM, signal_mod.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await server.stop()
+
+    asyncio.run(serve())
+    return 0
+
+
 def ping_handler(params: dict) -> int:
     try:
         _client_for(params.get("config_path")).get_ping()

@@ -67,6 +67,11 @@ class HTTPServer:
 
     def __init__(self) -> None:
         self.routes: Dict[Tuple[str, str], Handler] = {}
+        # optional catch-all for dynamic paths (e.g. /v1/agent/service/
+        # deregister/<id>); returning None falls through to 404
+        self.fallback: Optional[
+            Callable[[Request], Awaitable[Optional[Response]]]
+        ] = None
         self._server: Optional[asyncio.AbstractServer] = None
 
     def route(self, method: str, path: str, handler: Handler) -> None:
@@ -140,6 +145,10 @@ class HTTPServer:
         )
         handler = self.routes.get((request.method, request.path))
         if handler is None:
+            if self.fallback is not None:
+                response = await self.fallback(request)
+                if response is not None:
+                    return response
             if any(p == request.path for (_m, p) in self.routes):
                 return Response(405, b"method not allowed\n")
             return Response(404, b"not found\n")

@@ -1,0 +1,127 @@
+"""Catalog server tests: the ConsulBackend driven against our own
+Consul-API-compatible daemon — the multi-host TPU-pod discovery path
+(analog of the reference's real-Consul test server,
+reference: discovery/test_server.go)."""
+import asyncio
+import time
+
+import pytest
+
+from containerpilot_tpu.discovery import (
+    ConsulBackend,
+    ServiceDefinition,
+    ServiceRegistration,
+)
+from containerpilot_tpu.discovery.catalog_server import CatalogServer
+
+PORT = 18501
+
+
+def run_with_catalog(run, fn):
+    async def scenario():
+        server = CatalogServer("127.0.0.1", PORT)
+        await server.run()
+        backend = ConsulBackend(address=f"127.0.0.1:{PORT}")
+        loop = asyncio.get_event_loop()
+        try:
+            return await loop.run_in_executor(None, fn, backend)
+        finally:
+            await server.stop()
+
+    return run(scenario(), timeout=30)
+
+
+def test_register_heartbeat_query_deregister(run):
+    def fn(backend: ConsulBackend):
+        reg = ServiceRegistration(
+            id="trainer-host0", name="trainer", port=4000,
+            address="10.0.0.1", ttl=10, tags=["v1"],
+        )
+        svc = ServiceDefinition(reg, backend)
+        # critical until the first heartbeat
+        changed, healthy = backend.check_for_upstream_changes("trainer")
+        assert (changed, healthy) == (False, False)
+        svc._register_sync("")  # registered, unchecked
+        changed, healthy = backend.check_for_upstream_changes("trainer")
+        assert (changed, healthy) == (False, False)  # not passing yet
+        backend.update_ttl("service:trainer-host0", "ok", "pass")
+        changed, healthy = backend.check_for_upstream_changes("trainer")
+        assert (changed, healthy) == (True, True)
+        instances = backend.instances("trainer")
+        assert len(instances) == 1
+        assert instances[0].address == "10.0.0.1"
+        assert instances[0].port == 4000
+        backend.service_deregister("trainer-host0")
+        changed, healthy = backend.check_for_upstream_changes("trainer")
+        assert (changed, healthy) == (True, False)
+        return True
+
+    assert run_with_catalog(run, fn)
+
+
+def test_ttl_expiry_goes_critical(run):
+    def fn(backend: ConsulBackend):
+        reg = ServiceRegistration(
+            id="web-h1", name="web", port=80, address="10.0.0.2", ttl=1,
+        )
+        backend.service_register(reg, status="passing")
+        _c, healthy = backend.check_for_upstream_changes("web")
+        assert healthy
+        time.sleep(1.3)  # TTL 1s lapses
+        changed, healthy = backend.check_for_upstream_changes("web")
+        assert changed and not healthy
+        # a fresh heartbeat revives it
+        backend.update_ttl("service:web-h1", "ok", "pass")
+        changed, healthy = backend.check_for_upstream_changes("web")
+        assert changed and healthy
+        return True
+
+    assert run_with_catalog(run, fn)
+
+
+def test_tag_filtering(run):
+    def fn(backend: ConsulBackend):
+        for i, tags in enumerate((["blue"], ["green"])):
+            backend.service_register(
+                ServiceRegistration(
+                    id=f"api-{i}", name="api", port=80 + i,
+                    address=f"10.0.1.{i}", ttl=30, tags=tags,
+                ),
+                status="passing",
+            )
+        assert len(backend.instances("api")) == 2
+        assert len(backend.instances("api", tag="blue")) == 1
+        return True
+
+    assert run_with_catalog(run, fn)
+
+
+def test_deregister_critical_service_after(run):
+    async def scenario():
+        server = CatalogServer("127.0.0.1", PORT)
+        await server.run()
+        backend = ConsulBackend(address=f"127.0.0.1:{PORT}")
+        loop = asyncio.get_event_loop()
+
+        def setup():
+            backend.service_register(
+                ServiceRegistration(
+                    id="flaky-h1", name="flaky", port=80,
+                    address="10.0.0.3", ttl=1,
+                    deregister_critical_service_after="1s",
+                ),
+                status="passing",
+            )
+
+        await loop.run_in_executor(None, setup)
+        await asyncio.sleep(3.5)  # TTL lapses, then reaper fires
+        instances = await loop.run_in_executor(
+            None, lambda: backend.instances("flaky")
+        )
+        reaped = "flaky-h1" not in server._entries
+        await server.stop()
+        return instances, reaped
+
+    instances, reaped = run(scenario(), timeout=30)
+    assert instances == []
+    assert reaped

@@ -169,3 +169,11 @@ def test_mailbox_overflow_drops_not_deadlocks(run):
         return actor.rx.qsize()
 
     assert run(scenario()) == 1000
+
+
+def test_config_facing_event_aliases():
+    """healthy/unhealthy/changed are the documented config names
+    (reference: events/events.go FromString)."""
+    assert code_from_string("healthy") is EventCode.STATUS_HEALTHY
+    assert code_from_string("unhealthy") is EventCode.STATUS_UNHEALTHY
+    assert code_from_string("changed") is EventCode.STATUS_CHANGED

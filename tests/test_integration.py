@@ -1,0 +1,196 @@
+"""Integration scenarios mirroring the reference's docker-compose tests
+(reference: integration_tests/tests/*; SURVEY.md §4.2): real CLI, real
+processes, assertions on observable state."""
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from containerpilot_tpu.client import ControlClient
+from containerpilot_tpu.core import App
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CPSUP = os.path.join(REPO, "native", "cpsup")
+
+
+def write_config(tmp_path, text):
+    path = tmp_path / "containerpilot.json5"
+    path.write_text(text)
+    return str(path)
+
+
+def test_coprocess_restart_budget_resets_on_reload(run, tmp_path):
+    """integration test_coprocess: kill coprocess -> restarts once
+    (restarts: 1); kill again -> stays dead; reload -> budget reset."""
+    socket_path = str(tmp_path / "cp.socket")
+    pidfile = tmp_path / "co.pid"
+    config = """
+    {
+      stopTimeout: "1ms",
+      control: { socket: "%s" },
+      jobs: [
+        { name: "anchor", exec: "sleep 60" },
+        {
+          name: "coprocess",
+          exec: ["/bin/sh", "-c", "echo $$ > %s; exec sleep 60"],
+          restarts: 1,
+        },
+      ],
+    }
+    """ % (socket_path, pidfile)
+    path = write_config(tmp_path, config)
+
+    def read_pid():
+        return int(pidfile.read_text())
+
+    async def kill_co_and_wait(old_pid):
+        os.kill(old_pid, signal.SIGKILL)
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if pidfile.exists():
+                try:
+                    new = read_pid()
+                except ValueError:
+                    continue
+                if new != old_pid:
+                    return new
+        return old_pid
+
+    async def scenario():
+        app = App.from_config_path(path)
+        run_task = asyncio.get_event_loop().create_task(app.run())
+        await asyncio.sleep(0.4)
+        pid1 = read_pid()
+        pid2 = await kill_co_and_wait(pid1)        # budget 1 -> restarts
+        assert pid2 != pid1, "first kill should restart the coprocess"
+        os.kill(pid2, signal.SIGKILL)              # budget exhausted
+        await asyncio.sleep(0.6)
+        pid3 = read_pid()
+        assert pid3 == pid2, "second kill must NOT restart"
+        # reload resets the restart budget
+        client = ControlClient(socket_path)
+        await asyncio.get_event_loop().run_in_executor(None, client.reload)
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            try:
+                if read_pid() not in (pid2, pid1):
+                    break
+            except ValueError:
+                pass
+        pid4 = read_pid()
+        assert pid4 not in (pid1, pid2), "reload must start a fresh coprocess"
+        pid5 = await kill_co_and_wait(pid4)        # fresh budget -> restart
+        assert pid5 != pid4, "restart budget must be reset after reload"
+        app.terminate()
+        await asyncio.wait_for(run_task, timeout=20)
+        return True
+
+    assert run(scenario(), timeout=60)
+
+
+@pytest.mark.skipif(not os.path.exists(CPSUP), reason="cpsup not built")
+def test_cpsup_reaps_zombies():
+    """integration test_reap_zombies: orphans reparented onto cpsup get
+    reaped (reference asserts <=1 transient zombie)."""
+    # worker double-forks: the intermediate parent exits so the
+    # grandchild (which exits fast) reparents to cpsup as a zombie
+    script = (
+        "for i in 1 2 3; do (sh -c 'sleep 0.2' &) ; done; sleep 2"
+    )
+    proc = subprocess.Popen(
+        [CPSUP, "/bin/sh", "-c", script],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+    )
+    try:
+        time.sleep(1.2)  # grandchildren exited; cpsup should have reaped
+        zombies = 0
+        for pid_dir in os.listdir("/proc"):
+            if not pid_dir.isdigit():
+                continue
+            try:
+                with open(f"/proc/{pid_dir}/stat") as f:
+                    fields = f.read().split()
+                if fields[2] == "Z" and int(fields[3]) == proc.pid:
+                    zombies += 1
+            except OSError:
+                continue
+        assert zombies <= 1, f"cpsup left {zombies} zombies"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+@pytest.mark.skipif(not os.path.exists(CPSUP), reason="cpsup not built")
+def test_cpsup_forwards_term_and_propagates_exit():
+    proc = subprocess.Popen(
+        [CPSUP, "/bin/sh", "-c", "trap 'exit 9' TERM; sleep 30 & wait"],
+        stdout=subprocess.PIPE,
+    )
+    time.sleep(0.5)
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=10) == 9
+
+
+def test_sigusr1_reopens_log_file(run, tmp_path):
+    """integration test_reopen: after the log file is rotated away,
+    SIGUSR1 makes the supervisor reopen it at the configured path."""
+    log_path = tmp_path / "cp.log"
+    rotated = tmp_path / "cp.log.1"
+    path = write_config(
+        tmp_path,
+        """
+        {
+          stopTimeout: "1ms",
+          logging: { level: "INFO", output: "%s" },
+          jobs: [
+            {
+              name: "chatty",
+              exec: ["/bin/sh", "-c", "echo hello"],
+              when: { interval: "200ms" },
+            },
+          ],
+        }
+        """
+        % log_path,
+    )
+
+    async def scenario():
+        app = App.from_config_path(path)
+        run_task = asyncio.get_event_loop().create_task(app.run())
+        await asyncio.sleep(0.6)
+        os.rename(log_path, rotated)  # logrotate
+        from containerpilot_tpu.config.logger import reopen_log_file
+
+        reopen_log_file()  # what the SIGUSR1 handler calls
+        await asyncio.sleep(0.8)
+        app.terminate()
+        await asyncio.wait_for(run_task, timeout=20)
+        return log_path.exists() and log_path.stat().st_size > 0
+
+    assert run(scenario(), timeout=30)
+
+
+def test_version_flag_cli():
+    """integration test_version_flag."""
+    out = subprocess.run(
+        [sys.executable, "-m", "containerpilot_tpu", "-version"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert out.returncode == 0
+    assert "Version:" in out.stdout
+
+
+def test_no_command_is_error():
+    """integration test_no_command: missing config is a clean error."""
+    out = subprocess.run(
+        [sys.executable, "-m", "containerpilot_tpu"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+        env={**os.environ, "CONTAINERPILOT": ""},
+    )
+    assert out.returncode == 1
+    assert "-config flag is required" in out.stderr
