@@ -38,6 +38,10 @@ class TransformerConfig:
     max_seq_len: int = 2048
     rope_theta: float = 10_000.0
     dtype: Any = jnp.bfloat16  # compute dtype
+    # attention override: None = XLA causal attention; set to e.g. a
+    # mesh-bound ring_attention for context parallelism
+    # (parallel/context.py)
+    attention_fn: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -115,7 +119,8 @@ def _layer(
     v = jnp.einsum("bsd,dhk->bshk", h, layer_params["wv"].astype(dt),
                    preferred_element_type=jnp.float32).astype(dt)
     q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
-    attn = causal_attention(q, k, v)
+    attn_fn = cfg.attention_fn or causal_attention
+    attn = attn_fn(q, k, v)
     attn_out = jnp.einsum("bshk,hkd->bsd", attn,
                           layer_params["wo"].astype(dt),
                           preferred_element_type=jnp.float32).astype(dt)

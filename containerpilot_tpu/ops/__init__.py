@@ -1,4 +1,6 @@
-"""Op library for the TPU workload: attention (XLA + pallas flash)."""
+"""Op library for the TPU workload: attention (XLA + pallas flash +
+ring/context-parallel)."""
 from .attention import causal_attention, flash_attention_forward
+from .ring_attention import ring_attention
 
-__all__ = ["causal_attention", "flash_attention_forward"]
+__all__ = ["causal_attention", "flash_attention_forward", "ring_attention"]

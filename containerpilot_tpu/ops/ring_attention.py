@@ -1,0 +1,161 @@
+"""Ring attention: causal attention with the sequence sharded over a
+mesh axis.
+
+Long-context sequence/context parallelism, TPU-native: each device
+holds a contiguous sequence shard of Q, K, V. K/V blocks rotate around
+the ring via ``lax.ppermute`` (neighbor exchange rides ICI) while every
+device accumulates its queries' attention with blockwise online softmax
+— O(local_seq) memory per device, full-sequence numerics identical to
+single-device causal attention.
+
+Step s gives device i the K/V block that originated on device
+``(i - s) mod P``; global positions make the causal mask exact across
+shards. Step 0 is the device's own (diagonal) block, so every query row
+is live from the first step and the running max is never -inf when it
+matters.
+
+The public technique (blockwise ring attention; see PAPERS.md) is
+implemented fresh against jax shard_map/ppermute.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .attention import NEG_INF
+
+import inspect
+
+try:  # stable API from jax 0.6+; experimental path for older
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication-check kwarg was renamed check_rep -> check_vma across
+# jax versions; resolve once
+_CHECK_KWARG = (
+    "check_vma"
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+del inspect
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    return _shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{_CHECK_KWARG: False},
+    )
+
+def _ring_shard_fn(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+) -> jax.Array:
+    """Per-device body; runs under shard_map. Shapes are the local
+    shards: [batch, local_seq, heads, head_dim]."""
+    idx = lax.axis_index(axis_name)
+    b, lq, h, hd = q.shape
+    scale = hd ** -0.5
+    qf = q.astype(jnp.float32) * scale
+
+    q_pos = idx * lq + jnp.arange(lq, dtype=jnp.int32)
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def step(s, carry):
+        k_blk, v_blk, m, l, acc = carry
+        src = (idx - s) % axis_size
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            qf,
+            k_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        k_pos = src * lq + jnp.arange(lq, dtype=jnp.int32)
+        mask = q_pos[:, None] >= k_pos[None, :]  # [lq, lk] global causal
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))  # [b,h,lq]
+        # fully-masked-so-far rows keep m at NEG_INF; guard the exps
+        m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        correction = jnp.where(
+            m <= NEG_INF / 2, 0.0, jnp.exp(m - m_safe)
+        )
+        p = jnp.exp(scores - m_safe[..., None])
+        p = jnp.where(mask[None, None], p, 0.0)
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        acc_new = acc * correction[..., None].transpose(0, 2, 1, 3) + (
+            jnp.einsum(
+                "bhqk,bkhd->bqhd",
+                p,
+                v_blk.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+        )
+        # rotate K/V to the next device in the ring; the final
+        # iteration's rotation would be discarded, so skip it
+        k_blk, v_blk = lax.cond(
+            s < axis_size - 1,
+            lambda kv: (
+                lax.ppermute(kv[0], axis_name, perm),
+                lax.ppermute(kv[1], axis_name, perm),
+            ),
+            lambda kv: kv,
+            (k_blk, v_blk),
+        )
+        return k_blk, v_blk, m_new, l_new, acc_new
+
+    m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    acc0 = jnp.zeros((b, lq, h, hd), jnp.float32)
+    _k, _v, _m, l, acc = lax.fori_loop(
+        0, axis_size, step, (k, v, m0, l0, acc0)
+    )
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]  # [b,lq,h,1]
+    return (acc / denom).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "seq",
+) -> jax.Array:
+    """Causal attention with [batch, seq, heads, head_dim] inputs whose
+    sequence dimension is sharded over ``axis_name`` of ``mesh``.
+
+    The global sequence length must divide evenly by the axis size.
+    """
+    if axis_name not in mesh.axis_names:
+        raise ValueError(f"mesh has no {axis_name!r} axis: {mesh.axis_names}")
+    axis_size = mesh.shape[axis_name]
+    if q.shape[1] % axis_size:
+        raise ValueError(
+            f"seq len {q.shape[1]} not divisible by {axis_name}={axis_size}"
+        )
+    # keep batch/head sharding on their own axes inside the shard_map so
+    # entering it doesn't all-gather what dp/tp already sharded
+    batch_axis = "data" if "data" in mesh.axis_names else None
+    head_axis = "model" if "model" in mesh.axis_names else None
+    spec = P(batch_axis, axis_name, head_axis, None)
+    fn = shard_map(
+        functools.partial(
+            _ring_shard_fn, axis_name=axis_name, axis_size=axis_size
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

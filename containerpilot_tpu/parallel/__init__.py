@@ -7,12 +7,14 @@ supervises coordinates *chips* through jax.sharding: pick a Mesh,
 annotate shardings, and let XLA insert the collectives over ICI/DCN
 (SURVEY.md §5 distributed-backend mapping).
 """
+from .context import context_parallel_config
 from .mesh import MeshPlan, make_mesh
 from .sharding import param_sharding_rules, shard_params
 from .train import TrainState, make_train_step, init_train_state
 
 __all__ = [
     "MeshPlan",
+    "context_parallel_config",
     "make_mesh",
     "param_sharding_rules",
     "shard_params",

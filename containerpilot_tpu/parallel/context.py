@@ -1,0 +1,32 @@
+"""Context parallelism: bind ring attention into the model config.
+
+Long sequences are sharded over the mesh's ``seq`` axis; attention runs
+as a ring (ops/ring_attention.py) while every other op stays local and
+XLA partitions it from the shard_map boundary's in/out specs. The rest
+of the stack — sharding rules, optimizer, train step — is unchanged:
+context parallelism composes with tensor and data parallelism by
+construction.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from jax.sharding import Mesh
+
+from ..models.transformer import TransformerConfig
+from ..ops.ring_attention import ring_attention
+
+
+def context_parallel_config(
+    cfg: TransformerConfig, mesh: Mesh, axis_name: str = "seq"
+) -> TransformerConfig:
+    """A config whose attention runs as a ring over ``axis_name``."""
+    if axis_name not in mesh.axis_names:
+        raise ValueError(
+            f"mesh has no {axis_name!r} axis: {mesh.axis_names}"
+        )
+
+    def attn(q, k, v):
+        return ring_attention(q, k, v, mesh, axis_name)
+
+    return dataclasses.replace(cfg, attention_fn=attn)

@@ -23,14 +23,19 @@ from jax.sharding import Mesh
 
 @dataclass(frozen=True)
 class MeshPlan:
-    """A named factorization of the device count."""
+    """A named factorization of the device count.
+
+    ``seq`` > 1 adds a context-parallel axis for ring attention over
+    long sequences (ops/ring_attention.py).
+    """
 
     data: int
     model: int
+    seq: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.data * self.model
+        return self.data * self.model * self.seq
 
 
 def _factor(n: int, max_model: int) -> MeshPlan:
@@ -48,7 +53,12 @@ def make_mesh(
     plan: Optional[MeshPlan] = None,
     max_model: int = 4,
 ) -> Mesh:
-    """Build a ("data", "model") mesh over the given (or all) devices."""
+    """Build a mesh over the given (or all) devices.
+
+    Axis names are ("data", "model") for 2D plans, or
+    ("data", "seq", "model") when the plan's ``seq`` > 1 (context
+    parallelism — see ops/ring_attention.py).
+    """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
@@ -58,5 +68,8 @@ def make_mesh(
         raise ValueError(
             f"mesh plan {plan} does not cover {n} devices"
         )
+    if plan.seq > 1:
+        grid = np.asarray(devices).reshape(plan.data, plan.seq, plan.model)
+        return Mesh(grid, axis_names=("data", "seq", "model"))
     grid = np.asarray(devices).reshape(plan.data, plan.model)
     return Mesh(grid, axis_names=("data", "model"))

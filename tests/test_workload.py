@@ -127,3 +127,68 @@ def test_graft_entry_points():
     out = jax.jit(fn)(*args)
     assert out.shape[-1] == 256
     graft.dryrun_multichip(8)
+
+
+def test_ring_attention_matches_single_device():
+    """Context-parallel ring attention over a 4-way seq axis must match
+    single-device causal attention exactly in structure and closely in
+    numerics."""
+    from containerpilot_tpu.ops import ring_attention
+    from containerpilot_tpu.parallel import MeshPlan, make_mesh
+
+    mesh = make_mesh(jax.devices()[:8], plan=MeshPlan(data=2, model=1, seq=4))
+    assert mesh.axis_names == ("data", "seq", "model")
+    rng = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(rng, 3)
+    shape = (2, 128, 2, 32)  # [batch, seq, heads, head_dim]
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+    ref = causal_attention(q, k, v)
+    ring = ring_attention(q, k, v, mesh)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(ring), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_ring_attention_validates_inputs():
+    from containerpilot_tpu.ops import ring_attention
+    from containerpilot_tpu.parallel import MeshPlan, make_mesh
+
+    mesh2d = make_mesh(jax.devices()[:8])  # no seq axis
+    q = jnp.zeros((1, 64, 2, 16))
+    with pytest.raises(ValueError, match="no 'seq' axis"):
+        ring_attention(q, q, q, mesh2d)
+    mesh3d = make_mesh(jax.devices()[:8], plan=MeshPlan(2, 1, 4))
+    q_ragged = jnp.zeros((1, 66, 2, 16))
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_attention(q_ragged, q_ragged, q_ragged, mesh3d)
+
+
+def test_context_parallel_train_step():
+    """Full dp x sp x tp train step with ring attention inside the
+    model: loss must match the XLA-attention step closely."""
+    from containerpilot_tpu.parallel import context_parallel_config
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq_len=64,
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(7), (4, 65), 0, cfg.vocab_size, jnp.int32
+    )
+    # reference: plain 2D mesh step
+    mesh2 = make_mesh(jax.devices()[:8], plan=MeshPlan(data=4, model=2))
+    state2 = init_train_state(jax.random.PRNGKey(0), cfg, mesh2)
+    _, loss2 = make_train_step(cfg, mesh2)(state2, tokens)
+    # context-parallel: 3D mesh, ring attention in the forward
+    mesh3 = make_mesh(
+        jax.devices()[:8], plan=MeshPlan(data=2, seq=2, model=2)
+    )
+    cfg3 = context_parallel_config(cfg, mesh3)
+    state3 = init_train_state(jax.random.PRNGKey(0), cfg3, mesh3)
+    _, loss3 = make_train_step(cfg3, mesh3)(state3, tokens)
+    assert bool(jnp.isfinite(loss3))
+    np.testing.assert_allclose(
+        float(loss2), float(loss3), rtol=5e-3
+    )
