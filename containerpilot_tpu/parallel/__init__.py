@@ -7,6 +7,7 @@ supervises coordinates *chips* through jax.sharding: pick a Mesh,
 annotate shardings, and let XLA insert the collectives over ICI/DCN
 (SURVEY.md §5 distributed-backend mapping).
 """
+from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
 from .context import context_parallel_config
 from .mesh import MeshPlan, make_mesh
 from .sharding import param_sharding_rules, shard_params
@@ -21,4 +22,7 @@ __all__ = [
     "TrainState",
     "make_train_step",
     "init_train_state",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_step",
 ]

@@ -1,0 +1,106 @@
+"""Checkpoint save/restore for supervised training.
+
+The supervisor restarts a crashed trainer (restart budgets,
+health-check failures); the trainer resumes from its latest checkpoint
+— together they give crash-fault tolerance the reference can't express
+(its closest analog is config reload preserving container uptime,
+reference: SURVEY.md §5 checkpoint/resume row).
+
+Layout: <dir>/step_<n>/ orbax checkpoints; ``latest_step`` scans for
+the newest complete one. Saves are atomic (orbax writes to a tmp dir
+and renames), so a crash mid-save can't corrupt the resume point.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import re
+import shutil
+from typing import Any, Optional
+
+import jax
+
+log = logging.getLogger("containerpilot.checkpoint")
+
+_STEP_DIR = re.compile(r"^step_(\d+)$")
+
+# older checkpoints kept after each save (crash tolerance only needs
+# the latest; one spare guards against a corrupt newest)
+KEEP_CHECKPOINTS = 2
+
+_checkpointer = None
+
+
+def _get_checkpointer():
+    """One orbax checkpointer per process; orbax imported lazily so the
+    supervisor half never needs it installed."""
+    global _checkpointer
+    if _checkpointer is None:
+        import orbax.checkpoint as ocp
+
+        _checkpointer = ocp.StandardCheckpointer()
+    return _checkpointer
+
+
+def _step_path(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), f"step_{step}")
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Newest complete checkpoint step in the directory, if any."""
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return None
+    steps = []
+    for entry in entries:
+        # the anchored regex admits only completed "step_<n>" dirs;
+        # orbax's in-progress tmp dirs carry a suffix and never match
+        m = _STEP_DIR.match(entry)
+        if m:
+            steps.append(int(m.group(1)))
+    return max(steps) if steps else None
+
+
+def _prune(directory: str, keep: int) -> None:
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return
+    steps = sorted(
+        int(m.group(1)) for e in entries if (m := _STEP_DIR.match(e))
+    )
+    for step in steps[:-keep] if keep > 0 else []:
+        path = _step_path(directory, step)
+        log.debug("checkpoint: pruning %s", path)
+        shutil.rmtree(path, ignore_errors=True)
+
+
+def save_checkpoint(
+    directory: str, step: int, state: Any, keep: int = KEEP_CHECKPOINTS
+) -> None:
+    ckptr = _get_checkpointer()
+    ckptr.save(_step_path(directory, step), state, force=True)
+    ckptr.wait_until_finished()
+    _prune(directory, keep)
+    log.info("checkpoint: saved step %d to %s", step, directory)
+
+
+def restore_checkpoint(directory: str, state_like: Any) -> Optional[Any]:
+    """Restore the latest checkpoint into the structure (and shardings)
+    of ``state_like``; None when no checkpoint exists."""
+    step = latest_step(directory)
+    if step is None:
+        return None
+
+    def to_abstract(x: Any) -> Any:
+        # carry shardings through so the restore lands arrays exactly
+        # where the training step expects them (replicated scalars
+        # included)
+        sharding = getattr(x, "sharding", None)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+    abstract = jax.tree.map(to_abstract, state_like)
+    restored = _get_checkpointer().restore(_step_path(directory, step), abstract)
+    log.info("checkpoint: restored step %d from %s", step, directory)
+    return restored

@@ -14,7 +14,8 @@ from typing import Any, Callable, Tuple
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
 
 from ..models.transformer import TransformerConfig, init_params, loss_fn
 from .sharding import batch_spec, param_sharding_rules, shard_params
@@ -44,8 +45,20 @@ def init_train_state(
     params = shard_params(init_params(rng, cfg), mesh)
     optimizer = make_optimizer(learning_rate)
     opt_state = optimizer.init(params)
+    # moment tensors inherit the param shardings; scalar leaves (adam
+    # count etc.) land on the default device — commit them replicated so
+    # checkpoint-restored states (which ARE committed) match exactly
+    replicated = NamedSharding(mesh, P())
+    opt_state = jax.tree.map(
+        lambda x: jax.device_put(x, replicated)
+        if getattr(x, "ndim", None) == 0
+        else x,
+        opt_state,
+    )
     return TrainState(
-        params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32)
+        params=params,
+        opt_state=opt_state,
+        step=jax.device_put(jnp.zeros((), jnp.int32), replicated),
     )
 
 

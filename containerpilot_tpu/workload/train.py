@@ -38,6 +38,8 @@ def main() -> int:
     parser.add_argument("--progress-file", default="")
     parser.add_argument("--control-socket", default="")
     parser.add_argument("--learning-rate", type=float, default=3e-4)
+    parser.add_argument("--checkpoint-dir", default="")
+    parser.add_argument("--checkpoint-every", type=int, default=50)
     args = parser.parse_args()
 
     from ..models.transformer import TransformerConfig
@@ -58,6 +60,16 @@ def main() -> int:
     state = init_train_state(rng, cfg, mesh, args.learning_rate)
     train_step = make_train_step(cfg, mesh, args.learning_rate)
 
+    start_step = 0
+    if args.checkpoint_dir:
+        from ..parallel import restore_checkpoint, save_checkpoint
+
+        restored = restore_checkpoint(args.checkpoint_dir, state)
+        if restored is not None:
+            state = restored
+            start_step = int(state.step)
+            print(f"resumed from checkpoint at step {start_step}")
+
     client = None
     if args.control_socket:
         from ..client import ControlClient
@@ -66,12 +78,16 @@ def main() -> int:
 
     data_rng = jax.random.PRNGKey(1)
     t0 = time.monotonic()
-    for step in range(args.steps):
-        data_rng, k = jax.random.split(data_rng)
+    for step in range(start_step, args.steps):
+        # stateless per-step key: a resumed run continues the data
+        # stream exactly where the crashed run left off
+        k = jax.random.fold_in(data_rng, step)
         tokens = jax.random.randint(
             k, (args.batch, args.seq_len + 1), 0, cfg.vocab_size, jnp.int32
         )
         state, loss = train_step(state, tokens)
+        if args.checkpoint_dir and (step + 1) % args.checkpoint_every == 0:
+            save_checkpoint(args.checkpoint_dir, step + 1, state)
         if args.progress_file:
             tmp = args.progress_file + ".tmp"
             with open(tmp, "w") as f:
@@ -84,8 +100,8 @@ def main() -> int:
                                    "training_loss": float(loss)})
             except Exception:
                 pass  # the supervisor may be reloading; never die for this
-        if (step + 1) % 10 == 0 or step == 0:
-            rate = (step + 1) / (time.monotonic() - t0)
+        if (step + 1) % 10 == 0 or step == start_step:
+            rate = (step + 1 - start_step) / (time.monotonic() - t0)
             print(f"step {step + 1}: loss={float(loss):.4f} "
                   f"({rate:.1f} steps/s)")
     return 0
