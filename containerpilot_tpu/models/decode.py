@@ -1,0 +1,184 @@
+"""Incremental decoding: prefill + single-token steps with a KV cache.
+
+TPU-first inference for the flagship transformer:
+
+- static shapes throughout — the cache is allocated at ``max_len`` and
+  masked by position, so XLA compiles exactly two programs (prefill and
+  decode step) regardless of generation length;
+- the decode loop is a ``lax.scan`` over steps, the layer stack a
+  ``lax.scan`` over stacked layer params (same as training);
+- greedy or temperature sampling.
+
+Numerics are identical to the full forward: the parity test asserts
+incremental logits match ``forward``'s per-position logits.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .transformer import (
+    Params,
+    TransformerConfig,
+    _attn_out,
+    _mlp,
+    _qkv,
+    _rms_norm,
+)
+from ..ops.attention import NEG_INF, causal_attention
+
+Cache = Dict[str, jax.Array]
+
+
+def init_cache(
+    cfg: TransformerConfig, batch: int, max_len: int
+) -> Cache:
+    """Zeroed KV cache: k/v are [layers, batch, max_len, heads, head_dim]."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((), jnp.int32),  # number of tokens cached
+    }
+
+
+def _logits(params: Params, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    x = _rms_norm(x, params["norm_out"])
+    return jnp.einsum(
+        "bsd,dv->bsv", x, params["unembed"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def prefill(
+    params: Params, tokens: jax.Array, cfg: TransformerConfig, max_len: int
+) -> Tuple[jax.Array, Cache]:
+    """Process the prompt; returns (logits for the last position, cache).
+
+    tokens: [batch, prompt_len] int32; prompt_len <= max_len.
+    """
+    b, s = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    attn_fn = cfg.attention_fn or causal_attention
+
+    def body(carry, layer_params):
+        q, k, v = _qkv(carry, layer_params, cfg)
+        attn = attn_fn(q, k, v)
+        out = _mlp(_attn_out(carry, attn, layer_params, cfg), layer_params, cfg)
+        return out, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["layers"])
+    cache = init_cache(cfg, b, max_len)
+    cache["k"] = lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, 0, 0))
+    cache["v"] = lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, 0, 0))
+    cache["pos"] = jnp.asarray(s, jnp.int32)
+    logits = _logits(params, x[:, -1:, :], cfg)
+    return logits[:, 0, :], cache
+
+
+def decode_step(
+    params: Params, cache: Cache, token: jax.Array, cfg: TransformerConfig
+) -> Tuple[jax.Array, Cache]:
+    """One autoregressive step. token: [batch] int32 (the token at
+    position cache['pos']); returns (logits [batch, vocab], new cache)."""
+    pos = cache["pos"]
+    b = token.shape[0]
+    max_len = cache["k"].shape[2]
+    x = params["embed"].astype(cfg.dtype)[token][:, None, :]  # [b,1,d]
+    valid = jnp.arange(max_len) <= pos  # [max_len]; pos itself is valid
+
+    def body(carry, inputs):
+        x = carry
+        layer_params, k_cache, v_cache = inputs
+        q, k, v = _qkv(x, layer_params, cfg, offset=pos)
+        # write this step's k/v at position pos
+        k_cache = lax.dynamic_update_slice(
+            k_cache, k, (0, pos, 0, 0)
+        )
+        v_cache = lax.dynamic_update_slice(
+            v_cache, v, (0, pos, 0, 0)
+        )
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32) * cfg.head_dim ** -0.5,
+            k_cache.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )  # [b, h, 1, max_len]
+        scores = jnp.where(valid[None, None, None, :], scores, NEG_INF)
+        weights = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum(
+            "bhqk,bkhd->bqhd", weights, v_cache,
+            preferred_element_type=jnp.float32,
+        ).astype(cfg.dtype)
+        x = _attn_out(x, attn, layer_params, cfg)
+        x = _mlp(x, layer_params, cfg)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    logits = _logits(params, x, cfg)[:, 0, :]
+    new_cache = {"k": new_k, "v": new_v, "pos": pos + 1}
+    return logits, new_cache
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_generate(cfg: TransformerConfig, max_new_tokens: int,
+                     max_len: int, greedy: bool):
+    """One compiled program per (config, lengths, sampling mode); jit's
+    own cache covers distinct prompt lengths."""
+
+    def fn(params, prompt, rng, temperature):
+        logits, cache = prefill(params, prompt, cfg, max_len)
+
+        def sample(logits, key):
+            if greedy:
+                return jnp.argmax(logits, axis=-1)
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+
+        first_key, scan_key = jax.random.split(rng)
+        first = sample(logits, first_key).astype(jnp.int32)
+
+        def step(carry, key):
+            cache, token = carry
+            logits, cache = decode_step(params, cache, token, cfg)
+            next_token = sample(logits, key).astype(jnp.int32)
+            return (cache, next_token), next_token
+
+        keys = jax.random.split(scan_key, max_new_tokens - 1)
+        (_cache, _last), rest = lax.scan(step, (cache, first), keys)
+        return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+    return jax.jit(fn)
+
+
+def generate(
+    params: Params,
+    prompt: jax.Array,
+    cfg: TransformerConfig,
+    max_new_tokens: int,
+    max_len: int,
+    temperature: float = 0.0,
+    rng: jax.Array = None,
+) -> jax.Array:
+    """Autoregressive generation. prompt: [batch, prompt_len] int32;
+    returns [batch, max_new_tokens] int32."""
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if prompt.shape[1] + max_new_tokens > max_len:
+        # an overflowing decode would silently clamp cache writes onto
+        # the last slot and return garbage — fail loudly instead
+        raise ValueError(
+            f"prompt_len {prompt.shape[1]} + max_new_tokens "
+            f"{max_new_tokens} exceeds max_len {max_len}"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    fn = _jitted_generate(cfg, max_new_tokens, max_len, temperature <= 0.0)
+    return fn(params, prompt, rng, jnp.float32(max(temperature, 1e-6)))

@@ -92,25 +92,29 @@ def _rms_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
     return (x * lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale.astype(x.dtype)
 
 
-def _rope(x: jax.Array, theta: float) -> jax.Array:
+def _rope(x: jax.Array, theta: float, offset: Any = 0) -> jax.Array:
     """Rotary position embedding over the last (head_dim) axis.
-    x: [batch, seq, heads, head_dim]."""
+    x: [batch, seq, heads, head_dim]; ``offset`` shifts the absolute
+    positions (needed by incremental decoding — models/decode.py)."""
     b, s, h, hd = x.shape
     half = hd // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs[None, :]
+    positions = offset + jnp.arange(s, dtype=jnp.float32)
+    angles = positions[:, None] * freqs[None, :]
     cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
     sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
-def _layer(
-    x: jax.Array, layer_params: Dict[str, jax.Array], cfg: TransformerConfig
-) -> jax.Array:
-    """One transformer block. x: [batch, seq, d_model] in compute dtype."""
+def _qkv(
+    x: jax.Array,
+    layer_params: Dict[str, jax.Array],
+    cfg: TransformerConfig,
+    offset: Any = 0,
+):
+    """Pre-norm + q/k/v projections with RoPE applied at ``offset``."""
     dt = cfg.dtype
-    # -- attention --
     h = _rms_norm(x, layer_params["norm_attn"])
     q = jnp.einsum("bsd,dhk->bshk", h, layer_params["wq"].astype(dt),
                    preferred_element_type=jnp.float32).astype(dt)
@@ -118,14 +122,30 @@ def _layer(
                    preferred_element_type=jnp.float32).astype(dt)
     v = jnp.einsum("bsd,dhk->bshk", h, layer_params["wv"].astype(dt),
                    preferred_element_type=jnp.float32).astype(dt)
-    q, k = _rope(q, cfg.rope_theta), _rope(k, cfg.rope_theta)
-    attn_fn = cfg.attention_fn or causal_attention
-    attn = attn_fn(q, k, v)
+    q = _rope(q, cfg.rope_theta, offset)
+    k = _rope(k, cfg.rope_theta, offset)
+    return q, k, v
+
+
+def _attn_out(
+    x: jax.Array,
+    attn: jax.Array,
+    layer_params: Dict[str, jax.Array],
+    cfg: TransformerConfig,
+) -> jax.Array:
+    """Output projection + residual."""
+    dt = cfg.dtype
     attn_out = jnp.einsum("bshk,hkd->bsd", attn,
                           layer_params["wo"].astype(dt),
                           preferred_element_type=jnp.float32).astype(dt)
-    x = x + attn_out
-    # -- SwiGLU MLP --
+    return x + attn_out
+
+
+def _mlp(
+    x: jax.Array, layer_params: Dict[str, jax.Array], cfg: TransformerConfig
+) -> jax.Array:
+    """SwiGLU block + residual."""
+    dt = cfg.dtype
     h = _rms_norm(x, layer_params["norm_mlp"])
     gate = jnp.einsum("bsd,df->bsf", h, layer_params["w_gate"].astype(dt),
                       preferred_element_type=jnp.float32)
@@ -135,6 +155,17 @@ def _layer(
     down = jnp.einsum("bsf,fd->bsd", act, layer_params["w_down"].astype(dt),
                       preferred_element_type=jnp.float32).astype(dt)
     return x + down
+
+
+def _layer(
+    x: jax.Array, layer_params: Dict[str, jax.Array], cfg: TransformerConfig
+) -> jax.Array:
+    """One transformer block. x: [batch, seq, d_model] in compute dtype."""
+    q, k, v = _qkv(x, layer_params, cfg)
+    attn_fn = cfg.attention_fn or causal_attention
+    attn = attn_fn(q, k, v)
+    x = _attn_out(x, attn, layer_params, cfg)
+    return _mlp(x, layer_params, cfg)
 
 
 def forward(

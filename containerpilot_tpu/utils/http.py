@@ -80,6 +80,13 @@ class HTTPServer:
     async def start_tcp(self, host: str, port: int) -> None:
         self._server = await asyncio.start_server(self._handle, host, port)
 
+    @property
+    def bound_port(self) -> Optional[int]:
+        """The actual TCP port after binding (useful with port 0)."""
+        if self._server is None or not self._server.sockets:
+            return None
+        return self._server.sockets[0].getsockname()[1]
+
     async def start_unix(self, path: str) -> None:
         self._server = await asyncio.start_unix_server(self._handle, path)
 

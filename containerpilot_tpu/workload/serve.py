@@ -1,0 +1,206 @@
+"""A supervised inference server: the serving half of the demo workload.
+
+One server process per TPU host, supervised by containerpilot-tpu:
+health-checked over ``GET /health`` (so a wedged server goes
+catalog-critical and restarts), advertised in the catalog by its job's
+``port``, optionally loading weights from a training checkpoint dir.
+
+API (token-level; tokenization is the caller's concern):
+
+    POST /v1/generate {"tokens": [[1,2,3]], "max_new_tokens": 16,
+                       "temperature": 0.0}
+        -> {"tokens": [[...generated ids...]]}
+    GET /health   -> 200 once the model is compiled and warm
+    GET /v1/model -> config summary
+
+Generation runs on a worker thread so the asyncio loop (health checks
+included) never blocks on TPU execution.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.decode import generate
+from ..models.transformer import TransformerConfig, init_params
+from ..utils.http import HTTPServer, Request, Response
+
+log = logging.getLogger("containerpilot.serve")
+
+
+class InferenceServer:
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        params: Any,
+        host: str,
+        port: int,
+        max_len: int,
+    ) -> None:
+        self.cfg = cfg
+        self.params = params
+        self.host = host
+        self.port = port
+        self.max_len = max_len
+        self.ready = False
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="inference"
+        )
+        self._server = HTTPServer()
+        self._server.route("GET", "/health", self._health)
+        self._server.route("GET", "/v1/model", self._model_info)
+        self._server.route("POST", "/v1/generate", self._generate)
+
+    # -- handlers -------------------------------------------------------
+
+    async def _health(self, _req: Request) -> Response:
+        if not self.ready:
+            return Response(503, b"warming up\n")
+        return Response(200, b"ok\n")
+
+    async def _model_info(self, _req: Request) -> Response:
+        body = json.dumps(
+            {
+                "vocab_size": self.cfg.vocab_size,
+                "d_model": self.cfg.d_model,
+                "n_heads": self.cfg.n_heads,
+                "n_layers": self.cfg.n_layers,
+                "max_len": self.max_len,
+            }
+        ).encode()
+        return Response(200, body, content_type="application/json")
+
+    async def _generate(self, req: Request) -> Response:
+        try:
+            body = json.loads(req.body.decode() or "{}")
+            tokens = body["tokens"]
+            if not isinstance(tokens, list) or not tokens or not all(
+                isinstance(row, list) and row for row in tokens
+            ):
+                raise ValueError("'tokens' must be a non-empty list of lists")
+            max_new = int(body.get("max_new_tokens", 16))
+            temperature = float(body.get("temperature", 0.0))
+            seed = int(body.get("seed", 0))
+            prompt_len = len(tokens[0])
+            if any(len(row) != prompt_len for row in tokens):
+                raise ValueError("all prompts must share a length (pad first)")
+            if prompt_len + max_new > self.max_len:
+                raise ValueError(
+                    f"prompt_len + max_new_tokens exceeds max_len "
+                    f"{self.max_len}"
+                )
+            if max_new < 1:
+                raise ValueError("max_new_tokens must be >= 1")
+            vocab = self.cfg.vocab_size
+            if any(t < 0 or t >= vocab for row in tokens for t in row):
+                raise ValueError(f"token ids must be in [0, {vocab})")
+        except (ValueError, KeyError, TypeError) as exc:
+            return Response(422, f"{exc}\n".encode())
+
+        def run() -> Any:
+            prompt = jnp.asarray(tokens, jnp.int32)
+            out = generate(
+                self.params,
+                prompt,
+                self.cfg,
+                max_new_tokens=max_new,
+                max_len=self.max_len,
+                temperature=temperature,
+                rng=jax.random.PRNGKey(seed),
+            )
+            return jax.device_get(out).tolist()
+
+        loop = asyncio.get_event_loop()
+        generated = await loop.run_in_executor(self._executor, run)
+        return Response(
+            200,
+            json.dumps({"tokens": generated}).encode(),
+            content_type="application/json",
+        )
+
+    # -- lifecycle ------------------------------------------------------
+
+    async def warmup(self) -> None:
+        """Compile prefill+decode before reporting healthy."""
+
+        def run() -> None:
+            prompt = jnp.zeros((1, 4), jnp.int32)
+            generate(
+                self.params, prompt, self.cfg, max_new_tokens=2,
+                max_len=self.max_len,
+            )
+
+        await asyncio.get_event_loop().run_in_executor(self._executor, run)
+        self.ready = True
+        log.info("serve: model warm; accepting traffic")
+
+    async def run(self) -> None:
+        await self._server.start_tcp(self.host, self.port)
+        self.port = self._server.bound_port or self.port
+        log.info("serve: listening on %s:%d", self.host, self.port)
+        await self.warmup()
+
+    async def stop(self) -> None:
+        await self._server.stop()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--max-len", type=int, default=512)
+    parser.add_argument("--d-model", type=int, default=256)
+    parser.add_argument("--n-layers", type=int, default=2)
+    parser.add_argument("--n-heads", type=int, default=4)
+    parser.add_argument("--vocab", type=int, default=1024)
+    parser.add_argument(
+        "--checkpoint-dir", default="",
+        help="load trained params from the latest checkpoint",
+    )
+    args = parser.parse_args()
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab,
+        d_model=args.d_model,
+        n_heads=args.n_heads,
+        n_layers=args.n_layers,
+        d_ff=args.d_model * 3 // 128 * 128 or 128,
+        max_seq_len=args.max_len,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    if args.checkpoint_dir:
+        from ..parallel import make_mesh, init_train_state, restore_checkpoint
+
+        mesh = make_mesh()
+        state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
+        restored = restore_checkpoint(args.checkpoint_dir, state)
+        if restored is not None:
+            params = restored.params
+            print(f"serving checkpoint step {int(restored.step)}")
+
+    server = InferenceServer(cfg, params, args.host, args.port, args.max_len)
+
+    async def serve() -> None:
+        import signal as signal_mod
+
+        await server.run()
+        stop = asyncio.Event()
+        loop = asyncio.get_event_loop()
+        for sig in (signal_mod.SIGTERM, signal_mod.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await server.stop()
+
+    asyncio.run(serve())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

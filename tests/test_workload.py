@@ -237,3 +237,109 @@ def test_checkpoint_save_restore_roundtrip(tmp_path):
     import os
 
     assert sorted(os.listdir(ckdir)) == ["step_3"]
+
+
+def test_incremental_decode_matches_full_forward():
+    """Prefill + decode_step logits must equal the full forward's
+    per-position logits (teacher forcing)."""
+    from containerpilot_tpu.models.decode import decode_step, prefill
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=2, n_layers=2, d_ff=128,
+        max_seq_len=32, dtype=jnp.float32,  # f32 for tight comparison
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab_size, jnp.int32
+    )
+    full = forward(params, tokens, cfg)  # [b, 12, vocab]
+
+    # prefill on the first 6, then feed the rest one at a time
+    logits, cache = prefill(params, tokens[:, :6], cfg, max_len=16)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, 5]), rtol=2e-4, atol=2e-4
+    )
+    for i in range(6, 12):
+        logits, cache = decode_step(params, cache, tokens[:, i], cfg)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full[:, i]), rtol=2e-4, atol=2e-4,
+            err_msg=f"position {i}",
+        )
+
+
+def test_generate_greedy_deterministic():
+    from containerpilot_tpu.models.decode import generate
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (1, 4), 0, 64, jnp.int32
+    )
+    out1 = generate(params, prompt, cfg, max_new_tokens=8, max_len=16)
+    out2 = generate(params, prompt, cfg, max_new_tokens=8, max_len=16)
+    assert out1.shape == (1, 8)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    assert int(out1.min()) >= 0 and int(out1.max()) < 64
+
+
+def test_inference_server_end_to_end(run):
+    """The serving path: warmup -> health -> generate over HTTP."""
+    import urllib.request
+
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server = InferenceServer(cfg, params, "127.0.0.1", 0, max_len=32)
+
+    def fetch(path, body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{path}",
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"} if body else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as exc:
+            return exc.code, exc.read().decode()
+
+    async def scenario():
+        import asyncio
+
+        await server.run()  # includes warmup
+        loop = asyncio.get_event_loop()
+        health = await loop.run_in_executor(None, fetch, "/health")
+        gen = await loop.run_in_executor(
+            None,
+            lambda: fetch(
+                "/v1/generate",
+                {"tokens": [[1, 2, 3]], "max_new_tokens": 5},
+            ),
+        )
+        bad = await loop.run_in_executor(
+            None,
+            lambda: fetch(
+                "/v1/generate",
+                {"tokens": [[999]], "max_new_tokens": 5},
+            ),
+        )
+        await server.stop()
+        return health, gen, bad
+
+    import json
+    import urllib.error
+
+    health, gen, bad = run(scenario(), timeout=120)
+    assert health[0] == 200
+    assert gen[0] == 200
+    out = json.loads(gen[1])["tokens"]
+    assert len(out) == 1 and len(out[0]) == 5
+    assert bad[0] == 422 and "token ids" in bad[1]
