@@ -24,7 +24,7 @@ from .transformer import (
     Params,
     TransformerConfig,
     _attn_out,
-    _mlp,
+    _ffn,
     _qkv,
     _rms_norm,
 )
@@ -68,7 +68,9 @@ def prefill(
     def body(carry, layer_params):
         q, k, v = _qkv(carry, layer_params, cfg)
         attn = attn_fn(q, k, v)
-        out = _mlp(_attn_out(carry, attn, layer_params, cfg), layer_params, cfg)
+        out, _aux = _ffn(
+            _attn_out(carry, attn, layer_params, cfg), layer_params, cfg
+        )
         return out, (k, v)
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])
@@ -114,7 +116,7 @@ def decode_step(
             preferred_element_type=jnp.float32,
         ).astype(cfg.dtype)
         x = _attn_out(x, attn, layer_params, cfg)
-        x = _mlp(x, layer_params, cfg)
+        x, _aux = _ffn(x, layer_params, cfg)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = lax.scan(

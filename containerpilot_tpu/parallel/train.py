@@ -18,7 +18,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from ..models.transformer import TransformerConfig, init_params, loss_fn
-from .sharding import batch_spec, param_sharding_rules, shard_params
+from .sharding import batch_spec, shard_params
 
 
 @dataclass
@@ -42,7 +42,7 @@ def init_train_state(
     learning_rate: float = 3e-4,
 ) -> TrainState:
     """Initialize params already sharded onto the mesh."""
-    params = shard_params(init_params(rng, cfg), mesh)
+    params = shard_params(init_params(rng, cfg), mesh, cfg)
     optimizer = make_optimizer(learning_rate)
     opt_state = optimizer.init(params)
     # moment tensors inherit the param shardings; scalar leaves (adam
@@ -67,9 +67,6 @@ def make_train_step(
 ) -> Callable[[TrainState, jax.Array], Tuple[TrainState, jax.Array]]:
     """Build the jitted, donated, sharded train step."""
     optimizer = make_optimizer(learning_rate)
-    param_shardings = jax.tree_util.tree_map(
-        lambda spec: NamedSharding(mesh, spec), param_sharding_rules()
-    )
     data_sharding = NamedSharding(mesh, batch_spec())
 
     def step_fn(state: TrainState, tokens: jax.Array):

@@ -85,19 +85,25 @@ class InferenceServer:
                 isinstance(row, list) and row for row in tokens
             ):
                 raise ValueError("'tokens' must be a non-empty list of lists")
-            max_new = int(body.get("max_new_tokens", 16))
+            max_new_requested = int(body.get("max_new_tokens", 16))
             temperature = float(body.get("temperature", 0.0))
             seed = int(body.get("seed", 0))
             prompt_len = len(tokens[0])
             if any(len(row) != prompt_len for row in tokens):
                 raise ValueError("all prompts must share a length (pad first)")
-            if prompt_len + max_new > self.max_len:
+            if prompt_len + max_new_requested > self.max_len:
                 raise ValueError(
                     f"prompt_len + max_new_tokens exceeds max_len "
                     f"{self.max_len}"
                 )
-            if max_new < 1:
+            if max_new_requested < 1:
                 raise ValueError("max_new_tokens must be >= 1")
+            # bucket the compiled decode length to multiples of 16 so
+            # per-request max_new variation can't churn the jit cache
+            max_new = min(
+                -(-max_new_requested // 16) * 16,
+                self.max_len - prompt_len,
+            )
             vocab = self.cfg.vocab_size
             if any(t < 0 or t >= vocab for row in tokens for t in row):
                 raise ValueError(f"token ids must be in [0, {vocab})")
@@ -115,7 +121,7 @@ class InferenceServer:
                 temperature=temperature,
                 rng=jax.random.PRNGKey(seed),
             )
-            return jax.device_get(out).tolist()
+            return jax.device_get(out[:, :max_new_requested]).tolist()
 
         loop = asyncio.get_event_loop()
         generated = await loop.run_in_executor(self._executor, run)
@@ -128,18 +134,25 @@ class InferenceServer:
     # -- lifecycle ------------------------------------------------------
 
     async def warmup(self) -> None:
-        """Compile prefill+decode before reporting healthy."""
+        """Compile the default-shaped programs before reporting healthy.
+
+        Requests with other prompt lengths still compile on first use
+        (shapes are static); the bucketed max_new keeps that churn
+        bounded."""
 
         def run() -> None:
-            prompt = jnp.zeros((1, 4), jnp.int32)
-            generate(
-                self.params, prompt, self.cfg, max_new_tokens=2,
-                max_len=self.max_len,
-            )
+            for prompt_len in (4, 16):
+                if prompt_len + 16 > self.max_len:
+                    continue
+                prompt = jnp.zeros((1, prompt_len), jnp.int32)
+                generate(
+                    self.params, prompt, self.cfg, max_new_tokens=16,
+                    max_len=self.max_len,
+                )
 
         await asyncio.get_event_loop().run_in_executor(self._executor, run)
         self.ready = True
-        log.info("serve: model warm; accepting traffic")
+        log.info("serve: default shapes warm; accepting traffic")
 
     async def run(self) -> None:
         await self._server.start_tcp(self.host, self.port)
