@@ -24,7 +24,6 @@ def moe_layer(
     router_w: jax.Array,  # [d_model, n_experts]
     w_in: jax.Array,      # [n_experts, d_model, d_ff]
     w_out: jax.Array,     # [n_experts, d_ff, d_model]
-    capacity_factor: float = 0.0,  # reserved; routing is drop-free
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (output [b,s,d], aux_loss scalar). x in compute dtype.
 

@@ -43,10 +43,9 @@ class TransformerConfig:
     # (parallel/context.py)
     attention_fn: Any = None
     # mixture-of-experts: 0 = dense SwiGLU; >0 replaces the MLP with
-    # switch-routed experts (models/moe.py), expert axis sharded over
-    # the mesh's "model" axis (expert parallelism)
+    # switch-routed experts (models/moe.py — drop-free routing, expert
+    # axis sharded over the mesh's "model" axis for expert parallelism)
     moe_experts: int = 0
-    moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
 
     @property
@@ -183,7 +182,6 @@ def _ffn(
             layer_params["router"],
             layer_params["moe_w_in"],
             layer_params["moe_w_out"],
-            cfg.moe_capacity_factor,
         )
         return x + out, aux
     return _mlp(x, layer_params, cfg), jnp.zeros((), jnp.float32)

@@ -11,6 +11,11 @@ from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
 from .context import context_parallel_config
 from .distributed import initialize_from_catalog, initialize_from_env
 from .mesh import MeshPlan, make_mesh
+from .pipeline import (
+    pipeline_forward_with_aux,
+    pipeline_loss_fn,
+    pipeline_sharding_rules,
+)
 from .sharding import param_sharding_rules, shard_params
 from .train import TrainState, make_train_step, init_train_state
 
@@ -28,4 +33,7 @@ __all__ = [
     "latest_step",
     "initialize_from_catalog",
     "initialize_from_env",
+    "pipeline_forward_with_aux",
+    "pipeline_loss_fn",
+    "pipeline_sharding_rules",
 ]

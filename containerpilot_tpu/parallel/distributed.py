@@ -74,6 +74,14 @@ def initialize_from_catalog(
     """
     if process_id == 0:
         address = advertise_address or _routable_address()
+        # the coordinator role is singular: clear any stale registration
+        # from a previous pod incarnation so workers can't rendezvous
+        # with a dead host
+        for stale in backend.instances(COORDINATOR_SERVICE):
+            log.info(
+                "distributed: removing stale coordinator %s", stale.id
+            )
+            backend.service_deregister(stale.id)
         registration = ServiceRegistration(
             id=f"{COORDINATOR_SERVICE}-{socket.gethostname()}",
             name=COORDINATOR_SERVICE,

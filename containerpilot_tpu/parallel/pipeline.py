@@ -1,0 +1,197 @@
+"""Pipeline parallelism: GPipe-style microbatching over a ``pipe`` mesh
+axis.
+
+The scan-over-stacked-layers model design makes stage partitioning
+natural: the layer-stacked parameter arrays ``[L, ...]`` shard their
+leading axis over ``pipe`` (each device holds L/S contiguous layers),
+and microbatches stream through the stages with ``lax.ppermute``
+activation handoffs — the classic SPMD collective-permute pipeline
+(public recipe; see the scaling-book pattern, implemented fresh here).
+
+Schedule: S stages, M microbatches, M + S - 1 ticks. At tick t stage 0
+ingests microbatch ``min(t, M-1)`` (masked once t >= M), every stage
+applies its local layers, the result permutes to the next stage, and
+the last stage banks its output for microbatch ``t - S + 1``. The
+pipeline bubble is the standard (S-1)/(M+S-1); raise ``n_microbatches``
+to amortize it.
+
+Embedding/unembedding run replicated outside the pipelined stack, and
+the final activations are broadcast off the last stage with a masked
+psum, so the loss (and grads — ppermute is differentiable) compose with
+data parallelism on an outer ``data`` axis.
+
+Logits are bit-equal to the unpipelined forward. For MoE models the
+aux load-balance loss is the mean of per-*microbatch* statistics rather
+than the full-batch statistic (the loss is nonlinear in batch
+partitioning) — the standard behavior of microbatched MoE training.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.transformer import (
+    Params,
+    TransformerConfig,
+    _layer,
+    _rms_norm,
+)
+from ..ops.ring_attention import shard_map  # version-compat wrapper
+
+
+def _stage_fn(
+    x: jax.Array, local_layers: Any, cfg: TransformerConfig
+) -> Tuple[jax.Array, jax.Array]:
+    """Apply this stage's layer slice: scan over local layers."""
+
+    def body(carry, layer_params):
+        x, aux = carry
+        x, layer_aux = _layer(x, layer_params, cfg)
+        return (x, aux + layer_aux), None
+
+    (x, aux), _ = lax.scan(
+        body, (x, jnp.zeros((), jnp.float32)), local_layers
+    )
+    return x, aux
+
+
+def _pipeline_body(
+    layers: Any,
+    x_mb: jax.Array,  # [M, mb, s, d] microbatched embeddings (replicated)
+    *,
+    cfg: TransformerConfig,
+    axis_name: str,
+    n_stages: int,
+    n_microbatches: int,
+):
+    """Per-device body under shard_map; ``layers`` leaves are the local
+    [L/S, ...] slices."""
+    stage = lax.axis_index(axis_name)
+    _, mb, s, d = x_mb.shape
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    ticks = n_microbatches + n_stages - 1
+
+    def tick(t, carry):
+        acts, outputs, aux = carry
+        # stage 0 ingests microbatch t (clamped; masked when t >= M)
+        feed_idx = jnp.clip(t, 0, n_microbatches - 1)
+        fresh = lax.dynamic_index_in_dim(x_mb, feed_idx, 0, keepdims=False)
+        my_in = jnp.where(stage == 0, fresh, acts)
+        y, stage_aux = _stage_fn(my_in, layers, cfg)
+        # the last stage banks microbatch t-S+1's result once it's real
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_microbatches - 1)
+        is_valid = (t >= n_stages - 1) & (stage == n_stages - 1)
+        banked = lax.dynamic_update_index_in_dim(
+            outputs, y.astype(outputs.dtype), out_idx, 0
+        )
+        outputs = jnp.where(is_valid, banked, outputs)
+        # every stage contributes aux for the ticks where it held a
+        # real microbatch (stage s is busy during ticks s..s+M-1)
+        busy = (t >= stage) & (t < stage + n_microbatches)
+        aux = aux + jnp.where(busy, stage_aux, 0.0)
+        acts = lax.ppermute(y, axis_name, perm)
+        return acts, outputs, aux
+
+    acts0 = jnp.zeros((mb, s, d), cfg.dtype)
+    outputs0 = jnp.zeros((n_microbatches, mb, s, d), cfg.dtype)
+    aux0 = jnp.zeros((), jnp.float32)
+    _acts, outputs, aux = lax.fori_loop(
+        0, ticks, tick, (acts0, outputs0, aux0)
+    )
+    # broadcast the last stage's results to every device
+    outputs = lax.psum(
+        jnp.where(stage == n_stages - 1, outputs, 0.0).astype(jnp.float32),
+        axis_name,
+    ).astype(cfg.dtype)
+    aux = lax.psum(aux, axis_name)
+    return outputs, aux
+
+
+def pipeline_forward_with_aux(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    n_microbatches: int = 4,
+    axis_name: str = "pipe",
+):
+    """Forward through pipeline-sharded layers.
+
+    tokens: [batch, seq]; batch must divide by n_microbatches; n_layers
+    by the pipe axis size. Returns (logits, aux) like forward_with_aux.
+    """
+    if axis_name not in mesh.axis_names:
+        raise ValueError(f"mesh has no {axis_name!r} axis: {mesh.axis_names}")
+    n_stages = mesh.shape[axis_name]
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by {n_stages} stages"
+        )
+    b, s = tokens.shape
+    if b % n_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by {n_microbatches} microbatches"
+        )
+    mb = b // n_microbatches
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x_mb = x.reshape(n_microbatches, mb, s, -1)
+
+    layer_specs = jax.tree_util.tree_map(
+        lambda _: P(axis_name), params["layers"]
+    )
+    fn = shard_map(
+        functools.partial(
+            _pipeline_body,
+            cfg=cfg,
+            axis_name=axis_name,
+            n_stages=n_stages,
+            n_microbatches=n_microbatches,
+        ),
+        mesh=mesh,
+        in_specs=(layer_specs, P()),
+        out_specs=(P(), P()),
+    )
+    outputs, aux = fn(params["layers"], x_mb)
+    x = outputs.reshape(b, s, -1)
+    x = _rms_norm(x, params["norm_out"])
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["unembed"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, aux / n_microbatches
+
+
+def pipeline_loss_fn(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    n_microbatches: int = 4,
+) -> jax.Array:
+    """Next-token CE through the pipeline (drop-in for loss_fn)."""
+    logits, aux = pipeline_forward_with_aux(
+        params, tokens[:, :-1], cfg, mesh, n_microbatches
+    )
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll) + cfg.moe_aux_weight * aux
+
+
+def pipeline_sharding_rules(cfg: Any = None) -> Any:
+    """Param specs for a ("data", "pipe") mesh: layer stacks sharded
+    over pipe, embeddings replicated."""
+    from .sharding import param_sharding_rules
+
+    rules = param_sharding_rules(cfg)
+    rules["layers"] = jax.tree_util.tree_map(
+        lambda _: P("pipe"), rules["layers"]
+    )
+    rules["embed"] = P(None, None)
+    rules["unembed"] = P(None, None)
+    return rules

@@ -439,3 +439,71 @@ def test_distributed_initialize_from_catalog_single_process(tmp_path):
             FileCatalogBackend(str(tmp_path / "empty")), 8476,
             timeout=0.3, poll_interval=0.1,
         )
+
+
+def test_pipeline_parallel_forward_parity():
+    """GPipe-style pipeline over 4 stages must reproduce the plain
+    forward exactly (same params, dense model)."""
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    from containerpilot_tpu.parallel.pipeline import (
+        pipeline_forward_with_aux,
+        pipeline_loss_fn,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=4, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = Mesh(_np.asarray(jax.devices()[:4]), ("pipe",))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 12), 0, cfg.vocab_size, jnp.int32
+    )
+    ref = forward(params, tokens, cfg)
+    out, aux = pipeline_forward_with_aux(
+        params, tokens, cfg, mesh, n_microbatches=4
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(out), rtol=2e-4, atol=2e-4
+    )
+    assert float(aux) == 0.0  # dense model: no MoE aux
+
+    # training path: grads flow through ppermute/fori_loop
+    grads = jax.grad(
+        lambda p: pipeline_loss_fn(p, tokens, cfg, mesh, n_microbatches=4)
+    )(params)
+    flat, _ = jax.tree_util.tree_flatten(grads)
+    assert all(bool(jnp.isfinite(g).all()) for g in flat)
+    # layer grads are nonzero (the pipeline actually trained all stages)
+    assert float(jnp.abs(grads["layers"]["wq"]).sum()) > 0
+
+
+def test_pipeline_validates_inputs():
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    from containerpilot_tpu.parallel.pipeline import (
+        pipeline_forward_with_aux,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=3, d_ff=64,
+        max_seq_len=32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = Mesh(_np.asarray(jax.devices()[:4]), ("pipe",))
+    tokens = jnp.zeros((8, 8), jnp.int32)
+    with pytest.raises(ValueError, match="not divisible by 4 stages"):
+        pipeline_forward_with_aux(params, tokens, cfg, mesh)
+    cfg2 = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=4, d_ff=64,
+        max_seq_len=32,
+    )
+    params2 = init_params(jax.random.PRNGKey(0), cfg2)
+    with pytest.raises(ValueError, match="microbatches"):
+        pipeline_forward_with_aux(
+            params2, jnp.zeros((6, 8), jnp.int32), cfg2, mesh,
+            n_microbatches=4,
+        )
