@@ -23,6 +23,17 @@ bench:
 lint:
 	$(PYTHON) -m compileall -q containerpilot_tpu
 
+# release tarball (reference: makefile release target); VERSION expands
+# lazily so only the release target pays the interpreter startup
+VERSION = $(shell $(PYTHON) -c "from containerpilot_tpu.version import VERSION; print(VERSION)")
+release: build
+	mkdir -p release
+	tar -czf release/containerpilot-tpu-$(VERSION).tar.gz \
+		--exclude='__pycache__' --exclude='*.pyc' \
+		--exclude='native/cpsup' \
+		containerpilot_tpu bin/cpsup docs examples README.md \
+		CHANGELOG.md pyproject.toml Makefile native
+
 clean:
 	$(MAKE) -C native clean
-	rm -rf bin __pycache__ */__pycache__
+	rm -rf bin release __pycache__ */__pycache__

@@ -2,14 +2,14 @@
 
 TPU-first formulation: top-1 (switch) routing expressed entirely as
 one-hot einsums — dispatch and combine are batched matmuls the MXU
-eats, no gathers/scatters, static shapes with a capacity bound. Expert
-weights carry a leading expert axis sharded over the mesh's ``model``
-axis (expert parallelism); XLA inserts the all-to-alls at the dispatch
-and combine einsums.
+eats, no gathers/scatters, fully static shapes. Routing is per-token
+and drop-free (see moe_layer). Expert weights carry a leading expert
+axis sharded over the mesh's ``model`` axis (expert parallelism); XLA
+inserts the all-to-alls at the dispatch and combine einsums.
 
 Aux load-balancing loss is the standard switch formulation: E *
 sum_e(fraction_of_tokens_e * mean_router_prob_e), minimized at uniform
-routing. Dropped tokens (over capacity) pass through the residual.
+routing.
 """
 from __future__ import annotations
 

@@ -233,12 +233,23 @@ def forward(
     return forward_with_aux(params, tokens, cfg)[0]
 
 
+def next_token_loss(
+    logits: jax.Array,
+    aux: jax.Array,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+) -> jax.Array:
+    """Next-token CE over logits for tokens[:, :-1], plus weighted MoE
+    aux — shared by the plain and pipelined losses."""
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll) + cfg.moe_aux_weight * aux
+
+
 def loss_fn(
     params: Params, tokens: jax.Array, cfg: TransformerConfig
 ) -> jax.Array:
     """Next-token cross-entropy (+ weighted MoE aux loss when routed)."""
     logits, aux = forward_with_aux(params, tokens[:, :-1], cfg)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll) + cfg.moe_aux_weight * aux
+    return next_token_loss(logits, aux, tokens, cfg)

@@ -81,7 +81,14 @@ def initialize_from_catalog(
             log.info(
                 "distributed: removing stale coordinator %s", stale.id
             )
-            backend.service_deregister(stale.id)
+            try:
+                backend.service_deregister(stale.id)
+            except Exception as exc:  # noqa: BLE001
+                # best-effort: on Consul, another agent's registration
+                # can't be deregistered locally — never abort rendezvous
+                log.warning(
+                    "distributed: could not remove %s: %s", stale.id, exc
+                )
         registration = ServiceRegistration(
             id=f"{COORDINATOR_SERVICE}-{socket.gethostname()}",
             name=COORDINATOR_SERVICE,

@@ -20,10 +20,12 @@ the final activations are broadcast off the last stage with a masked
 psum, so the loss (and grads — ppermute is differentiable) compose with
 data parallelism on an outer ``data`` axis.
 
-Logits are bit-equal to the unpipelined forward. For MoE models the
-aux load-balance loss is the mean of per-*microbatch* statistics rather
-than the full-batch statistic (the loss is nonlinear in batch
-partitioning) — the standard behavior of microbatched MoE training.
+Logits are numerically equivalent to the unpipelined forward — same
+math, tolerance-level float differences from microbatched reduction
+tiling. For MoE models the aux load-balance loss is the mean of
+per-*microbatch* statistics rather than the full-batch statistic (the
+loss is nonlinear in batch partitioning) — the standard behavior of
+microbatched MoE training.
 """
 from __future__ import annotations
 
@@ -40,6 +42,7 @@ from ..models.transformer import (
     TransformerConfig,
     _layer,
     _rms_norm,
+    next_token_loss,
 )
 from ..ops.ring_attention import shard_map  # version-compat wrapper
 
@@ -177,10 +180,7 @@ def pipeline_loss_fn(
     logits, aux = pipeline_forward_with_aux(
         params, tokens[:, :-1], cfg, mesh, n_microbatches
     )
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll) + cfg.moe_aux_weight * aux
+    return next_token_loss(logits, aux, tokens, cfg)
 
 
 def pipeline_sharding_rules(cfg: Any = None) -> Any:

@@ -1,0 +1,24 @@
+"""Every shipped example config must render, parse, and validate
+(golden-fixture discipline; reference: jobs/testdata/* convention)."""
+import glob
+import os
+
+import pytest
+
+from containerpilot_tpu.config.loader import new_config, parse_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = sorted(glob.glob(os.path.join(REPO, "examples", "*.json5")))
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=os.path.basename)
+def test_example_validates(path, tmp_path, monkeypatch):
+    monkeypatch.setenv("CATALOG_DIR", str(tmp_path / "catalog"))
+    monkeypatch.setenv("CATALOG", f"file:{tmp_path / 'catalog'}")
+    with open(path, encoding="utf-8") as f:
+        cfg = new_config(parse_config(f.read()))
+    assert cfg.jobs, f"{path} defines no jobs"
+
+
+def test_examples_exist():
+    assert len(EXAMPLES) >= 5
