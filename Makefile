@@ -7,8 +7,12 @@ PYTHON ?= python
 
 all: build test
 
-build:
-	$(MAKE) -C native
+build: bin/cpsup
+
+bin/cpsup: native/sup.cpp
+	$(MAKE) -C native cpsup
+	mkdir -p bin
+	cp native/cpsup bin/cpsup
 
 test:
 	$(PYTHON) -m pytest tests/ -q

@@ -28,6 +28,7 @@ import socket
 from typing import Any, Dict, List, Optional
 
 from ..commands import ArgsError, Command
+from ..config.decode import coerce_int, coerce_number
 from ..config.services import get_ip, validate_name
 from ..config.timing import DurationError, get_timeout, parse_duration
 from ..discovery import Backend, ServiceDefinition, ServiceRegistration
@@ -65,7 +66,10 @@ class JobConfig:
             )
         self.name: str = raw.get("name", "") or ""
         self.exec_raw = raw.get("exec")
-        self.port: int = int(raw.get("port", 0) or 0)
+        port = coerce_int(raw.get("port", 0) or 0)
+        if port is None:
+            raise JobConfigError(f"job[{self.name}].port must be an integer")
+        self.port: int = port
         self.initial_status: str = (
             raw.get("initial_status") or raw.get("initialStatus") or ""
         )
@@ -140,8 +144,8 @@ class JobConfig:
             )
         if self.health_raw is None:
             return
-        heartbeat = self.health_raw.get("interval", 0)
-        ttl = self.health_raw.get("ttl", 0)
+        heartbeat = coerce_number(self.health_raw.get("interval", 0))
+        ttl = coerce_number(self.health_raw.get("ttl", 0))
         if not isinstance(heartbeat, (int, float)) or heartbeat < 1:
             raise JobConfigError(f"job[{self.name}].health.interval must be > 0")
         if not isinstance(ttl, (int, float)) or ttl < 1:

@@ -18,6 +18,7 @@ import asyncio
 import logging
 from typing import Any, Dict, List, Optional
 
+from ..config.decode import coerce_number
 from ..config.services import validate_name
 from ..discovery import Backend
 from ..events import (
@@ -49,7 +50,9 @@ class WatchConfig:
                 f"watch[{raw.get('name', '?')}]: unknown keys {sorted(unknown)}"
             )
         self.service_name: str = raw.get("name", "")
-        self.poll = raw.get("interval", 0)
+        # weakly-typed numerics, like the reference's mapstructure
+        # decoding (reference: config/decode/decode.go:14-18)
+        self.poll = coerce_number(raw.get("interval", 0))
         self.tag: str = raw.get("tag", "")
         self.dc: str = raw.get("dc", "")
         self.name = ""

@@ -264,3 +264,48 @@ def test_initial_status_validation():
                 "health": {"exec": "true", "interval": 5, "ttl": 15},
             }
         ).validate(NoopBackend())
+
+
+def test_weakly_typed_numeric_fields():
+    """String numbers are valid ports/intervals/ttls, matching the
+    reference's mapstructure WeaklyTypedInput decoding."""
+    cfg = JobConfig(
+        {
+            "name": "app",
+            "exec": "true",
+            "port": "8080",
+            "interfaces": ["static:10.0.0.1"],
+            "health": {"exec": "true", "interval": "5", "ttl": "15"},
+        }
+    ).validate(NoopBackend())
+    assert cfg.port == 8080
+    assert cfg.heartbeat_interval == 5.0
+    assert cfg.ttl == 15
+    with pytest.raises(JobConfigError, match="port must be an integer"):
+        JobConfig({"name": "app", "exec": "true", "port": "eighty"})
+
+    from containerpilot_tpu.watches import WatchConfig
+
+    wcfg = WatchConfig({"name": "backend", "interval": "7"}).validate(
+        NoopBackend()
+    )
+    assert wcfg.poll == 7
+
+
+def test_coerce_int_accepts_integral_floats():
+    from containerpilot_tpu.config.decode import coerce_int, coerce_number
+
+    assert coerce_int("8080") == 8080
+    assert coerce_int(8080.0) == 8080
+    assert coerce_int("8080.0") == 8080
+    assert coerce_int("eighty") is None
+    assert coerce_int(80.5) is None
+    assert coerce_number("7.5") == 7.5
+    cfg = JobConfig(
+        {
+            "name": "app", "exec": "true", "port": 8080.0,
+            "interfaces": ["static:10.0.0.1"],
+            "health": {"exec": "true", "interval": 5, "ttl": 15},
+        }
+    ).validate(NoopBackend())
+    assert cfg.port == 8080
