@@ -194,3 +194,73 @@ def test_no_command_is_error():
     )
     assert out.returncode == 1
     assert "-config flag is required" in out.stderr
+
+
+def test_real_sigterm_through_cli(tmp_path):
+    """Spawn the actual CLI, deliver a real SIGTERM, assert the pre-stop
+    hook ran and the exit was clean (integration test_sigterm)."""
+    order = tmp_path / "order.log"
+    started = tmp_path / "started"
+    sup_log = tmp_path / "supervisor.log"
+    path = write_config(
+        tmp_path,
+        """
+        {
+          stopTimeout: "1ms",
+          jobs: [
+            {
+              name: "main",
+              exec: ["/bin/sh", "-c", "touch %s; exec sleep 60"],
+              stopTimeout: "5s",
+            },
+            {
+              name: "preStop",
+              exec: ["/bin/sh", "-c", "echo PRESTOP >> %s"],
+              when: { once: "stopping", source: "main" },
+            },
+          ],
+        }
+        """
+        % (started, order),
+    )
+    with open(sup_log, "wb") as log_f:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "containerpilot_tpu", "-config", path],
+            cwd=REPO, stdout=log_f, stderr=subprocess.STDOUT,
+        )
+    try:
+        # poll for readiness instead of racing startup with a sleep
+        deadline = time.monotonic() + 30
+        while not started.exists():
+            assert time.monotonic() < deadline, (
+                f"main never started; log:\n{sup_log.read_text()}"
+            )
+            time.sleep(0.05)
+        time.sleep(0.3)  # signal handlers installed before jobs run
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        assert rc == 0, f"exit {rc}; log:\n{sup_log.read_text()}"
+        assert "PRESTOP" in order.read_text()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        subprocess.run(["pkill", "-f", str(started)], capture_output=True)
+
+
+def test_template_render_to_file(tmp_path):
+    """-template -out writes the rendered config (render subcommand)."""
+    cfg = tmp_path / "t.json5"
+    out = tmp_path / "rendered.json5"
+    cfg.write_text(
+        '{ jobs: [{ name: "app",'
+        ' exec: "run {{ .CP_TEST_UNSET_93 | default "1" }}" }] }'
+    )
+    env = {k: v for k, v in os.environ.items() if k != "CP_TEST_UNSET_93"}
+    result = subprocess.run(
+        [sys.executable, "-m", "containerpilot_tpu", "-template",
+         "-config", str(cfg), "-out", str(out)],
+        cwd=REPO, capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert result.returncode == 0, result.stderr
+    assert 'exec: "run 1"' in out.read_text()
