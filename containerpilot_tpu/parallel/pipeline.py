@@ -71,6 +71,7 @@ def _pipeline_body(
     axis_name: str,
     n_stages: int,
     n_microbatches: int,
+    data_axis: str = None,
 ):
     """Per-device body under shard_map; ``layers`` leaves are the local
     [L/S, ...] slices."""
@@ -112,6 +113,10 @@ def _pipeline_body(
         axis_name,
     ).astype(cfg.dtype)
     aux = lax.psum(aux, axis_name)
+    if data_axis is not None:
+        # the aux out_spec is replicated, so it must agree across the
+        # data axis: average the per-shard statistics
+        aux = lax.pmean(aux, data_axis)
     return outputs, aux
 
 
@@ -141,12 +146,21 @@ def pipeline_forward_with_aux(
             f"batch {b} not divisible by {n_microbatches} microbatches"
         )
     mb = b // n_microbatches
+    data_size = mesh.shape.get("data", 1)
+    if mb % data_size:
+        raise ValueError(
+            f"microbatch size {mb} not divisible by data axis {data_size}"
+        )
     x = params["embed"].astype(cfg.dtype)[tokens]
     x_mb = x.reshape(n_microbatches, mb, s, -1)
 
     layer_specs = jax.tree_util.tree_map(
         lambda _: P(axis_name), params["layers"]
     )
+    # compose with data parallelism: microbatch contents shard over an
+    # outer "data" axis (everything in the body is per-sample)
+    data_axis = "data" if "data" in mesh.axis_names else None
+    x_spec = P(None, data_axis, None, None)
     fn = shard_map(
         functools.partial(
             _pipeline_body,
@@ -154,10 +168,11 @@ def pipeline_forward_with_aux(
             axis_name=axis_name,
             n_stages=n_stages,
             n_microbatches=n_microbatches,
+            data_axis=data_axis,
         ),
         mesh=mesh,
-        in_specs=(layer_specs, P()),
-        out_specs=(P(), P()),
+        in_specs=(layer_specs, x_spec),
+        out_specs=(x_spec, P()),
     )
     outputs, aux = fn(params["layers"], x_mb)
     x = outputs.reshape(b, s, -1)

@@ -210,7 +210,7 @@ def test_real_sigterm_through_cli(tmp_path):
           jobs: [
             {
               name: "main",
-              exec: ["/bin/sh", "-c", "touch %s; exec sleep 60"],
+              exec: ["/bin/sh", "-c", "echo $$ > %s; exec sleep 60"],
               stopTimeout: "5s",
             },
             {
@@ -245,7 +245,12 @@ def test_real_sigterm_through_cli(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=10)
-        subprocess.run(["pkill", "-f", str(started)], capture_output=True)
+        # on failure paths the job child may outlive the supervisor;
+        # its pid was written to the sentinel file
+        try:
+            os.kill(int(started.read_text()), signal.SIGKILL)
+        except (OSError, ValueError):
+            pass
 
 
 def test_template_render_to_file(tmp_path):
