@@ -1,6 +1,13 @@
-"""Op library for the TPU workload: attention (XLA + pallas flash +
-ring/context-parallel)."""
+"""Op library for the TPU workload: attention four ways — XLA einsum,
+pallas flash forward, memory-efficient training (custom VJP), and
+ring/context-parallel."""
 from .attention import causal_attention, flash_attention_forward
+from .flash_training import memory_efficient_attention
 from .ring_attention import ring_attention
 
-__all__ = ["causal_attention", "flash_attention_forward", "ring_attention"]
+__all__ = [
+    "causal_attention",
+    "flash_attention_forward",
+    "memory_efficient_attention",
+    "ring_attention",
+]

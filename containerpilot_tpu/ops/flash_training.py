@@ -1,0 +1,223 @@
+"""Memory-efficient causal attention for training: the flash-attention
+algorithm with a custom VJP, O(seq) activation memory both ways.
+
+The einsum path (attention.py) materializes the [seq, seq] score matrix
+in both passes; this op streams KV blocks with online softmax in the
+forward (saving only out + per-row logsumexp) and replays blocks in the
+backward using the standard flash gradients:
+
+    D_i   = rowsum(dO_i * O_i)
+    dP_ij = dO_i @ V_j^T
+    dS_ij = P_ij * (dP_ij - D_i)
+    dQ_i += dS_ij @ K_j ;  dK_j += dS_ij^T @ Q_i ;  dV_j += P_ij^T @ dO_i
+
+Everything is lax.scan over blocks: the backward carries the full dQ
+accumulator (one [b,s,h,hd] buffer) and emits per-block dK/dV, so peak
+activation memory is O(seq), never O(seq^2). Fully-future (qi < kj)
+block pairs are skipped with lax.cond — causal attention does ~half
+the block-pair work. The public flash technique (see PAPERS.md),
+implemented fresh on jax.
+
+Composable: per-device memory-bounded attention here, cross-device
+sequence sharding via ops/ring_attention.py.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .attention import NEG_INF
+
+
+def _blocks(x: jax.Array, block: int) -> jax.Array:
+    """[b, s, h, hd] -> [n_blocks, b, block, h, hd]."""
+    b, s, h, hd = x.shape
+    return x.reshape(b, s // block, block, h, hd).transpose(1, 0, 2, 3, 4)
+
+
+def _unblocks(x: jax.Array) -> jax.Array:
+    """[n_blocks, b, block, h, hd] -> [b, s, h, hd]."""
+    n, b, blk, h, hd = x.shape
+    return x.transpose(1, 0, 2, 3, 4).reshape(b, n * blk, h, hd)
+
+
+def _block_causal_mask(qi: jax.Array, kj: jax.Array, block: int) -> jax.Array:
+    """[block, block] bool: global causal mask for block pair (qi, kj).
+    Shared by forward and backward so the passes can never disagree."""
+    q_pos = qi * block + lax.broadcasted_iota(jnp.int32, (block, block), 0)
+    k_pos = kj * block + lax.broadcasted_iota(jnp.int32, (block, block), 1)
+    return q_pos >= k_pos
+
+
+def _fwd_pass(
+    q: jax.Array, k: jax.Array, v: jax.Array, block: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (out [b,s,h,hd], lse [b,h,s])."""
+    b, s, h, hd = q.shape
+    if s % block:
+        # validated here (not only in the public wrapper) so the
+        # custom_vjp fwd rule under jax.grad errors just as cleanly
+        raise ValueError(f"seq len {s} not a multiple of block {block}")
+    scale = hd ** -0.5
+    qb = _blocks(q, block)  # [nq, b, blk, h, hd]
+    kb = _blocks(k, block)
+    vb = _blocks(v, block)
+    n_blocks = s // block
+
+    def per_q_block(qi, q_blk):
+        qf = q_blk.astype(jnp.float32) * scale
+
+        def inner(carry, inputs):
+            kj, k_blk, v_blk = inputs
+
+            def compute(carry):
+                m, l, acc = carry
+                scores = jnp.einsum(
+                    "bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32),
+                    preferred_element_type=jnp.float32,
+                )
+                mask = _block_causal_mask(qi, kj, block)
+                scores = jnp.where(mask[None, None], scores, NEG_INF)
+                m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+                m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+                p = jnp.exp(scores - m_safe[..., None])
+                p = jnp.where(mask[None, None], p, 0.0)
+                corr = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_safe))
+                l_new = l * corr + jnp.sum(p, axis=-1)
+                acc_new = acc * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+                    "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32),
+                    preferred_element_type=jnp.float32,
+                )
+                return m_new, l_new, acc_new
+
+            # fully-future blocks are skipped, not computed-and-discarded
+            carry = lax.cond(kj <= qi, compute, lambda c: c, carry)
+            return carry, None
+
+        m0 = jnp.full((b, h, block), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, block), jnp.float32)
+        acc0 = jnp.zeros((b, block, h, hd), jnp.float32)
+        (m, l, acc), _ = lax.scan(
+            inner, (m0, l0, acc0), (jnp.arange(n_blocks), kb, vb)
+        )
+        l_safe = jnp.maximum(l, 1e-30)
+        out = acc / l_safe.transpose(0, 2, 1)[..., None]
+        lse = m + jnp.log(l_safe)  # [b, h, block]
+        return out.astype(q.dtype), lse
+
+    outs, lses = lax.map(
+        lambda args: per_q_block(*args), (jnp.arange(n_blocks), qb)
+    )
+    out = _unblocks(outs)
+    # lses: [nq, b, h, block] -> [b, h, s]
+    lse = lses.transpose(1, 2, 0, 3).reshape(b, h, s)
+    return out, lse
+
+
+def _bwd_pass(q, k, v, out, lse, d_out, block: int):
+    b, s, h, hd = q.shape
+    scale = hd ** -0.5
+    qb, kb, vb = _blocks(q, block), _blocks(k, block), _blocks(v, block)
+    ob, dob = _blocks(out, block), _blocks(d_out, block)
+    n_blocks = s // block
+    lse_b = lse.reshape(b, h, n_blocks, block).transpose(2, 0, 1, 3)
+    # D_i = rowsum(dO * O)  [nq, b, h, block]
+    d_rows = jnp.einsum(
+        "nbqhd,nbqhd->nbhq", dob.astype(jnp.float32), ob.astype(jnp.float32)
+    )
+
+    def per_kv_block(dq_total, inputs):
+        kj, k_blk, v_blk = inputs
+        kf = k_blk.astype(jnp.float32)
+        vf = v_blk.astype(jnp.float32)
+
+        def inner(carry, inputs2):
+            qi, q_blk, do_blk, lse_blk, d_blk = inputs2
+
+            def compute(carry):
+                dk, dv = carry
+                qf = q_blk.astype(jnp.float32) * scale
+                scores = jnp.einsum(
+                    "bqhd,bkhd->bhqk", qf, kf,
+                    preferred_element_type=jnp.float32,
+                )
+                mask = _block_causal_mask(qi, kj, block)
+                p = jnp.exp(scores - lse_blk[..., None])
+                p = jnp.where(mask[None, None], p, 0.0)
+                dof = do_blk.astype(jnp.float32)
+                dp = jnp.einsum(
+                    "bqhd,bkhd->bhqk", dof, vf,
+                    preferred_element_type=jnp.float32,
+                )
+                ds = p * (dp - d_blk[..., None])
+                dk_new = dk + jnp.einsum(
+                    "bhqk,bqhd->bkhd", ds, qf,
+                    preferred_element_type=jnp.float32,
+                )
+                dv_new = dv + jnp.einsum(
+                    "bhqk,bqhd->bkhd", p, dof,
+                    preferred_element_type=jnp.float32,
+                )
+                dq_part = jnp.einsum(
+                    "bhqk,bkhd->bqhd", ds, kf,
+                    preferred_element_type=jnp.float32,
+                )
+                return (dk_new, dv_new), dq_part
+
+            def skip(carry):
+                return carry, jnp.zeros((b, block, h, hd), jnp.float32)
+
+            # only past-or-diagonal block pairs contribute
+            carry, dq_part = lax.cond(qi >= kj, compute, skip, carry)
+            return carry, dq_part
+
+        dk0 = jnp.zeros((b, block, h, hd), jnp.float32)
+        dv0 = jnp.zeros((b, block, h, hd), jnp.float32)
+        (dk, dv), dq_parts = lax.scan(
+            inner, (dk0, dv0),
+            (jnp.arange(n_blocks), qb, dob, lse_b, d_rows),
+        )
+        # fold this kv block's dQ contribution into the single running
+        # accumulator — O(seq) carry, no [nk, nq, ...] stacking
+        dq_total = dq_total + _unblocks(dq_parts)
+        return dq_total, (dk, dv)
+
+    dq0 = jnp.zeros((b, s, h, hd), jnp.float32)
+    dq, (dks, dvs) = lax.scan(
+        per_kv_block, dq0, (jnp.arange(n_blocks), kb, vb)
+    )
+    dq = dq * scale
+    dk = _unblocks(dks)
+    dv = _unblocks(dvs)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def memory_efficient_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, block: int = 256
+) -> jax.Array:
+    """Causal attention with O(seq) activation memory in both passes.
+
+    Same [batch, seq, heads, head_dim] contract as causal_attention;
+    seq must be a multiple of ``block`` (pad upstream for ragged
+    lengths).
+    """
+    out, _lse = _fwd_pass(q, k, v, block)
+    return out
+
+
+def _mea_fwd(q, k, v, block):
+    out, lse = _fwd_pass(q, k, v, block)
+    return out, (q, k, v, out, lse)
+
+
+def _mea_bwd(block, residuals, d_out):
+    q, k, v, out, lse = residuals
+    return _bwd_pass(q, k, v, out, lse, d_out, block)
+
+
+memory_efficient_attention.defvjp(_mea_fwd, _mea_bwd)
