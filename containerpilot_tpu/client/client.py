@@ -75,3 +75,8 @@ class ControlClient:
         """GET /v3/ping (reference: client.go:104-115)."""
         self._request("GET", "/v3/ping")
         return True
+
+    def get_events(self) -> list:
+        """GET /v3/events: the supervisor's recent-event ring (an
+        observability extension over the reference's control API)."""
+        return json.loads(self._request("GET", "/v3/events"))

@@ -74,6 +74,9 @@ class ControlServer:
         self._server.route(
             "POST", "/v3/maintenance/disable", self._post_maintenance_disable
         )
+        # observability beyond the reference: the bus's recent-event
+        # ring, for debugging live supervisors
+        self._server.route("GET", "/v3/events", self._get_events)
 
     # -- lifecycle ------------------------------------------------------
 
@@ -122,9 +125,15 @@ class ControlServer:
             except Exception:  # pragma: no cover
                 pass
 
-    def _respond(self, status: int, path: str, body: bytes = b"\n") -> Response:
+    def _respond(
+        self,
+        status: int,
+        path: str,
+        body: bytes = b"\n",
+        content_type: str = "text/plain; charset=utf-8",
+    ) -> Response:
         self._count(status, path)
-        return Response(status, body)
+        return Response(status, body, content_type=content_type)
 
     # -- endpoints ------------------------------------------------------
 
@@ -164,6 +173,16 @@ class ControlServer:
         for key, value in metrics.items():
             self.bus.publish(Event(EventCode.METRIC, f"{key}|{value}"))
         return self._respond(200, req.path)
+
+    async def _get_events(self, req: Request) -> Response:
+        assert self.bus is not None
+        body = json.dumps(
+            [
+                {"code": e.code.value, "source": e.source}
+                for e in self.bus.debug_events()
+            ]
+        ).encode()
+        return self._respond(200, req.path, body, "application/json")
 
     async def _post_maintenance_enable(self, req: Request) -> Response:
         assert self.bus is not None

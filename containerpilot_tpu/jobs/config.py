@@ -74,7 +74,17 @@ class JobConfig:
             raw.get("initial_status") or raw.get("initialStatus") or ""
         )
         self.interfaces = raw.get("interfaces")
-        self.tags: List[str] = list(raw.get("tags") or [])
+        tags = raw.get("tags") or []
+        if not isinstance(tags, (list, tuple)):
+            raise JobConfigError(f"job[{self.name}].tags must be a list")
+        self.tags: List[str] = [str(t) for t in tags]
+        # structured sections must be mappings (JSON5 objects)
+        for key in ("consul", "health", "when", "logging"):
+            value = raw.get(key)
+            if value is not None and not isinstance(value, dict):
+                raise JobConfigError(
+                    f"job[{self.name}].{key} must be an object"
+                )
         self.consul_extras: Optional[Dict[str, Any]] = raw.get("consul")
         self.health_raw: Optional[Dict[str, Any]] = raw.get("health")
         self.exec_timeout_raw = raw.get("timeout", "")
@@ -165,6 +175,10 @@ class JobConfig:
             check_name = f"check.{self.name}"
             fields: Optional[Dict[str, Any]] = {"check": check_name}
             health_logging = self.health_raw.get("logging") or {}
+            if not isinstance(health_logging, dict):
+                raise JobConfigError(
+                    f"job[{self.name}].health.logging must be an object"
+                )
             if health_logging.get("raw"):
                 fields = None
             try:

@@ -309,3 +309,14 @@ def test_coerce_int_accepts_integral_floats():
         }
     ).validate(NoopBackend())
     assert cfg.port == 8080
+
+
+def test_health_logging_must_be_object():
+    with pytest.raises(JobConfigError, match="health.logging must be"):
+        JobConfig(
+            {
+                "name": "app", "exec": "true",
+                "health": {"exec": "x", "interval": 1, "ttl": 1,
+                           "logging": [1]},
+            }
+        ).validate(None)

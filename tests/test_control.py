@@ -99,3 +99,12 @@ def test_bad_body_is_422(run, socket_path):
 
     _bus, err = drive(run, socket_path, post_bad)
     assert err is not None and "422" in err
+
+
+def test_get_events_exposes_debug_ring(run, socket_path):
+    def fn(c):
+        c.put_metric({"zz_ring_probe": 1})
+        return c.get_events()
+
+    _bus, events = drive(run, socket_path, fn)
+    assert {"code": "metric", "source": "zz_ring_probe|1"} in events

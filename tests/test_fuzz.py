@@ -1,0 +1,99 @@
+"""Property-based robustness tests: the config pipeline must never
+crash with anything but its own typed errors, whatever bytes arrive.
+(Strengthens the reference's table-driven validation strategy with
+generative coverage.)"""
+import json
+
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from containerpilot_tpu.config.loader import ConfigError, parse_config  # noqa: E402
+from containerpilot_tpu.config.template import (  # noqa: E402
+    TemplateError,
+    apply_template,
+)
+from containerpilot_tpu.config.timing import DurationError, parse_duration  # noqa: E402
+from containerpilot_tpu.jobs import JobConfig, JobConfigError  # noqa: E402
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.text(max_size=300))
+def test_template_never_crashes_unexpectedly(src):
+    """Arbitrary text either renders or raises TemplateError."""
+    try:
+        apply_template(src, {"A": "1", "B": ""})
+    except TemplateError:
+        pass
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.text(max_size=300))
+def test_parse_config_never_crashes_unexpectedly(src):
+    try:
+        parse_config(src)
+    except (ConfigError, TemplateError):
+        pass
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    st.one_of(
+        st.text(max_size=20),
+        st.integers(min_value=-(10 ** 12), max_value=10 ** 12),
+        st.floats(allow_nan=False, allow_infinity=False),
+        st.none(),
+        st.booleans(),
+    )
+)
+def test_parse_duration_total(raw):
+    """Any scalar either parses to a float or raises DurationError."""
+    try:
+        result = parse_duration(raw)
+        assert isinstance(result, float)
+    except DurationError:
+        pass
+
+
+_JSONISH = st.recursive(
+    st.one_of(
+        st.none(),
+        st.booleans(),
+        st.integers(min_value=-(10 ** 9), max_value=10 ** 9),
+        st.text(max_size=20),
+    ),
+    lambda children: st.one_of(
+        st.lists(children, max_size=4),
+        st.dictionaries(st.text(max_size=10), children, max_size=4),
+    ),
+    max_leaves=10,
+)
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    st.fixed_dictionaries(
+        {},
+        optional={
+            "name": _JSONISH,
+            "exec": _JSONISH,
+            "port": _JSONISH,
+            "restarts": _JSONISH,
+            "when": _JSONISH,
+            "health": _JSONISH,
+            "timeout": _JSONISH,
+            "stopTimeout": _JSONISH,
+            "logging": _JSONISH,
+            "tags": _JSONISH,
+            "interfaces": _JSONISH,
+        },
+    )
+)
+def test_job_config_never_crashes_unexpectedly(raw):
+    """Arbitrary JSON-ish job configs either validate or raise the
+    package's typed errors — never an uncontrolled exception."""
+    try:
+        JobConfig(raw).validate(None)
+    except (JobConfigError, ValueError):
+        pass  # ValueError covers nested validators (durations, names)
