@@ -17,7 +17,13 @@ from .pipeline import (
     pipeline_sharding_rules,
 )
 from .sharding import param_sharding_rules, shard_params
-from .train import TrainState, make_train_step, init_train_state
+from .train import (
+    TrainState,
+    abstract_train_state,
+    init_train_state,
+    make_train_step,
+    train_state_shardings,
+)
 
 __all__ = [
     "MeshPlan",
@@ -26,8 +32,10 @@ __all__ = [
     "param_sharding_rules",
     "shard_params",
     "TrainState",
+    "abstract_train_state",
     "make_train_step",
     "init_train_state",
+    "train_state_shardings",
     "save_checkpoint",
     "restore_checkpoint",
     "latest_step",

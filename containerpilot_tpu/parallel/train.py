@@ -62,12 +62,101 @@ def init_train_state(
     )
 
 
+def _abstract_init(
+    rng: jax.Array, cfg: TransformerConfig, learning_rate: float
+) -> TrainState:
+    def init_fn(rng):
+        params = init_params(rng, cfg)
+        opt_state = make_optimizer(learning_rate).init(params)
+        return TrainState(
+            params=params,
+            opt_state=opt_state,
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    return jax.eval_shape(init_fn, rng)
+
+
+def train_state_shardings(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    learning_rate: float = 3e-4,
+    abstract: "TrainState" = None,
+) -> TrainState:
+    """A TrainState-shaped pytree of NamedShardings: the canonical
+    placement of every piece of training state on the mesh.
+
+    Built by walking each leaf's tree path against
+    param_sharding_rules — adam's mu/nu subtrees mirror the param tree,
+    so the same rules resolve; scalar leaves replicate. Used both as
+    the train step's pinned in/out shardings (so state placement can
+    never drift across steps) and as the checkpoint-restore target.
+    """
+    from .sharding import param_sharding_rules
+
+    if abstract is None:
+        abstract = _abstract_init(jax.random.PRNGKey(0), cfg, learning_rate)
+    rules = param_sharding_rules(cfg)
+    replicated = NamedSharding(mesh, P())
+
+    def resolve(path, leaf):
+        if getattr(leaf, "ndim", None) == 0:
+            return replicated
+        cursor: Any = rules
+        for key in path:
+            name = getattr(key, "key", getattr(key, "name", None))
+            if not isinstance(name, str):
+                continue  # tuple/namedtuple positions carry no rule info
+            # descend first; re-anchor at the top only on a miss (mu/nu
+            # subtrees mirror the param tree), so a nested param that
+            # happens to share a top-level name can't mis-resolve
+            if isinstance(cursor, dict) and name in cursor:
+                cursor = cursor[name]
+            elif name in rules:
+                cursor = rules[name]
+        if not isinstance(cursor, P):
+            # fail as loudly as shard_params' tree_map does on a
+            # rules/params mismatch — a silently replicated tensor is a
+            # multi-GB placement bug at real scale
+            raise ValueError(
+                f"no sharding rule resolves for state leaf at path "
+                f"{jax.tree_util.keystr(path)} (shape {leaf.shape})"
+            )
+        return NamedSharding(mesh, cursor)
+
+    return jax.tree_util.tree_map_with_path(resolve, abstract)
+
+
+def abstract_train_state(
+    rng: jax.Array,
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    learning_rate: float = 3e-4,
+) -> TrainState:
+    """The shape/dtype/sharding skeleton of init_train_state's result,
+    without materializing any arrays — the restore target for resuming
+    from a checkpoint (checkpoint.restore_checkpoint accepts it), so
+    resume never pays init + double residency."""
+    abstract = _abstract_init(rng, cfg, learning_rate)
+    shardings = train_state_shardings(cfg, mesh, learning_rate, abstract)
+    return jax.tree_util.tree_map(
+        lambda leaf, s: jax.ShapeDtypeStruct(
+            leaf.shape, leaf.dtype, sharding=s
+        ),
+        abstract,
+        shardings,
+    )
+
+
 def make_train_step(
     cfg: TransformerConfig, mesh: Mesh, learning_rate: float = 3e-4
 ) -> Callable[[TrainState, jax.Array], Tuple[TrainState, jax.Array]]:
     """Build the jitted, donated, sharded train step."""
     optimizer = make_optimizer(learning_rate)
     data_sharding = NamedSharding(mesh, batch_spec())
+    # pin the state's placement on both sides of the step so shardings
+    # can never drift from the rules across steps/restores
+    state_shardings = train_state_shardings(cfg, mesh, learning_rate)
 
     def step_fn(state: TrainState, tokens: jax.Array):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, cfg)
@@ -86,7 +175,8 @@ def make_train_step(
 
     jitted = jax.jit(
         step_fn,
-        in_shardings=(None, data_sharding),
+        in_shardings=(state_shardings, data_sharding),
+        out_shardings=(state_shardings, None),
         donate_argnums=(0,),
     )
 

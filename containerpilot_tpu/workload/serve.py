@@ -187,16 +187,22 @@ def main() -> int:
         d_ff=args.d_model * 3 // 128 * 128 or 128,
         max_seq_len=args.max_len,
     )
-    params = init_params(jax.random.PRNGKey(0), cfg)
+    params = None
     if args.checkpoint_dir:
-        from ..parallel import make_mesh, init_train_state, restore_checkpoint
+        from ..parallel import (
+            abstract_train_state,
+            make_mesh,
+            restore_checkpoint,
+        )
 
         mesh = make_mesh()
-        state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
-        restored = restore_checkpoint(args.checkpoint_dir, state)
+        abstract = abstract_train_state(jax.random.PRNGKey(0), cfg, mesh)
+        restored = restore_checkpoint(args.checkpoint_dir, abstract)
         if restored is not None:
             params = restored.params
             print(f"serving checkpoint step {int(restored.step)}")
+    if params is None:
+        params = init_params(jax.random.PRNGKey(0), cfg)
 
     server = InferenceServer(cfg, params, args.host, args.port, args.max_len)
 

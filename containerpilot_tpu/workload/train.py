@@ -57,18 +57,26 @@ def main() -> int:
     print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} "
           f"on {jax.default_backend()}")
     rng = jax.random.PRNGKey(0)
-    state = init_train_state(rng, cfg, mesh, args.learning_rate)
     train_step = make_train_step(cfg, mesh, args.learning_rate)
 
+    state = None
     start_step = 0
     if args.checkpoint_dir:
-        from ..parallel import restore_checkpoint, save_checkpoint
+        from ..parallel import (
+            abstract_train_state,
+            restore_checkpoint,
+            save_checkpoint,
+        )
 
-        restored = restore_checkpoint(args.checkpoint_dir, state)
-        if restored is not None:
-            state = restored
+        # restore into the eval_shape skeleton: no throwaway init, no
+        # double residency of model + optimizer state during resume
+        abstract = abstract_train_state(rng, cfg, mesh, args.learning_rate)
+        state = restore_checkpoint(args.checkpoint_dir, abstract)
+        if state is not None:
             start_step = int(state.step)
             print(f"resumed from checkpoint at step {start_step}")
+    if state is None:
+        state = init_train_state(rng, cfg, mesh, args.learning_rate)
 
     client = None
     if args.control_socket:
