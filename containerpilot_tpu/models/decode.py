@@ -27,6 +27,7 @@ from .transformer import (
     _ffn,
     _qkv,
     _rms_norm,
+    repeat_kv,
 )
 from ..ops.attention import NEG_INF, causal_attention
 
@@ -36,8 +37,10 @@ Cache = Dict[str, jax.Array]
 def init_cache(
     cfg: TransformerConfig, batch: int, max_len: int
 ) -> Cache:
-    """Zeroed KV cache: k/v are [layers, batch, max_len, heads, head_dim]."""
-    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    """Zeroed KV cache: k/v are [layers, batch, max_len, kv_heads,
+    head_dim] — under GQA the cache holds only the kv heads, which is
+    the whole point (n_heads/kv_heads smaller cache)."""
+    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -67,11 +70,13 @@ def prefill(
 
     def body(carry, layer_params):
         q, k, v = _qkv(carry, layer_params, cfg)
-        attn = attn_fn(q, k, v)
+        attn = attn_fn(
+            q, repeat_kv(k, cfg.n_heads), repeat_kv(v, cfg.n_heads)
+        )
         out, _aux = _ffn(
             _attn_out(carry, attn, layer_params, cfg), layer_params, cfg
         )
-        return out, (k, v)
+        return out, (k, v)  # cache stores the unrepeated kv heads
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])
     cache = init_cache(cfg, b, max_len)
@@ -104,15 +109,17 @@ def decode_step(
         v_cache = lax.dynamic_update_slice(
             v_cache, v, (0, pos, 0, 0)
         )
+        k_full = repeat_kv(k_cache, cfg.n_heads)
+        v_full = repeat_kv(v_cache, cfg.n_heads)
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk", q.astype(jnp.float32) * cfg.head_dim ** -0.5,
-            k_cache.astype(jnp.float32),
+            k_full.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )  # [b, h, 1, max_len]
         scores = jnp.where(valid[None, None, None, :], scores, NEG_INF)
         weights = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
         attn = jnp.einsum(
-            "bhqk,bkhd->bqhd", weights, v_cache,
+            "bhqk,bkhd->bqhd", weights, v_full,
             preferred_element_type=jnp.float32,
         ).astype(cfg.dtype)
         x = _attn_out(x, attn, layer_params, cfg)

@@ -33,6 +33,9 @@ class TransformerConfig:
     vocab_size: int = 32_000
     d_model: int = 512
     n_heads: int = 8
+    # grouped-query attention: fewer K/V heads than query heads shrinks
+    # the KV cache by n_heads/n_kv_heads; 0 means full multi-head
+    n_kv_heads: int = 0
     n_layers: int = 4
     d_ff: int = 1408  # SwiGLU hidden (multiple of 128)
     max_seq_len: int = 2048
@@ -53,6 +56,12 @@ class TransformerConfig:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_kv_heads or self.n_heads
+        assert self.n_heads % kv == 0, "n_heads must divide by n_kv_heads"
+        return kv
+
 
 Params = Dict[str, Any]
 
@@ -64,6 +73,7 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
     d, h, hd, f, L = (
         cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers,
     )
+    kv = cfg.kv_heads
 
     def dense(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32)
@@ -74,8 +84,8 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
     layers: Dict[str, Any] = {
         # attention projections, stacked over layers
         "wq": dense(ks[0], (L, d, h, hd), d),
-        "wk": dense(ks[1], (L, d, h, hd), d),
-        "wv": dense(ks[2], (L, d, h, hd), d),
+        "wk": dense(ks[1], (L, d, kv, hd), d),
+        "wv": dense(ks[2], (L, d, kv, hd), d),
         "wo": dense(ks[3], (L, h, hd, d), h * hd),
         "norm_attn": jnp.ones((L, d), jnp.float32),
         "norm_mlp": jnp.ones((L, d), jnp.float32),
@@ -124,7 +134,12 @@ def _qkv(
     cfg: TransformerConfig,
     offset: Any = 0,
 ):
-    """Pre-norm + q/k/v projections with RoPE applied at ``offset``."""
+    """Pre-norm + q/k/v projections with RoPE applied at ``offset``.
+
+    Under GQA, k/v come back with ``cfg.kv_heads`` heads — callers
+    either store them that way (the KV cache, which is the point of
+    GQA) or broadcast to full heads via ``repeat_kv`` for attention.
+    """
     dt = cfg.dtype
     h = _rms_norm(x, layer_params["norm_attn"])
     q = jnp.einsum("bsd,dhk->bshk", h, layer_params["wq"].astype(dt),
@@ -136,6 +151,14 @@ def _qkv(
     q = _rope(q, cfg.rope_theta, offset)
     k = _rope(k, cfg.rope_theta, offset)
     return q, k, v
+
+
+def repeat_kv(x: jax.Array, n_heads: int) -> jax.Array:
+    """Broadcast GQA k/v [b,s,kv,hd] to [b,s,n_heads,hd]."""
+    kv = x.shape[2]
+    if kv == n_heads:
+        return x
+    return jnp.repeat(x, n_heads // kv, axis=2)
 
 
 def _attn_out(
@@ -193,6 +216,8 @@ def _layer(
     """One transformer block. x: [batch, seq, d_model] in compute dtype.
     Returns (x, aux_loss)."""
     q, k, v = _qkv(x, layer_params, cfg)
+    k = repeat_kv(k, cfg.n_heads)
+    v = repeat_kv(v, cfg.n_heads)
     attn_fn = cfg.attention_fn or causal_attention
     attn = attn_fn(q, k, v)
     x = _attn_out(x, attn, layer_params, cfg)

@@ -23,18 +23,30 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def param_sharding_rules(cfg: Optional[Any] = None) -> Dict[str, Any]:
+def param_sharding_rules(
+    cfg: Optional[Any] = None, mesh: Optional[Mesh] = None
+) -> Dict[str, Any]:
     """PartitionSpec pytree matching models.transformer.init_params.
 
     With an MoE config (cfg.moe_experts > 0) the feed-forward specs are
     expert-parallel: the expert axis shards over ``model`` and XLA
     inserts all-to-alls at the dispatch/combine einsums.
+
+    Under GQA, wk/wv's kv-head axis may be smaller than the model axis;
+    when ``mesh`` is provided and kv_heads doesn't divide by it, those
+    two (small) tensors replicate instead of crashing placement.
     """
+    kv_spec = P(None, None, "model", None)
+    if cfg is not None and mesh is not None:
+        kv_heads = getattr(cfg, "kv_heads", None)
+        model_size = mesh.shape.get("model", 1)
+        if kv_heads is not None and kv_heads % model_size:
+            kv_spec = P(None, None, None, None)
     layers: Dict[str, Any] = {
         # [L, d, heads, head_dim]: shard heads over model axis
         "wq": P(None, None, "model", None),
-        "wk": P(None, None, "model", None),
-        "wv": P(None, None, "model", None),
+        "wk": kv_spec,
+        "wv": kv_spec,
         # [L, heads, head_dim, d]: row-parallel output projection
         "wo": P(None, "model", None, None),
         "norm_attn": P(None, None),  # replicated
@@ -74,7 +86,7 @@ def batch_spec() -> P:
 
 def shard_params(params: Any, mesh: Mesh, cfg: Optional[Any] = None) -> Any:
     """Place a param pytree onto the mesh per the rules."""
-    rules = param_sharding_rules(cfg)
+    rules = param_sharding_rules(cfg, mesh)
     return jax.tree_util.tree_map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         params,

@@ -96,7 +96,7 @@ def train_state_shardings(
 
     if abstract is None:
         abstract = _abstract_init(jax.random.PRNGKey(0), cfg, learning_rate)
-    rules = param_sharding_rules(cfg)
+    rules = param_sharding_rules(cfg, mesh)
     replicated = NamedSharding(mesh, P())
 
     def resolve(path, leaf):
@@ -132,13 +132,16 @@ def abstract_train_state(
     cfg: TransformerConfig,
     mesh: Mesh,
     learning_rate: float = 3e-4,
+    shardings: "TrainState" = None,
 ) -> TrainState:
     """The shape/dtype/sharding skeleton of init_train_state's result,
     without materializing any arrays — the restore target for resuming
     from a checkpoint (checkpoint.restore_checkpoint accepts it), so
-    resume never pays init + double residency."""
+    resume never pays init + double residency. Pass ``shardings`` (from
+    train_state_shardings) to avoid re-deriving them."""
     abstract = _abstract_init(rng, cfg, learning_rate)
-    shardings = train_state_shardings(cfg, mesh, learning_rate, abstract)
+    if shardings is None:
+        shardings = train_state_shardings(cfg, mesh, learning_rate, abstract)
     return jax.tree_util.tree_map(
         lambda leaf, s: jax.ShapeDtypeStruct(
             leaf.shape, leaf.dtype, sharding=s

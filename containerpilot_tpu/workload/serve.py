@@ -172,6 +172,9 @@ def main() -> int:
     parser.add_argument("--d-model", type=int, default=256)
     parser.add_argument("--n-layers", type=int, default=2)
     parser.add_argument("--n-heads", type=int, default=4)
+    parser.add_argument("--n-kv-heads", type=int, default=0,
+                        help="GQA kv heads (0 = full multi-head); must "
+                        "match the checkpoint being served")
     parser.add_argument("--vocab", type=int, default=1024)
     parser.add_argument(
         "--checkpoint-dir", default="",
@@ -183,6 +186,7 @@ def main() -> int:
         vocab_size=args.vocab,
         d_model=args.d_model,
         n_heads=args.n_heads,
+        n_kv_heads=args.n_kv_heads,
         n_layers=args.n_layers,
         d_ff=args.d_model * 3 // 128 * 128 or 128,
         max_seq_len=args.max_len,
@@ -196,6 +200,9 @@ def main() -> int:
         )
 
         mesh = make_mesh()
+        # the restore target includes optimizer state the server drops;
+        # this orbax version lacks partial (PLACEHOLDER) restore, so a
+        # params-only target is a later-round optimization
         abstract = abstract_train_state(jax.random.PRNGKey(0), cfg, mesh)
         restored = restore_checkpoint(args.checkpoint_dir, abstract)
         if restored is not None:

@@ -34,6 +34,8 @@ def main() -> int:
     parser.add_argument("--d-model", type=int, default=256)
     parser.add_argument("--n-layers", type=int, default=2)
     parser.add_argument("--n-heads", type=int, default=4)
+    parser.add_argument("--n-kv-heads", type=int, default=0,
+                        help="GQA kv heads (0 = full multi-head)")
     parser.add_argument("--vocab", type=int, default=1024)
     parser.add_argument("--progress-file", default="")
     parser.add_argument("--control-socket", default="")
@@ -49,6 +51,7 @@ def main() -> int:
         vocab_size=args.vocab,
         d_model=args.d_model,
         n_heads=args.n_heads,
+        n_kv_heads=args.n_kv_heads,
         n_layers=args.n_layers,
         d_ff=args.d_model * 3 // 128 * 128 or 128,
         max_seq_len=args.seq_len,

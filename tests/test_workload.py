@@ -646,3 +646,74 @@ def test_abstract_restore_skips_materialization(tmp_path):
     assert wq.sharding.spec == state.params["layers"]["wq"].sharding.spec
     restored, loss = step(restored, tokens)
     assert bool(jnp.isfinite(loss))
+
+
+def test_gqa_forward_and_decode_parity():
+    """Grouped-query attention: 4 query heads over 2 kv heads — the KV
+    cache shrinks and incremental decode still matches the forward."""
+    from containerpilot_tpu.models.decode import decode_step, init_cache, prefill
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=64, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=64, max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert params["layers"]["wk"].shape == (2, 64, 2, 16)  # kv heads
+    assert params["layers"]["wq"].shape == (2, 64, 4, 16)  # full heads
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (1, 10), 0, cfg.vocab_size, jnp.int32
+    )
+    full = forward(params, tokens, cfg)
+    assert bool(jnp.isfinite(full).all())
+
+    cache = init_cache(cfg, 1, 16)
+    assert cache["k"].shape == (2, 1, 16, 2, 16)  # halved kv-head cache
+
+    logits, cache = prefill(params, tokens[:, :5], cfg, max_len=16)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, 4]), rtol=2e-4, atol=2e-4
+    )
+    for i in range(5, 10):
+        logits, cache = decode_step(params, cache, tokens[:, i], cfg)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full[:, i]), rtol=2e-4,
+            atol=2e-4, err_msg=f"position {i}",
+        )
+
+
+def test_gqa_trains_sharded():
+    """GQA + tp: kv heads (2) shard over a 2-way model axis."""
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=128, max_seq_len=64,
+    )
+    mesh = make_mesh(jax.devices()[:8], plan=MeshPlan(data=4, model=2))
+    state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (4, 33), 0, cfg.vocab_size, jnp.int32
+    )
+    state, loss = step(state, tokens)
+    assert bool(jnp.isfinite(loss))
+
+
+def test_gqa_default_mesh_replicates_small_kv_axis():
+    """GQA with kv_heads smaller than the auto-picked model axis must
+    place (replicate wk/wv) instead of crashing."""
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=128, max_seq_len=64,
+    )
+    mesh = make_mesh(jax.devices()[:8])  # auto plan: model=4 > kv=2
+    state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (4, 33), 0, cfg.vocab_size, jnp.int32
+    )
+    state, loss = step(state, tokens)
+    assert bool(jnp.isfinite(loss))
+    from jax.sharding import PartitionSpec as P
+
+    assert state.params["layers"]["wk"].sharding.spec == P(
+        None, None, None, None
+    )
