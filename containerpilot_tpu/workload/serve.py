@@ -71,6 +71,7 @@ class InferenceServer:
                 "vocab_size": self.cfg.vocab_size,
                 "d_model": self.cfg.d_model,
                 "n_heads": self.cfg.n_heads,
+                "n_kv_heads": self.cfg.kv_heads,
                 "n_layers": self.cfg.n_layers,
                 "max_len": self.max_len,
             }
