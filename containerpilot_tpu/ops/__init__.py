@@ -3,6 +3,7 @@ pallas flash forward, memory-efficient training (custom VJP), and
 ring/context-parallel."""
 from .attention import causal_attention, flash_attention_forward
 from .flash_training import memory_efficient_attention
+from .quant import int8_matmul, int8_matmul_pallas, quantize_int8
 from .ring_attention import ring_attention
 
 __all__ = [
@@ -10,4 +11,7 @@ __all__ = [
     "flash_attention_forward",
     "memory_efficient_attention",
     "ring_attention",
+    "quantize_int8",
+    "int8_matmul",
+    "int8_matmul_pallas",
 ]

@@ -717,3 +717,36 @@ def test_gqa_default_mesh_replicates_small_kv_axis():
     assert state.params["layers"]["wk"].sharding.spec == P(
         None, None, None, None
     )
+
+
+def test_int8_quantized_matmul():
+    """Weight-only int8: quantization error bounded, pallas kernel
+    (interpret mode) matches the XLA dequant path."""
+    from containerpilot_tpu.ops import (
+        int8_matmul,
+        int8_matmul_pallas,
+        quantize_int8,
+    )
+
+    kx, kw = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.random.normal(kx, (128, 256), jnp.float32)
+    w = jax.random.normal(kw, (256, 384), jnp.float32)
+    w_q, scales = quantize_int8(w)
+    assert w_q.dtype == jnp.int8 and scales.shape == (384,)
+    # dequantized weights approximate the originals per-channel
+    w_hat = w_q.astype(jnp.float32) * scales[None, :]
+    assert float(jnp.max(jnp.abs(w_hat - w))) < float(jnp.max(scales)) * 0.51
+
+    exact = x @ w
+    ref = int8_matmul(x, w_q, scales)
+    # int8 matmul error grows with sqrt(K); relative tolerance
+    rel = float(jnp.max(jnp.abs(ref - exact)) / jnp.max(jnp.abs(exact)))
+    assert rel < 0.02, rel
+    out = int8_matmul_pallas(x, w_q, scales)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(out), rtol=2e-3, atol=2e-3
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        int8_matmul_pallas(x[:100], w_q, scales)
+    with pytest.raises(ValueError, match="inner dims"):
+        int8_matmul_pallas(x[:, :128], w_q, scales)
