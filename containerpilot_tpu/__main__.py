@@ -41,6 +41,13 @@ def main(argv=None) -> int:
         asyncio.run(app.run())
     except KeyboardInterrupt:  # pragma: no cover
         return 130
+    except OSError as exc:
+        # e.g. telemetry/control bind exhausting its retries — a clean
+        # one-line fatal beats an asyncio traceback; the full trace
+        # still lands in the log for diagnosis
+        logging.getLogger("containerpilot").exception("fatal error")
+        print(f"fatal: {exc}", file=sys.stderr)
+        return 1
     return 0
 
 

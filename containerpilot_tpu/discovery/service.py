@@ -107,6 +107,11 @@ class ServiceDefinition:
                 self.backend.update_ttl(f"service:{self.id}", "ok", "pass")
             except DiscoveryError as exc:
                 log.warning("service update TTL failed: %s", exc)
+                # self-heal from catalog state loss (restarted agent,
+                # wiped store): assume our registration is gone and
+                # lazily re-register on the next heartbeat. The
+                # reference warns forever and never recovers.
+                self.was_registered = False
 
         return self._enqueue(work, dedup=True)
 

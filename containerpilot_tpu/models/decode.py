@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .quantized import embed_lookup, maybe_dequant_layer, maybe_dequant_top
 from .transformer import (
     Params,
     TransformerConfig,
@@ -51,7 +52,7 @@ def init_cache(
 def _logits(params: Params, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
     x = _rms_norm(x, params["norm_out"])
     return jnp.einsum(
-        "bsd,dv->bsv", x, params["unembed"].astype(cfg.dtype),
+        "bsd,dv->bsv", x, maybe_dequant_top(params, "unembed", cfg.dtype),
         preferred_element_type=jnp.float32,
     )
 
@@ -64,11 +65,12 @@ def prefill(
     tokens: [batch, prompt_len] int32; prompt_len <= max_len.
     """
     b, s = tokens.shape
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = embed_lookup(params, tokens, cfg.dtype)
 
     attn_fn = cfg.attention_fn or causal_attention
 
     def body(carry, layer_params):
+        layer_params = maybe_dequant_layer(layer_params, cfg.dtype)
         q, k, v = _qkv(carry, layer_params, cfg)
         attn = attn_fn(
             q, repeat_kv(k, cfg.n_heads), repeat_kv(v, cfg.n_heads)
@@ -95,12 +97,13 @@ def decode_step(
     pos = cache["pos"]
     b = token.shape[0]
     max_len = cache["k"].shape[2]
-    x = params["embed"].astype(cfg.dtype)[token][:, None, :]  # [b,1,d]
+    x = embed_lookup(params, token, cfg.dtype)[:, None, :]  # [b,1,d]
     valid = jnp.arange(max_len) <= pos  # [max_len]; pos itself is valid
 
     def body(carry, inputs):
         x = carry
         layer_params, k_cache, v_cache = inputs
+        layer_params = maybe_dequant_layer(layer_params, cfg.dtype)
         q, k, v = _qkv(x, layer_params, cfg, offset=pos)
         # write this step's k/v at position pos
         k_cache = lax.dynamic_update_slice(

@@ -1,0 +1,113 @@
+"""Model-level weight-only int8: quantize a trained param pytree and
+run the same forward/decode code on it.
+
+``quantize_model_params`` converts every large matmul weight (attention
+projections, MLP/MoE, embed/unembed) to int8 with broadcast-ready
+per-output-channel scales; norms stay float. The model's scan bodies
+call ``maybe_dequant_layer`` first, so quantized and full-precision
+params flow through identical math — resident weight memory shrinks ~4x (int8 vs the f32 master copies)
+(the per-layer bf16 dequant is transient, one layer at a time under the
+scan; fusing the dequant into each matmul via ops/quant.py's pallas
+GEMM is the round-2 step).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# layers-dict keys to quantize -> axes reduced for the scale (the input
+# axes of the matmul; remaining axes are output channels). Leading axis
+# 0 is the stacked-layer axis, never reduced.
+_LAYER_QUANT_AXES: Dict[str, Tuple[int, ...]] = {
+    "wq": (1,),        # [L, d, h, hd]: reduce d
+    "wk": (1,),
+    "wv": (1,),
+    "wo": (1, 2),      # [L, h, hd, d]: reduce h, hd
+    "w_gate": (1,),    # [L, d, f]
+    "w_up": (1,),
+    "w_down": (1,),    # [L, f, d]
+    "moe_w_in": (2,),  # [L, E, d, f]: reduce d (per expert)
+    "moe_w_out": (2,), # [L, E, f, d]
+}
+
+_TOP_QUANT_AXES: Dict[str, Tuple[int, ...]] = {
+    "embed": (1,),     # [vocab, d]: reduce d -> scale per vocab row
+    "unembed": (0,),   # [d, vocab]: reduce d -> scale per vocab col
+}
+
+
+def _quantize_tensor(
+    w: jax.Array, axes: Tuple[int, ...]
+) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 with scales keepdims-shaped for one-multiply
+    dequant (and clean slicing through the stacked-layer axis)."""
+    from ..ops.quant import quantize_int8_axes
+
+    return quantize_int8_axes(w, axes)
+
+
+def quantize_model_params(params: Any) -> Any:
+    """Quantize a transformer param pytree in place-shape: each listed
+    weight W becomes W_q (int8) + W_s (f32 scales); others unchanged."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    for key, axes in _LAYER_QUANT_AXES.items():
+        if key in layers:
+            w_q, scales = _quantize_tensor(layers.pop(key), axes)
+            layers[key + "_q"] = w_q
+            layers[key + "_s"] = scales
+    out["layers"] = layers
+    for key, axes in _TOP_QUANT_AXES.items():
+        if key in out:
+            w_q, scales = _quantize_tensor(out.pop(key), axes)
+            out[key + "_q"] = w_q
+            out[key + "_s"] = scales
+    return out
+
+
+def is_quantized(params: Any) -> bool:
+    return "wq_q" in params.get("layers", {}) or "embed_q" in params
+
+
+def maybe_dequant_layer(
+    layer_params: Dict[str, jax.Array], dtype: Any
+) -> Dict[str, jax.Array]:
+    """Rebuild a dense layer-params dict from a quantized one (no-op
+    for full-precision input). Runs inside the layer scan body, so only
+    one layer's weights are ever dense at a time."""
+    if "wq_q" not in layer_params and "moe_w_in_q" not in layer_params:
+        return layer_params
+    dense = dict(layer_params)
+    for key in _LAYER_QUANT_AXES:
+        q = dense.pop(key + "_q", None)
+        s = dense.pop(key + "_s", None)
+        if q is not None:
+            dense[key] = (q.astype(jnp.float32) * s).astype(dtype)
+    return dense
+
+
+def embed_lookup(params: Any, tokens: jax.Array, dtype: Any) -> jax.Array:
+    """Embedding gather that dequantizes only the gathered rows when
+    the table is stored int8."""
+    if "embed" in params:
+        return params["embed"].astype(dtype)[tokens]
+    rows = params["embed_q"][tokens].astype(jnp.float32)
+    scales = params["embed_s"][tokens][..., 0][..., None]  # [., 1]
+    return (rows * scales).astype(dtype)
+
+
+def maybe_dequant_top(params: Any, key: str, dtype: Any) -> jax.Array:
+    """Fetch a top-level tensor, dequantizing if stored int8."""
+    if key in params:
+        return params[key].astype(dtype)
+    q = params[key + "_q"]
+    s = params[key + "_s"]
+    return (q.astype(jnp.float32) * s).astype(dtype)
+
+
+def param_bytes(params: Any) -> int:
+    return sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(params)
+    )

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import causal_attention
+from .quantized import embed_lookup, maybe_dequant_layer, maybe_dequant_top
 
 
 @dataclass(frozen=True)
@@ -215,6 +216,7 @@ def _layer(
 ):
     """One transformer block. x: [batch, seq, d_model] in compute dtype.
     Returns (x, aux_loss)."""
+    layer_params = maybe_dequant_layer(layer_params, cfg.dtype)
     q, k, v = _qkv(x, layer_params, cfg)
     k = repeat_kv(k, cfg.n_heads)
     v = repeat_kv(v, cfg.n_heads)
@@ -233,7 +235,7 @@ def forward_with_aux(
     The layer stack is a lax.scan over stacked layer params: one
     compiled block body, L iterations, rematerialization-friendly.
     """
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = embed_lookup(params, tokens, cfg.dtype)
 
     def body(carry, layer_params):
         x, aux = carry
@@ -245,7 +247,7 @@ def forward_with_aux(
     )
     x = _rms_norm(x, params["norm_out"])
     logits = jnp.einsum(
-        "bsd,dv->bsv", x, params["unembed"].astype(cfg.dtype),
+        "bsd,dv->bsv", x, maybe_dequant_top(params, "unembed", cfg.dtype),
         preferred_element_type=jnp.float32,
     )
     return logits, aux

@@ -26,19 +26,28 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+def quantize_int8_axes(
+    w: jax.Array, axes: Tuple[int, ...]
+) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 over the given (input) axes; scales come back
+    keepdims-shaped so dequant is a single broadcast multiply. The one
+    quantization formula in the codebase — model-level quantization
+    (models/quantized.py) calls this too."""
+    wf = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=axes, keepdims=True)
+    scales = jnp.maximum(absmax, 1e-8) / 127.0
+    w_q = jnp.clip(jnp.round(wf / scales), -127, 127).astype(jnp.int8)
+    return w_q, scales
+
+
 def quantize_int8(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Per-output-channel symmetric int8 quantization.
 
     w: [in_features, out_features] float -> (w_q int8 same shape,
     scales f32 [out_features]); w ≈ w_q * scales.
     """
-    wf = w.astype(jnp.float32)
-    absmax = jnp.max(jnp.abs(wf), axis=0)  # per output channel
-    scales = jnp.maximum(absmax, 1e-8) / 127.0
-    w_q = jnp.clip(jnp.round(wf / scales[None, :]), -127, 127).astype(
-        jnp.int8
-    )
-    return w_q, scales
+    w_q, scales = quantize_int8_axes(w, (0,))
+    return w_q, scales[0, :]
 
 
 def int8_matmul(

@@ -181,6 +181,10 @@ def main() -> int:
         "--checkpoint-dir", default="",
         help="load trained params from the latest checkpoint",
     )
+    parser.add_argument(
+        "--int8", action="store_true",
+        help="weight-only int8: ~4x smaller resident params",
+    )
     args = parser.parse_args()
 
     cfg = TransformerConfig(
@@ -211,6 +215,15 @@ def main() -> int:
             print(f"serving checkpoint step {int(restored.step)}")
     if params is None:
         params = init_params(jax.random.PRNGKey(0), cfg)
+    if args.int8:
+        from ..models.quantized import param_bytes, quantize_model_params
+
+        before = param_bytes(params)
+        params = quantize_model_params(params)
+        print(
+            f"int8: params {before} -> {param_bytes(params)} bytes "
+            f"({before / param_bytes(params):.1f}x smaller)"
+        )
 
     server = InferenceServer(cfg, params, args.host, args.port, args.max_len)
 

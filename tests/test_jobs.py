@@ -259,3 +259,42 @@ def test_sighup_triggered_job(run):
     ran_once, total = run(scenario(), timeout=15)
     assert ran_once
     assert total >= 2
+
+
+def test_heartbeat_self_heals_after_catalog_loss(run, tmp_path):
+    """If the catalog loses our registration (restart, wipe), the next
+    heartbeat re-registers instead of warning forever."""
+    import shutil
+
+    from containerpilot_tpu.discovery import FileCatalogBackend
+
+    async def scenario():
+        disc = FileCatalogBackend(str(tmp_path / "cat"))
+        bus = EventBus()
+        job = make_job(
+            {
+                "name": "web",
+                "exec": "sleep 10",
+                "port": 8000,
+                "interfaces": ["static:10.0.0.1"],
+                "health": {"exec": "true", "interval": 1, "ttl": 5},
+            },
+            disc,
+        )
+        job.heartbeat = 0.05
+        tasks = await start_jobs(bus, job)
+        bus.publish(GLOBAL_STARTUP)
+        await asyncio.sleep(0.3)
+        assert disc.instances("web"), "registered initially"
+        # catalog wiped out from under us
+        shutil.rmtree(str(tmp_path / "cat" / "services" / "web"))
+        await asyncio.sleep(0.4)  # one failed TTL + a healing heartbeat
+        healed = bool(disc.instances("web"))
+        bus.shutdown()
+        await bus.wait()
+        await asyncio.gather(*tasks)
+        job.kill()
+        await asyncio.sleep(0.1)
+        return healed
+
+    assert run(scenario(), timeout=15)

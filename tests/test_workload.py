@@ -750,3 +750,66 @@ def test_int8_quantized_matmul():
         int8_matmul_pallas(x[:100], w_q, scales)
     with pytest.raises(ValueError, match="inner dims"):
         int8_matmul_pallas(x[:, :128], w_q, scales)
+
+
+def test_int8_model_quantization_end_to_end():
+    """Model-level weight-only int8: ~4x smaller params, small logit
+    error, and the quantized decode path matches the quantized forward
+    (teacher forcing) so serving is self-consistent."""
+    from containerpilot_tpu.models.decode import decode_step, generate, prefill
+    from containerpilot_tpu.models.quantized import (
+        is_quantized,
+        param_bytes,
+        quantize_model_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=128, max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    pq = quantize_model_params(params)
+    assert is_quantized(pq) and not is_quantized(params)
+    assert param_bytes(params) / param_bytes(pq) > 3.0
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 10), 0, cfg.vocab_size, jnp.int32
+    )
+    full = forward(params, tokens, cfg)
+    quant = forward(pq, tokens, cfg)
+    rel = float(jnp.max(jnp.abs(full - quant)) / jnp.max(jnp.abs(full)))
+    assert rel < 0.05, rel
+
+    # quantized incremental decode == quantized forward, per position
+    logits, cache = prefill(pq, tokens[:, :5], cfg, max_len=16)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(quant[:, 4]), rtol=2e-4, atol=2e-4
+    )
+    for i in range(5, 10):
+        logits, cache = decode_step(pq, cache, tokens[:, i], cfg)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(quant[:, i]), rtol=2e-4,
+            atol=2e-4, err_msg=f"position {i}",
+        )
+    out = generate(pq, tokens[:, :4], cfg, max_new_tokens=4, max_len=16)
+    assert out.shape == (2, 4)
+
+
+def test_int8_moe_quantization():
+    """MoE expert weights quantize too."""
+    from containerpilot_tpu.models.quantized import quantize_model_params
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, moe_experts=2, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    pq = quantize_model_params(params)
+    assert "moe_w_in_q" in pq["layers"]
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (1, 8), 0, 64, jnp.int32
+    )
+    full = forward(params, tokens, cfg)
+    quant = forward(pq, tokens, cfg)
+    rel = float(jnp.max(jnp.abs(full - quant)) / jnp.max(jnp.abs(full)))
+    assert rel < 0.08, rel
