@@ -64,6 +64,12 @@ def prefill(
 
     tokens: [batch, prompt_len] int32; prompt_len <= max_len.
     """
+    if cfg.moe_train_capacity > 0:
+        raise ValueError(
+            "incremental decoding requires a serving config with "
+            "moe_train_capacity=0 (capacity routing is sequence-length "
+            "dependent and cannot match decode)"
+        )
     b, s = tokens.shape
     x = embed_lookup(params, tokens, cfg.dtype)
 

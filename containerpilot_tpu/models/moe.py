@@ -19,6 +19,24 @@ import jax
 import jax.numpy as jnp
 
 
+def _route(x: jax.Array, router_w: jax.Array):
+    """Top-1 switch routing shared by the drop-free and capacity
+    layers: returns (probs, gate, onehot, aux_loss)."""
+    n_experts = router_w.shape[-1]
+    router_logits = jnp.einsum(
+        "bsd,de->bse", x.astype(jnp.float32), router_w.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [b,s,E]
+    expert_idx = jnp.argmax(probs, axis=-1)  # [b,s]
+    gate = jnp.max(probs, axis=-1)  # [b,s]
+    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)
+    fraction = jnp.mean(onehot, axis=(0, 1))
+    router_mean = jnp.mean(probs, axis=(0, 1))
+    aux_loss = n_experts * jnp.sum(fraction * router_mean)
+    return probs, gate, onehot, aux_loss
+
+
 def moe_layer(
     x: jax.Array,
     router_w: jax.Array,  # [d_model, n_experts]
@@ -31,21 +49,11 @@ def moe_layer(
     result for any token depends only on that token's features — which
     is what makes incremental decoding bit-identical to the full
     forward. The cost is dense dispatch (each expert processes the full
-    masked sequence); a capacity-bounded sparse dispatch is a
-    throughput optimization for a later round and must thread its drop
-    state through the KV cache to keep decode parity.
+    masked sequence). For bounded expert compute during training use
+    ``moe_layer_capacity``; decoding always uses this drop-free layer
+    (models/decode.py rejects capacity configs).
     """
-    b, s, d = x.shape
-    n_experts = router_w.shape[-1]
-
-    router_logits = jnp.einsum(
-        "bsd,de->bse", x.astype(jnp.float32), router_w.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    )
-    probs = jax.nn.softmax(router_logits, axis=-1)  # [b,s,E]
-    expert_idx = jnp.argmax(probs, axis=-1)  # [b,s]
-    gate = jnp.max(probs, axis=-1)  # [b,s]
-    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)
+    _probs, gate, onehot, aux_loss = _route(x, router_w)
 
     # note: no preferred_element_type=f32 on the batched expert einsums
     # — the TPU MXU accumulates bf16 inputs in f32 internally, and the
@@ -57,9 +65,48 @@ def moe_layer(
     expert_out = jnp.einsum("besf,efd->besd", hidden, w_out.astype(dt))
     combine = (onehot * gate[..., None]).astype(dt)
     out = jnp.einsum("bse,besd->bsd", combine, expert_out)
+    return out, aux_loss
 
-    # switch load-balancing loss
-    fraction = jnp.mean(onehot, axis=(0, 1))          # tokens per expert
-    router_mean = jnp.mean(probs, axis=(0, 1))        # mean prob per expert
-    aux_loss = n_experts * jnp.sum(fraction * router_mean)
+
+def moe_layer_capacity(
+    x: jax.Array,
+    router_w: jax.Array,  # [d_model, n_experts]
+    w_in: jax.Array,      # [n_experts, d_model, d_ff]
+    w_out: jax.Array,     # [n_experts, d_ff, d_model]
+    capacity_factor: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Capacity-bounded switch MoE for TRAINING: each expert processes
+    at most ``ceil(capacity_factor * s / E)`` tokens per batch row;
+    overflow tokens drop to the residual (standard switch training).
+    Expert FLOPs are bounded at capacity instead of the drop-free
+    layer's dense E×. Inference must use the drop-free ``moe_layer``
+    (capacity depends on sequence length, so this routing cannot match
+    incremental decode — models/decode.py enforces that).
+    """
+    import math
+
+    b, s, d = x.shape
+    n_experts = router_w.shape[-1]
+    capacity = max(1, math.ceil(capacity_factor * s / n_experts))
+
+    _probs, gate, onehot, aux_loss = _route(x, router_w)
+
+    # position of each token within its expert's queue (per batch row);
+    # tokens past capacity drop to the residual
+    pos_in_expert = (jnp.cumsum(onehot, axis=1) * onehot - 1.0).astype(
+        jnp.int32
+    )
+    keep = (pos_in_expert >= 0) & (pos_in_expert < capacity)
+    dispatch = (
+        jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)
+        * keep[..., None]
+    )  # [b, s, E, C]
+    combine = dispatch * gate[..., None, None]
+
+    dt = x.dtype
+    expert_in = jnp.einsum("bsec,bsd->becd", dispatch.astype(dt), x)
+    hidden = jnp.einsum("becd,edf->becf", expert_in, w_in.astype(dt))
+    hidden = jax.nn.gelu(hidden.astype(jnp.float32)).astype(dt)
+    expert_out = jnp.einsum("becf,efd->becd", hidden, w_out.astype(dt))
+    out = jnp.einsum("bsec,becd->bsd", combine.astype(dt), expert_out)
     return out, aux_loss

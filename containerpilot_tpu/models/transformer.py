@@ -51,6 +51,11 @@ class TransformerConfig:
     # axis sharded over the mesh's "model" axis for expert parallelism)
     moe_experts: int = 0
     moe_aux_weight: float = 0.01
+    # >0 enables capacity-bounded expert compute for TRAINING (tokens
+    # past ceil(factor*s/E) per expert drop to the residual — standard
+    # switch training). Inference/serving configs must leave this 0:
+    # capacity routing can't match incremental decode.
+    moe_train_capacity: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -198,15 +203,24 @@ def _ffn(
     """The feed-forward half: dense SwiGLU or switch-routed experts.
     Returns (x, aux_loss)."""
     if cfg.moe_experts > 0:
-        from .moe import moe_layer
+        from .moe import moe_layer, moe_layer_capacity
 
         h = _rms_norm(x, layer_params["norm_mlp"])
-        out, aux = moe_layer(
-            h,
-            layer_params["router"],
-            layer_params["moe_w_in"],
-            layer_params["moe_w_out"],
-        )
+        if cfg.moe_train_capacity > 0:
+            out, aux = moe_layer_capacity(
+                h,
+                layer_params["router"],
+                layer_params["moe_w_in"],
+                layer_params["moe_w_out"],
+                cfg.moe_train_capacity,
+            )
+        else:
+            out, aux = moe_layer(
+                h,
+                layer_params["router"],
+                layer_params["moe_w_in"],
+                layer_params["moe_w_out"],
+            )
         return x + out, aux
     return _mlp(x, layer_params, cfg), jnp.zeros((), jnp.float32)
 

@@ -176,6 +176,9 @@ def main() -> int:
     parser.add_argument("--n-kv-heads", type=int, default=0,
                         help="GQA kv heads (0 = full multi-head); must "
                         "match the checkpoint being served")
+    parser.add_argument("--moe-experts", type=int, default=0,
+                        help="switch-MoE experts; must match the "
+                        "checkpoint being served")
     parser.add_argument("--vocab", type=int, default=1024)
     parser.add_argument(
         "--checkpoint-dir", default="",
@@ -195,6 +198,7 @@ def main() -> int:
         n_layers=args.n_layers,
         d_ff=args.d_model * 3 // 128 * 128 or 128,
         max_seq_len=args.max_len,
+        moe_experts=args.moe_experts,
     )
     params = None
     if args.checkpoint_dir:

@@ -36,6 +36,11 @@ def main() -> int:
     parser.add_argument("--n-heads", type=int, default=4)
     parser.add_argument("--n-kv-heads", type=int, default=0,
                         help="GQA kv heads (0 = full multi-head)")
+    parser.add_argument("--moe-experts", type=int, default=0,
+                        help="switch-MoE experts (0 = dense MLP)")
+    parser.add_argument("--moe-capacity", type=float, default=0.0,
+                        help="capacity factor for bounded expert compute "
+                        "during training (0 = drop-free routing)")
     parser.add_argument("--vocab", type=int, default=1024)
     parser.add_argument("--progress-file", default="")
     parser.add_argument("--control-socket", default="")
@@ -55,6 +60,8 @@ def main() -> int:
         n_layers=args.n_layers,
         d_ff=args.d_model * 3 // 128 * 128 or 128,
         max_seq_len=args.seq_len,
+        moe_experts=args.moe_experts,
+        moe_train_capacity=args.moe_capacity,
     )
     mesh = make_mesh()
     print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} "

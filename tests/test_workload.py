@@ -813,3 +813,48 @@ def test_int8_moe_quantization():
     quant = forward(pq, tokens, cfg)
     rel = float(jnp.max(jnp.abs(full - quant)) / jnp.max(jnp.abs(full)))
     assert rel < 0.08, rel
+
+
+def test_moe_capacity_training_mode():
+    """Capacity-bounded MoE: trains (loss drops), matches drop-free
+    routing when capacity is ample, diverges under pressure, and is
+    refused by the decode path."""
+    import dataclasses
+
+    from containerpilot_tpu.models.decode import prefill
+
+    base = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq_len=64, moe_experts=2, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), base)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 16), 0, base.vocab_size, jnp.int32
+    )
+    free = forward(params, tokens, base)
+    ample = dataclasses.replace(base, moe_train_capacity=8.0)
+    np.testing.assert_allclose(
+        np.asarray(free), np.asarray(forward(params, tokens, ample)),
+        rtol=1e-4, atol=1e-4,
+    )  # capacity >= every queue: identical routing
+    tight = dataclasses.replace(base, moe_train_capacity=0.5)
+    squeezed = forward(params, tokens, tight)
+    assert float(jnp.max(jnp.abs(free - squeezed))) > 1e-3  # drops happened
+
+    # trains end-to-end
+    mesh = make_mesh(jax.devices()[:8], plan=MeshPlan(data=4, model=2))
+    state = init_train_state(jax.random.PRNGKey(0), tight, mesh,
+                             learning_rate=1e-2)
+    step = make_train_step(tight, mesh, learning_rate=1e-2)
+    batch = jax.random.randint(
+        jax.random.PRNGKey(2), (4, 33), 0, base.vocab_size, jnp.int32
+    )
+    first = None
+    for _ in range(5):
+        state, loss = step(state, batch)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first
+
+    with pytest.raises(ValueError, match="moe_train_capacity"):
+        prefill(params, tokens[:, :8], tight, max_len=32)
