@@ -64,7 +64,7 @@ def prefill(
 
     tokens: [batch, prompt_len] int32; prompt_len <= max_len.
     """
-    if cfg.moe_train_capacity > 0:
+    if cfg.moe_experts > 0 and cfg.moe_train_capacity > 0:
         raise ValueError(
             "incremental decoding requires a serving config with "
             "moe_train_capacity=0 (capacity routing is sequence-length "

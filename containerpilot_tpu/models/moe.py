@@ -96,10 +96,10 @@ def moe_layer_capacity(
     pos_in_expert = (jnp.cumsum(onehot, axis=1) * onehot - 1.0).astype(
         jnp.int32
     )
-    keep = (pos_in_expert >= 0) & (pos_in_expert < capacity)
-    dispatch = (
-        jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)
-        * keep[..., None]
+    # one_hot zeroes out-of-range rows itself: the -1 of unrouted
+    # tokens and queue positions >= capacity both drop
+    dispatch = jax.nn.one_hot(
+        pos_in_expert, capacity, dtype=jnp.float32
     )  # [b, s, E, C]
     combine = dispatch * gate[..., None, None]
 

@@ -57,6 +57,12 @@ class TransformerConfig:
     # capacity routing can't match incremental decode.
     moe_train_capacity: float = 0.0
 
+    def __post_init__(self) -> None:
+        if self.moe_train_capacity > 0 and self.moe_experts == 0:
+            raise ValueError(
+                "moe_train_capacity requires moe_experts > 0"
+            )
+
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0

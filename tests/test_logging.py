@@ -1,0 +1,65 @@
+"""Logging config tests (reference: config/logger/logging_test.go):
+formats, outputs, level filtering, and validation."""
+import json
+import logging
+
+import pytest
+
+from containerpilot_tpu.config.logger import LogConfig, LogConfigError
+
+
+@pytest.fixture
+def cp_logger():
+    return logging.getLogger("containerpilot")
+
+
+def test_defaults():
+    cfg = LogConfig(None)
+    assert (cfg.level, cfg.format, cfg.output) == ("INFO", "default", "stdout")
+
+
+@pytest.mark.parametrize(
+    "raw",
+    [
+        {"level": "SOMETIMES"},
+        {"format": "xml"},
+        {"bogus": 1},
+    ],
+)
+def test_invalid_config_rejected(raw):
+    with pytest.raises(LogConfigError):
+        LogConfig(raw)
+
+
+def test_json_format_to_file(tmp_path, cp_logger):
+    log_file = tmp_path / "cp.json.log"
+    LogConfig({"level": "INFO", "format": "json", "output": str(log_file)}).init()
+    cp_logger.info("hello %s", "world", extra={"job": "j1", "pid": 42})
+    cp_logger.debug("filtered out")
+    for handler in cp_logger.handlers:
+        handler.flush()
+    lines = log_file.read_text().strip().splitlines()
+    assert len(lines) == 1
+    entry = json.loads(lines[0])
+    assert entry["msg"] == "hello world"
+    assert entry["level"] == "info"
+    assert entry["job"] == "j1" and entry["pid"] == 42
+
+
+def test_default_format_includes_fields(tmp_path, cp_logger):
+    log_file = tmp_path / "cp.log"
+    LogConfig({"level": "DEBUG", "output": str(log_file)}).init()
+    cp_logger.debug("tick", extra={"check": "check.web"})
+    for handler in cp_logger.handlers:
+        handler.flush()
+    line = log_file.read_text()
+    assert "[DEBUG]" in line and "check=check.web" in line and "tick" in line
+
+
+def test_text_format(tmp_path, cp_logger):
+    log_file = tmp_path / "t.log"
+    LogConfig({"format": "text", "output": str(log_file)}).init()
+    cp_logger.warning("boom")
+    for handler in cp_logger.handlers:
+        handler.flush()
+    assert "level=WARNING" in log_file.read_text()

@@ -858,3 +858,8 @@ def test_moe_capacity_training_mode():
 
     with pytest.raises(ValueError, match="moe_train_capacity"):
         prefill(params, tokens[:, :8], tight, max_len=32)
+
+
+def test_moe_capacity_requires_experts():
+    with pytest.raises(ValueError, match="requires moe_experts"):
+        TransformerConfig(moe_train_capacity=1.0)
