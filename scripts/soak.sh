@@ -1,0 +1,47 @@
+#!/bin/sh
+# Endurance soak: reload churn under a crash-looping job, health
+# checks, a watch, and telemetry; samples supervisor RSS per cycle.
+# Reproduces the README endurance claim:
+#   scripts/soak.sh [cycles=60] [period_seconds=55]
+# Pass/fail: prints FIRST/LAST RSS and grep counts of exceptions; a
+# healthy run holds RSS flat and reports zero exceptions.
+set -eu
+
+CYCLES=${1:-60}
+PERIOD=${2:-55}
+DIR=$(mktemp -d /tmp/cp-soak.XXXXXX)
+CFG="$DIR/soak.json5"
+
+cat > "$CFG" <<EOF
+{
+  consul: "file:$DIR/cat",
+  stopTimeout: "500ms",
+  control: { socket: "$DIR/s.socket" },
+  telemetry: { port: 19500, interfaces: ["static:127.0.0.1"] },
+  jobs: [
+    { name: "steady", exec: ["/bin/sh", "-c", "while true; do sleep 0.5; done"],
+      restarts: "unlimited", port: 7500, interfaces: ["static:127.0.0.1"],
+      health: { exec: "true", interval: 1, ttl: 5 } },
+    { name: "crashy", exec: ["/bin/sh", "-c", "sleep 1; exit 1"], restarts: "unlimited" },
+    { name: "tick", exec: "true", when: { interval: "500ms" } },
+  ],
+  watches: [{ name: "steady", interval: 1 }],
+}
+EOF
+
+python -m containerpilot_tpu -config "$CFG" > "$DIR/sup.log" 2>&1 &
+SUP=$!
+trap 'kill -TERM $SUP 2>/dev/null || true' EXIT
+
+i=0
+while [ "$i" -lt "$CYCLES" ]; do
+  sleep "$PERIOD"
+  python -m containerpilot_tpu -config "$CFG" -reload >/dev/null 2>&1 || true
+  ps -o rss= -p "$SUP" >> "$DIR/rss.log" 2>/dev/null || break
+  i=$((i + 1))
+done
+
+echo "cycles completed: $(wc -l < "$DIR/rss.log")"
+echo "rss first/last KB: $(head -1 "$DIR/rss.log") / $(tail -1 "$DIR/rss.log")"
+echo "exceptions: $(grep -ciE 'traceback|exception|TTL failed' "$DIR/sup.log" || true)"
+echo "artifacts: $DIR"

@@ -269,3 +269,64 @@ def test_template_render_to_file(tmp_path):
     )
     assert result.returncode == 0, result.stderr
     assert 'exec: "run 1"' in out.read_text()
+
+
+def test_python_sup_fallback_propagates_exit_code():
+    """The pure-Python PID-1 fallback: forks the worker and propagates
+    its exit code."""
+    code = (
+        "import sys; from containerpilot_tpu.sup import run_sup; "
+        "sys.exit(run_sup(['containerpilot', '-version']))"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=REPO, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "Version:" in out.stdout
+
+
+def test_python_sup_fallback_forwards_sigterm(tmp_path):
+    """SIGTERM to the sup process forwards to the worker supervisor,
+    which shuts down gracefully (pre-stop hook runs, exit 0)."""
+    order = tmp_path / "order.log"
+    started = tmp_path / "started"
+    cfg = write_config(
+        tmp_path,
+        """
+        {
+          stopTimeout: "1ms",
+          jobs: [
+            { name: "main",
+              exec: ["/bin/sh", "-c", "touch %s; exec sleep 60"],
+              stopTimeout: "5s" },
+            { name: "preStop",
+              exec: ["/bin/sh", "-c", "echo PRESTOP >> %s"],
+              when: { once: "stopping", source: "main" } },
+          ],
+        }
+        """
+        % (started, order),
+    )
+    code = (
+        "import sys; from containerpilot_tpu.sup import run_sup; "
+        f"sys.exit(run_sup(['containerpilot', '-config', {str(cfg)!r}]))"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code], cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.monotonic() + 30
+        while not started.exists():
+            assert time.monotonic() < deadline, "worker never started"
+            time.sleep(0.05)
+        time.sleep(0.3)
+        proc.send_signal(signal.SIGTERM)  # to sup, NOT the worker
+        rc = proc.wait(timeout=30)
+        assert rc == 0
+        assert "PRESTOP" in order.read_text()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
