@@ -80,3 +80,7 @@ class ControlClient:
         """GET /v3/events: the supervisor's recent-event ring (an
         observability extension over the reference's control API)."""
         return json.loads(self._request("GET", "/v3/events"))
+
+    def get_tasks(self) -> list:
+        """GET /v3/tasks: the live actor/timer/exec task table."""
+        return json.loads(self._request("GET", "/v3/tasks"))

@@ -75,8 +75,10 @@ class ControlServer:
             "POST", "/v3/maintenance/disable", self._post_maintenance_disable
         )
         # observability beyond the reference: the bus's recent-event
-        # ring, for debugging live supervisors
+        # ring and the live actor-task table, for debugging live
+        # supervisors
         self._server.route("GET", "/v3/events", self._get_events)
+        self._server.route("GET", "/v3/tasks", self._get_tasks)
 
     # -- lifecycle ------------------------------------------------------
 
@@ -182,6 +184,17 @@ class ControlServer:
                 for e in self.bus.debug_events()
             ]
         ).encode()
+        return self._respond(200, req.path, body, "application/json")
+
+    async def _get_tasks(self, req: Request) -> Response:
+        """Live asyncio task table — which actors/timers/execs exist
+        right now (the single-event-loop analog of a thread dump)."""
+        tasks = sorted(
+            t.get_name()
+            for t in asyncio.all_tasks()
+            if not t.done()
+        )
+        body = json.dumps(tasks).encode()
         return self._respond(200, req.path, body, "application/json")
 
     async def _post_maintenance_enable(self, req: Request) -> Response:

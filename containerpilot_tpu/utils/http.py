@@ -54,8 +54,10 @@ _REASONS = {
     400: "Bad Request",
     404: "Not Found",
     405: "Method Not Allowed",
+    408: "Request Timeout",
     422: "Unprocessable Entity",
     500: "Internal Server Error",
+    503: "Service Unavailable",
 }
 
 Handler = Callable[[Request], Awaitable[Response]]
@@ -96,14 +98,36 @@ class HTTPServer:
             await self._server.wait_closed()
             self._server = None
 
+    # bound on reading one request (headers+body): a stalled client
+    # can't pin a connection open indefinitely. Handler execution is
+    # deliberately unbounded (inference warmup can be slow).
+    REQUEST_READ_TIMEOUT = 30.0
+
     async def _handle(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        # the narrow client-error excepts cover only the READ phase;
+        # a handler raising TimeoutError must surface as a logged 500,
+        # not be misblamed on the client as a 408
         try:
-            response = await self._process(reader)
+            request = await asyncio.wait_for(
+                self._read_request(reader), timeout=self.REQUEST_READ_TIMEOUT
+            )
+        except asyncio.TimeoutError:
+            request = Response(408, b"request timeout\n")
+        except asyncio.IncompleteReadError:
+            request = Response(400, b"truncated request\n")
         except Exception:
-            log.exception("request handling failed")
-            response = Response(500, b"internal server error\n")
+            log.exception("request read failed")
+            request = Response(500, b"internal server error\n")
+        if isinstance(request, Response):
+            response = request
+        else:
+            try:
+                response = await self._dispatch(request)
+            except Exception:
+                log.exception("request handling failed")
+                response = Response(500, b"internal server error\n")
         try:
             reason = _REASONS.get(response.status, "Unknown")
             headers = {
@@ -126,7 +150,9 @@ class HTTPServer:
             except Exception:
                 pass
 
-    async def _process(self, reader: asyncio.StreamReader) -> Response:
+    async def _read_request(self, reader: asyncio.StreamReader):
+        """Parse one request; returns a Request, or a Response for
+        protocol-level errors."""
         request_line = await reader.readline()
         if not request_line:
             return Response(400, b"empty request\n")
@@ -147,9 +173,11 @@ class HTTPServer:
             return Response(400, b"body too large\n")
         body = await reader.readexactly(length) if length else b""
         parts = urlsplit(target)
-        request = Request(
+        return Request(
             method.upper(), parts.path, parse_qs(parts.query), headers, body
         )
+
+    async def _dispatch(self, request: Request) -> Response:
         handler = self.routes.get((request.method, request.path))
         if handler is None:
             if self.fallback is not None:

@@ -7,6 +7,8 @@
 # healthy run holds RSS flat and reports zero exceptions.
 set -eu
 
+cd "$(dirname "$0")/.."  # the package runs from the repo root
+
 CYCLES=${1:-60}
 PERIOD=${2:-55}
 DIR=$(mktemp -d /tmp/cp-soak.XXXXXX)
@@ -33,6 +35,13 @@ python -m containerpilot_tpu -config "$CFG" > "$DIR/sup.log" 2>&1 &
 SUP=$!
 trap 'kill -TERM $SUP 2>/dev/null || true' EXIT
 
+sleep 3
+if ! python -m containerpilot_tpu -config "$CFG" -ping >/dev/null 2>&1; then
+  echo "FAIL: supervisor did not come up; log:" >&2
+  tail -5 "$DIR/sup.log" >&2
+  exit 1
+fi
+
 i=0
 while [ "$i" -lt "$CYCLES" ]; do
   sleep "$PERIOD"
@@ -41,7 +50,9 @@ while [ "$i" -lt "$CYCLES" ]; do
   i=$((i + 1))
 done
 
-echo "cycles completed: $(wc -l < "$DIR/rss.log")"
+DONE=$(wc -l < "$DIR/rss.log" 2>/dev/null || echo 0)
+echo "cycles completed: $DONE / $CYCLES"
 echo "rss first/last KB: $(head -1 "$DIR/rss.log") / $(tail -1 "$DIR/rss.log")"
 echo "exceptions: $(grep -ciE 'traceback|exception|TTL failed' "$DIR/sup.log" || true)"
 echo "artifacts: $DIR"
+[ "$DONE" -eq "$CYCLES" ] || { echo "FAIL: supervisor died mid-soak" >&2; exit 1; }

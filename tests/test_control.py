@@ -108,3 +108,47 @@ def test_get_events_exposes_debug_ring(run, socket_path):
 
     _bus, events = drive(run, socket_path, fn)
     assert {"code": "metric", "source": "zz_ring_probe|1"} in events
+
+
+def test_get_tasks_lists_live_actors(run, socket_path):
+    _bus, tasks = drive(run, socket_path, lambda c: c.get_tasks())
+    assert isinstance(tasks, list) and tasks, "at least the handler task"
+    assert all(isinstance(t, str) for t in tasks)
+
+
+def test_slow_client_times_out():
+    """A connection that sends nothing must not pin the server (slow
+    loris): the read timeout closes it with 408."""
+    import asyncio as aio
+    import socket as sock
+
+    from containerpilot_tpu.utils.http import HTTPServer, Response
+
+    async def scenario():
+        server = HTTPServer()
+        server.REQUEST_READ_TIMEOUT = 0.3
+
+        async def ok(_req):
+            return Response(200, b"fine\n")
+
+        server.route("GET", "/ok", ok)
+        await server.start_tcp("127.0.0.1", 0)
+        port = server.bound_port
+        loop = aio.get_event_loop()
+
+        def stall():
+            s = sock.create_connection(("127.0.0.1", port), timeout=5)
+            try:
+                s.sendall(b"GET /ok HTTP/1.1\r\n")  # never finishes headers
+                return s.recv(200)
+            finally:
+                s.close()
+
+        data = await loop.run_in_executor(None, stall)
+        await server.stop()
+        return data
+
+    import asyncio
+
+    data = asyncio.run(scenario())
+    assert b"408" in data
