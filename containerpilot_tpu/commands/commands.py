@@ -77,10 +77,12 @@ class Command:
         self._proc: Optional[asyncio.subprocess.Process] = None
         self._lock = asyncio.Lock()
         self._reader_tasks: List["asyncio.Task[None]"] = []
-        # a term/kill that arrives before the spawn task has actually
-        # started the child is remembered and delivered right after
-        # spawn, so teardown can't race the (fire-and-forget) run task
+        # a term/kill that arrives after run() but before the (fire-and-
+        # forget) spawn task has actually started the child is remembered
+        # and delivered right after spawn, so teardown can't race it; a
+        # term/kill with no spawn in flight is simply a no-op
         self._pending_signal: Optional[signal.Signals] = None
+        self._spawn_pending = False
 
     @classmethod
     def from_config(
@@ -119,6 +121,8 @@ class Command:
         fire-and-forget the task (the job state machine reacts to the
         published events, not the task result).
         """
+        self._spawn_pending = True
+        self._pending_signal = None  # nothing queued from before this run
         return asyncio.get_event_loop().create_task(
             self._run(bus), name=f"exec:{self.name}"
         )
@@ -141,10 +145,13 @@ class Command:
                 )
             except Exception as exc:  # spawn failure (ENOENT, EACCES, ...)
                 log.error("unable to start %s: %s", self.name, exc)
+                self._spawn_pending = False
+                self._pending_signal = None
                 bus.publish(Event(EventCode.EXIT_FAILED, self.name))
                 bus.publish(Event(EventCode.ERROR, str(exc)))
                 return None
             proc = self._proc
+            self._spawn_pending = False
             if self._pending_signal is not None:
                 sig, self._pending_signal = self._pending_signal, None
                 log.debug(
@@ -235,8 +242,9 @@ class Command:
 
     def _signal_group(self, sig: signal.Signals) -> None:
         if self._proc is None:
-            # spawn task created but child not started yet: queue it
-            self._pending_signal = sig
+            if self._spawn_pending:
+                # spawn task created but child not started yet: queue it
+                self._pending_signal = sig
             return
         if self._proc.returncode is not None:
             return

@@ -30,6 +30,7 @@ import asyncio
 import json
 import logging
 import time
+import urllib.parse
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -165,14 +166,14 @@ class CatalogServer:
         if req.method == "PUT" and req.path.startswith(
             "/v1/agent/service/deregister/"
         ):
-            service_id = req.path.rsplit("/", 1)[-1]
+            service_id = urllib.parse.unquote(req.path.rsplit("/", 1)[-1])
             self._entries.pop(service_id, None)
             log.debug("catalog: deregistered %s", service_id)
             return Response(200, b"")
         if req.method == "PUT" and req.path.startswith(
             "/v1/agent/check/update/"
         ):
-            check_id = req.path.rsplit("/", 1)[-1]
+            check_id = urllib.parse.unquote(req.path.rsplit("/", 1)[-1])
             # check ids are "service:<instance-id>"
             instance_id = check_id.split(":", 1)[-1]
             entry = self._entries.get(instance_id)
@@ -190,7 +191,7 @@ class CatalogServer:
                 entry.expires = time.time() + entry.ttl
             return Response(200, b"")
         if req.method == "GET" and req.path.startswith("/v1/health/service/"):
-            name = req.path.rsplit("/", 1)[-1]
+            name = urllib.parse.unquote(req.path.rsplit("/", 1)[-1])
             passing_only = req.query.get("passing", ["0"])[0] not in ("0", "")
             tag = req.query.get("tag", [""])[0]
             dc = req.query.get("dc", [""])[0]

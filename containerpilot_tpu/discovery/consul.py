@@ -16,6 +16,7 @@ import json
 import logging
 import os
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -145,23 +146,35 @@ class ConsulBackend(Backend):
         self._request("PUT", "/v1/agent/service/register", body)
 
     def service_deregister(self, service_id: str) -> None:
-        self._request("PUT", f"/v1/agent/service/deregister/{service_id}")
-
-    def update_ttl(self, check_id: str, output: str, status: str) -> None:
         self._request(
             "PUT",
-            f"/v1/agent/check/update/{check_id}",
+            "/v1/agent/service/deregister/"
+            + urllib.parse.quote(service_id, safe=":"),
+        )
+
+    def update_ttl(self, check_id: str, output: str, status: str) -> None:
+        # ":" stays raw — it is legal in a path segment and check ids are
+        # "service:<id>" (the reference's client sends them unescaped)
+        self._request(
+            "PUT",
+            "/v1/agent/check/update/" + urllib.parse.quote(check_id, safe=":"),
             {"Output": output, "Status": "passing" if status == "pass" else status},
         )
 
     def _health_service(
         self, service_name: str, tag: str, dc: str
     ) -> List[ServiceInstance]:
-        path = f"/v1/health/service/{service_name}?passing=1"
+        query: List[Tuple[str, str]] = [("passing", "1")]
         if tag:
-            path += f"&tag={tag}"
+            query.append(("tag", tag))
         if dc:
-            path += f"&dc={dc}"
+            query.append(("dc", dc))
+        path = (
+            "/v1/health/service/"
+            + urllib.parse.quote(service_name, safe=":")
+            + "?"
+            + urllib.parse.urlencode(query)
+        )
         entries = self._request("GET", path) or []
         out: List[ServiceInstance] = []
         for entry in entries:

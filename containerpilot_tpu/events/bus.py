@@ -16,9 +16,13 @@ Capability parity with the reference supervisor's bus
 
 Design note (TPU-host idiom): the supervisor runs a single asyncio event
 loop — the analogue of the reference pinning itself to one OS thread so
-it never contends with the supervised JAX workload for host cores. The
-lock is kept because command-waiter callbacks and the control server may
-publish from other threads in embedding scenarios.
+it never contends with the supervised JAX workload for host cores.
+Fan-out delivers into per-actor ``asyncio.Queue`` mailboxes, which are
+NOT thread-safe off the loop, so ``publish`` from a foreign thread is
+routed onto the bus's home loop via ``call_soon_threadsafe`` (the home
+loop is remembered the first time subscribe/register/publish runs on a
+loop thread). In-tree publishers are all loop-resident; the routing
+exists for embedding scenarios.
 """
 from __future__ import annotations
 
@@ -65,6 +69,7 @@ class EventBus:
 
     def __init__(self, ring_size: int = DEBUG_RING_SIZE) -> None:
         self._lock = threading.RLock()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._subscribers: List["Subscriber"] = []
         self._registered: int = 0
         self._done = asyncio.Event()
@@ -75,8 +80,17 @@ class EventBus:
 
     # -- subscription ---------------------------------------------------
 
+    def _remember_home_loop(self) -> None:
+        """Record the loop whose thread this call runs on, if any."""
+        if self._loop is None:
+            try:
+                self._loop = asyncio.get_running_loop()
+            except RuntimeError:
+                pass
+
     def subscribe(self, subscriber: "Subscriber") -> None:
         with self._lock:
+            self._remember_home_loop()
             self._subscribers.append(subscriber)
 
     def unsubscribe(self, subscriber: "Subscriber") -> None:
@@ -91,6 +105,7 @@ class EventBus:
     def register(self, _actor: object = None) -> None:
         """Count an actor into this bus generation's lifetime."""
         with self._lock:
+            self._remember_home_loop()
             self._registered += 1
             self._done.clear()
 
@@ -118,11 +133,29 @@ class EventBus:
         """Fan the event out to all subscribers, synchronously, in order.
 
         A subscriber with a full mailbox gets the event dropped with an
-        error log rather than wedging the entire bus (the reference
-        blocks in that case, which is a documented deadlock hazard —
+        error log and a ``containerpilot_events_dropped`` counter bump
+        rather than wedging the entire bus (the reference blocks in that
+        case, which is a documented deadlock hazard —
         reference: events/bus.go:125-140, jobs/jobs.go:23).
+
+        Calls from a thread other than the bus's home loop thread are
+        re-routed onto the home loop: mailbox delivery touches
+        ``asyncio.Queue`` internals that are not thread-safe off-loop.
         """
+        home = self._loop
+        if home is not None and not home.is_closed():
+            try:
+                running = asyncio.get_running_loop()
+            except RuntimeError:
+                running = None
+            if running is not home:
+                home.call_soon_threadsafe(self._publish_on_loop, event)
+                return
+        self._publish_on_loop(event)
+
+    def _publish_on_loop(self, event: Event) -> None:
         with self._lock:
+            self._remember_home_loop()
             log.debug("event: %s", event)
             self._ring.append(event)
             if _EVENT_COUNTER is not None:

@@ -25,6 +25,26 @@ log = logging.getLogger("containerpilot.events")
 
 MAILBOX_CAPACITY = 1000
 
+try:  # mirror the bus's optional-metrics posture
+    from prometheus_client import Counter, REGISTRY
+
+    def _make_drop_counter() -> Optional["Counter"]:
+        try:
+            return Counter(
+                "containerpilot_events_dropped",
+                "Events dropped because an actor's mailbox overflowed",
+                ["code", "source"],
+            )
+        except ValueError:  # re-registration in the same process (reloads)
+            collector = REGISTRY._names_to_collectors.get(  # noqa: SLF001
+                "containerpilot_events_dropped"
+            )
+            return collector  # type: ignore[return-value]
+
+    _DROP_COUNTER = _make_drop_counter()
+except Exception:  # pragma: no cover - prometheus always present in-tree
+    _DROP_COUNTER = None
+
 
 class Publisher:
     """Gives an actor a handle to publish onto the bus and be counted
@@ -70,13 +90,22 @@ class Subscriber(Publisher):
             self.rx.put_nowait(event)
         except asyncio.QueueFull:
             # The reference would block the whole bus here; dropping with
-            # a loud error is the safer failure mode for a supervisor.
+            # a loud error + a counter is the safer failure mode for a
+            # supervisor, and the counter makes the deviation observable
+            # in /metrics.
             log.error(
                 "mailbox full (%d): dropping %s for %r",
                 MAILBOX_CAPACITY,
                 event,
                 self,
             )
+            if _DROP_COUNTER is not None:
+                try:
+                    _DROP_COUNTER.labels(
+                        code=event.code.value, source=event.source
+                    ).inc()
+                except Exception:  # pragma: no cover
+                    pass
 
     async def next_event(self) -> Event:
         return await self.rx.get()

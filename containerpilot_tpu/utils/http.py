@@ -158,7 +158,7 @@ class HTTPServer:
             return Response(400, b"empty request\n")
         try:
             method, target, _version = request_line.decode().split(None, 2)
-        except ValueError:
+        except (ValueError, UnicodeDecodeError):
             return Response(400, b"malformed request line\n")
         headers: Dict[str, str] = {}
         while True:
@@ -166,9 +166,17 @@ class HTTPServer:
             if line in (b"\r\n", b"\n", b""):
                 break
             if b":" in line:
-                key, _, value = line.decode().partition(":")
+                try:
+                    key, _, value = line.decode().partition(":")
+                except UnicodeDecodeError:
+                    return Response(400, b"malformed header\n")
                 headers[key.strip().lower()] = value.strip()
-        length = int(headers.get("content-length", "0") or "0")
+        try:
+            length = int(headers.get("content-length", "0") or "0")
+        except ValueError:
+            return Response(400, b"bad content-length\n")
+        if length < 0:
+            return Response(400, b"bad content-length\n")
         if length > MAX_BODY:
             return Response(400, b"body too large\n")
         body = await reader.readexactly(length) if length else b""

@@ -152,3 +152,33 @@ def test_slow_client_times_out():
 
     data = asyncio.run(scenario())
     assert b"408" in data
+
+
+def test_protocol_errors_get_400_not_500(run, socket_path):
+    """Malformed Content-Length (negative, non-numeric) and non-UTF-8
+    bytes are CLIENT errors: 400, never a 500 + stack trace."""
+
+    async def scenario():
+        bus = EventBus()
+        server = ControlServer(ControlConfig({"socket": socket_path}))
+        await server.run(bus)
+
+        async def raw(request_bytes: bytes) -> bytes:
+            reader, writer = await asyncio.open_unix_connection(socket_path)
+            writer.write(request_bytes)
+            await writer.drain()
+            response = await reader.read(4096)
+            writer.close()
+            return response
+
+        results = [
+            await raw(b"GET /v3/ping HTTP/1.1\r\nContent-Length: -1\r\n\r\n"),
+            await raw(b"GET /v3/ping HTTP/1.1\r\nContent-Length: nope\r\n\r\n"),
+            await raw(b"GET /v3/ping HTTP/1.1\r\nX-Bad: \xff\xfe\r\n\r\n"),
+            await raw(b"\xff\xfe malformed\r\n\r\n"),
+        ]
+        await server.stop()
+        return results
+
+    for response in run(scenario()):
+        assert response.startswith(b"HTTP/1.1 400"), response[:60]
