@@ -42,10 +42,21 @@ class TransformerConfig:
     max_seq_len: int = 2048
     rope_theta: float = 10_000.0
     dtype: Any = jnp.bfloat16  # compute dtype
-    # attention override: None = XLA causal attention; set to e.g. a
-    # mesh-bound ring_attention for context parallelism
-    # (parallel/context.py)
+    # attention override: None = auto (pallas flash at/after
+    # flash_min_seq, XLA causal below it); set to e.g. a mesh-bound
+    # ring_attention for context parallelism (parallel/context.py)
     attention_fn: Any = None
+    # sequences at/above this length (and 128-aligned) run the pallas
+    # flash kernels — fwd AND bwd (ops/flash.py); 0 disables auto-flash.
+    # Mesh-parallel trainers bind the shard_map-wrapped equivalent via
+    # parallel.context.flash_parallel_config (pallas calls don't
+    # partition under automatic pjit sharding).
+    flash_min_seq: int = 1024
+    # rematerialize each layer in the backward pass instead of saving
+    # its activations: the standard TPU trade of MXU FLOPs (~1/3 extra)
+    # for HBM. Without it the scan-over-layers saves every layer's MLP
+    # hiddens ([L, b, s, d_ff]) and real model sizes blow the 16GB HBM.
+    remat: bool = True
     # mixture-of-experts: 0 = dense SwiGLU; >0 replaces the MLP with
     # switch-routed experts (models/moe.py — drop-free routing, expert
     # axis sharded over the mesh's "model" axis for expert parallelism)
@@ -76,6 +87,26 @@ class TransformerConfig:
 
 
 Params = Dict[str, Any]
+
+FLASH_BLOCK = 128
+
+
+def flash_eligible(cfg: "TransformerConfig", seq: int) -> bool:
+    """True when the auto-selected attention should be the pallas flash
+    path: at/above the threshold and block-aligned."""
+    return (
+        cfg.flash_min_seq > 0
+        and seq >= cfg.flash_min_seq
+        and seq % FLASH_BLOCK == 0
+    )
+
+
+def _auto_attention(cfg: "TransformerConfig", seq: int) -> Any:
+    if flash_eligible(cfg, seq):
+        from ..ops.flash import flash_attention
+
+        return flash_attention
+    return causal_attention
 
 
 def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
@@ -240,7 +271,7 @@ def _layer(
     q, k, v = _qkv(x, layer_params, cfg)
     k = repeat_kv(k, cfg.n_heads)
     v = repeat_kv(v, cfg.n_heads)
-    attn_fn = cfg.attention_fn or causal_attention
+    attn_fn = cfg.attention_fn or _auto_attention(cfg, q.shape[1])
     attn = attn_fn(q, k, v)
     x = _attn_out(x, attn, layer_params, cfg)
     return _ffn(x, layer_params, cfg)
@@ -262,6 +293,8 @@ def forward_with_aux(
         x, layer_aux = _layer(x, layer_params, cfg)
         return (x, aux + layer_aux), None
 
+    if cfg.remat:
+        body = jax.checkpoint(body)
     (x, aux), _ = lax.scan(
         body, (x, jnp.zeros((), jnp.float32)), params["layers"]
     )

@@ -1,13 +1,15 @@
 """Op library for the TPU workload: attention four ways — XLA einsum,
-pallas flash forward, memory-efficient training (custom VJP), and
-ring/context-parallel."""
-from .attention import causal_attention, flash_attention_forward
+pallas flash (fwd+bwd, differentiable), memory-efficient XLA training
+fallback (custom VJP), and ring/context-parallel."""
+from .attention import causal_attention
+from .flash import flash_attention, flash_attention_forward
 from .flash_training import memory_efficient_attention
 from .quant import int8_matmul, int8_matmul_pallas, quantize_int8
 from .ring_attention import ring_attention
 
 __all__ = [
     "causal_attention",
+    "flash_attention",
     "flash_attention_forward",
     "memory_efficient_attention",
     "ring_attention",

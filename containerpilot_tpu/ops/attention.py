@@ -1,31 +1,19 @@
-"""Causal attention: XLA einsum path + a pallas flash-attention kernel.
-
-Two implementations with identical numerics:
+"""Causal attention: the XLA einsum path.
 
 - ``causal_attention``: plain einsum + masked softmax. XLA fuses this
-  well at moderate sequence lengths and it's fully differentiable — the
-  training path uses it.
-- ``flash_attention_forward``: a pallas TPU kernel with blockwise
-  online softmax — O(seq) memory instead of O(seq^2), for long-context
-  inference. Grid is (batch*heads, q_blocks); each program streams KV
-  blocks through VMEM with running (max, sum) rescaling. Runs in
-  interpret mode off-TPU so tests cover it on the CPU mesh.
-
-The kernel follows the standard flash-attention algorithm structure
-(public technique; see PAPERS.md) implemented fresh against the pallas
-API.
+  well at moderate sequence lengths and it's fully differentiable.
+- The pallas flash kernels (forward + backward, KV streamed through
+  the grid) live in ops/flash.py; ``flash_attention_forward`` is
+  re-exported here for compatibility.
 """
 from __future__ import annotations
 
-import functools
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.experimental import pallas as pl
 
-NEG_INF = -1e30
+from .flash import NEG_INF, flash_attention_forward  # noqa: F401
+
+__all__ = ["NEG_INF", "causal_attention", "flash_attention_forward"]
 
 
 def causal_attention(
@@ -43,86 +31,3 @@ def causal_attention(
     return jnp.einsum(
         "bhqs,bshk->bqhk", weights, v, preferred_element_type=jnp.float32
     ).astype(q.dtype)
-
-
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, seq_len: int,
-                  scale: float):
-    """One (batch*head, q_block) program: stream KV blocks, online
-    softmax with running max/sum."""
-    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, head_dim]
-    block_q = q.shape[0]
-    q_block_idx = pl.program_id(1)
-    q_offset = q_block_idx * block_q
-
-    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)   # running max
-    l = jnp.zeros((block_q, 1), jnp.float32)           # running sum
-    acc = jnp.zeros((block_q, q.shape[1]), jnp.float32)
-
-    n_kv_blocks = seq_len // block_k
-
-    def body(kv_idx, carry):
-        m, l, acc = carry
-        kv_offset = kv_idx * block_k
-        k_blk = k_ref[0, pl.dslice(kv_offset, block_k)].astype(jnp.float32)
-        v_blk = v_ref[0, pl.dslice(kv_offset, block_k)].astype(jnp.float32)
-        scores = q @ k_blk.T  # [block_q, block_k]
-        # causal mask: query position >= key position
-        q_pos = q_offset + lax.broadcasted_iota(jnp.int32, scores.shape, 0)
-        k_pos = kv_offset + lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
-        correction = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new)
-        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * correction + p @ v_blk
-        return m_new, l_new, acc_new
-
-    # only blocks at or before this q block can contribute (causal)
-    last_block = jnp.minimum((q_offset + block_q + block_k - 1) // block_k,
-                             n_kv_blocks)
-    m, l, acc = lax.fori_loop(0, last_block, body, (m, l, acc))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-
-
-@functools.partial(
-    jax.jit, static_argnames=("block_q", "block_k", "interpret")
-)
-def flash_attention_forward(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    block_q: int = 128,
-    block_k: int = 128,
-    interpret: Optional[bool] = None,
-) -> jax.Array:
-    """Flash attention forward. [batch, seq, heads, head_dim] layout.
-
-    Sequence length must be a multiple of the block sizes (pad upstream
-    for ragged lengths — static shapes keep the MXU tiling clean).
-    """
-    b, s, h, hd = q.shape
-    if s % block_q or s % block_k:
-        raise ValueError(f"seq len {s} not a multiple of block sizes")
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    # [b, s, h, hd] -> [b*h, s, hd]: one grid row per (batch, head)
-    def to_rows(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s, hd)
-
-    qr, kr, vr = to_rows(q), to_rows(k), to_rows(v)
-    kernel = functools.partial(
-        _flash_kernel, block_k=block_k, seq_len=s, scale=hd ** -0.5
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid=(b * h, s // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, hd), lambda r, i: (r, i, 0)),
-            pl.BlockSpec((1, s, hd), lambda r, i: (r, 0, 0)),
-            pl.BlockSpec((1, s, hd), lambda r, i: (r, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, hd), lambda r, i: (r, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, hd), q.dtype),
-        interpret=interpret,
-    )(qr, kr, vr)
-    return out.reshape(b, h, s, hd).transpose(0, 2, 1, 3)

@@ -38,21 +38,36 @@ except ImportError:  # pragma: no cover
 
 # the replication-check kwarg was renamed check_rep -> check_vma across
 # jax versions; resolve once
+_SHARD_MAP_PARAMS = inspect.signature(_shard_map).parameters
 _CHECK_KWARG = (
-    "check_vma"
-    if "check_vma" in inspect.signature(_shard_map).parameters
-    else "check_rep"
+    "check_vma" if "check_vma" in _SHARD_MAP_PARAMS else "check_rep"
 )
 del inspect
 
 
-def shard_map(f, *, mesh, in_specs, out_specs):
+_HAS_AXIS_NAMES = "axis_names" in _SHARD_MAP_PARAMS
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, auto=None):
+    """Version-compat shard_map. ``auto`` names mesh axes left to the
+    automatic partitioner inside the manual region (pp×tp composition:
+    pipe is manual, model stays auto so XLA inserts the tensor-parallel
+    collectives inside each stage). Newer jax expresses this as
+    ``axis_names`` = the manual complement; older jax as ``auto``."""
+    kwargs = {_CHECK_KWARG: False}
+    if auto:
+        if _HAS_AXIS_NAMES:
+            kwargs["axis_names"] = frozenset(mesh.axis_names) - frozenset(
+                auto
+            )
+        else:  # pragma: no cover - older jax
+            kwargs["auto"] = frozenset(auto)
     return _shard_map(
         f,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=out_specs,
-        **{_CHECK_KWARG: False},
+        **kwargs,
     )
 
 def _ring_shard_fn(

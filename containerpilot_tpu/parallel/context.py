@@ -11,10 +11,44 @@ from __future__ import annotations
 
 import dataclasses
 
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.transformer import TransformerConfig
-from ..ops.ring_attention import ring_attention
+from ..models.transformer import TransformerConfig, flash_eligible
+from ..ops.ring_attention import ring_attention, shard_map
+
+
+def flash_parallel_config(
+    cfg: TransformerConfig, mesh: Mesh
+) -> TransformerConfig:
+    """Bind mesh-aware attention auto-selection for pjit'd training.
+
+    pallas calls don't partition under automatic pjit sharding, so the
+    flash path must run under shard_map. Causal attention is
+    independent per (batch, head), and the tensor-parallel rules shard
+    heads over ``model`` and batch over ``data``
+    (parallel/sharding.py) — so the manual region needs no collectives
+    at all: each device runs the flash kernel on its local
+    [b/data, s, h/model, hd] block. Below the flash threshold the
+    plain einsum path is returned and XLA partitions it as before.
+    """
+    spec = P("data", None, "model", None)
+
+    def attn(q, k, v):
+        if not flash_eligible(cfg, q.shape[1]):
+            from ..ops.attention import causal_attention
+
+            return causal_attention(q, k, v)
+        from ..ops.flash import flash_attention
+
+        f = shard_map(
+            lambda q, k, v: flash_attention(q, k, v),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+        return f(q, k, v)
+
+    return dataclasses.replace(cfg, attention_fn=attn)
 
 
 def context_parallel_config(
