@@ -8,7 +8,7 @@ annotate shardings, and let XLA insert the collectives over ICI/DCN
 (SURVEY.md §5 distributed-backend mapping).
 """
 from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
-from .context import context_parallel_config
+from .context import context_parallel_config, flash_parallel_config
 from .distributed import initialize_from_catalog, initialize_from_env
 from .mesh import MeshPlan, make_mesh
 from .pipeline import (
@@ -21,6 +21,7 @@ from .train import (
     TrainState,
     abstract_train_state,
     init_train_state,
+    make_pipeline_train_step,
     make_train_step,
     train_state_shardings,
 )
@@ -28,6 +29,8 @@ from .train import (
 __all__ = [
     "MeshPlan",
     "context_parallel_config",
+    "flash_parallel_config",
+    "make_pipeline_train_step",
     "make_mesh",
     "param_sharding_rules",
     "shard_params",

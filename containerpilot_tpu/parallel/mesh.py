@@ -26,16 +26,18 @@ class MeshPlan:
     """A named factorization of the device count.
 
     ``seq`` > 1 adds a context-parallel axis for ring attention over
-    long sequences (ops/ring_attention.py).
+    long sequences (ops/ring_attention.py); ``pipe`` > 1 adds a
+    pipeline-stage axis for GPipe microbatching (parallel/pipeline.py).
     """
 
     data: int
     model: int
     seq: int = 1
+    pipe: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.data * self.model * self.seq
+        return self.data * self.model * self.seq * self.pipe
 
 
 def _factor(n: int, max_model: int) -> MeshPlan:
@@ -55,9 +57,13 @@ def make_mesh(
 ) -> Mesh:
     """Build a mesh over the given (or all) devices.
 
-    Axis names are ("data", "model") for 2D plans, or
+    Axis names are ("data", "model") for 2D plans,
     ("data", "seq", "model") when the plan's ``seq`` > 1 (context
-    parallelism — see ops/ring_attention.py).
+    parallelism — see ops/ring_attention.py), or
+    ("data", "pipe", "model") when ``pipe`` > 1 (pipeline stages —
+    see parallel/pipeline.py). pipe is placed outside model so the
+    per-tick activation ppermute crosses the slower links once while
+    the chatty tensor-parallel collectives stay on the innermost axis.
     """
     if devices is None:
         devices = jax.devices()
@@ -68,8 +74,13 @@ def make_mesh(
         raise ValueError(
             f"mesh plan {plan} does not cover {n} devices"
         )
+    if plan.seq > 1 and plan.pipe > 1:
+        raise ValueError("seq and pipe axes cannot be combined (yet)")
     if plan.seq > 1:
         grid = np.asarray(devices).reshape(plan.data, plan.seq, plan.model)
         return Mesh(grid, axis_names=("data", "seq", "model"))
+    if plan.pipe > 1:
+        grid = np.asarray(devices).reshape(plan.data, plan.pipe, plan.model)
+        return Mesh(grid, axis_names=("data", "pipe", "model"))
     grid = np.asarray(devices).reshape(plan.data, plan.model)
     return Mesh(grid, axis_names=("data", "model"))

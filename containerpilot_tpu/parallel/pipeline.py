@@ -151,15 +151,43 @@ def pipeline_forward_with_aux(
         raise ValueError(
             f"microbatch size {mb} not divisible by data axis {data_size}"
         )
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    x_mb = x.reshape(n_microbatches, mb, s, -1)
-
     layer_specs = jax.tree_util.tree_map(
         lambda _: P(axis_name), params["layers"]
     )
     # compose with data parallelism: microbatch contents shard over an
     # outer "data" axis (everything in the body is per-sample)
     data_axis = "data" if "data" in mesh.axis_names else None
+    # compose with tensor parallelism: any remaining mesh axes (e.g.
+    # "model") stay AUTO inside the manual region, so XLA partitions
+    # each stage's layer math over them and inserts the tp collectives
+    # — pp x tp without hand-writing the tp collectives here. Size-1
+    # axes need no partitioning at all and are kept manual(-and-
+    # unused), so a trivial model axis doesn't force the auto-region
+    # restrictions (no pallas flash, f32-on-CPU) onto plain dp x pp.
+    auto = {
+        a
+        for a in mesh.axis_names
+        if a != axis_name and a != data_axis and mesh.shape[a] > 1
+    }
+    if auto:
+        import dataclasses
+
+        if cfg.attention_fn is None and cfg.flash_min_seq:
+            # pallas calls can't be partitioned by the AUTO axes inside
+            # this manual region, so the auto-selected flash path must
+            # stay off here: the einsum attention partitions fine over
+            # the auto model axis. (pp x tp flash needs manual-tp
+            # kernels — future work.)
+            cfg = dataclasses.replace(cfg, flash_min_seq=0)
+        if jax.default_backend() == "cpu" and cfg.dtype == jnp.bfloat16:
+            # XLA CPU's AllReducePromotion pass CHECK-crashes cloning
+            # the bf16 all-reduces that auto partitioning inserts
+            # around this manual region; run the whole pipelined
+            # forward in f32 on the CPU test/dryrun backend (TPU is
+            # unaffected)
+            cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x_mb = x.reshape(n_microbatches, mb, s, -1)
     x_spec = P(None, data_axis, None, None)
     fn = shard_map(
         functools.partial(
@@ -173,6 +201,7 @@ def pipeline_forward_with_aux(
         mesh=mesh,
         in_specs=(layer_specs, x_spec),
         out_specs=(x_spec, P()),
+        auto=auto or None,
     )
     outputs, aux = fn(params["layers"], x_mb)
     x = outputs.reshape(b, s, -1)
@@ -198,15 +227,26 @@ def pipeline_loss_fn(
     return next_token_loss(logits, aux, tokens, cfg)
 
 
-def pipeline_sharding_rules(cfg: Any = None) -> Any:
-    """Param specs for a ("data", "pipe") mesh: layer stacks sharded
-    over pipe, embeddings replicated."""
+def pipeline_sharding_rules(cfg: Any = None, mesh: Mesh = None) -> Any:
+    """Param specs for a ("data", "pipe"[, "model"]) mesh: layer stacks
+    shard their leading layer axis over ``pipe`` while KEEPING the
+    tensor-parallel ``model`` shardings inside each stage (pp x tp).
+    Without a model axis on the mesh, the in-stage specs replicate."""
     from .sharding import param_sharding_rules
 
-    rules = param_sharding_rules(cfg)
+    rules = param_sharding_rules(cfg, mesh)
+    has_model = mesh is not None and "model" in mesh.axis_names
+
+    def stage_spec(spec: P) -> P:
+        rest = tuple(spec)[1:]  # the leading dim is the layer axis
+        if not has_model:
+            rest = tuple(None if a == "model" else a for a in rest)
+        return P("pipe", *rest)
+
     rules["layers"] = jax.tree_util.tree_map(
-        lambda _: P("pipe"), rules["layers"]
+        stage_spec, rules["layers"]
     )
-    rules["embed"] = P(None, None)
-    rules["unembed"] = P(None, None)
+    if not has_model:
+        rules["embed"] = P(None, None)
+        rules["unembed"] = P(None, None)
     return rules

@@ -84,9 +84,12 @@ def batch_spec() -> P:
     return P("data", None)
 
 
-def shard_params(params: Any, mesh: Mesh, cfg: Optional[Any] = None) -> Any:
+def shard_params(
+    params: Any, mesh: Mesh, cfg: Optional[Any] = None, rules: Any = None
+) -> Any:
     """Place a param pytree onto the mesh per the rules."""
-    rules = param_sharding_rules(cfg, mesh)
+    if rules is None:
+        rules = param_sharding_rules(cfg, mesh)
     return jax.tree_util.tree_map(
         lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
         params,

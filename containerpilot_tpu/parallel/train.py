@@ -40,9 +40,11 @@ def init_train_state(
     cfg: TransformerConfig,
     mesh: Mesh,
     learning_rate: float = 3e-4,
+    rules: Any = None,
 ) -> TrainState:
-    """Initialize params already sharded onto the mesh."""
-    params = shard_params(init_params(rng, cfg), mesh, cfg)
+    """Initialize params already sharded onto the mesh. ``rules``
+    overrides the tensor-parallel param specs (e.g. pipeline rules)."""
+    params = shard_params(init_params(rng, cfg), mesh, cfg, rules=rules)
     optimizer = make_optimizer(learning_rate)
     opt_state = optimizer.init(params)
     # moment tensors inherit the param shardings; scalar leaves (adam
@@ -82,6 +84,7 @@ def train_state_shardings(
     mesh: Mesh,
     learning_rate: float = 3e-4,
     abstract: "TrainState" = None,
+    rules: Any = None,
 ) -> TrainState:
     """A TrainState-shaped pytree of NamedShardings: the canonical
     placement of every piece of training state on the mesh.
@@ -96,7 +99,8 @@ def train_state_shardings(
 
     if abstract is None:
         abstract = _abstract_init(jax.random.PRNGKey(0), cfg, learning_rate)
-    rules = param_sharding_rules(cfg, mesh)
+    if rules is None:
+        rules = param_sharding_rules(cfg, mesh)
     replicated = NamedSharding(mesh, P())
 
     def resolve(path, leaf):
@@ -133,6 +137,7 @@ def abstract_train_state(
     mesh: Mesh,
     learning_rate: float = 3e-4,
     shardings: "TrainState" = None,
+    rules: Any = None,
 ) -> TrainState:
     """The shape/dtype/sharding skeleton of init_train_state's result,
     without materializing any arrays — the restore target for resuming
@@ -141,7 +146,9 @@ def abstract_train_state(
     train_state_shardings) to avoid re-deriving them."""
     abstract = _abstract_init(rng, cfg, learning_rate)
     if shardings is None:
-        shardings = train_state_shardings(cfg, mesh, learning_rate, abstract)
+        shardings = train_state_shardings(
+            cfg, mesh, learning_rate, abstract, rules=rules
+        )
     return jax.tree_util.tree_map(
         lambda leaf, s: jax.ShapeDtypeStruct(
             leaf.shape, leaf.dtype, sharding=s
@@ -155,6 +162,13 @@ def make_train_step(
     cfg: TransformerConfig, mesh: Mesh, learning_rate: float = 3e-4
 ) -> Callable[[TrainState, jax.Array], Tuple[TrainState, jax.Array]]:
     """Build the jitted, donated, sharded train step."""
+    if cfg.attention_fn is None and mesh.size > 1 and "seq" not in mesh.axis_names:
+        # multi-device without context parallelism: the flash path (if
+        # the seq length triggers it) must run under shard_map — pallas
+        # calls don't partition under automatic pjit sharding
+        from .context import flash_parallel_config
+
+        cfg = flash_parallel_config(cfg, mesh)
     optimizer = make_optimizer(learning_rate)
     data_sharding = NamedSharding(mesh, batch_spec())
     # pin the state's placement on both sides of the step so shardings
@@ -188,6 +202,62 @@ def make_train_step(
             return jitted(state, tokens)
 
     # register TrainState as a pytree once, lazily
+    return run
+
+
+def make_pipeline_train_step(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    learning_rate: float = 3e-4,
+    n_microbatches: int = 4,
+) -> Callable[[TrainState, jax.Array], Tuple[TrainState, jax.Array]]:
+    """The pipelined (GPipe) train step over a ("data","pipe"[,"model"])
+    mesh: layers shard over pipe stages, microbatches stream with
+    ppermute handoffs, tensor parallelism stays live inside each stage
+    (pipeline.py). Same TrainState/optimizer contract as
+    make_train_step, so checkpointing and the supervised trainer reuse
+    everything."""
+    from .pipeline import pipeline_loss_fn, pipeline_sharding_rules
+
+    if "pipe" not in mesh.axis_names:
+        raise ValueError(f"mesh has no 'pipe' axis: {mesh.axis_names}")
+    optimizer = make_optimizer(learning_rate)
+    data_sharding = NamedSharding(
+        mesh, P("data") if "data" in mesh.axis_names else P()
+    )
+    rules = pipeline_sharding_rules(cfg, mesh)
+    state_shardings = train_state_shardings(
+        cfg, mesh, learning_rate, rules=rules
+    )
+
+    def step_fn(state: TrainState, tokens: jax.Array):
+        loss, grads = jax.value_and_grad(pipeline_loss_fn)(
+            state.params, tokens, cfg, mesh, n_microbatches
+        )
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(
+                params=new_params,
+                opt_state=new_opt_state,
+                step=state.step + 1,
+            ),
+            loss,
+        )
+
+    jitted = jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, data_sharding),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,),
+    )
+
+    def run(state: TrainState, tokens: jax.Array):
+        with mesh:
+            return jitted(state, tokens)
+
     return run
 
 

@@ -42,6 +42,14 @@ def main() -> int:
                         help="capacity factor for bounded expert compute "
                         "during training (0 = drop-free routing)")
     parser.add_argument("--vocab", type=int, default=1024)
+    parser.add_argument("--pipeline-stages", type=int, default=0,
+                        help="GPipe pipeline stages (0 = no pipeline); "
+                        "n_layers must divide by it")
+    parser.add_argument("--microbatches", type=int, default=4,
+                        help="pipeline microbatches (batch must divide)")
+    parser.add_argument("--tensor-parallel", type=int, default=0,
+                        help="model-axis size when pipelining "
+                        "(0 = all remaining devices go to data)")
     parser.add_argument("--progress-file", default="")
     parser.add_argument("--control-socket", default="")
     parser.add_argument("--learning-rate", type=float, default=3e-4)
@@ -50,7 +58,13 @@ def main() -> int:
     args = parser.parse_args()
 
     from ..models.transformer import TransformerConfig
-    from ..parallel import init_train_state, make_mesh, make_train_step
+    from ..parallel import (
+        MeshPlan,
+        init_train_state,
+        make_mesh,
+        make_pipeline_train_step,
+        make_train_step,
+    )
 
     cfg = TransformerConfig(
         vocab_size=args.vocab,
@@ -63,11 +77,36 @@ def main() -> int:
         moe_experts=args.moe_experts,
         moe_train_capacity=args.moe_capacity,
     )
-    mesh = make_mesh()
+    rules = None
+    if args.pipeline_stages > 1:
+        # dp x pp x tp: layers shard over pipe stages, tensor
+        # parallelism stays live inside each stage (parallel/pipeline.py)
+        n_dev = len(jax.devices())
+        tp = args.tensor_parallel or 1
+        if n_dev % (args.pipeline_stages * tp):
+            raise SystemExit(
+                f"{n_dev} devices not divisible by pipeline-stages x "
+                f"tensor-parallel = {args.pipeline_stages} x {tp}"
+            )
+        mesh = make_mesh(plan=MeshPlan(
+            data=n_dev // (args.pipeline_stages * tp),
+            model=tp,
+            pipe=args.pipeline_stages,
+        ))
+    else:
+        mesh = make_mesh()
     print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} "
           f"on {jax.default_backend()}")
     rng = jax.random.PRNGKey(0)
-    train_step = make_train_step(cfg, mesh, args.learning_rate)
+    if args.pipeline_stages > 1:
+        from ..parallel import pipeline_sharding_rules
+
+        rules = pipeline_sharding_rules(cfg, mesh)
+        train_step = make_pipeline_train_step(
+            cfg, mesh, args.learning_rate, args.microbatches
+        )
+    else:
+        train_step = make_train_step(cfg, mesh, args.learning_rate)
 
     state = None
     start_step = 0
@@ -80,13 +119,17 @@ def main() -> int:
 
         # restore into the eval_shape skeleton: no throwaway init, no
         # double residency of model + optimizer state during resume
-        abstract = abstract_train_state(rng, cfg, mesh, args.learning_rate)
+        abstract = abstract_train_state(
+            rng, cfg, mesh, args.learning_rate, rules=rules
+        )
         state = restore_checkpoint(args.checkpoint_dir, abstract)
         if state is not None:
             start_step = int(state.step)
             print(f"resumed from checkpoint at step {start_step}")
     if state is None:
-        state = init_train_state(rng, cfg, mesh, args.learning_rate)
+        state = init_train_state(
+            rng, cfg, mesh, args.learning_rate, rules=rules
+        )
 
     client = None
     if args.control_socket:
