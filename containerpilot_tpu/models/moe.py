@@ -75,13 +75,23 @@ def moe_layer_capacity(
     w_out: jax.Array,     # [n_experts, d_ff, d_model]
     capacity_factor: float,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Capacity-bounded switch MoE for TRAINING: each expert processes
-    at most ``ceil(capacity_factor * s / E)`` tokens per batch row;
-    overflow tokens drop to the residual (standard switch training).
-    Expert FLOPs are bounded at capacity instead of the drop-free
-    layer's dense E×. Inference must use the drop-free ``moe_layer``
-    (capacity depends on sequence length, so this routing cannot match
-    incremental decode — models/decode.py enforces that).
+    """Capacity-bounded switch MoE: each expert processes at most
+    ``ceil(capacity_factor * s / E)`` tokens per batch row; overflow
+    tokens drop to the residual (standard switch training).
+
+    Dispatch is **sparse**: every token knows its queue position within
+    its expert (a cumsum over the routing one-hot), so tokens scatter
+    straight into static-shape ``[E, capacity, d]`` blocks and results
+    gather back by the same slot index. Expert compute AND
+    dispatch/combine are O(E*capacity*d) / O(s*d) — no ``[b,s,E,C]``
+    one-hot dispatch tensor, no O(s*E*C*d) dispatch einsums. Shapes are
+    fully static, so XLA tiles the expert GEMMs on the MXU and (with
+    the expert axis sharded over ``model``) inserts all-to-alls at the
+    scatter/gather boundaries.
+
+    Inference must use the drop-free ``moe_layer`` (capacity depends on
+    sequence length, so this routing cannot match incremental decode —
+    models/decode.py enforces that).
     """
     import math
 
@@ -89,24 +99,38 @@ def moe_layer_capacity(
     n_experts = router_w.shape[-1]
     capacity = max(1, math.ceil(capacity_factor * s / n_experts))
 
-    _probs, gate, onehot, aux_loss = _route(x, router_w)
+    probs, gate, onehot, aux_loss = _route(x, router_w)
+    expert_idx = jnp.argmax(probs, axis=-1)  # [b,s]
 
-    # position of each token within its expert's queue (per batch row);
-    # tokens past capacity drop to the residual
-    pos_in_expert = (jnp.cumsum(onehot, axis=1) * onehot - 1.0).astype(
-        jnp.int32
-    )
-    # one_hot zeroes out-of-range rows itself: the -1 of unrouted
-    # tokens and queue positions >= capacity both drop
-    dispatch = jax.nn.one_hot(
-        pos_in_expert, capacity, dtype=jnp.float32
-    )  # [b, s, E, C]
-    combine = dispatch * gate[..., None, None]
+    # queue position of each token within its expert, per batch row
+    pos = jnp.sum(
+        (jnp.cumsum(onehot, axis=1) - 1.0) * onehot, axis=-1
+    ).astype(jnp.int32)  # [b,s]
+    keep = pos < capacity
+    # flat slot in the [E*C] dispatch buffer; overflow tokens get an
+    # out-of-range slot, which the scatter drops and the gather fills 0
+    slot = jnp.where(keep, expert_idx * capacity + pos, n_experts * capacity)
 
     dt = x.dtype
-    expert_in = jnp.einsum("bsec,bsd->becd", dispatch.astype(dt), x)
+
+    def dispatch_row(x_row: jax.Array, slot_row: jax.Array) -> jax.Array:
+        buf = jnp.zeros((n_experts * capacity, d), dt)
+        return buf.at[slot_row].set(x_row, mode="drop")
+
+    expert_in = jax.vmap(dispatch_row)(x, slot).reshape(
+        b, n_experts, capacity, d
+    )
     hidden = jnp.einsum("becd,edf->becf", expert_in, w_in.astype(dt))
     hidden = jax.nn.gelu(hidden.astype(jnp.float32)).astype(dt)
     expert_out = jnp.einsum("becf,efd->becd", hidden, w_out.astype(dt))
-    out = jnp.einsum("bsec,becd->bsd", combine.astype(dt), expert_out)
+
+    def gather_row(flat_row: jax.Array, slot_row: jax.Array) -> jax.Array:
+        return jnp.take(
+            flat_row, slot_row, axis=0, mode="fill", fill_value=0
+        )
+
+    out = jax.vmap(gather_row)(
+        expert_out.reshape(b, n_experts * capacity, d), slot
+    )
+    out = out * (gate * keep).astype(dt)[..., None]
     return out, aux_loss
