@@ -330,3 +330,217 @@ def test_python_sup_fallback_forwards_sigterm(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=10)
+
+
+def _spawn_cli(config_path, log_path, env=None):
+    log_f = open(log_path, "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "containerpilot_tpu", "-config", config_path],
+        cwd=REPO, stdout=log_f, stderr=subprocess.STDOUT,
+        env=dict(os.environ, **(env or {})),
+    )
+    proc._log_f = log_f  # keep the handle alive with the process
+    return proc
+
+
+def _teardown_cli(proc, timeout=30):
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+    proc._log_f.close()
+
+
+def _wait_for(path, deadline_s=30, what="sentinel"):
+    deadline = time.monotonic() + deadline_s
+    while not os.path.exists(str(path)):
+        assert time.monotonic() < deadline, f"{what} never appeared"
+        time.sleep(0.05)
+
+
+def test_real_sighup_triggers_signal_job(tmp_path):
+    """A REAL SIGHUP delivered to the running CLI runs when.source:
+    SIGHUP jobs and does NOT reload/exit (v3 semantics; reference:
+    integration_tests/tests/test_sighup, core/signals.go:24-27)."""
+    started = tmp_path / "started"
+    hupped = tmp_path / "hupped"
+    cfg = write_config(
+        tmp_path,
+        """
+        {
+          stopTimeout: "1ms",
+          jobs: [
+            { name: "main",
+              exec: ["/bin/sh", "-c", "touch %s; exec sleep 60"] },
+            { name: "on-hup",
+              exec: ["/bin/sh", "-c", "echo HUP >> %s"],
+              when: { source: "SIGHUP" } },
+          ],
+        }
+        """
+        % (started, hupped),
+    )
+    proc = _spawn_cli(cfg, tmp_path / "sup.log")
+    try:
+        _wait_for(started, what="main job")
+        time.sleep(0.3)  # handlers installed before jobs run
+        proc.send_signal(signal.SIGHUP)
+        _wait_for(hupped, what="SIGHUP-triggered job")
+        # SIGHUP is an event, not a reload: the supervisor stays up
+        time.sleep(0.3)
+        assert proc.poll() is None
+        # a second SIGHUP runs it again ("each" semantics by default)
+        proc.send_signal(signal.SIGHUP)
+        deadline = time.monotonic() + 30
+        while hupped.read_text().count("HUP") < 2:
+            assert time.monotonic() < deadline, "second SIGHUP never ran"
+            time.sleep(0.05)
+    finally:
+        _teardown_cli(proc)
+
+
+def test_putenv_visible_to_next_generation_exec(tmp_path):
+    """-putenv persists an env var across reload and the NEXT
+    generation's rendered exec sees it (reference:
+    integration_tests/tests/test_envvars + control/endpoints.go:57-72)."""
+    socket_path = str(tmp_path / "cp.socket")
+    out = tmp_path / "rendered"
+    started = tmp_path / "started"
+    cfg = write_config(
+        tmp_path,
+        """
+        {
+          stopTimeout: "1ms",
+          control: { socket: "%s" },
+          jobs: [
+            { name: "main",
+              exec: ["/bin/sh", "-c", "touch %s; exec sleep 60"],
+              restarts: "unlimited" },
+            { name: "render-env",
+              exec: ["/bin/sh", "-c",
+                     "echo RENDERED={{ .ROUND2_FLAG | default "unset" }} >> %s"] },
+          ],
+        }
+        """
+        % (socket_path, started, out),
+    )
+    proc = _spawn_cli(cfg, tmp_path / "sup.log")
+    try:
+        _wait_for(started, what="first generation")
+        _wait_for(out, what="first render")
+        assert "RENDERED=unset" in out.read_text()
+
+        rc = subprocess.run(
+            [sys.executable, "-m", "containerpilot_tpu",
+             "-putenv", "ROUND2_FLAG=set-via-control",
+             "-config", cfg],
+            cwd=REPO, capture_output=True, text=True, timeout=30,
+        )
+        assert rc.returncode == 0, rc.stderr
+        rc = subprocess.run(
+            [sys.executable, "-m", "containerpilot_tpu",
+             "-reload", "-config", cfg],
+            cwd=REPO, capture_output=True, text=True, timeout=30,
+        )
+        assert rc.returncode == 0, rc.stderr
+
+        # the reloaded generation re-renders the template against the
+        # updated supervisor environment
+        deadline = time.monotonic() + 30
+        while "RENDERED=set-via-control" not in out.read_text():
+            assert time.monotonic() < deadline, (
+                f"next generation never saw putenv: {out.read_text()!r}"
+            )
+            time.sleep(0.1)
+    finally:
+        _teardown_cli(proc)
+
+
+def test_two_supervisors_discover_via_catalog(tmp_path):
+    """Two real supervisors + a live catalog server: A advertises a
+    health-checked service, B's watch observes it appear and fires the
+    dependent job (reference:
+    integration_tests/tests/test_discovery_consul)."""
+    import socket as socketlib
+
+    def free_port():
+        with socketlib.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    catalog_port = free_port()
+    svc_port = free_port()
+    seen = tmp_path / "seen"
+    a_started = tmp_path / "a_started"
+
+    catalog = subprocess.Popen(
+        [sys.executable, "-m", "containerpilot_tpu",
+         "-catalog-server", f"127.0.0.1:{catalog_port}"],
+        cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    proc_a = proc_b = None
+    try:
+        import urllib.request
+
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{catalog_port}/v1/health/service/x",
+                    timeout=1,
+                )
+                break
+            except Exception:
+                assert time.monotonic() < deadline, "catalog never came up"
+                time.sleep(0.2)
+
+        cfg_a = tmp_path / "a.json5"
+        cfg_a.write_text(
+            """
+            {
+              consul: "127.0.0.1:%d",
+              stopTimeout: "1ms",
+              jobs: [
+                { name: "svc-a",
+                  exec: ["/bin/sh", "-c", "touch %s; exec sleep 60"],
+                  port: %d,
+                  interfaces: ["static:127.0.0.1"],
+                  health: { exec: "/bin/true", interval: 1, ttl: 5 } },
+              ],
+            }
+            """
+            % (catalog_port, a_started, svc_port)
+        )
+        cfg_b = tmp_path / "b.json5"
+        cfg_b.write_text(
+            """
+            {
+              consul: "127.0.0.1:%d",
+              stopTimeout: "1ms",
+              jobs: [
+                { name: "observer",
+                  exec: ["/bin/sh", "-c", "echo CHANGED >> %s"],
+                  when: { each: "changed", source: "watch.svc-a" } },
+                { name: "keepalive", exec: "sleep 60" },
+              ],
+              watches: [ { name: "svc-a", interval: 1 } ],
+            }
+            """
+            % (catalog_port, seen)
+        )
+        proc_b = _spawn_cli(str(cfg_b), tmp_path / "b.log")
+        time.sleep(0.5)
+        proc_a = _spawn_cli(str(cfg_a), tmp_path / "a.log")
+        _wait_for(a_started, what="supervisor A's service")
+        # B's watch poll sees svc-a appear in the catalog -> observer runs
+        _wait_for(seen, deadline_s=60, what="B observing A via catalog")
+        assert "CHANGED" in seen.read_text()
+    finally:
+        for p in (proc_a, proc_b):
+            if p is not None:
+                _teardown_cli(p)
+        catalog.terminate()
+        catalog.wait(timeout=10)
