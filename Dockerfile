@@ -1,0 +1,35 @@
+# containerpilot-tpu container image.
+#
+# The reference ships as a container init (reference: Dockerfile:1,
+# makefile:21-30 — a static binary built inside a container). The
+# TPU-host equivalent: a native PID-1 reaper (cpsup) as ENTRYPOINT
+# that forks the Python supervisor, which runs jobs/health/discovery/
+# telemetry for the host's JAX processes.
+#
+#   make image                      # build
+#   docker run -v $PWD/examples/training-pod.json5:/etc/containerpilot.json5 \
+#       containerpilot-tpu          # run the example pod config
+#
+# The workload extra (jax/optax/orbax) is NOT installed here: TPU
+# images layer the matching libtpu+jax wheels on top (they are
+# hardware/driver specific). The supervisor half has no jax dependency.
+
+FROM debian:bookworm-slim AS build-sup
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+COPY native/ /src/native/
+RUN make -C /src/native cpsup
+
+FROM python:3.12-slim
+COPY --from=build-sup /src/native/cpsup /bin/cpsup
+WORKDIR /opt/containerpilot-tpu
+COPY pyproject.toml README.md ./
+COPY containerpilot_tpu/ containerpilot_tpu/
+RUN pip install --no-cache-dir .
+COPY examples/ /etc/containerpilot/examples/
+
+# PID 1 is the native reaper; it forks the supervisor CLI
+# (reference: main.go:23-27 — the Go binary re-execs itself under sup)
+ENTRYPOINT ["/bin/cpsup", "python", "-m", "containerpilot_tpu"]
+CMD ["-config", "/etc/containerpilot.json5"]

@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all build test integration bench lint clean
+.PHONY: all build test integration bench lint clean image
 
 all: build test
 
@@ -37,6 +37,12 @@ release: build
 		--exclude='native/cpsup' \
 		containerpilot_tpu bin/cpsup docs examples README.md \
 		CHANGELOG.md pyproject.toml Makefile native
+
+# container image with cpsup as the PID-1 entrypoint (reference:
+# Dockerfile, makefile build-in-container targets)
+IMAGE ?= containerpilot-tpu:latest
+image:
+	docker build -t $(IMAGE) .
 
 clean:
 	$(MAKE) -C native clean

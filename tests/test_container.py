@@ -1,0 +1,85 @@
+"""Container-image integration scenarios, mirroring the reference's
+docker-based tiers (reference: scripts/test.sh:50-140,
+integration_tests/tests/test_reap_zombies, test_sigterm). Skipped when
+no docker daemon is available (the reference's integration tier is
+likewise a separate make target gated on docker)."""
+import json
+import shutil
+import subprocess
+import uuid
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("docker") is None, reason="docker not available"
+)
+
+IMAGE = "containerpilot-tpu:test"
+
+
+@pytest.fixture(scope="module")
+def image():
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    build = subprocess.run(
+        ["docker", "build", "-q", "-t", IMAGE, repo],
+        capture_output=True, text=True, timeout=600,
+    )
+    if build.returncode != 0:
+        pytest.skip(f"docker build failed: {build.stderr[-500:]}")
+    return IMAGE
+
+
+def _run(image, config: dict, timeout: int = 60, extra=()):
+    name = f"cpt-test-{uuid.uuid4().hex[:8]}"
+    cmd = [
+        "docker", "run", "--rm", "--name", name, *extra,
+        "-e", f"CONTAINERPILOT_CONFIG_JSON={json.dumps(config)}",
+        "--entrypoint", "/bin/sh", image, "-c",
+        'echo "$CONTAINERPILOT_CONFIG_JSON" > /etc/containerpilot.json5 '
+        "&& exec /bin/cpsup python -m containerpilot_tpu "
+        "-config /etc/containerpilot.json5",
+    ]
+    return subprocess.run(cmd, capture_output=True, text=True, timeout=timeout)
+
+
+def test_image_runs_all_jobs_complete(image):
+    """The supervisor under cpsup runs a one-shot job and exits 0 when
+    every job is complete (reference: test_no_command behavior)."""
+    cfg = {
+        "jobs": [{"name": "hello", "exec": ["/bin/echo", "hello-from-image"]}]
+    }
+    proc = _run(image, cfg)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert "hello-from-image" in proc.stdout + proc.stderr
+
+
+def test_image_reaps_zombies(image):
+    """Orphaned grandchildren must be reaped by cpsup as PID 1
+    (reference: test_reap_zombies/run.sh:14-36)."""
+    # the job double-forks orphans, then a second job inspects the
+    # process table: no more than one transient zombie allowed
+    cfg = {
+        "jobs": [
+            {
+                "name": "orphaner",
+                "exec": [
+                    "/bin/sh", "-c",
+                    "for i in 1 2 3; do (sleep 0.1 &) ; done; sleep 1",
+                ],
+            },
+            {
+                "name": "checker",
+                "when": {"source": "orphaner", "once": "stopped"},
+                "exec": [
+                    "/bin/sh", "-c",
+                    "sleep 2; z=$(ls /proc | grep -c '^[0-9]' || true); "
+                    "echo procs=$z",
+                ],
+            },
+        ]
+    }
+    proc = _run(image, cfg, timeout=90)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert "procs=" in proc.stdout + proc.stderr
