@@ -20,17 +20,26 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .quantized import embed_lookup, maybe_dequant_layer, maybe_dequant_top
+from .quantized import (
+    can_fuse_int8,
+    embed_lookup,
+    fused_attn_out,
+    fused_mlp,
+    fused_qkv,
+    maybe_dequant_layer,
+    maybe_dequant_top,
+)
 from .transformer import (
     Params,
     TransformerConfig,
     _attn_out,
+    _auto_attention,
     _ffn,
     _qkv,
     _rms_norm,
     repeat_kv,
 )
-from ..ops.attention import NEG_INF, causal_attention
+from ..ops.attention import NEG_INF
 
 Cache = Dict[str, jax.Array]
 
@@ -73,7 +82,9 @@ def prefill(
     b, s = tokens.shape
     x = embed_lookup(params, tokens, cfg.dtype)
 
-    attn_fn = cfg.attention_fn or causal_attention
+    # long prompts go through the pallas flash kernels just like
+    # training (same auto-selection rule); short prompts stay einsum
+    attn_fn = cfg.attention_fn or _auto_attention(cfg, s)
 
     def body(carry, layer_params):
         layer_params = maybe_dequant_layer(layer_params, cfg.dtype)
@@ -105,12 +116,19 @@ def decode_step(
     max_len = cache["k"].shape[2]
     x = embed_lookup(params, token, cfg.dtype)[:, None, :]  # [b,1,d]
     valid = jnp.arange(max_len) <= pos  # [max_len]; pos itself is valid
+    # int8-quantized dense models run their projections through the
+    # fused dequant pallas GEMM: decode is weight-streaming bound, so
+    # reading int8 instead of dequantized bf16 halves the HBM traffic
+    fused = can_fuse_int8(params["layers"], cfg, rows=b)
 
     def body(carry, inputs):
         x = carry
         layer_params, k_cache, v_cache = inputs
-        layer_params = maybe_dequant_layer(layer_params, cfg.dtype)
-        q, k, v = _qkv(x, layer_params, cfg, offset=pos)
+        if fused:
+            q, k, v = fused_qkv(x, layer_params, cfg, offset=pos)
+        else:
+            layer_params = maybe_dequant_layer(layer_params, cfg.dtype)
+            q, k, v = _qkv(x, layer_params, cfg, offset=pos)
         # write this step's k/v at position pos
         k_cache = lax.dynamic_update_slice(
             k_cache, k, (0, pos, 0, 0)
@@ -131,8 +149,12 @@ def decode_step(
             "bhqk,bkhd->bqhd", weights, v_full,
             preferred_element_type=jnp.float32,
         ).astype(cfg.dtype)
-        x = _attn_out(x, attn, layer_params, cfg)
-        x, _aux = _ffn(x, layer_params, cfg)
+        if fused:
+            x = fused_attn_out(x, attn, layer_params, cfg)
+            x = fused_mlp(x, layer_params, cfg)
+        else:
+            x = _attn_out(x, attn, layer_params, cfg)
+            x, _aux = _ffn(x, layer_params, cfg)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = lax.scan(

@@ -3,12 +3,19 @@ run the same forward/decode code on it.
 
 ``quantize_model_params`` converts every large matmul weight (attention
 projections, MLP/MoE, embed/unembed) to int8 with broadcast-ready
-per-output-channel scales; norms stay float. The model's scan bodies
-call ``maybe_dequant_layer`` first, so quantized and full-precision
-params flow through identical math — resident weight memory shrinks ~4x (int8 vs the f32 master copies)
-(the per-layer bf16 dequant is transient, one layer at a time under the
-scan; fusing the dequant into each matmul via ops/quant.py's pallas
-GEMM is the round-2 step).
+per-output-channel scales; norms stay float. Two execution paths:
+
+- **dense dequant** (``maybe_dequant_layer``): rebuild one layer's
+  bf16 weights inside the scan body — quantized and full-precision
+  params flow through identical math. Used for training-size token
+  counts and any non-tile-aligned/MoE model.
+- **fused int8** (``fused_qkv``/``fused_attn_out``/``fused_mlp``):
+  the decode step's projections run through ops/quant.py's pallas
+  dequant-GEMM, so weights stream from HBM as int8 and upcast in
+  VMEM — half the weight traffic in the weight-streaming-bound decode
+  regime. Selected by ``can_fuse_int8`` (models/decode.py wires it).
+
+Resident weight memory shrinks ~4x either way (int8 vs f32 masters).
 """
 from __future__ import annotations
 
@@ -111,3 +118,99 @@ def param_bytes(params: Any) -> int:
     return sum(
         leaf.nbytes for leaf in jax.tree_util.tree_leaves(params)
     )
+
+
+# ---------------------------------------------------------------------------
+# fused int8 serving path: projections through the pallas dequant-GEMM
+# ---------------------------------------------------------------------------
+
+# beyond this many rows (batch*seq tokens) the GEMMs are MXU-bound and
+# bf16 wins; below it they are weight-streaming-bound and reading int8
+# halves the HBM traffic — the decode regime
+FUSED_MAX_ROWS = 256
+
+_GEMM_TILE = 128
+
+
+def can_fuse_int8(
+    layers: Dict[str, jax.Array], cfg: Any, rows: int
+) -> bool:
+    """True when the decode-step projections can run through the fused
+    int8 pallas GEMM: dense (non-MoE) quantized weights, a
+    weight-streaming-bound row count, and tile-aligned dims."""
+    if "wq_q" not in layers or "w_gate_q" not in layers:
+        return False
+    if rows > FUSED_MAX_ROWS:
+        return False
+    d = cfg.d_model
+    kv_out = cfg.kv_heads * cfg.head_dim
+    return (
+        d % _GEMM_TILE == 0
+        and kv_out % _GEMM_TILE == 0
+        and cfg.d_ff % _GEMM_TILE == 0
+    )
+
+
+def _fused_proj(
+    h2d: jax.Array, layer_params: Dict[str, jax.Array], key: str
+) -> jax.Array:
+    """[rows, k] @ dequant(W[key]) via the pallas kernel; W's non-layer
+    leading axes flatten to the GEMM's (k, n)."""
+    from ..ops.quant import int8_matmul_padded
+
+    w_q = layer_params[key + "_q"]
+    k = h2d.shape[-1]
+    return int8_matmul_padded(
+        h2d,
+        w_q.reshape(k, -1),
+        layer_params[key + "_s"].reshape(-1),
+    )
+
+
+def fused_qkv(
+    x: jax.Array, layer_params: Dict[str, jax.Array], cfg: Any, offset: Any
+):
+    """The _qkv contract (pre-norm, projections, RoPE) with the
+    projections running int8-fused — weights stream from HBM as int8
+    and dequantize in VMEM (ops/quant.py)."""
+    from .transformer import _rms_norm, _rope
+
+    b, s, d = x.shape
+    h = _rms_norm(x, layer_params["norm_attn"]).reshape(b * s, d)
+    hd = cfg.head_dim
+    q = _fused_proj(h, layer_params, "wq").reshape(b, s, cfg.n_heads, hd)
+    k = _fused_proj(h, layer_params, "wk").reshape(b, s, cfg.kv_heads, hd)
+    v = _fused_proj(h, layer_params, "wv").reshape(b, s, cfg.kv_heads, hd)
+    q = _rope(q, cfg.rope_theta, offset)
+    k = _rope(k, cfg.rope_theta, offset)
+    return q, k, v
+
+
+def fused_attn_out(
+    x: jax.Array,
+    attn: jax.Array,
+    layer_params: Dict[str, jax.Array],
+    cfg: Any,
+) -> jax.Array:
+    """Output projection + residual, int8-fused (wo is [h, hd, d]:
+    the h*hd axes flatten to the GEMM's k)."""
+    b, s, h, hd = attn.shape
+    out = _fused_proj(
+        attn.reshape(b * s, h * hd), layer_params, "wo"
+    ).reshape(b, s, -1)
+    return x + out
+
+
+def fused_mlp(
+    x: jax.Array, layer_params: Dict[str, jax.Array], cfg: Any
+) -> jax.Array:
+    """SwiGLU block + residual with all three GEMMs int8-fused."""
+    from .transformer import _rms_norm
+
+    b, s, d = x.shape
+    h = _rms_norm(x, layer_params["norm_mlp"]).reshape(b * s, d)
+    gate = _fused_proj(h, layer_params, "w_gate").astype(jnp.float32)
+    up = _fused_proj(h, layer_params, "w_up").astype(jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(cfg.dtype)
+    down = _fused_proj(act, layer_params, "w_down").reshape(b, s, d)
+    return x + down

@@ -83,6 +83,28 @@ def _int8_matmul_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, n_k: int):
         )
 
 
+def int8_matmul_padded(
+    x: jax.Array,
+    w_q: jax.Array,
+    scales: jax.Array,
+    block_m: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """``int8_matmul_pallas`` for arbitrary row counts: decode
+    microbatches are far below the 128-row tile, so rows pad up to one
+    tile and slice back — the padding rows are dead weight the MXU
+    doesn't notice in the weight-streaming-bound regime this kernel
+    serves."""
+    m = x.shape[0]
+    pad = (-m) % block_m
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    out = int8_matmul_pallas(
+        x, w_q, scales, block_m=block_m, interpret=interpret
+    )
+    return out[:m] if pad else out
+
+
 @functools.partial(
     jax.jit, static_argnames=("block_m", "block_n", "block_k", "interpret")
 )
