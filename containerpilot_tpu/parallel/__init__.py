@@ -7,7 +7,12 @@ supervises coordinates *chips* through jax.sharding: pick a Mesh,
 annotate shardings, and let XLA insert the collectives over ICI/DCN
 (SURVEY.md §5 distributed-backend mapping).
 """
-from .checkpoint import latest_step, restore_checkpoint, save_checkpoint
+from .checkpoint import (
+    latest_step,
+    restore_checkpoint,
+    restore_params,
+    save_checkpoint,
+)
 from .context import context_parallel_config, flash_parallel_config
 from .distributed import initialize_from_catalog, initialize_from_env
 from .mesh import MeshPlan, make_mesh
@@ -41,6 +46,7 @@ __all__ = [
     "train_state_shardings",
     "save_checkpoint",
     "restore_checkpoint",
+    "restore_params",
     "latest_step",
     "initialize_from_catalog",
     "initialize_from_env",

@@ -86,6 +86,14 @@ def save_checkpoint(
     log.info("checkpoint: saved step %d to %s", step, directory)
 
 
+def _to_abstract(x: Any) -> Any:
+    # carry shardings through so the restore lands arrays exactly
+    # where the training step expects them (replicated scalars
+    # included)
+    sharding = getattr(x, "sharding", None)
+    return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+
 def restore_checkpoint(directory: str, state_like: Any) -> Optional[Any]:
     """Restore the latest checkpoint into the structure (and shardings)
     of ``state_like``; None when no checkpoint exists."""
@@ -93,14 +101,57 @@ def restore_checkpoint(directory: str, state_like: Any) -> Optional[Any]:
     if step is None:
         return None
 
-    def to_abstract(x: Any) -> Any:
-        # carry shardings through so the restore lands arrays exactly
-        # where the training step expects them (replicated scalars
-        # included)
-        sharding = getattr(x, "sharding", None)
-        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
-
-    abstract = jax.tree.map(to_abstract, state_like)
+    abstract = jax.tree.map(_to_abstract, state_like)
     restored = _get_checkpointer().restore(_step_path(directory, step), abstract)
     log.info("checkpoint: restored step %d from %s", step, directory)
     return restored
+
+
+def restore_params(directory: str, state_like: Any) -> Optional[Any]:
+    """Restore ONLY the params (and step) of the latest train-state
+    checkpoint — optimizer moments are orbax PLACEHOLDERs and never
+    leave disk. Serving pays params-sized memory instead of the full
+    train state (adam's mu/nu alone double it).
+
+    ``state_like`` is a TrainState-shaped pytree of arrays or
+    ShapeDtypeStructs (e.g. from abstract_train_state). Returns
+    (params, step) or None when no checkpoint exists.
+    """
+    step = latest_step(directory)
+    if step is None:
+        return None
+    import orbax.checkpoint as ocp
+
+    abstract = jax.tree.map(_to_abstract, state_like)
+    skeleton = jax.tree.map(lambda _: ocp.PLACEHOLDER, abstract)
+    # TrainState is a registered pytree (params, opt_state, step);
+    # rebuild it with real abstract leaves only where we want data.
+    # StandardCheckpointer rejects PLACEHOLDER leaves; the PyTree
+    # handler (same on-disk format) honors them.
+    from .train import TrainState
+
+    target = TrainState(
+        params=abstract.params,
+        opt_state=skeleton.opt_state,
+        step=abstract.step,
+    )
+    # explicit per-leaf restore_args: PyTreeRestore ignores the
+    # shardings carried on abstract leaves and would otherwise fall
+    # back to the sharding file saved at TRAINING time — wrong (or
+    # fatal) when serving on a different topology
+    def restore_arg(leaf: Any) -> Any:
+        if leaf is ocp.PLACEHOLDER:
+            return ocp.RestoreArgs()
+        return ocp.ArrayRestoreArgs(sharding=leaf.sharding)
+
+    restore_args = jax.tree.map(
+        restore_arg, target, is_leaf=lambda x: x is ocp.PLACEHOLDER
+    )
+    restored = ocp.PyTreeCheckpointer().restore(
+        _step_path(directory, step),
+        ocp.args.PyTreeRestore(item=target, restore_args=restore_args),
+    )
+    log.info(
+        "checkpoint: restored params-only step %d from %s", step, directory
+    )
+    return restored.params, restored.step

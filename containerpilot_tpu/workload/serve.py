@@ -205,18 +205,17 @@ def main() -> int:
         from ..parallel import (
             abstract_train_state,
             make_mesh,
-            restore_checkpoint,
+            restore_params,
         )
 
         mesh = make_mesh()
-        # the restore target includes optimizer state the server drops;
-        # this orbax version lacks partial (PLACEHOLDER) restore, so a
-        # params-only target is a later-round optimization
+        # params-only restore: optimizer moments stay PLACEHOLDERs on
+        # disk, so the server never pays train-state memory
         abstract = abstract_train_state(jax.random.PRNGKey(0), cfg, mesh)
-        restored = restore_checkpoint(args.checkpoint_dir, abstract)
+        restored = restore_params(args.checkpoint_dir, abstract)
         if restored is not None:
-            params = restored.params
-            print(f"serving checkpoint step {int(restored.step)}")
+            params, step = restored
+            print(f"serving checkpoint step {int(step)}")
     if params is None:
         params = init_params(jax.random.PRNGKey(0), cfg)
     if args.int8:
