@@ -1,0 +1,210 @@
+"""Token-shard data loading for the supervised trainer.
+
+TPU-first IO design:
+
+- **Shards are memory-mapped**: each shard is a flat ``.npy`` of token
+  ids; ``numpy.memmap`` reads lean on the OS page cache, so the hot
+  path is a zero-copy slice — no Python-side decode loop, no
+  per-example framing. (The reference supervisor has no data plane at
+  all — SURVEY.md §2; this subsystem serves the workload half.)
+- **Deterministic, resumable order**: the window served at step N is a
+  pure function of (seed, N). A trainer that crashes at step 1000 and
+  is restarted by the supervisor resumes from its checkpoint and
+  replays the exact stream the dead process would have seen — the same
+  property the synthetic path gets from ``fold_in(seed, step)``.
+- **Background prefetch**: a thread stages the next batches and
+  ``jax.device_put``s them ahead of the step, overlapping host IO with
+  device compute (double buffering; the usual input-pipeline shape for
+  a single host).
+
+Shard layout: ``<dir>/shard_*.npy``, each a 1-D int array of token
+ids. ``write_token_shards`` produces it; any tokenizer pipeline that
+emits flat id streams can too.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+_SHARD_GLOB = "shard_*.npy"
+
+
+def write_token_shards(
+    tokens: Sequence[int] | np.ndarray,
+    directory: str,
+    shard_size: int = 1 << 20,
+    dtype=np.int32,
+) -> List[str]:
+    """Split a flat token stream into memmap-able .npy shards."""
+    os.makedirs(directory, exist_ok=True)
+    arr = np.asarray(tokens, dtype=dtype)
+    paths = []
+    for i, start in enumerate(range(0, len(arr), shard_size)):
+        path = os.path.join(directory, f"shard_{i:05d}.npy")
+        np.save(path, arr[start : start + shard_size])
+        paths.append(path)
+    return paths
+
+
+class TokenShardDataset:
+    """Deterministic [batch, seq_len + 1] windows over memmapped
+    shards (the +1 is the next-token target column)."""
+
+    def __init__(
+        self,
+        directory: str,
+        seq_len: int,
+        batch_size: int,
+        seed: int = 0,
+        vocab_size: int = 0,
+    ) -> None:
+        paths = sorted(glob.glob(os.path.join(directory, _SHARD_GLOB)))
+        if not paths:
+            raise FileNotFoundError(
+                f"no {_SHARD_GLOB} shards under {directory!r}"
+            )
+        self.seq_len = seq_len
+        self.batch_size = batch_size
+        self.seed = seed
+        # >0: every served batch is range-checked (JAX clamps
+        # out-of-range gathers, so a vocab mismatch would otherwise
+        # train silently on garbage)
+        self.vocab_size = vocab_size
+        # mmap_mode keeps shards on disk; slices fault in via page cache
+        self._shards = [np.load(p, mmap_mode="r") for p in paths]
+        window = seq_len + 1
+        # windows per shard as pure arithmetic — the index is
+        # O(#shards) memory (a prefix sum), never a per-window list
+        counts = np.array(
+            [len(s) // window for s in self._shards], dtype=np.int64
+        )
+        self._window_starts = np.concatenate(
+            [[0], np.cumsum(counts)]
+        )  # prefix sum; window i lives in shard searchsorted(i)
+        self.n_windows = int(self._window_starts[-1])
+        if self.n_windows == 0:
+            raise ValueError(
+                f"shards under {directory!r} are shorter than "
+                f"seq_len+1 = {window} tokens"
+            )
+
+    def _window(self, index: int) -> np.ndarray:
+        index = index % self.n_windows
+        si = int(
+            np.searchsorted(self._window_starts, index, side="right") - 1
+        )
+        off = (index - int(self._window_starts[si])) * (self.seq_len + 1)
+        return np.asarray(
+            self._shards[si][off : off + self.seq_len + 1], dtype=np.int32
+        )
+
+    def batch_at(self, step: int) -> np.ndarray:
+        """The [batch, seq_len+1] batch for a given global step — a
+        pure function of (seed, step), which is what makes crash-resume
+        replay exact. Windows are visited in a per-epoch pseudo-random
+        order via a coprime stride (an affine permutation of the window
+        index space), so consecutive steps don't read one shard
+        sequentially forever."""
+        rows = []
+        stride = self._epoch_stride()
+        for j in range(self.batch_size):
+            flat = step * self.batch_size + j
+            epoch, pos = divmod(flat, self.n_windows)
+            # affine permutation: (a*pos + b) mod n, a coprime with n
+            index = (stride * pos + epoch * 7919 + self.seed) % self.n_windows
+            rows.append(self._window(index))
+        batch = np.stack(rows)
+        if self.vocab_size:
+            top = int(batch.max())
+            if top >= self.vocab_size or int(batch.min()) < 0:
+                raise ValueError(
+                    f"shard token id {top} out of range for vocab_size "
+                    f"{self.vocab_size} — wrong shards or wrong --vocab "
+                    "(JAX would silently clamp the embedding gather)"
+                )
+        return batch
+
+    def _epoch_stride(self) -> int:
+        # largest prime-ish stride below n that is coprime with n
+        n = self.n_windows
+        for cand in (7919, 104729, 1299709, 15485863):
+            if n > 1 and np.gcd(cand % n or 1, n) == 1:
+                return cand % n or 1
+        return 1
+
+    def batches(self, start_step: int = 0) -> Iterator[np.ndarray]:
+        step = start_step
+        while True:
+            yield self.batch_at(step)
+            step += 1
+
+
+class DevicePrefetcher:
+    """Stage upcoming batches onto the device from a background thread
+    (double buffering: host IO + H2D transfer overlap the train step)."""
+
+    def __init__(
+        self,
+        dataset: TokenShardDataset,
+        start_step: int = 0,
+        depth: int = 2,
+        sharding=None,
+    ) -> None:
+        import jax
+
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+
+        def worker() -> None:
+            step = start_step
+            try:
+                while not self._stop.is_set():
+                    batch = dataset.batch_at(step)
+                    staged = (
+                        jax.device_put(batch, sharding)
+                        if sharding is not None
+                        else jax.device_put(batch)
+                    )
+                    while not self._stop.is_set():
+                        try:
+                            self._queue.put((step, staged), timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    step += 1
+            except BaseException as exc:  # surface it — never die silent
+                self._error = exc
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(None, timeout=0.1)  # wake next()
+                        break
+                    except queue.Full:
+                        continue
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def next(self):
+        """(step, device_batch) in order. Re-raises any exception that
+        killed the background worker — a dead loader must fail the
+        training step, not hang it."""
+        item = self._queue.get()
+        if item is None:
+            raise RuntimeError("data prefetch worker died") from self._error
+        return item
+
+    def stop(self) -> None:
+        self._stop.set()
+        # drain so the worker's blocked put wakes and sees the flag
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)

@@ -42,6 +42,9 @@ def main() -> int:
                         help="capacity factor for bounded expert compute "
                         "during training (0 = drop-free routing)")
     parser.add_argument("--vocab", type=int, default=1024)
+    parser.add_argument("--data-dir", default="",
+                        help="token shards (shard_*.npy; workload/data.py)"
+                        " — default is synthetic data")
     parser.add_argument("--pipeline-stages", type=int, default=0,
                         help="GPipe pipeline stages (0 = no pipeline); "
                         "n_layers must divide by it")
@@ -137,34 +140,65 @@ def main() -> int:
 
         client = ControlClient(args.control_socket)
 
+    prefetcher = None
+    if args.data_dir:
+        from jax.sharding import NamedSharding
+
+        from ..parallel.sharding import batch_spec
+        from .data import DevicePrefetcher, TokenShardDataset
+
+        dataset = TokenShardDataset(
+            args.data_dir, args.seq_len, args.batch,
+            vocab_size=cfg.vocab_size,  # fail loudly on id/vocab mismatch
+        )
+        # batches stage onto the mesh from a background thread; the
+        # window order is a pure function of the step, so a restarted
+        # trainer replays the exact stream from its checkpoint step
+        prefetcher = DevicePrefetcher(
+            dataset,
+            start_step=start_step,
+            sharding=NamedSharding(mesh, batch_spec()),
+        )
+        print(f"data: {dataset.n_windows} windows from {args.data_dir}")
+
     data_rng = jax.random.PRNGKey(1)
     t0 = time.monotonic()
-    for step in range(start_step, args.steps):
-        # stateless per-step key: a resumed run continues the data
-        # stream exactly where the crashed run left off
-        k = jax.random.fold_in(data_rng, step)
-        tokens = jax.random.randint(
-            k, (args.batch, args.seq_len + 1), 0, cfg.vocab_size, jnp.int32
-        )
-        state, loss = train_step(state, tokens)
-        if args.checkpoint_dir and (step + 1) % args.checkpoint_every == 0:
-            save_checkpoint(args.checkpoint_dir, step + 1, state)
-        if args.progress_file:
-            tmp = args.progress_file + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump({"step": step + 1, "loss": float(loss),
-                           "time": time.time()}, f)
-            os.replace(tmp, args.progress_file)
-        if client is not None and (step + 1) % 10 == 0:
-            try:
-                client.put_metric({"training_steps_total": 10,
-                                   "training_loss": float(loss)})
-            except Exception:
-                pass  # the supervisor may be reloading; never die for this
-        if (step + 1) % 10 == 0 or step == start_step:
-            rate = (step + 1 - start_step) / (time.monotonic() - t0)
-            print(f"step {step + 1}: loss={float(loss):.4f} "
-                  f"({rate:.1f} steps/s)")
+    try:
+        for step in range(start_step, args.steps):
+            if prefetcher is not None:
+                _pstep, tokens = prefetcher.next()
+            else:
+                # stateless per-step key: a resumed run continues the
+                # data stream exactly where the crashed run left off
+                k = jax.random.fold_in(data_rng, step)
+                tokens = jax.random.randint(
+                    k, (args.batch, args.seq_len + 1), 0, cfg.vocab_size,
+                    jnp.int32,
+                )
+            state, loss = train_step(state, tokens)
+            if args.checkpoint_dir and (step + 1) % args.checkpoint_every == 0:
+                save_checkpoint(args.checkpoint_dir, step + 1, state)
+            if args.progress_file:
+                tmp = args.progress_file + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"step": step + 1, "loss": float(loss),
+                               "time": time.time()}, f)
+                os.replace(tmp, args.progress_file)
+            if client is not None and (step + 1) % 10 == 0:
+                try:
+                    client.put_metric({"training_steps_total": 10,
+                                       "training_loss": float(loss)})
+                except Exception:
+                    pass  # supervisor may be reloading; never die for this
+            if (step + 1) % 10 == 0 or step == start_step:
+                rate = (step + 1 - start_step) / (time.monotonic() - t0)
+                print(f"step {step + 1}: loss={float(loss):.4f} "
+                      f"({rate:.1f} steps/s)")
+    finally:
+        # a failed step must not leak the staging thread (in-process
+        # callers would otherwise keep a live worker + device buffers)
+        if prefetcher is not None:
+            prefetcher.stop()
     return 0
 
 

@@ -1,0 +1,133 @@
+"""Token-shard data loading: memmap windows, deterministic resume,
+device prefetch, trainer integration (workload/data.py)."""
+import numpy as np
+import pytest
+
+from containerpilot_tpu.workload.data import (
+    DevicePrefetcher,
+    TokenShardDataset,
+    write_token_shards,
+)
+
+
+@pytest.fixture()
+def shard_dir(tmp_path):
+    # 3 shards x 1000 tokens of a recognizable ramp
+    tokens = np.arange(3000, dtype=np.int32) % 255
+    write_token_shards(tokens, str(tmp_path / "data"), shard_size=1000)
+    return str(tmp_path / "data")
+
+
+def test_windows_cover_shards_without_crossing(shard_dir):
+    ds = TokenShardDataset(shard_dir, seq_len=9, batch_size=2)
+    # 1000 // 10 = 100 windows per shard, never straddling a boundary
+    assert ds.n_windows == 300
+    batch = ds.batch_at(0)
+    assert batch.shape == (2, 10)
+    assert batch.dtype == np.int32
+    # every window is a contiguous ramp slice (mod the 255 wrap)
+    for row in batch:
+        deltas = np.diff(row.astype(np.int64)) % 255
+        assert (deltas == 1).all()
+
+
+def test_batches_are_deterministic_and_resumable(shard_dir):
+    ds = TokenShardDataset(shard_dir, seq_len=9, batch_size=4, seed=7)
+    ds2 = TokenShardDataset(shard_dir, seq_len=9, batch_size=4, seed=7)
+    for step in (0, 1, 17, 300):
+        np.testing.assert_array_equal(ds.batch_at(step), ds2.batch_at(step))
+    # a "resumed" iterator continues the exact stream
+    it = ds.batches(start_step=5)
+    np.testing.assert_array_equal(next(it), ds.batch_at(5))
+    np.testing.assert_array_equal(next(it), ds.batch_at(6))
+    # different seeds see different orders
+    ds3 = TokenShardDataset(shard_dir, seq_len=9, batch_size=4, seed=8)
+    assert not np.array_equal(ds3.batch_at(0), ds.batch_at(0))
+
+
+def test_epoch_order_is_a_permutation(shard_dir):
+    ds = TokenShardDataset(shard_dir, seq_len=9, batch_size=1)
+    starts = set()
+    for step in range(ds.n_windows):
+        starts.add(int(ds.batch_at(step)[0, 0]))
+    # one epoch of batch-1 steps touches every distinct window start
+    # value (ramp mod 255 collapses some, so compare against truth)
+    truth = {int(ds._window(i)[0]) for i in range(ds.n_windows)}
+    assert starts == truth
+
+
+def test_validates_empty_and_short(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        TokenShardDataset(str(tmp_path), seq_len=8, batch_size=1)
+    write_token_shards(np.arange(4), str(tmp_path / "tiny"))
+    with pytest.raises(ValueError, match="shorter than"):
+        TokenShardDataset(str(tmp_path / "tiny"), seq_len=8, batch_size=1)
+
+
+def test_vocab_range_check(shard_dir):
+    """A vocab/shard mismatch must fail loudly — JAX clamps the
+    embedding gather, so silence means training on garbage."""
+    ok = TokenShardDataset(shard_dir, seq_len=9, batch_size=2,
+                           vocab_size=255)
+    ok.batch_at(0)  # ids are 0..254: fine
+    bad = TokenShardDataset(shard_dir, seq_len=9, batch_size=2,
+                            vocab_size=100)
+    with pytest.raises(ValueError, match="out of range"):
+        bad.batch_at(0)
+
+
+def test_prefetcher_propagates_worker_death(shard_dir):
+    """A dying worker must fail next(), never hang it."""
+    ds = TokenShardDataset(shard_dir, seq_len=9, batch_size=2,
+                           vocab_size=10)  # every batch raises
+    pf = DevicePrefetcher(ds, start_step=0)
+    try:
+        with pytest.raises(RuntimeError, match="worker died"):
+            pf.next()
+    finally:
+        pf.stop()
+
+
+def test_device_prefetcher_orders_and_stops(shard_dir):
+    import jax.numpy as jnp
+
+    ds = TokenShardDataset(shard_dir, seq_len=9, batch_size=2)
+    pf = DevicePrefetcher(ds, start_step=3, depth=2)
+    try:
+        for expect in (3, 4, 5):
+            step, batch = pf.next()
+            assert step == expect
+            assert isinstance(batch, jnp.ndarray)
+            np.testing.assert_array_equal(
+                np.asarray(batch), ds.batch_at(expect)
+            )
+    finally:
+        pf.stop()
+
+
+def test_trainer_runs_on_token_shards(tmp_path, capsys):
+    """End-to-end: the supervised trainer consumes real shards."""
+    import sys
+
+    import jax
+
+    from containerpilot_tpu.workload.train import main
+
+    tokens = np.random.default_rng(0).integers(
+        0, 128, size=20_000, dtype=np.int32
+    )
+    data_dir = str(tmp_path / "data")
+    write_token_shards(tokens, data_dir, shard_size=10_000)
+    argv = sys.argv
+    sys.argv = [
+        "train", "--steps", "3", "--batch", "2", "--seq-len", "32",
+        "--d-model", "64", "--n-layers", "1", "--n-heads", "4",
+        "--vocab", "128", "--data-dir", data_dir,
+    ]
+    try:
+        assert main() == 0
+    finally:
+        sys.argv = argv
+    out = capsys.readouterr().out
+    assert "windows from" in out
+    assert "step 1:" in out

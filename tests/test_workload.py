@@ -364,6 +364,63 @@ def test_checkpoint_save_restore_roundtrip(tmp_path):
     assert sorted(os.listdir(ckdir)) == ["step_3"]
 
 
+def test_restore_params_only(tmp_path):
+    """Serving restore: params (and step) come back; optimizer moments
+    stay orbax PLACEHOLDERs and are never materialized."""
+    from containerpilot_tpu.parallel import (
+        abstract_train_state,
+        restore_params,
+        save_checkpoint,
+    )
+
+    mesh = make_mesh(jax.devices()[:8])
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq_len=64,
+    )
+    state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (4, 33), 0, cfg.vocab_size, jnp.int32
+    )
+    state, _ = step(state, tokens)
+    ckdir = str(tmp_path / "ckpts")
+    save_checkpoint(ckdir, 1, state)
+
+    abstract = abstract_train_state(jax.random.PRNGKey(0), cfg, mesh)
+    params, ck_step = restore_params(ckdir, abstract)
+    assert int(ck_step) == 1
+    np.testing.assert_allclose(
+        np.asarray(state.params["norm_out"]), np.asarray(params["norm_out"])
+    )
+    # the restored params serve a forward directly
+    logits = forward(params, tokens[:, :8], cfg)
+    assert bool(jnp.isfinite(logits).all())
+    assert restore_params(str(tmp_path / "nope"), abstract) is None
+
+
+def test_prefill_through_flash_matches_forward():
+    """A flash-eligible prompt length routes prefill through the pallas
+    kernels; last-position logits must equal the full forward."""
+    from containerpilot_tpu.models.decode import prefill
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=256, dtype=jnp.float32, flash_min_seq=128,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 128), 0, cfg.vocab_size, jnp.int32
+    )
+    with jax.default_matmul_precision("float32"):
+        ref = forward(params, tokens, cfg)[:, -1, :]
+        logits, cache = prefill(params, tokens, cfg, max_len=256)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(logits), rtol=2e-3, atol=2e-3
+    )
+    assert int(cache["pos"]) == 128
+
+
 def test_incremental_decode_matches_full_forward():
     """Prefill + decode_step logits must equal the full forward's
     per-position logits (teacher forcing)."""
@@ -1055,6 +1112,47 @@ def test_int8_model_quantization_end_to_end():
         )
     out = generate(pq, tokens[:, :4], cfg, max_new_tokens=4, max_len=16)
     assert out.shape == (2, 4)
+
+
+def test_int8_fused_decode_matches_dense_dequant():
+    """On a tile-aligned model the decode step routes its projections
+    through the fused int8 pallas GEMM; logits must match the
+    dense-dequant path (same math, different streaming)."""
+    from containerpilot_tpu.models import decode as decode_mod
+    from containerpilot_tpu.models.decode import decode_step, prefill
+    from containerpilot_tpu.models.quantized import (
+        can_fuse_int8,
+        quantize_model_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=128, n_heads=1, n_layers=2, d_ff=128,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    pq = quantize_model_params(params)
+    assert can_fuse_int8(pq["layers"], cfg, rows=2)
+    # tiny dims or MoE fall back to dense dequant
+    assert not can_fuse_int8(pq["layers"], cfg, rows=10_000)
+    small = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    small_q = quantize_model_params(init_params(jax.random.PRNGKey(0), small))
+    assert not can_fuse_int8(small_q["layers"], small, rows=2)
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size, jnp.int32
+    )
+    with jax.default_matmul_precision("float32"):
+        quant_fwd = forward(pq, tokens, cfg)
+        logits, cache = prefill(pq, tokens[:, :4], cfg, max_len=16)
+        for i in range(4, 8):
+            logits, cache = decode_step(pq, cache, tokens[:, i], cfg)
+            np.testing.assert_allclose(
+                np.asarray(logits), np.asarray(quant_fwd[:, i]),
+                rtol=2e-3, atol=2e-3, err_msg=f"position {i}",
+            )
 
 
 def test_int8_moe_quantization():
