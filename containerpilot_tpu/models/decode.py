@@ -33,13 +33,14 @@ from .transformer import (
     Params,
     TransformerConfig,
     _attn_out,
-    _auto_attention,
     _ffn,
     _qkv,
     _rms_norm,
+    flash_eligible,
     repeat_kv,
 )
-from ..ops.attention import NEG_INF
+from ..ops.attention import NEG_INF, causal_attention
+from ..ops.flash import flash_attention_forward
 
 Cache = Dict[str, jax.Array]
 
@@ -82,16 +83,22 @@ def prefill(
     b, s = tokens.shape
     x = embed_lookup(params, tokens, cfg.dtype)
 
-    # long prompts go through the pallas flash kernels just like
-    # training (same auto-selection rule); short prompts stay einsum
-    attn_fn = cfg.attention_fn or _auto_attention(cfg, s)
+    # long prompts go through the pallas flash kernels, same threshold
+    # as training; short prompts stay einsum. The flash path is
+    # GQA-native: it reads the unrepeated kv heads straight from the
+    # cache layout, skipping the repeat_kv copy.
+    gqa_flash = cfg.attention_fn is None and flash_eligible(cfg, s)
+    attn_fn = cfg.attention_fn or causal_attention
 
     def body(carry, layer_params):
         layer_params = maybe_dequant_layer(layer_params, cfg.dtype)
         q, k, v = _qkv(carry, layer_params, cfg)
-        attn = attn_fn(
-            q, repeat_kv(k, cfg.n_heads), repeat_kv(v, cfg.n_heads)
-        )
+        if gqa_flash:
+            attn = flash_attention_forward(q, k, v)
+        else:
+            attn = attn_fn(
+                q, repeat_kv(k, cfg.n_heads), repeat_kv(v, cfg.n_heads)
+            )
         out, _aux = _ffn(
             _attn_out(carry, attn, layer_params, cfg), layer_params, cfg
         )

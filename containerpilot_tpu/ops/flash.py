@@ -132,8 +132,21 @@ def _fwd_rows(
     lse keeps a trailing unit axis so its blocks are (1, block_q, 1) —
     sublane-aligned for the TPU tiling rules and broadcastable against
     [block_q, block_k] score tiles in the backward without transposes.
+
+    Grouped-query attention: kr/vr may carry fewer rows than qr (one
+    per (batch, kv_head)). With group = q_rows // kv_rows, q row
+    r = b*h + head reads kv row r // group = b*kv_heads + head//group —
+    exact because h = kv_heads * group. The kernel then streams each
+    K/V block once per query head from HBM *without* a materialized
+    repeat_kv copy.
     """
     rows, s, hd = qr.shape
+    kv_rows = kr.shape[0]
+    if rows % kv_rows:
+        raise ValueError(
+            f"q rows {rows} not a multiple of kv rows {kv_rows}"
+        )
+    group = rows // kv_rows
     kernel = functools.partial(
         _fwd_kernel, block_q=block_q, block_k=block_k, scale=hd ** -0.5
     )
@@ -142,8 +155,12 @@ def _fwd_rows(
         grid=(rows, s // block_q, s // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, hd), lambda r, i, j: (r, i, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda r, i, j: (r, j, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda r, i, j: (r, j, 0)),
+            pl.BlockSpec(
+                (1, block_k, hd), lambda r, i, j: (r // group, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_k, hd), lambda r, i, j: (r // group, j, 0)
+            ),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, hd), lambda r, i, j: (r, i, 0)),
@@ -350,6 +367,14 @@ def flash_attention(
 
 def _flash_fwd_impl(q, k, v, block_q, block_k, interpret):
     _check_shapes(q, block_q, block_k)
+    if k.shape != q.shape or v.shape != q.shape:
+        # the backward kernels index k/v by q-row; grouped (GQA) kv
+        # would produce wrong-shaped, wrong-valued dk/dv here
+        raise ValueError(
+            f"flash_attention requires full-head k/v matching q "
+            f"{q.shape}, got k {k.shape} — repeat GQA kv upstream, or "
+            "use flash_attention_forward for GQA-native inference"
+        )
     b, s, h, hd = q.shape
     interp = _resolve_interpret(interpret)
     out, lse = _fwd_rows(
@@ -402,9 +427,22 @@ def flash_attention_forward(
 ) -> jax.Array:
     """Forward-only entry point (inference/serving). Same kernel as the
     differentiable path, KV grid-streamed: VMEM use is O(block) per
-    program regardless of sequence length."""
+    program regardless of sequence length.
+
+    Grouped-query attention is native: k/v may carry fewer heads than
+    q (n_heads % kv_heads == 0) and the kernel reads the shared K/V
+    rows directly — no repeat_kv materialization."""
     _check_shapes(q, block_q, block_k)
     b, s, h, hd = q.shape
+    if k.shape != v.shape:
+        raise ValueError(f"k {k.shape} and v {v.shape} must agree")
+    kb, ks, kvh, khd = k.shape
+    if kb != b or ks != s or khd != hd or kvh < 1 or h % kvh:
+        raise ValueError(
+            f"kv shape {k.shape} incompatible with q {q.shape}: need "
+            "(batch, seq, kv_heads, head_dim) with kv_heads >= 1 "
+            "dividing the query heads"
+        )
     out, _lse = _fwd_rows(
         _to_rows(q), _to_rows(k), _to_rows(v), block_q, block_k,
         _resolve_interpret(interpret),

@@ -544,3 +544,37 @@ def test_two_supervisors_discover_via_catalog(tmp_path):
                 _teardown_cli(p)
         catalog.terminate()
         catalog.wait(timeout=10)
+
+
+def test_periodic_task_through_cli(tmp_path):
+    """An interval job ticks repeatedly in the real supervisor
+    (reference: integration_tests/tests/test_tasks)."""
+    ticks = tmp_path / "ticks"
+    started = tmp_path / "started"
+    cfg = write_config(
+        tmp_path,
+        """
+        {
+          stopTimeout: "1ms",
+          jobs: [
+            { name: "main",
+              exec: ["/bin/sh", "-c", "touch %s; exec sleep 60"] },
+            { name: "tick",
+              exec: ["/bin/sh", "-c", "echo T >> %s"],
+              when: { interval: "200ms" } },
+          ],
+        }
+        """
+        % (started, ticks),
+    )
+    proc = _spawn_cli(cfg, tmp_path / "sup.log")
+    try:
+        _wait_for(started, what="main job")
+        deadline = time.monotonic() + 30
+        while not (ticks.exists() and ticks.read_text().count("T") >= 3):
+            assert time.monotonic() < deadline, "periodic task never ticked"
+            time.sleep(0.05)
+        proc.terminate()
+        assert proc.wait(timeout=30) == 0
+    finally:
+        _teardown_cli(proc)

@@ -421,6 +421,66 @@ def test_prefill_through_flash_matches_forward():
     assert int(cache["pos"]) == 128
 
 
+def test_flash_forward_gqa_native():
+    """flash_attention_forward reads unrepeated kv heads (GQA) and
+    must match the repeat_kv + einsum reference."""
+    rng = jax.random.PRNGKey(5)
+    kq, kk, kv = jax.random.split(rng, 3)
+    b, s, h, kvh, hd = 2, 256, 4, 2, 64
+    q = jax.random.normal(kq, (b, s, h, hd), jnp.float32)
+    k = jax.random.normal(kk, (b, s, kvh, hd), jnp.float32)
+    v = jax.random.normal(kv, (b, s, kvh, hd), jnp.float32)
+    from containerpilot_tpu.models.transformer import repeat_kv as rep
+
+    with jax.default_matmul_precision("float32"):
+        ref = causal_attention(q, rep(k, h), rep(v, h))
+        out = flash_attention_forward(q, k, v, block_q=64, block_k=64)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(out), rtol=2e-3, atol=2e-3
+    )
+    with pytest.raises(ValueError, match="dividing"):
+        # 3 kv heads don't divide 4 query heads
+        kk3 = jnp.concatenate([k, k[:, :, :1]], axis=2)
+        flash_attention_forward(q, kk3, kk3, 64, 64)
+    with pytest.raises(ValueError, match="incompatible"):
+        # cache-shaped kv longer than the prompt must be rejected, not
+        # silently truncated
+        k2 = jnp.concatenate([k, k], axis=1)
+        flash_attention_forward(q, k2, k2, 64, 64)
+    with pytest.raises(ValueError, match="incompatible"):
+        flash_attention_forward(q, k[:1], v[:1], 64, 64)  # batch mismatch
+    with pytest.raises(ValueError, match="incompatible"):
+        flash_attention_forward(q, k[:, :, :0], v[:, :, :0], 64, 64)
+
+    # the differentiable path must refuse unrepeated GQA kv — its
+    # backward would return wrong-shaped dk/dv
+    from containerpilot_tpu.ops.flash import flash_attention
+
+    with pytest.raises(ValueError, match="full-head"):
+        flash_attention(q, k, v, 64, 64)
+
+
+def test_gqa_prefill_through_flash_matches_forward():
+    """A GQA model's flash-eligible prefill (unrepeated kv through the
+    kernel) must match the full forward."""
+    from containerpilot_tpu.models.decode import prefill
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=64, n_heads=4, n_kv_heads=2, n_layers=1,
+        d_ff=64, max_seq_len=256, dtype=jnp.float32, flash_min_seq=128,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 128), 0, cfg.vocab_size, jnp.int32
+    )
+    with jax.default_matmul_precision("float32"):
+        ref = forward(params, tokens, cfg)[:, -1, :]
+        logits, _cache = prefill(params, tokens, cfg, max_len=256)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(logits), rtol=2e-3, atol=2e-3
+    )
+
+
 def test_incremental_decode_matches_full_forward():
     """Prefill + decode_step logits must equal the full forward's
     per-position logits (teacher forcing)."""
@@ -869,6 +929,31 @@ def test_pipeline_composes_with_tensor_parallelism():
     state, loss = step(state, batch)
     assert bool(jnp.isfinite(loss))
     assert int(state.step) == 1
+
+
+def test_pipeline_composes_with_expert_parallelism():
+    """pp x ep x dp: switch-MoE experts shard over the auto model axis
+    inside each pipeline stage."""
+    from containerpilot_tpu.parallel import (
+        init_train_state as _init,
+        make_pipeline_train_step,
+    )
+    from containerpilot_tpu.parallel.pipeline import pipeline_sharding_rules
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=2, n_layers=4, d_ff=128,
+        max_seq_len=32, moe_experts=2, dtype=jnp.float32,
+    )
+    mesh = make_mesh(jax.devices()[:8], plan=MeshPlan(2, 2, pipe=2))
+    rules = pipeline_sharding_rules(cfg, mesh)
+    assert tuple(rules["layers"]["moe_w_in"]) == ("pipe", "model", None, None)
+    state = _init(jax.random.PRNGKey(0), cfg, mesh, rules=rules)
+    step = make_pipeline_train_step(cfg, mesh, n_microbatches=4)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(2), (8, 33), 0, cfg.vocab_size, jnp.int32
+    )
+    state, loss = step(state, tokens)
+    assert bool(jnp.isfinite(loss))
 
 
 def test_memory_efficient_attention_value_and_grad():
