@@ -269,9 +269,13 @@ def _layer(
     Returns (x, aux_loss)."""
     layer_params = maybe_dequant_layer(layer_params, cfg.dtype)
     q, k, v = _qkv(x, layer_params, cfg)
-    k = repeat_kv(k, cfg.n_heads)
-    v = repeat_kv(v, cfg.n_heads)
     attn_fn = cfg.attention_fn or _auto_attention(cfg, q.shape[1])
+    if not getattr(attn_fn, "gqa_native", False):
+        # fns that handle grouped kv themselves (e.g. ring attention)
+        # get the small K/V — rotating the unrepeated heads over ICI is
+        # the point of GQA; everything else gets full heads
+        k = repeat_kv(k, cfg.n_heads)
+        v = repeat_kv(v, cfg.n_heads)
     attn = attn_fn(q, k, v)
     x = _attn_out(x, attn, layer_params, cfg)
     return _ffn(x, layer_params, cfg)

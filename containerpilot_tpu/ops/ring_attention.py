@@ -79,44 +79,53 @@ def _ring_shard_fn(
     axis_size: int,
 ) -> jax.Array:
     """Per-device body; runs under shard_map. Shapes are the local
-    shards: [batch, local_seq, heads, head_dim]."""
+    shards: [batch, local_seq, heads, head_dim].
+
+    Grouped-query attention is native: k/v may carry fewer heads than
+    q. The ring rotates the SMALL grouped K/V over ICI — the whole
+    point of GQA — and the einsums keep K/V at kv-head width by
+    carrying the query heads as a [kv_heads, group] pair of axes, so
+    no repeated copy is ever materialized."""
     idx = lax.axis_index(axis_name)
     b, lq, h, hd = q.shape
+    kvh = k.shape[2]
+    group = h // kvh
     scale = hd ** -0.5
-    qf = q.astype(jnp.float32) * scale
+    # queries grouped by the kv head they attend with: [b,lq,kvh,g,hd]
+    qf = q.astype(jnp.float32).reshape(b, lq, kvh, group, hd) * scale
 
     q_pos = idx * lq + jnp.arange(lq, dtype=jnp.int32)
 
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
     def step(s, carry):
-        k_blk, v_blk, m, l, acc = carry
+        k_blk, v_blk, m, l, acc = carry  # m/l: [b,kvh,g,lq]
         src = (idx - s) % axis_size
         scores = jnp.einsum(
-            "bqhd,bkhd->bhqk",
+            "bqkgd,bskd->bkgqs",
             qf,
             k_blk.astype(jnp.float32),
             preferred_element_type=jnp.float32,
-        )
+        )  # [b, kvh, g, lq, lk]
         k_pos = src * lq + jnp.arange(lq, dtype=jnp.int32)
         mask = q_pos[:, None] >= k_pos[None, :]  # [lq, lk] global causal
-        scores = jnp.where(mask[None, None], scores, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))  # [b,h,lq]
+        scores = jnp.where(mask[None, None, None], scores, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))  # [b,kvh,g,lq]
         # fully-masked-so-far rows keep m at NEG_INF; guard the exps
         m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
         correction = jnp.where(
             m <= NEG_INF / 2, 0.0, jnp.exp(m - m_safe)
         )
         p = jnp.exp(scores - m_safe[..., None])
-        p = jnp.where(mask[None, None], p, 0.0)
+        p = jnp.where(mask[None, None, None], p, 0.0)
         l_new = l * correction + jnp.sum(p, axis=-1)
-        acc_new = acc * correction[..., None].transpose(0, 2, 1, 3) + (
-            jnp.einsum(
-                "bhqk,bkhd->bqhd",
-                p,
-                v_blk.astype(jnp.float32),
-                preferred_element_type=jnp.float32,
-            )
+        # correction: [b,kvh,g,lq] -> [b,lq,kvh,g,1] to scale acc
+        corr_acc = correction.transpose(0, 3, 1, 2)[..., None]
+        acc_new = acc * corr_acc + jnp.einsum(
+            "bkgqs,bskd->bqkgd",
+            p,
+            v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
         )
         # rotate K/V to the next device in the ring; the final
         # iteration's rotation would be discarded, so skip it
@@ -131,14 +140,15 @@ def _ring_shard_fn(
         )
         return k_blk, v_blk, m_new, l_new, acc_new
 
-    m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, lq), jnp.float32)
-    acc0 = jnp.zeros((b, lq, h, hd), jnp.float32)
+    m0 = jnp.full((b, kvh, group, lq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, kvh, group, lq), jnp.float32)
+    acc0 = jnp.zeros((b, lq, kvh, group, hd), jnp.float32)
     _k, _v, _m, l, acc = lax.fori_loop(
         0, axis_size, step, (k, v, m0, l0, acc0)
     )
-    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]  # [b,lq,h,1]
-    return (acc / denom).astype(q.dtype)
+    # l: [b,kvh,g,lq] -> [b,lq,kvh,g,1]
+    denom = jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2)[..., None]
+    return (acc / denom).reshape(b, lq, h, hd).astype(q.dtype)
 
 
 def ring_attention(
@@ -160,10 +170,26 @@ def ring_attention(
         raise ValueError(
             f"seq len {q.shape[1]} not divisible by {axis_name}={axis_size}"
         )
+    kvh = k.shape[2]
+    if k.shape != v.shape or kvh < 1 or q.shape[2] % kvh:
+        raise ValueError(
+            f"kv shape {k.shape} incompatible with q {q.shape}: kv heads "
+            "must divide the query heads and k/v must agree"
+        )
     # keep batch/head sharding on their own axes inside the shard_map so
     # entering it doesn't all-gather what dp/tp already sharded
     batch_axis = "data" if "data" in mesh.axis_names else None
     head_axis = "model" if "model" in mesh.axis_names else None
+    if (
+        head_axis is not None
+        and kvh != q.shape[2]
+        and kvh % mesh.shape[head_axis]
+    ):
+        # grouped kv heads don't divide the tp axis: the per-device
+        # group factor would be wrong, so give up the GQA ICI saving
+        # and rotate full heads (correctness first)
+        k = jnp.repeat(k, q.shape[2] // kvh, axis=2)
+        v = jnp.repeat(v, q.shape[2] // kvh, axis=2)
     spec = P(batch_axis, axis_name, head_axis, None)
     fn = shard_map(
         functools.partial(

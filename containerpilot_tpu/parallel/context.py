@@ -63,4 +63,7 @@ def context_parallel_config(
     def attn(q, k, v):
         return ring_attention(q, k, v, mesh, axis_name)
 
+    # the ring handles grouped kv itself (rotates the SMALL K/V over
+    # ICI); the layer passes unrepeated heads through
+    attn.gqa_native = True
     return dataclasses.replace(cfg, attention_fn=attn)

@@ -45,6 +45,11 @@ def main() -> int:
     parser.add_argument("--data-dir", default="",
                         help="token shards (shard_*.npy; workload/data.py)"
                         " — default is synthetic data")
+    parser.add_argument("--profile-dir", default="",
+                        help="capture an XLA/TPU profiler trace of steps "
+                        "2..2+profile-steps into this dir (view with "
+                        "tensorboard or xprof)")
+    parser.add_argument("--profile-steps", type=int, default=3)
     parser.add_argument("--pipeline-stages", type=int, default=0,
                         help="GPipe pipeline stages (0 = no pipeline); "
                         "n_layers must divide by it")
@@ -161,10 +166,27 @@ def main() -> int:
         )
         print(f"data: {dataset.n_windows} windows from {args.data_dir}")
 
+    # profiler window: skip step 1 (compile) and capture a few steady
+    # steps — the standard "pick a mesh, profile, iterate" loop
+    if args.profile_dir and args.profile_steps < 1:
+        raise SystemExit("--profile-steps must be >= 1")
+    profile_start = start_step + 1 if args.profile_dir else -1
+    profile_stop = profile_start + args.profile_steps
+    if args.profile_dir and profile_start >= args.steps:
+        print(
+            f"warning: --profile-dir needs at least "
+            f"{profile_start - start_step + 1} steps after resume to "
+            "capture a steady-state window; nothing will be profiled"
+        )
+    profiling = False
+
     data_rng = jax.random.PRNGKey(1)
     t0 = time.monotonic()
     try:
         for step in range(start_step, args.steps):
+            if step == profile_start:
+                jax.profiler.start_trace(args.profile_dir)
+                profiling = True
             if prefetcher is not None:
                 _pstep, tokens = prefetcher.next()
             else:
@@ -176,6 +198,11 @@ def main() -> int:
                     jnp.int32,
                 )
             state, loss = train_step(state, tokens)
+            if step + 1 == profile_stop and profiling:
+                loss.block_until_ready()  # close the window on real work
+                jax.profiler.stop_trace()
+                profiling = False
+                print(f"profiler trace written to {args.profile_dir}")
             if args.checkpoint_dir and (step + 1) % args.checkpoint_every == 0:
                 save_checkpoint(args.checkpoint_dir, step + 1, state)
             if args.progress_file:
@@ -196,9 +223,15 @@ def main() -> int:
                       f"({rate:.1f} steps/s)")
     finally:
         # a failed step must not leak the staging thread (in-process
-        # callers would otherwise keep a live worker + device buffers)
+        # callers would otherwise keep a live worker + device buffers),
+        # and a dangling profiler window must be closed
         if prefetcher is not None:
             prefetcher.stop()
+        if profiling:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
     return 0
 
 

@@ -131,3 +131,27 @@ def test_trainer_runs_on_token_shards(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "windows from" in out
     assert "step 1:" in out
+
+
+def test_trainer_profiler_trace(tmp_path):
+    """--profile-dir captures an XLA trace of steady-state steps."""
+    import os
+    import sys
+
+    from containerpilot_tpu.workload.train import main
+
+    prof = str(tmp_path / "prof")
+    argv = sys.argv
+    sys.argv = [
+        "train", "--steps", "4", "--batch", "2", "--seq-len", "16",
+        "--d-model", "64", "--n-layers", "1", "--n-heads", "4",
+        "--vocab", "64", "--profile-dir", prof, "--profile-steps", "2",
+    ]
+    try:
+        assert main() == 0
+    finally:
+        sys.argv = argv
+    traces = []
+    for root, _dirs, files in os.walk(prof):
+        traces += [f for f in files if f.endswith((".pb", ".json.gz", ".xplane.pb"))]
+    assert traces, f"no trace files under {prof}"

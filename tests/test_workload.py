@@ -276,6 +276,83 @@ def test_ring_attention_matches_single_device():
     )
 
 
+def test_ring_attention_gqa_native():
+    """The ring rotates unrepeated (grouped) kv heads and must match
+    repeat_kv + single-device attention."""
+    from containerpilot_tpu.models.transformer import repeat_kv as rep
+    from containerpilot_tpu.ops import ring_attention
+    from containerpilot_tpu.parallel import MeshPlan, make_mesh
+
+    mesh = make_mesh(jax.devices()[:8], plan=MeshPlan(data=2, model=1, seq=4))
+    rng = jax.random.PRNGKey(6)
+    kq, kk, kv = jax.random.split(rng, 3)
+    b, s, h, kvh, hd = 2, 128, 4, 2, 32
+    q = jax.random.normal(kq, (b, s, h, hd), jnp.float32)
+    k = jax.random.normal(kk, (b, s, kvh, hd), jnp.float32)
+    v = jax.random.normal(kv, (b, s, kvh, hd), jnp.float32)
+    with jax.default_matmul_precision("float32"):
+        ref = causal_attention(q, rep(k, h), rep(v, h))
+        ring = jax.jit(
+            lambda q, k, v: ring_attention(q, k, v, mesh)
+        )(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(ring), rtol=2e-4, atol=2e-4
+    )
+    with pytest.raises(ValueError, match="divide"):
+        ring_attention(q, k[:, :, :0], v[:, :, :0], mesh)
+
+
+def test_ring_attention_mqa_fallback_on_tp_axis():
+    """MQA (1 kv head) with a >1 tp axis: grouped heads can't shard
+    over model, so the ring falls back to rotating full heads — and
+    must still be exact."""
+    from containerpilot_tpu.models.transformer import repeat_kv as rep
+    from containerpilot_tpu.ops import ring_attention
+    from containerpilot_tpu.parallel import MeshPlan, make_mesh
+
+    mesh = make_mesh(jax.devices()[:8], plan=MeshPlan(data=2, model=2, seq=2))
+    rng = jax.random.PRNGKey(9)
+    kq, kk, kv = jax.random.split(rng, 3)
+    b, s, h, kvh, hd = 2, 64, 4, 1, 32
+    q = jax.random.normal(kq, (b, s, h, hd), jnp.float32)
+    k = jax.random.normal(kk, (b, s, kvh, hd), jnp.float32)
+    v = jax.random.normal(kv, (b, s, kvh, hd), jnp.float32)
+    with jax.default_matmul_precision("float32"):
+        ref = causal_attention(q, rep(k, h), rep(v, h))
+        ring = jax.jit(
+            lambda q, k, v: ring_attention(q, k, v, mesh)
+        )(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(ring), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_gqa_context_parallel_train_step():
+    """dp x sp x tp with a GQA model: the ring gets the unrepeated kv
+    (gqa_native contract) and the loss matches the 2D-mesh step."""
+    from containerpilot_tpu.parallel import context_parallel_config
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=128, max_seq_len=64,
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(8), (4, 65), 0, cfg.vocab_size, jnp.int32
+    )
+    mesh2 = make_mesh(jax.devices()[:8], plan=MeshPlan(data=4, model=2))
+    state2 = init_train_state(jax.random.PRNGKey(0), cfg, mesh2)
+    _, loss2 = make_train_step(cfg, mesh2)(state2, tokens)
+    mesh3 = make_mesh(
+        jax.devices()[:8], plan=MeshPlan(data=2, seq=2, model=2)
+    )
+    cfg3 = context_parallel_config(cfg, mesh3)
+    assert getattr(cfg3.attention_fn, "gqa_native", False)
+    state3 = init_train_state(jax.random.PRNGKey(0), cfg3, mesh3)
+    _, loss3 = make_train_step(cfg3, mesh3)(state3, tokens)
+    assert bool(jnp.isfinite(loss3))
+    np.testing.assert_allclose(float(loss2), float(loss3), rtol=5e-3)
+
+
 def test_ring_attention_validates_inputs():
     from containerpilot_tpu.ops import ring_attention
     from containerpilot_tpu.parallel import MeshPlan, make_mesh
