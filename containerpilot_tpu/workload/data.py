@@ -62,6 +62,7 @@ class TokenShardDataset:
         batch_size: int,
         seed: int = 0,
         vocab_size: int = 0,
+        holdout_windows: int = 0,
     ) -> None:
         paths = sorted(glob.glob(os.path.join(directory, _SHARD_GLOB)))
         if not paths:
@@ -86,15 +87,26 @@ class TokenShardDataset:
         self._window_starts = np.concatenate(
             [[0], np.cumsum(counts)]
         )  # prefix sum; window i lives in shard searchsorted(i)
-        self.n_windows = int(self._window_starts[-1])
-        if self.n_windows == 0:
+        total = int(self._window_starts[-1])
+        if total == 0:
             raise ValueError(
                 f"shards under {directory!r} are shorter than "
                 f"seq_len+1 = {window} tokens"
             )
+        # the LAST holdout_windows windows are a held-out eval split:
+        # the training permutation never touches them and
+        # ``eval_batch`` serves them in fixed order
+        if holdout_windows < 0 or holdout_windows >= total:
+            raise ValueError(
+                f"holdout_windows {holdout_windows} must be in "
+                f"[0, {total})"
+            )
+        self.holdout_windows = holdout_windows
+        self._total_windows = total
+        self.n_windows = total - holdout_windows
 
     def _window(self, index: int) -> np.ndarray:
-        index = index % self.n_windows
+        index = index % self._total_windows
         si = int(
             np.searchsorted(self._window_starts, index, side="right") - 1
         )
@@ -118,7 +130,9 @@ class TokenShardDataset:
             # affine permutation: (a*pos + b) mod n, a coprime with n
             index = (stride * pos + epoch * 7919 + self.seed) % self.n_windows
             rows.append(self._window(index))
-        batch = np.stack(rows)
+        return self._check_vocab(np.stack(rows))
+
+    def _check_vocab(self, batch: np.ndarray) -> np.ndarray:
         if self.vocab_size:
             top = int(batch.max())
             if top >= self.vocab_size or int(batch.min()) < 0:
@@ -142,6 +156,23 @@ class TokenShardDataset:
         while True:
             yield self.batch_at(step)
             step += 1
+
+    @property
+    def n_eval_batches(self) -> int:
+        return (
+            self.holdout_windows + self.batch_size - 1
+        ) // self.batch_size
+
+    def eval_batch(self, index: int) -> np.ndarray:
+        """Held-out batch ``index`` in fixed order (the tail pads by
+        wrapping within the holdout split, keeping shapes static)."""
+        if not self.holdout_windows:
+            raise ValueError("dataset has no holdout split")
+        rows = []
+        for j in range(self.batch_size):
+            pos = (index * self.batch_size + j) % self.holdout_windows
+            rows.append(self._window(self.n_windows + pos))
+        return self._check_vocab(np.stack(rows))
 
 
 class DevicePrefetcher:

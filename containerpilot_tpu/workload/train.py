@@ -45,6 +45,12 @@ def main() -> int:
     parser.add_argument("--data-dir", default="",
                         help="token shards (shard_*.npy; workload/data.py)"
                         " — default is synthetic data")
+    parser.add_argument("--eval-every", type=int, default=0,
+                        help="report held-out loss every N steps "
+                        "(requires --data-dir and --eval-holdout)")
+    parser.add_argument("--eval-holdout", type=int, default=0,
+                        help="windows reserved from the shard tail as "
+                        "the eval split")
     parser.add_argument("--profile-dir", default="",
                         help="capture an XLA/TPU profiler trace of steps "
                         "2..2+profile-steps into this dir (view with "
@@ -145,6 +151,13 @@ def main() -> int:
 
         client = ControlClient(args.control_socket)
 
+    if args.eval_every > 0 and not (args.data_dir and args.eval_holdout):
+        # validated before any dataset/prefetcher exists so a bad flag
+        # combination can't leak the staging thread
+        raise SystemExit(
+            "--eval-every requires --data-dir and --eval-holdout"
+        )
+
     prefetcher = None
     if args.data_dir:
         from jax.sharding import NamedSharding
@@ -155,6 +168,7 @@ def main() -> int:
         dataset = TokenShardDataset(
             args.data_dir, args.seq_len, args.batch,
             vocab_size=cfg.vocab_size,  # fail loudly on id/vocab mismatch
+            holdout_windows=args.eval_holdout,
         )
         # batches stage onto the mesh from a background thread; the
         # window order is a pure function of the step, so a restarted
@@ -164,7 +178,20 @@ def main() -> int:
             start_step=start_step,
             sharding=NamedSharding(mesh, batch_spec()),
         )
-        print(f"data: {dataset.n_windows} windows from {args.data_dir}")
+        print(f"data: {dataset.n_windows} train windows "
+              f"(+{dataset.holdout_windows} held out) from {args.data_dir}")
+
+    eval_step = None
+    if args.eval_every > 0:
+        from ..models.transformer import loss_fn as _loss_fn
+
+        eval_step = jax.jit(lambda p, t: _loss_fn(p, t, cfg))
+
+    def run_eval(params) -> float:
+        total = 0.0
+        for i in range(dataset.n_eval_batches):
+            total += float(eval_step(params, dataset.eval_batch(i)))
+        return total / dataset.n_eval_batches
 
     # profiler window: skip step 1 (compile) and capture a few steady
     # steps — the standard "pick a mesh, profile, iterate" loop
@@ -221,6 +248,14 @@ def main() -> int:
                 rate = (step + 1 - start_step) / (time.monotonic() - t0)
                 print(f"step {step + 1}: loss={float(loss):.4f} "
                       f"({rate:.1f} steps/s)")
+            if eval_step is not None and (step + 1) % args.eval_every == 0:
+                eval_loss = run_eval(state.params)
+                print(f"step {step + 1}: eval_loss={eval_loss:.4f}")
+                if client is not None:
+                    try:
+                        client.put_metric({"training_eval_loss": eval_loss})
+                    except Exception:
+                        pass
     finally:
         # a failed step must not leak the staging thread (in-process
         # callers would otherwise keep a live worker + device buffers),
