@@ -10,6 +10,9 @@ API (token-level; tokenization is the caller's concern):
     POST /v1/generate {"tokens": [[1,2,3]], "max_new_tokens": 16,
                        "temperature": 0.0}
         -> {"tokens": [[...generated ids...]]}
+    POST /v1/score    {"tokens": [[1,2,3,4]]}
+        -> {"logprobs": [[lp(t1|t0), lp(t2|t0..1), ...]],
+            "sums": [total lp per row]}   (teacher-forced scoring)
     GET /health   -> 200 once the model is compiled and warm
     GET /v1/model -> config summary
 
@@ -35,6 +38,30 @@ from ..utils.http import HTTPServer, Request, Response
 log = logging.getLogger("containerpilot.serve")
 
 
+def _parse_token_rows(body: Dict[str, Any], vocab: int, min_row_len: int):
+    """Shared request validation for token-matrix endpoints: a
+    non-empty list of equal-length integer rows within the vocab.
+    Raises ValueError with a client-facing message."""
+    tokens = body["tokens"]
+    if not isinstance(tokens, list) or not tokens or not all(
+        isinstance(row, list) and len(row) >= min_row_len for row in tokens
+    ):
+        raise ValueError(
+            f"'tokens' must be a non-empty list of rows with "
+            f">= {min_row_len} ids"
+        )
+    row_len = len(tokens[0])
+    if any(len(row) != row_len for row in tokens):
+        raise ValueError("all rows must share a length (pad first)")
+    if any(
+        not isinstance(t, int) or isinstance(t, bool) or t < 0 or t >= vocab
+        for row in tokens
+        for t in row
+    ):
+        raise ValueError(f"token ids must be integers in [0, {vocab})")
+    return tokens, row_len
+
+
 class InferenceServer:
     def __init__(
         self,
@@ -57,6 +84,8 @@ class InferenceServer:
         self._server.route("GET", "/health", self._health)
         self._server.route("GET", "/v1/model", self._model_info)
         self._server.route("POST", "/v1/generate", self._generate)
+        self._server.route("POST", "/v1/score", self._score)
+        self._score_fn = None  # jitted lazily; jit caches per length
 
     # -- handlers -------------------------------------------------------
 
@@ -81,17 +110,12 @@ class InferenceServer:
     async def _generate(self, req: Request) -> Response:
         try:
             body = json.loads(req.body.decode() or "{}")
-            tokens = body["tokens"]
-            if not isinstance(tokens, list) or not tokens or not all(
-                isinstance(row, list) and row for row in tokens
-            ):
-                raise ValueError("'tokens' must be a non-empty list of lists")
+            tokens, prompt_len = _parse_token_rows(
+                body, self.cfg.vocab_size, min_row_len=1
+            )
             max_new_requested = int(body.get("max_new_tokens", 16))
             temperature = float(body.get("temperature", 0.0))
             seed = int(body.get("seed", 0))
-            prompt_len = len(tokens[0])
-            if any(len(row) != prompt_len for row in tokens):
-                raise ValueError("all prompts must share a length (pad first)")
             if prompt_len + max_new_requested > self.max_len:
                 raise ValueError(
                     f"prompt_len + max_new_tokens exceeds max_len "
@@ -105,9 +129,6 @@ class InferenceServer:
                 -(-max_new_requested // 16) * 16,
                 self.max_len - prompt_len,
             )
-            vocab = self.cfg.vocab_size
-            if any(t < 0 or t >= vocab for row in tokens for t in row):
-                raise ValueError(f"token ids must be in [0, {vocab})")
         except (ValueError, KeyError, TypeError) as exc:
             return Response(422, f"{exc}\n".encode())
 
@@ -129,6 +150,52 @@ class InferenceServer:
         return Response(
             200,
             json.dumps({"tokens": generated}).encode(),
+            content_type="application/json",
+        )
+
+    async def _score(self, req: Request) -> Response:
+        """Teacher-forced per-token logprobs of the given sequences —
+        the standard scoring/perplexity endpoint (no sampling)."""
+        try:
+            body = json.loads(req.body.decode() or "{}")
+            tokens, row_len = _parse_token_rows(
+                body, self.cfg.vocab_size, min_row_len=2
+            )
+            if row_len > self.max_len:
+                raise ValueError(f"row length exceeds max_len {self.max_len}")
+        except (ValueError, KeyError, TypeError) as exc:
+            return Response(422, f"{exc}\n".encode())
+
+        if self._score_fn is None:
+            from ..models.transformer import forward
+
+            def score(params, toks):
+                logits = forward(params, toks[:, :-1], self.cfg)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                picked = jnp.take_along_axis(
+                    logp, toks[:, 1:, None], axis=-1
+                )[..., 0]
+                return picked  # [batch, len-1]
+
+            self._score_fn = jax.jit(score)
+
+        def run() -> Any:
+            toks = jnp.asarray(tokens, jnp.int32)
+            picked = self._score_fn(self.params, toks)
+            picked = jax.device_get(picked).astype(float)
+            return picked
+
+        loop = asyncio.get_event_loop()
+        picked = await loop.run_in_executor(self._executor, run)
+        return Response(
+            200,
+            json.dumps(
+                {
+                    "logprobs": [[round(float(x), 6) for x in row]
+                                 for row in picked],
+                    "sums": [round(float(row.sum()), 6) for row in picked],
+                }
+            ).encode(),
             content_type="application/json",
         )
 

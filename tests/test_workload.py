@@ -613,7 +613,7 @@ def test_inference_server_end_to_end(run):
 
     cfg = TransformerConfig(
         vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
-        max_seq_len=32,
+        max_seq_len=32, dtype=jnp.float32,  # tight score-parity check
     )
     params = init_params(jax.random.PRNGKey(0), cfg)
     server = InferenceServer(cfg, params, "127.0.0.1", 0, max_len=32)
@@ -650,18 +650,45 @@ def test_inference_server_end_to_end(run):
                 {"tokens": [[999]], "max_new_tokens": 5},
             ),
         )
+        score = await loop.run_in_executor(
+            None,
+            lambda: fetch("/v1/score", {"tokens": [[1, 2, 3, 4]]}),
+        )
+        bad_score = await loop.run_in_executor(
+            None,
+            lambda: fetch("/v1/score", {"tokens": [[7]]}),
+        )
         await server.stop()
-        return health, gen, bad
+        return health, gen, bad, score, bad_score
 
     import json
     import urllib.error
 
-    health, gen, bad = run(scenario(), timeout=120)
+    health, gen, bad, score, bad_score = run(scenario(), timeout=120)
     assert health[0] == 200
     assert gen[0] == 200
     out = json.loads(gen[1])["tokens"]
     assert len(out) == 1 and len(out[0]) == 5
     assert bad[0] == 422 and "token ids" in bad[1]
+
+    # teacher-forced scoring: one logprob per continuation token, all
+    # negative, matching the forward's log-softmax
+    assert score[0] == 200
+    scored = json.loads(score[1])
+    assert len(scored["logprobs"][0]) == 3
+    assert all(lp < 0 for lp in scored["logprobs"][0])
+    from containerpilot_tpu.models.transformer import forward as _fwd
+
+    toks = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    logp = jax.nn.log_softmax(_fwd(params, toks[:, :-1], cfg), axis=-1)
+    expect = [float(logp[0, i, int(toks[0, i + 1])]) for i in range(3)]
+    np.testing.assert_allclose(
+        scored["logprobs"][0], expect, rtol=1e-3, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        scored["sums"][0], sum(expect), rtol=1e-3, atol=1e-3
+    )
+    assert bad_score[0] == 422 and ">= 2 ids" in bad_score[1]
 
 
 def test_moe_forward_and_training():
