@@ -175,31 +175,84 @@ def decode_step(
 import functools
 
 
+def sample_logits(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: jax.Array,
+    top_k=None,
+    top_p=None,
+) -> jax.Array:
+    """Sample token ids from [batch, vocab] logits.
+
+    ``top_k`` and ``top_p`` may be traced scalars — both filters are
+    static-shape masks over one shared sorted copy of the logits, so
+    arbitrary per-request values run in a single compiled program.
+    top-k keeps the k highest logits (k <= 0 keeps all; ties at the
+    k-th value all survive); nucleus keeps the smallest set of tokens
+    whose probability mass reaches p (the top token always survives;
+    p outside (0,1) keeps all). ``None`` disables a filter statically,
+    skipping the sort when both are off.
+    """
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None or top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        keep = jnp.ones(sorted_logits.shape, bool)
+        if top_k is not None:
+            vocab = logits.shape[-1]
+            k = jnp.where(top_k > 0, top_k, vocab)
+            keep &= jnp.arange(vocab)[None, :] < k
+        if top_p is not None:
+            p = jnp.where((top_p > 0.0) & (top_p < 1.0), top_p, 1.0)
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            keep &= (jnp.cumsum(probs, axis=-1) - probs) < p
+        threshold = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < threshold, NEG_INF, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
 @functools.lru_cache(maxsize=32)
 def _jitted_generate(cfg: TransformerConfig, max_new_tokens: int,
-                     max_len: int, greedy: bool):
+                     max_len: int, greedy: bool, filtered: bool):
     """One compiled program per (config, lengths, sampling mode); jit's
-    own cache covers distinct prompt lengths."""
+    own cache covers distinct prompt lengths. Everything
+    request-controlled that doesn't change shapes (temperature, top_k,
+    top_p, eos_id, pad_id) is a traced operand, so per-request
+    variation can't churn this cache."""
 
-    def fn(params, prompt, rng, temperature):
+    def fn(params, prompt, rng, temperature, top_k, top_p, eos_id,
+           pad_id):
         logits, cache = prefill(params, prompt, cfg, max_len)
 
         def sample(logits, key):
             if greedy:
                 return jnp.argmax(logits, axis=-1)
-            return jax.random.categorical(key, logits / temperature, axis=-1)
+            return sample_logits(
+                logits, key, temperature,
+                top_k if filtered else None,
+                top_p if filtered else None,
+            )
 
         first_key, scan_key = jax.random.split(rng)
         first = sample(logits, first_key).astype(jnp.int32)
+        # rows that have emitted eos keep decoding (static shapes) but
+        # emit pad from then on; eos_id == -1 disables the early stop
+        # dynamically (token ids are non-negative, so it never matches)
+        done = first == eos_id
 
         def step(carry, key):
-            cache, token = carry
+            cache, token, done = carry
             logits, cache = decode_step(params, cache, token, cfg)
             next_token = sample(logits, key).astype(jnp.int32)
-            return (cache, next_token), next_token
+            next_token = jnp.where(done, pad_id, next_token)
+            done = done | (next_token == eos_id)
+            return (cache, next_token, done), next_token
 
         keys = jax.random.split(scan_key, max_new_tokens - 1)
-        (_cache, _last), rest = lax.scan(step, (cache, first), keys)
+        (_cache, _last, _done), rest = lax.scan(
+            step, (cache, first, done), keys
+        )
         return jnp.concatenate([first[:, None], rest.T], axis=1)
 
     return jax.jit(fn)
@@ -213,9 +266,18 @@ def generate(
     max_len: int,
     temperature: float = 0.0,
     rng: jax.Array = None,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    eos_id: int = -1,
+    pad_id: int = 0,
 ) -> jax.Array:
     """Autoregressive generation. prompt: [batch, prompt_len] int32;
-    returns [batch, max_new_tokens] int32."""
+    returns [batch, max_new_tokens] int32.
+
+    ``top_k``/``top_p`` filter the sampling distribution (0 disables
+    either; both compose, top-k first). ``eos_id >= 0`` enables early
+    stop: once a row samples eos, the rest of that row is ``pad_id``.
+    """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
     if prompt.shape[1] + max_new_tokens > max_len:
@@ -225,7 +287,27 @@ def generate(
             f"prompt_len {prompt.shape[1]} + max_new_tokens "
             f"{max_new_tokens} exceeds max_len {max_len}"
         )
+    if not 0 <= top_k <= cfg.vocab_size or not 0.0 <= top_p <= 1.0:
+        raise ValueError(
+            f"top_k must be in [0, vocab {cfg.vocab_size}] and "
+            "top_p in [0, 1]"
+        )
+    if eos_id >= cfg.vocab_size or not 0 <= pad_id < cfg.vocab_size:
+        raise ValueError(
+            f"eos_id (< 0 disables) and pad_id must be < vocab "
+            f"{cfg.vocab_size}, pad_id non-negative"
+        )
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    fn = _jitted_generate(cfg, max_new_tokens, max_len, temperature <= 0.0)
-    return fn(params, prompt, rng, jnp.float32(max(temperature, 1e-6)))
+    greedy = temperature <= 0.0
+    if greedy:
+        top_k, top_p = 0, 0.0  # dead under argmax; normalize the key
+    fn = _jitted_generate(
+        cfg, max_new_tokens, max_len, greedy,
+        top_k > 0 or 0.0 < top_p < 1.0,
+    )
+    return fn(
+        params, prompt, rng, jnp.float32(max(temperature, 1e-6)),
+        jnp.int32(top_k), jnp.float32(top_p),
+        jnp.int32(max(eos_id, -1)), jnp.int32(pad_id),
+    )

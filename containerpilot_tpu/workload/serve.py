@@ -116,6 +116,17 @@ class InferenceServer:
             max_new_requested = int(body.get("max_new_tokens", 16))
             temperature = float(body.get("temperature", 0.0))
             seed = int(body.get("seed", 0))
+            top_k = int(body.get("top_k", 0))
+            top_p = float(body.get("top_p", 0.0))
+            eos_id = int(body.get("eos_id", -1))
+            if (not 0 <= top_k <= self.cfg.vocab_size
+                    or not 0.0 <= top_p <= 1.0):
+                raise ValueError(
+                    f"top_k must be in [0, vocab {self.cfg.vocab_size}] "
+                    "and top_p in [0, 1]"
+                )
+            if eos_id >= self.cfg.vocab_size:
+                raise ValueError(f"eos_id must be < vocab {self.cfg.vocab_size}")
             if prompt_len + max_new_requested > self.max_len:
                 raise ValueError(
                     f"prompt_len + max_new_tokens exceeds max_len "
@@ -142,11 +153,21 @@ class InferenceServer:
                 max_len=self.max_len,
                 temperature=temperature,
                 rng=jax.random.PRNGKey(seed),
+                top_k=top_k,
+                top_p=top_p,
+                eos_id=eos_id,
             )
             return jax.device_get(out[:, :max_new_requested]).tolist()
 
         loop = asyncio.get_event_loop()
         generated = await loop.run_in_executor(self._executor, run)
+        if eos_id >= 0:
+            # trim each row at its first eos (inclusive); the model
+            # emitted pad beyond it anyway
+            generated = [
+                row[: row.index(eos_id) + 1] if eos_id in row else row
+                for row in generated
+            ]
         return Response(
             200,
             json.dumps({"tokens": generated}).encode(),

@@ -604,6 +604,73 @@ def test_generate_greedy_deterministic():
     assert int(out1.min()) >= 0 and int(out1.max()) < 64
 
 
+def test_sample_logits_top_k_top_p():
+    """top-k keeps the k best ids; top-p keeps the minimal nucleus."""
+    from containerpilot_tpu.models.decode import sample_logits
+
+    # id 3 is the mode (p ~ 0.64 at temp 1), then 2, 1, 0, 4
+    logits = jnp.tile(
+        jnp.asarray([[0.0, 1.0, 2.0, 3.0, -1.0]], jnp.float32), (512, 1)
+    )
+    one = jnp.float32(1.0)
+    key = jax.random.PRNGKey(7)
+    top2 = sample_logits(logits, key, one, top_k=2)
+    assert set(np.asarray(top2).tolist()) <= {2, 3}
+    # top_k=1 is greedy regardless of the key
+    top1 = sample_logits(logits, jax.random.PRNGKey(8), one, top_k=1)
+    assert set(np.asarray(top1).tolist()) == {3}
+    # nucleus 0.5: the mode alone already covers the mass
+    nucleus = sample_logits(logits, key, one, top_p=0.5)
+    assert set(np.asarray(nucleus).tolist()) == {3}
+    # nucleus 0.9 needs {3, 2, 1}; id 0 and 4 stay excluded
+    wide = sample_logits(logits, key, one, top_p=0.9)
+    assert set(np.asarray(wide).tolist()) <= {1, 2, 3}
+    # unfiltered sampling can reach every id
+    free = sample_logits(logits, key, jnp.float32(3.0))
+    assert len(set(np.asarray(free).tolist())) >= 4
+
+
+def test_generate_sampling_modes_and_eos():
+    from containerpilot_tpu.models.decode import generate
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 4), 0, 64, jnp.int32
+    )
+    greedy = generate(params, prompt, cfg, max_new_tokens=6, max_len=16)
+    # temperature ~0 with top_k=1 reproduces greedy
+    t1 = generate(
+        params, prompt, cfg, max_new_tokens=6, max_len=16,
+        temperature=0.5, top_k=1,
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(t1))
+    # sampled output stays in-vocab
+    sampled = generate(
+        params, prompt, cfg, max_new_tokens=6, max_len=16,
+        temperature=1.0, top_k=8, top_p=0.9,
+    )
+    assert int(sampled.min()) >= 0 and int(sampled.max()) < 64
+
+    # eos early-stop: make the first greedy token the eos — the rest of
+    # the row must be pad
+    eos = int(greedy[0, 0])
+    stopped = generate(
+        params, prompt, cfg, max_new_tokens=6, max_len=16,
+        eos_id=eos, pad_id=63,
+    )
+    row = np.asarray(stopped[0]).tolist()
+    first_eos = row.index(eos)
+    assert all(t == 63 for t in row[first_eos + 1:])
+
+    with pytest.raises(ValueError, match="top_k"):
+        generate(params, prompt, cfg, max_new_tokens=2, max_len=16,
+                 top_p=1.5)
+
+
 def test_inference_server_end_to_end(run):
     """The serving path: warmup -> health -> generate over HTTP."""
     import urllib.request
