@@ -72,6 +72,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="Run the Consul-API-compatible catalog server for pods "
         "without an external catalog (e.g. '0.0.0.0:8500').",
     )
+    parser.add_argument(
+        "-catalog-snapshot", dest="catalog_snapshot", default="",
+        metavar="PATH",
+        help="With -catalog-server: journal catalog state to this file "
+        "and restore it on start, so a restarted daemon serves its "
+        "last known registrations immediately.",
+    )
     return parser
 
 
@@ -105,5 +112,6 @@ def get_args(
         return subcommands.ping_handler, params
     if args.catalog_server:
         params["catalog_addr"] = args.catalog_server
+        params["catalog_snapshot"] = args.catalog_snapshot
         return subcommands.catalog_server_handler, params
     return None, params

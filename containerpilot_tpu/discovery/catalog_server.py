@@ -20,18 +20,23 @@ over DCN. TTL semantics match Consul: a check that misses its TTL goes
 critical and drops out of passing health queries;
 ``DeregisterCriticalServiceAfter`` reaps long-critical services.
 
-State is in-memory per generation — exactly as ephemeral as the
-services it tracks (a catalog restart just means one TTL round of
-re-registration, since supervisors lazily re-register on heartbeat).
+State is in-memory; with ``--snapshot`` it is also journaled to disk
+(atomic JSON snapshot, written when dirty) and reloaded on start, so a
+supervised catalog daemon that crashes and restarts serves its last
+known registrations immediately instead of returning an empty catalog
+until every supervisor's next heartbeat. Restored TTLs are re-armed
+for one fresh TTL window (the entry was passing when snapshotted; its
+owner gets one round to heartbeat before it goes critical).
 """
 from __future__ import annotations
 
 import asyncio
 import json
 import logging
+import os
 import time
 import urllib.parse
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field
 from typing import Any, Dict, List, Optional
 
 from ..config.timing import DurationError, parse_duration
@@ -64,11 +69,15 @@ class CatalogServer:
     """In-memory Consul-compatible catalog."""
 
     def __init__(
-        self, host: str = "0.0.0.0", port: int = 8500, dc: str = "dc1"
+        self, host: str = "0.0.0.0", port: int = 8500, dc: str = "dc1",
+        snapshot_path: str = "", snapshot_every: float = 2.0,
     ) -> None:
         self.host = host
         self.port = port
         self.dc = dc  # health queries for another dc return empty
+        self.snapshot_path = snapshot_path
+        self.snapshot_every = snapshot_every
+        self._dirty = False
         self._entries: Dict[str, _Entry] = {}  # by instance id
         self._server = HTTPServer()
         self._reaper: Optional["asyncio.Task[None]"] = None
@@ -81,6 +90,8 @@ class CatalogServer:
     # -- lifecycle --------------------------------------------------------
 
     async def run(self) -> None:
+        if self.snapshot_path:
+            self._load_snapshot()
         await self._server.start_tcp(self.host, self.port)
         self._reaper = asyncio.get_event_loop().create_task(self._reap_loop())
         log.info("catalog: serving Consul-compatible API on %s:%d",
@@ -90,12 +101,79 @@ class CatalogServer:
         if self._reaper is not None:
             self._reaper.cancel()
         await self._server.stop()
+        # final write AFTER the listener is down: a mutation handled
+        # during shutdown was acknowledged, so it must be journaled
+        if self.snapshot_path:
+            self._write_snapshot()
+
+    # -- durability -------------------------------------------------------
+
+    def _load_snapshot(self) -> None:
+        try:
+            with open(self.snapshot_path) as fh:
+                raw = json.load(fh)
+        except FileNotFoundError:
+            return
+        except (OSError, ValueError) as exc:
+            log.warning("catalog: unreadable snapshot %s (%s); starting "
+                        "empty", self.snapshot_path, exc)
+            return
+        now = time.time()
+        saved_at = float(raw.get("saved_at") or now)
+        for item in raw.get("entries", []):
+            try:
+                entry = _Entry(**item)
+            except TypeError:
+                log.warning("catalog: skipping malformed snapshot entry")
+                continue
+            if entry.status == "passing" and entry.ttl > 0:
+                if entry.expires >= saved_at:
+                    # it was genuinely passing when journaled: one
+                    # fresh TTL window to heartbeat before critical
+                    entry.expires = now + entry.ttl
+                else:
+                    # its TTL had already lapsed pre-snapshot (expiry
+                    # is computed at query time, never written back) —
+                    # don't resurrect a dead service as healthy
+                    entry.status = "critical"
+            entry.critical_since = 0.0
+            self._entries[entry.id] = entry
+        if self._entries:
+            log.info("catalog: restored %d entries from %s",
+                     len(self._entries), self.snapshot_path)
+
+    def _write_snapshot(self) -> None:
+        tmp = f"{self.snapshot_path}.tmp"
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(
+                    {"saved_at": time.time(),
+                     "entries": [asdict(e) for e in
+                                 sorted(self._entries.values(),
+                                        key=lambda e: e.id)]},
+                    fh,
+                )
+            os.replace(tmp, self.snapshot_path)  # atomic on POSIX
+            self._dirty = False
+        except OSError as exc:
+            log.warning("catalog: snapshot write failed: %s", exc)
 
     async def _reap_loop(self) -> None:
-        """Reap services critical longer than DeregisterCriticalServiceAfter."""
+        """Reap services critical longer than DeregisterCriticalServiceAfter;
+        journal dirty state to the snapshot file on the same cadence."""
+        last_snapshot = 0.0
         try:
             while True:
-                await asyncio.sleep(1.0)
+                await asyncio.sleep(
+                    min(1.0, self.snapshot_every) if self.snapshot_path
+                    else 1.0
+                )
+                if (
+                    self.snapshot_path and self._dirty
+                    and time.time() - last_snapshot >= self.snapshot_every
+                ):
+                    self._write_snapshot()
+                    last_snapshot = time.time()
                 now = time.time()
                 for entry in list(self._entries.values()):
                     status = entry.effective_status(now)
@@ -112,6 +190,7 @@ class CatalogServer:
                                 entry.dereg_after,
                             )
                             self._entries.pop(entry.id, None)
+                            self._dirty = True
                     else:
                         entry.critical_since = 0.0
         except asyncio.CancelledError:
@@ -159,6 +238,7 @@ class CatalogServer:
         if entry.status == "passing" and entry.ttl > 0:
             entry.expires = time.time() + entry.ttl
         self._entries[entry.id] = entry
+        self._dirty = True
         log.debug("catalog: registered %s (%s)", entry.id, entry.status)
         return Response(200, b"")
 
@@ -167,7 +247,8 @@ class CatalogServer:
             "/v1/agent/service/deregister/"
         ):
             service_id = urllib.parse.unquote(req.path.rsplit("/", 1)[-1])
-            self._entries.pop(service_id, None)
+            if self._entries.pop(service_id, None) is not None:
+                self._dirty = True
             log.debug("catalog: deregistered %s", service_id)
             return Response(200, b"")
         if req.method == "PUT" and req.path.startswith(
@@ -184,9 +265,14 @@ class CatalogServer:
             except ValueError:
                 return Response(400, b"bad json\n")
             status = body.get("Status", "passing")
-            entry.status = "passing" if status in ("pass", "passing") else (
+            new_status = "passing" if status in ("pass", "passing") else (
                 "warning" if status in ("warn", "warning") else "critical"
             )
+            if new_status != entry.status:
+                # TTL refreshes alone don't dirty the snapshot (expires
+                # is re-armed on restore); status transitions do
+                self._dirty = True
+            entry.status = new_status
             if entry.status == "passing" and entry.ttl > 0:
                 entry.expires = time.time() + entry.ttl
             return Response(200, b"")

@@ -115,7 +115,9 @@ def catalog_server_handler(params: dict) -> int:
         return 1
 
     async def serve() -> None:
-        server = CatalogServer(host, port)
+        server = CatalogServer(
+            host, port, snapshot_path=params.get("catalog_snapshot", "")
+        )
         await server.run()
         stop = asyncio.Event()
         loop = asyncio.get_event_loop()

@@ -125,3 +125,104 @@ def test_deregister_critical_service_after(run):
     instances, reaped = run(scenario(), timeout=30)
     assert instances == []
     assert reaped
+
+
+def test_snapshot_restore_across_restart(run, tmp_path):
+    """A supervised catalog daemon that dies and restarts must serve its
+    last known registrations immediately (one re-armed TTL window)
+    instead of an empty catalog (round-1 weak spot: in-memory SPOF)."""
+    snap = str(tmp_path / "catalog.json")
+
+    async def scenario():
+        server = CatalogServer("127.0.0.1", PORT, snapshot_path=snap)
+        await server.run()
+        backend = ConsulBackend(address=f"127.0.0.1:{PORT}")
+        loop = asyncio.get_event_loop()
+
+        def setup():
+            backend.service_register(
+                ServiceRegistration(
+                    id="db-h1", name="db", port=5432,
+                    address="10.0.0.9", ttl=5, tags=["primary"],
+                ),
+                status="passing",
+            )
+            backend.service_register(
+                ServiceRegistration(
+                    id="cache-h1", name="cache", port=6379,
+                    address="10.0.0.10", ttl=5,
+                ),
+            )  # registered but never passed: stays critical
+        await loop.run_in_executor(None, setup)
+        # stop() writes the final snapshot (simulates SIGTERM path);
+        # a crash between journal ticks loses at most snapshot_every
+        await server.stop()
+
+        reborn = CatalogServer("127.0.0.1", PORT, snapshot_path=snap)
+        await reborn.run()
+        try:
+            instances = await loop.run_in_executor(
+                None, lambda: backend.instances("db")
+            )
+            crit = await loop.run_in_executor(
+                None, lambda: backend.check_for_upstream_changes("cache")
+            )
+        finally:
+            await reborn.stop()
+        return instances, crit
+
+    instances, crit = run(scenario(), timeout=30)
+    # the passing service survived the restart with tags/address intact
+    assert len(instances) == 1
+    assert (instances[0].address, instances[0].port) == ("10.0.0.9", 5432)
+    # the never-passing one restored as critical (no false health)
+    assert crit == (False, False)
+
+
+def test_snapshot_unreadable_starts_empty(run, tmp_path):
+    snap = tmp_path / "corrupt.json"
+    snap.write_text("{not json")
+
+    async def scenario():
+        server = CatalogServer("127.0.0.1", PORT, snapshot_path=str(snap))
+        await server.run()
+        backend = ConsulBackend(address=f"127.0.0.1:{PORT}")
+        loop = asyncio.get_event_loop()
+        try:
+            return await loop.run_in_executor(
+                None, lambda: backend.instances("anything")
+            )
+        finally:
+            await server.stop()
+
+    assert run(scenario(), timeout=30) == []
+
+
+def test_snapshot_does_not_resurrect_expired_service(run, tmp_path):
+    """A service whose TTL lapsed before the snapshot was written must
+    restore as critical — never as a false healthy."""
+    snap = str(tmp_path / "catalog.json")
+
+    async def scenario():
+        server = CatalogServer("127.0.0.1", PORT, snapshot_path=snap)
+        await server.run()
+        backend = ConsulBackend(address=f"127.0.0.1:{PORT}")
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(None, lambda: backend.service_register(
+            ServiceRegistration(id="dead-h1", name="dead", port=80,
+                                address="10.0.0.11", ttl=1),
+            status="passing",
+        ))
+        await asyncio.sleep(1.3)  # TTL lapses (status field still says
+        await server.stop()       # "passing"; expiry is query-time)
+
+        reborn = CatalogServer("127.0.0.1", PORT, snapshot_path=snap)
+        await reborn.run()
+        try:
+            return await loop.run_in_executor(
+                None, lambda: backend.instances("dead")
+            )
+        finally:
+            await reborn.stop()
+
+    assert run(scenario(), timeout=30) == []

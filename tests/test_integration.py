@@ -546,6 +546,79 @@ def test_two_supervisors_discover_via_catalog(tmp_path):
         catalog.wait(timeout=10)
 
 
+def test_catalog_server_snapshot_survives_restart(tmp_path):
+    """cp-catalogd with -catalog-snapshot: SIGTERM the daemon, restart
+    it, and the registrations it held are served again immediately —
+    the supervised-catalog self-heal story (a catalog restart no longer
+    blanks the pod's view until every host re-heartbeats)."""
+    import json as json_mod
+    import socket as socketlib
+    import urllib.request
+
+    def free_port():
+        with socketlib.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    port = free_port()
+    snap = tmp_path / "catalog.json"
+
+    def spawn():
+        return subprocess.Popen(
+            [sys.executable, "-m", "containerpilot_tpu",
+             "-catalog-server", f"127.0.0.1:{port}",
+             "-catalog-snapshot", str(snap)],
+            cwd=REPO, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    def wait_up():
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/health/service/x",
+                    timeout=1,
+                )
+                return
+            except Exception:
+                assert time.monotonic() < deadline, "catalog never came up"
+                time.sleep(0.2)
+
+    def health(name):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/health/service/{name}?passing=1",
+            timeout=5,
+        ) as resp:
+            return json_mod.loads(resp.read().decode())
+
+    catalog = spawn()
+    try:
+        wait_up()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/agent/service/register",
+            method="PUT",
+            data=json_mod.dumps(
+                {"ID": "svc-h1", "Name": "svc", "Address": "10.0.0.4",
+                 "Port": 9000,
+                 "Check": {"TTL": "30s", "Status": "passing"}}
+            ).encode(),
+        )
+        urllib.request.urlopen(req, timeout=5)
+        assert len(health("svc")) == 1
+        catalog.terminate()  # stop() writes the final snapshot
+        assert catalog.wait(timeout=10) == 0
+        assert snap.exists()
+
+        catalog = spawn()
+        wait_up()
+        entries = health("svc")
+        assert len(entries) == 1, f"restart lost the catalog: {entries}"
+        assert entries[0]["Service"]["Address"] == "10.0.0.4"
+    finally:
+        catalog.terminate()
+        catalog.wait(timeout=10)
+
+
 def test_periodic_task_through_cli(tmp_path):
     """An interval job ticks repeatedly in the real supervisor
     (reference: integration_tests/tests/test_tasks)."""
