@@ -20,7 +20,7 @@ over DCN. TTL semantics match Consul: a check that misses its TTL goes
 critical and drops out of passing health queries;
 ``DeregisterCriticalServiceAfter`` reaps long-critical services.
 
-State is in-memory; with ``--snapshot`` it is also journaled to disk
+State is in-memory; with ``-catalog-snapshot`` it is also journaled to disk
 (atomic JSON snapshot, written when dirty) and reloaded on start, so a
 supervised catalog daemon that crashes and restarts serves its last
 known registrations immediately instead of returning an empty catalog
@@ -180,6 +180,11 @@ class CatalogServer:
                     if status == "critical":
                         if entry.critical_since == 0.0:
                             entry.critical_since = now
+                            # journal the transition: a later hard
+                            # crash must not restore this entry from a
+                            # stale passing-era snapshot (the rewrite
+                            # moves saved_at past its expires)
+                            self._dirty = True
                         elif (
                             entry.dereg_after > 0
                             and now - entry.critical_since > entry.dereg_after

@@ -26,6 +26,7 @@ from .train import (
     TrainState,
     abstract_train_state,
     init_train_state,
+    make_optimizer,
     make_pipeline_train_step,
     make_train_step,
     train_state_shardings,
@@ -43,6 +44,7 @@ __all__ = [
     "abstract_train_state",
     "make_train_step",
     "init_train_state",
+    "make_optimizer",
     "train_state_shardings",
     "save_checkpoint",
     "restore_checkpoint",

@@ -123,16 +123,30 @@ def restore_params(directory: str, state_like: Any) -> Optional[Any]:
     import orbax.checkpoint as ocp
 
     abstract = jax.tree.map(_to_abstract, state_like)
-    skeleton = jax.tree.map(lambda _: ocp.PLACEHOLDER, abstract)
     # TrainState is a registered pytree (params, opt_state, step);
     # rebuild it with real abstract leaves only where we want data.
     # StandardCheckpointer rejects PLACEHOLDER leaves; the PyTree
-    # handler (same on-disk format) honors them.
+    # handler (same on-disk format) honors them. The opt_state
+    # skeleton's STRUCTURE comes from the checkpoint's own metadata,
+    # not the caller: the serving process doesn't know the training
+    # optimizer's layout (an lr schedule adds a count state), and a
+    # placeholder-only subtree needs structure, nothing else.
     from .train import TrainState
 
+    try:
+        meta = ocp.PyTreeCheckpointer().metadata(
+            _step_path(directory, step)
+        ).item_metadata
+        meta_tree = meta.tree if hasattr(meta, "tree") else meta
+        opt_skeleton = jax.tree.map(lambda _: ocp.PLACEHOLDER, meta_tree[1])
+    except (KeyError, IndexError, TypeError, AttributeError):
+        # metadata shape surprised us: fall back to the caller's layout
+        opt_skeleton = jax.tree.map(
+            lambda _: ocp.PLACEHOLDER, abstract.opt_state
+        )
     target = TrainState(
         params=abstract.params,
-        opt_state=skeleton.opt_state,
+        opt_state=opt_skeleton,
         step=abstract.step,
     )
     # explicit per-leaf restore_args: PyTreeRestore ignores the

@@ -28,11 +28,58 @@ class TrainState:
     step: jax.Array
 
 
-def make_optimizer(learning_rate: float = 3e-4) -> optax.GradientTransformation:
+def make_optimizer(
+    learning_rate: float = 3e-4,
+    *,
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
+    min_lr_ratio: float = 0.1,
+    clip_norm: float = 1.0,
+) -> optax.GradientTransformation:
+    """Global-norm-clipped AdamW, optionally under a linear-warmup +
+    cosine-decay schedule (the standard LLM pretraining shape).
+
+    - ``warmup_steps > 0``: lr ramps 0 -> learning_rate linearly;
+    - ``decay_steps > 0``: cosine decay from the peak down to
+      ``learning_rate * min_lr_ratio`` over that many post-warmup
+      steps, then holds the floor;
+    - both zero (the default): constant lr, state layout unchanged.
+    """
     return optax.chain(
-        optax.clip_by_global_norm(1.0),
-        optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=0.1),
+        optax.clip_by_global_norm(clip_norm),
+        optax.adamw(
+            lr_schedule(learning_rate, warmup_steps, decay_steps,
+                        min_lr_ratio),
+            b1=0.9, b2=0.95, weight_decay=0.1,
+        ),
     )
+
+
+def lr_schedule(
+    learning_rate: float,
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
+    min_lr_ratio: float = 0.1,
+):
+    """The lr trajectory make_optimizer uses: a float when constant,
+    else an optax schedule (step -> lr)."""
+    if decay_steps > 0:
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0 if warmup_steps > 0 else learning_rate,
+            peak_value=learning_rate,
+            warmup_steps=warmup_steps,
+            decay_steps=warmup_steps + decay_steps,
+            end_value=learning_rate * min_lr_ratio,
+        )
+    if warmup_steps > 0:
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, learning_rate, warmup_steps),
+                optax.constant_schedule(learning_rate),
+            ],
+            boundaries=[warmup_steps],
+        )
+    return learning_rate
 
 
 def init_train_state(
@@ -41,11 +88,14 @@ def init_train_state(
     mesh: Mesh,
     learning_rate: float = 3e-4,
     rules: Any = None,
+    optimizer: optax.GradientTransformation = None,
 ) -> TrainState:
     """Initialize params already sharded onto the mesh. ``rules``
-    overrides the tensor-parallel param specs (e.g. pipeline rules)."""
+    overrides the tensor-parallel param specs (e.g. pipeline rules);
+    ``optimizer`` overrides the default make_optimizer(learning_rate)
+    (pass the same one to make_train_step and abstract_train_state)."""
     params = shard_params(init_params(rng, cfg), mesh, cfg, rules=rules)
-    optimizer = make_optimizer(learning_rate)
+    optimizer = optimizer or make_optimizer(learning_rate)
     opt_state = optimizer.init(params)
     # moment tensors inherit the param shardings; scalar leaves (adam
     # count etc.) land on the default device — commit them replicated so
@@ -65,11 +115,12 @@ def init_train_state(
 
 
 def _abstract_init(
-    rng: jax.Array, cfg: TransformerConfig, learning_rate: float
+    rng: jax.Array, cfg: TransformerConfig, learning_rate: float,
+    optimizer: optax.GradientTransformation = None,
 ) -> TrainState:
     def init_fn(rng):
         params = init_params(rng, cfg)
-        opt_state = make_optimizer(learning_rate).init(params)
+        opt_state = (optimizer or make_optimizer(learning_rate)).init(params)
         return TrainState(
             params=params,
             opt_state=opt_state,
@@ -85,6 +136,7 @@ def train_state_shardings(
     learning_rate: float = 3e-4,
     abstract: "TrainState" = None,
     rules: Any = None,
+    optimizer: optax.GradientTransformation = None,
 ) -> TrainState:
     """A TrainState-shaped pytree of NamedShardings: the canonical
     placement of every piece of training state on the mesh.
@@ -98,7 +150,9 @@ def train_state_shardings(
     from .sharding import param_sharding_rules
 
     if abstract is None:
-        abstract = _abstract_init(jax.random.PRNGKey(0), cfg, learning_rate)
+        abstract = _abstract_init(
+            jax.random.PRNGKey(0), cfg, learning_rate, optimizer
+        )
     if rules is None:
         rules = param_sharding_rules(cfg, mesh)
     replicated = NamedSharding(mesh, P())
@@ -138,13 +192,14 @@ def abstract_train_state(
     learning_rate: float = 3e-4,
     shardings: "TrainState" = None,
     rules: Any = None,
+    optimizer: optax.GradientTransformation = None,
 ) -> TrainState:
     """The shape/dtype/sharding skeleton of init_train_state's result,
     without materializing any arrays — the restore target for resuming
     from a checkpoint (checkpoint.restore_checkpoint accepts it), so
     resume never pays init + double residency. Pass ``shardings`` (from
     train_state_shardings) to avoid re-deriving them."""
-    abstract = _abstract_init(rng, cfg, learning_rate)
+    abstract = _abstract_init(rng, cfg, learning_rate, optimizer)
     if shardings is None:
         shardings = train_state_shardings(
             cfg, mesh, learning_rate, abstract, rules=rules
@@ -159,9 +214,20 @@ def abstract_train_state(
 
 
 def make_train_step(
-    cfg: TransformerConfig, mesh: Mesh, learning_rate: float = 3e-4
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    learning_rate: float = 3e-4,
+    optimizer: optax.GradientTransformation = None,
+    accum_steps: int = 1,
 ) -> Callable[[TrainState, jax.Array], Tuple[TrainState, jax.Array]]:
-    """Build the jitted, donated, sharded train step."""
+    """Build the jitted, donated, sharded train step.
+
+    ``accum_steps > 1`` runs gradient accumulation: the batch splits
+    into that many sequential chunks inside one compiled step
+    (``lax.scan``), grads average across chunks, one optimizer update —
+    the effective batch stays the full batch while activation memory
+    drops to one chunk's worth. Batch size must divide by it.
+    """
     if cfg.attention_fn is None and mesh.size > 1 and "seq" not in mesh.axis_names:
         # multi-device without context parallelism: the flash path (if
         # the seq length triggers it) must run under shard_map — pallas
@@ -169,14 +235,43 @@ def make_train_step(
         from .context import flash_parallel_config
 
         cfg = flash_parallel_config(cfg, mesh)
-    optimizer = make_optimizer(learning_rate)
+    if accum_steps < 1:
+        raise ValueError("accum_steps must be >= 1")
+    optimizer = optimizer or make_optimizer(learning_rate)
     data_sharding = NamedSharding(mesh, batch_spec())
     # pin the state's placement on both sides of the step so shardings
     # can never drift from the rules across steps/restores
-    state_shardings = train_state_shardings(cfg, mesh, learning_rate)
+    state_shardings = train_state_shardings(
+        cfg, mesh, learning_rate, optimizer=optimizer
+    )
+
+    def grads_of(params, tokens):
+        if accum_steps == 1:
+            return jax.value_and_grad(loss_fn)(params, tokens, cfg)
+        chunks = tokens.reshape(
+            accum_steps, tokens.shape[0] // accum_steps, tokens.shape[1]
+        )
+
+        def acc(carry, chunk):
+            loss_sum, grad_sum = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, chunk, cfg)
+            return (
+                loss_sum + loss,
+                jax.tree_util.tree_map(jnp.add, grad_sum, grads),
+            ), None
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            acc, (jnp.zeros((), jnp.float32), zeros), chunks
+        )
+        # equal-sized chunks: mean-of-chunk-means == full-batch mean
+        return (
+            loss_sum / accum_steps,
+            jax.tree_util.tree_map(lambda g: g / accum_steps, grad_sum),
+        )
 
     def step_fn(state: TrainState, tokens: jax.Array):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, cfg)
+        loss, grads = grads_of(state.params, tokens)
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
@@ -198,6 +293,11 @@ def make_train_step(
     )
 
     def run(state: TrainState, tokens: jax.Array):
+        if tokens.shape[0] % accum_steps:
+            raise ValueError(
+                f"batch {tokens.shape[0]} not divisible by "
+                f"accum_steps {accum_steps}"
+            )
         with mesh:
             return jitted(state, tokens)
 
@@ -210,6 +310,7 @@ def make_pipeline_train_step(
     mesh: Mesh,
     learning_rate: float = 3e-4,
     n_microbatches: int = 4,
+    optimizer: optax.GradientTransformation = None,
 ) -> Callable[[TrainState, jax.Array], Tuple[TrainState, jax.Array]]:
     """The pipelined (GPipe) train step over a ("data","pipe"[,"model"])
     mesh: layers shard over pipe stages, microbatches stream with
@@ -221,13 +322,13 @@ def make_pipeline_train_step(
 
     if "pipe" not in mesh.axis_names:
         raise ValueError(f"mesh has no 'pipe' axis: {mesh.axis_names}")
-    optimizer = make_optimizer(learning_rate)
+    optimizer = optimizer or make_optimizer(learning_rate)
     data_sharding = NamedSharding(
         mesh, P("data") if "data" in mesh.axis_names else P()
     )
     rules = pipeline_sharding_rules(cfg, mesh)
     state_shardings = train_state_shardings(
-        cfg, mesh, learning_rate, rules=rules
+        cfg, mesh, learning_rate, rules=rules, optimizer=optimizer
     )
 
     def step_fn(state: TrainState, tokens: jax.Array):

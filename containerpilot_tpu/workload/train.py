@@ -67,6 +67,16 @@ def main() -> int:
     parser.add_argument("--progress-file", default="")
     parser.add_argument("--control-socket", default="")
     parser.add_argument("--learning-rate", type=float, default=3e-4)
+    parser.add_argument("--warmup-steps", type=int, default=0,
+                        help="linear lr warmup from 0 over N steps")
+    parser.add_argument("--decay-steps", type=int, default=0,
+                        help="cosine-decay the lr to 10%% of peak over "
+                        "N post-warmup steps (0 = constant)")
+    parser.add_argument("--accum-steps", type=int, default=1,
+                        help="gradient accumulation: split each batch "
+                        "into N sequential chunks inside the compiled "
+                        "step (batch must divide; not with --pipeline-"
+                        "stages, whose microbatching already does this)")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=50)
     args = parser.parse_args()
@@ -76,6 +86,7 @@ def main() -> int:
         MeshPlan,
         init_train_state,
         make_mesh,
+        make_optimizer,
         make_pipeline_train_step,
         make_train_step,
     )
@@ -112,15 +123,34 @@ def main() -> int:
     print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} "
           f"on {jax.default_backend()}")
     rng = jax.random.PRNGKey(0)
+    optimizer = make_optimizer(
+        args.learning_rate,
+        warmup_steps=args.warmup_steps,
+        decay_steps=args.decay_steps,
+    )
     if args.pipeline_stages > 1:
         from ..parallel import pipeline_sharding_rules
 
+        if args.accum_steps > 1:
+            raise SystemExit(
+                "--accum-steps composes with the plain trainer only; "
+                "pipeline microbatching already bounds activations"
+            )
         rules = pipeline_sharding_rules(cfg, mesh)
         train_step = make_pipeline_train_step(
-            cfg, mesh, args.learning_rate, args.microbatches
+            cfg, mesh, args.learning_rate, args.microbatches,
+            optimizer=optimizer,
         )
     else:
-        train_step = make_train_step(cfg, mesh, args.learning_rate)
+        if args.batch % args.accum_steps:
+            raise SystemExit(
+                f"--batch {args.batch} not divisible by --accum-steps "
+                f"{args.accum_steps}"
+            )
+        train_step = make_train_step(
+            cfg, mesh, args.learning_rate, optimizer=optimizer,
+            accum_steps=args.accum_steps,
+        )
 
     state = None
     start_step = 0
@@ -134,7 +164,8 @@ def main() -> int:
         # restore into the eval_shape skeleton: no throwaway init, no
         # double residency of model + optimizer state during resume
         abstract = abstract_train_state(
-            rng, cfg, mesh, args.learning_rate, rules=rules
+            rng, cfg, mesh, args.learning_rate, rules=rules,
+            optimizer=optimizer,
         )
         state = restore_checkpoint(args.checkpoint_dir, abstract)
         if state is not None:
@@ -142,7 +173,8 @@ def main() -> int:
             print(f"resumed from checkpoint at step {start_step}")
     if state is None:
         state = init_train_state(
-            rng, cfg, mesh, args.learning_rate, rules=rules
+            rng, cfg, mesh, args.learning_rate, rules=rules,
+            optimizer=optimizer,
         )
 
     client = None

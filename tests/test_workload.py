@@ -245,6 +245,66 @@ def test_sharded_train_step_8_devices():
     assert len(wq_sharding.device_set) == 8
 
 
+def test_lr_schedule_shapes():
+    """Warmup ramps from 0, cosine decays to the floor, constant stays
+    a plain float (state layout unchanged for existing checkpoints)."""
+    from containerpilot_tpu.parallel import make_optimizer
+    from containerpilot_tpu.parallel.train import lr_schedule
+
+    assert lr_schedule(3e-4) == 3e-4
+    warm = lr_schedule(1e-3, warmup_steps=10)
+    assert float(warm(0)) == 0.0
+    np.testing.assert_allclose(float(warm(5)), 5e-4, rtol=1e-6)
+    np.testing.assert_allclose(float(warm(10)), 1e-3, rtol=1e-6)
+    np.testing.assert_allclose(float(warm(1000)), 1e-3, rtol=1e-6)
+    full = lr_schedule(1e-3, warmup_steps=10, decay_steps=90)
+    np.testing.assert_allclose(float(full(10)), 1e-3, rtol=1e-6)
+    # halfway through decay: midpoint of peak and floor
+    np.testing.assert_allclose(float(full(55)), 5.5e-4, rtol=1e-3)
+    np.testing.assert_allclose(float(full(100)), 1e-4, rtol=1e-3)
+    np.testing.assert_allclose(float(full(500)), 1e-4, rtol=1e-3)
+    # a scheduled optimizer still initializes and updates
+    opt = make_optimizer(1e-3, warmup_steps=2, decay_steps=4)
+    params = {"w": jnp.ones((4,))}
+    opt_state = opt.init(params)
+    updates, _ = opt.update(
+        {"w": jnp.full((4,), 0.5)}, opt_state, params
+    )
+    assert updates["w"].shape == (4,)
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=2 must produce the same loss and parameter update as
+    the single-shot step on the same batch (equal-size chunks: mean of
+    chunk means == full-batch mean)."""
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    mesh = make_mesh(jax.devices()[:8])
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size, jnp.int32
+    )
+    state_a = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
+    state_b = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
+    step_full = make_train_step(cfg, mesh)
+    step_accum = make_train_step(cfg, mesh, accum_steps=2)
+    state_a, loss_a = step_full(state_a, tokens)
+    state_b, loss_b = step_accum(state_b, tokens)
+    np.testing.assert_allclose(
+        float(loss_a), float(loss_b), rtol=1e-5, atol=1e-6
+    )
+    flat_a = jax.tree_util.tree_leaves(state_a.params)
+    flat_b = jax.tree_util.tree_leaves(state_b.params)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        )
+    with pytest.raises(ValueError, match="not divisible"):
+        step3 = make_train_step(cfg, mesh, accum_steps=3)
+        step3(init_train_state(jax.random.PRNGKey(0), cfg, mesh), tokens)
+
+
 def test_graft_entry_points():
     import __graft_entry__ as graft
 
@@ -393,6 +453,45 @@ def test_context_parallel_train_step():
     assert bool(jnp.isfinite(loss3))
     np.testing.assert_allclose(
         float(loss2), float(loss3), rtol=5e-3
+    )
+
+
+def test_restore_params_from_scheduled_checkpoint(tmp_path):
+    """A checkpoint written under an lr-scheduled optimizer (extra
+    count state in the opt tree) must still open with the serving
+    path's default skeleton: the opt_state placeholder structure comes
+    from the checkpoint's own metadata, not the caller."""
+    from containerpilot_tpu.parallel import (
+        abstract_train_state,
+        make_optimizer,
+        restore_params,
+        save_checkpoint,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    mesh = make_mesh(jax.devices()[:1])
+    opt = make_optimizer(1e-3, warmup_steps=2, decay_steps=10)
+    state = init_train_state(
+        jax.random.PRNGKey(0), cfg, mesh, optimizer=opt
+    )
+    step = make_train_step(cfg, mesh, optimizer=opt)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size, jnp.int32
+    )
+    state, _ = step(state, tokens)
+    save_checkpoint(str(tmp_path), 1, state)
+
+    # the serving process knows nothing of the training schedule
+    abstract = abstract_train_state(jax.random.PRNGKey(0), cfg, mesh)
+    params, restored_step = restore_params(str(tmp_path), abstract)
+    assert int(restored_step) == 1
+    np.testing.assert_allclose(
+        np.asarray(params["embed"]),
+        np.asarray(state.params["embed"]),
+        rtol=1e-6,
     )
 
 
