@@ -117,16 +117,37 @@ def decode_step(
     params: Params, cache: Cache, token: jax.Array, cfg: TransformerConfig
 ) -> Tuple[jax.Array, Cache]:
     """One autoregressive step. token: [batch] int32 (the token at
-    position cache['pos']); returns (logits [batch, vocab], new cache)."""
+    position cache['pos']); returns (logits [batch, vocab], new cache).
+    The m=1 case of decode_chunk — one shared implementation keeps
+    single-step and speculative-verify numerics identical by
+    construction."""
+    logits, new_cache = decode_chunk(params, cache, token[:, None], cfg)
+    return logits[:, 0, :], new_cache
+
+
+def decode_chunk(
+    params: Params, cache: Cache, tokens: jax.Array, cfg: TransformerConfig
+) -> Tuple[jax.Array, Cache]:
+    """Process m tokens against the cache in ONE forward — the verify
+    step of speculative decoding (m = speculate+1), and the general
+    multi-token incremental step.
+
+    ``tokens[:, i]`` sits at position ``pos + i``; ``logits[:, i]``
+    predicts position ``pos + i + 1``. Within the chunk attention is
+    causal; everything already cached is visible. Numerics match m
+    sequential ``decode_step`` calls (and therefore the full forward).
+    """
     pos = cache["pos"]
-    b = token.shape[0]
+    b, m = tokens.shape
     max_len = cache["k"].shape[2]
-    x = embed_lookup(params, token, cfg.dtype)[:, None, :]  # [b,1,d]
-    valid = jnp.arange(max_len) <= pos  # [max_len]; pos itself is valid
+    x = embed_lookup(params, tokens, cfg.dtype)  # [b, m, d]
+    key_pos = jnp.arange(max_len)
+    q_pos = pos + jnp.arange(m)
+    valid = key_pos[None, :] <= q_pos[:, None]  # [m, max_len]
     # int8-quantized dense models run their projections through the
     # fused dequant pallas GEMM: decode is weight-streaming bound, so
     # reading int8 instead of dequantized bf16 halves the HBM traffic
-    fused = can_fuse_int8(params["layers"], cfg, rows=b)
+    fused = can_fuse_int8(params["layers"], cfg, rows=b * m)
 
     def body(carry, inputs):
         x = carry
@@ -136,21 +157,17 @@ def decode_step(
         else:
             layer_params = maybe_dequant_layer(layer_params, cfg.dtype)
             q, k, v = _qkv(x, layer_params, cfg, offset=pos)
-        # write this step's k/v at position pos
-        k_cache = lax.dynamic_update_slice(
-            k_cache, k, (0, pos, 0, 0)
-        )
-        v_cache = lax.dynamic_update_slice(
-            v_cache, v, (0, pos, 0, 0)
-        )
+        k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
         k_full = repeat_kv(k_cache, cfg.n_heads)
         v_full = repeat_kv(v_cache, cfg.n_heads)
         scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q.astype(jnp.float32) * cfg.head_dim ** -0.5,
+            "bqhd,bkhd->bhqk",
+            q.astype(jnp.float32) * cfg.head_dim ** -0.5,
             k_full.astype(jnp.float32),
             preferred_element_type=jnp.float32,
-        )  # [b, h, 1, max_len]
-        scores = jnp.where(valid[None, None, None, :], scores, NEG_INF)
+        )  # [b, h, m, max_len]
+        scores = jnp.where(valid[None, None, :, :], scores, NEG_INF)
         weights = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
         attn = jnp.einsum(
             "bhqk,bkhd->bqhd", weights, v_full,
@@ -167,9 +184,8 @@ def decode_step(
     x, (new_k, new_v) = lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"])
     )
-    logits = _logits(params, x, cfg)[:, 0, :]
-    new_cache = {"k": new_k, "v": new_v, "pos": pos + 1}
-    return logits, new_cache
+    logits = _logits(params, x, cfg)  # [b, m, vocab]
+    return logits, {"k": new_k, "v": new_v, "pos": pos + m}
 
 
 import functools

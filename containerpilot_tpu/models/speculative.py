@@ -1,0 +1,178 @@
+"""Speculative decoding: a cheap draft proposes, the target verifies.
+
+Greedy speculative decoding (the deterministic core of Leviathan et
+al.'s scheme): each round the draft model autoregressively proposes
+``speculate`` tokens (tiny per-token cost), then the target model
+scores the whole proposal in ONE chunked forward (`decode_chunk`) —
+one target pass per round instead of one per token. Accepted prefix +
+one target-chosen token are emitted; both KV caches roll back to the
+accepted position by resetting ``pos`` (stale cache rows beyond pos
+are masked/overwritten by design, models/decode.py).
+
+**The output is exactly the target model's greedy decode** for any
+draft — the draft only changes speed, never content (tested). Decode
+is memory-bandwidth-bound on TPU (the whole model streams from HBM per
+token), so accepting n tokens per round divides the dominant cost by
+~n at small-batch serving.
+
+The draft can be any same-vocab model; `layer_prefix_draft` builds one
+for free from the target's own first N layers (scan-stacked params
+slice — no extra checkpoint, self-speculative style).
+
+TPU shape discipline: every jitted helper has static (k, lengths);
+only the handful of distinct k values near the sequence end compile
+extra variants. The accept/rollback decision is a few-byte host
+round-trip per ROUND (not per token) — the same cadence a vanilla
+decode loop pays for its sampled token.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .decode import Cache, decode_chunk, decode_step, prefill
+from .transformer import Params, TransformerConfig
+
+
+def layer_prefix_draft(
+    params: Params, cfg: TransformerConfig, n_layers: int
+) -> Tuple[Params, TransformerConfig]:
+    """A free draft model: the target's first ``n_layers`` layers with
+    the shared embed/norm/unembed. Scan-stacked layer params make this
+    a leading-axis slice — no copy of anything else, no checkpoint."""
+    if not 0 < n_layers < cfg.n_layers:
+        raise ValueError(
+            f"draft layers must be in (0, {cfg.n_layers}), got {n_layers}"
+        )
+    draft_params = dict(params)
+    draft_params["layers"] = jax.tree_util.tree_map(
+        lambda a: a[:n_layers], params["layers"]
+    )
+    return draft_params, dataclasses.replace(cfg, n_layers=n_layers)
+
+
+@functools.lru_cache(maxsize=64)
+def _jit_draft_round(draft_cfg: TransformerConfig, k: int):
+    """k greedy draft steps from (cache, prev): returns the k proposed
+    tokens and the advanced draft cache."""
+
+    def fn(draft_params, cache: Cache, prev: jax.Array):
+        def step(carry, _):
+            cache, tok = carry
+            logits, cache = decode_step(draft_params, cache, tok, draft_cfg)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (cache, nxt), nxt
+
+        (cache, _last), drafts = lax.scan(
+            step, (cache, prev), None, length=k
+        )
+        return drafts[:, 0], cache  # [k] for batch 1
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
+def _jit_verify_round(cfg: TransformerConfig, k: int):
+    """One chunked target forward over [prev, d_1..d_{k-1}] (k
+    tokens): returns the target's greedy prediction at each position —
+    its own choices for d_1..d_k. Both caches advance over exactly the
+    same k rows the draft wrote, which keeps their frontiers aligned
+    for every acceptance count."""
+
+    def fn(params, cache: Cache, chunk: jax.Array):
+        logits, cache = decode_chunk(params, cache, chunk, cfg)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)[0], cache
+
+    return jax.jit(fn)
+
+
+def speculative_generate(
+    params: Params,
+    draft_params: Params,
+    prompt: jax.Array,
+    cfg: TransformerConfig,
+    draft_cfg: TransformerConfig,
+    max_new_tokens: int,
+    max_len: int,
+    speculate: int = 4,
+) -> Tuple[jax.Array, dict]:
+    """Greedy generation via draft-and-verify; batch 1.
+
+    Returns ``(tokens [1, max_new_tokens], stats)`` where stats counts
+    rounds and accepted drafts. Output is identical to
+    ``generate(params, ..., temperature=0)``.
+    """
+    if prompt.shape[0] != 1:
+        raise ValueError("speculative decoding serves batch 1")
+    if speculate < 1:
+        raise ValueError("speculate must be >= 1")
+    if cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError("draft and target must share a vocab")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if prompt.shape[1] + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt_len {prompt.shape[1]} + max_new_tokens "
+            f"{max_new_tokens} exceeds max_len {max_len}"
+        )
+
+    logits, cache = prefill(params, prompt, cfg, max_len)
+    _dlogits, dcache = prefill(draft_params, prompt, draft_cfg, max_len)
+    prev = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [1]
+    out = [int(prev[0])]
+    pos = int(cache["pos"])  # == prompt_len; tracked on host
+    rounds = 0
+    accepted_total = 0
+
+    while len(out) < max_new_tokens:
+        # the verify chunk [prev, d_1..d_{k-1}] writes k cache rows at
+        # pos..pos+k-1 (the draft wrote the same k rows), so the round
+        # needs pos + k <= max_len
+        k = min(speculate, max_new_tokens - len(out), max_len - pos)
+        if k < 1:
+            break  # cache exhausted (max_len reached): out is full anyway
+        drafts, dcache = _jit_draft_round(draft_cfg, k)(
+            draft_params, dcache, prev
+        )
+        chunk = jnp.concatenate([prev, drafts[:-1]])[None, :]  # [1, k]
+        target_choice, cache = _jit_verify_round(cfg, k)(
+            params, cache, chunk
+        )
+        drafts_h = jax.device_get(drafts)
+        target_h = jax.device_get(target_choice)  # [k]
+        n_acc = 0
+        while n_acc < k and int(drafts_h[n_acc]) == int(target_h[n_acc]):
+            n_acc += 1
+        if n_acc == k:
+            # full accept: every draft token IS the target's choice
+            emitted = [int(t) for t in drafts_h]
+        else:
+            emitted = (
+                [int(t) for t in drafts_h[:n_acc]] + [int(target_h[n_acc])]
+            )
+        out.extend(emitted)
+        rounds += 1
+        accepted_total += n_acc
+        # roll back both caches to the accepted frontier: the last
+        # emitted token is NOT processed yet — it is next round's prev.
+        # Both models processed rows pos..pos+k-1, and
+        # len(emitted) <= k, so the new frontier is always <= what each
+        # cache actually holds (stale rows beyond it get overwritten).
+        pos += len(emitted)
+        cache = {**cache, "pos": jnp.asarray(pos, jnp.int32)}
+        dcache = {**dcache, "pos": jnp.asarray(pos, jnp.int32)}
+        prev = jnp.asarray([emitted[-1]], jnp.int32)
+
+    tokens = jnp.asarray([out[:max_new_tokens]], jnp.int32)
+    stats = {
+        "rounds": rounds,
+        "accepted_drafts": accepted_total,
+        "tokens": len(out[:max_new_tokens]),
+        "mean_accepted": accepted_total / rounds if rounds else 0.0,
+    }
+    return tokens, stats

@@ -70,6 +70,8 @@ class InferenceServer:
         host: str,
         port: int,
         max_len: int,
+        draft_layers: int = 0,
+        speculate: int = 4,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -77,6 +79,19 @@ class InferenceServer:
         self.port = port
         self.max_len = max_len
         self.ready = False
+        # self-speculative decoding: a layer-prefix draft accelerates
+        # greedy single-sequence generation, output unchanged
+        self.draft_params = self.draft_cfg = None
+        self.speculate = speculate
+        if draft_layers > 0 and speculate < 1:
+            # fail at startup, not as request-time 500s
+            raise ValueError("speculate must be >= 1")
+        if draft_layers > 0:
+            from ..models.speculative import layer_prefix_draft
+
+            self.draft_params, self.draft_cfg = layer_prefix_draft(
+                params, cfg, draft_layers
+            )
         self._executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="inference"
         )
@@ -145,18 +160,35 @@ class InferenceServer:
 
         def run() -> Any:
             prompt = jnp.asarray(tokens, jnp.int32)
-            out = generate(
-                self.params,
-                prompt,
-                self.cfg,
-                max_new_tokens=max_new,
-                max_len=self.max_len,
-                temperature=temperature,
-                rng=jax.random.PRNGKey(seed),
-                top_k=top_k,
-                top_p=top_p,
-                eos_id=eos_id,
-            )
+            if (
+                self.draft_params is not None
+                and temperature <= 0.0
+                and prompt.shape[0] == 1
+            ):
+                # greedy single-sequence: draft-and-verify, identical
+                # output, ~accepted-per-round fewer target passes. An
+                # eos trim below applies the same truncation the
+                # padded greedy path would get.
+                from ..models.speculative import speculative_generate
+
+                out, _stats = speculative_generate(
+                    self.params, self.draft_params, prompt, self.cfg,
+                    self.draft_cfg, max_new_tokens=max_new,
+                    max_len=self.max_len, speculate=self.speculate,
+                )
+            else:
+                out = generate(
+                    self.params,
+                    prompt,
+                    self.cfg,
+                    max_new_tokens=max_new,
+                    max_len=self.max_len,
+                    temperature=temperature,
+                    rng=jax.random.PRNGKey(seed),
+                    top_k=top_k,
+                    top_p=top_p,
+                    eos_id=eos_id,
+                )
             return jax.device_get(out[:, :max_new_requested]).tolist()
 
         loop = asyncio.get_event_loop()
@@ -238,6 +270,34 @@ class InferenceServer:
                     self.params, prompt, self.cfg, max_new_tokens=16,
                     max_len=self.max_len,
                 )
+                if self.draft_params is not None and prompt_len == 4:
+                    # the DEFAULT path for greedy traffic: compile the
+                    # draft prefill and EVERY per-k draft/verify
+                    # variant — k varies 1..speculate at request time
+                    # with data-dependent acceptance, and any uncompiled
+                    # k would stall a live request
+                    from ..models.decode import prefill
+                    from ..models.speculative import (
+                        _jit_draft_round,
+                        _jit_verify_round,
+                    )
+
+                    _logits, cache = prefill(
+                        self.params, prompt, self.cfg, self.max_len
+                    )
+                    _dlogits, dcache = prefill(
+                        self.draft_params, prompt, self.draft_cfg,
+                        self.max_len,
+                    )
+                    prev = jnp.zeros((1,), jnp.int32)
+                    for k in range(1, self.speculate + 1):
+                        _jit_draft_round(self.draft_cfg, k)(
+                            self.draft_params, dcache, prev
+                        )
+                        _jit_verify_round(self.cfg, k)(
+                            self.params, cache,
+                            jnp.zeros((1, k), jnp.int32),
+                        )
 
         await asyncio.get_event_loop().run_in_executor(self._executor, run)
         self.ready = True
@@ -275,6 +335,16 @@ def main() -> int:
     parser.add_argument(
         "--int8", action="store_true",
         help="weight-only int8: ~4x smaller resident params",
+    )
+    parser.add_argument(
+        "--draft-layers", type=int, default=0,
+        help="self-speculative decoding: draft with the model's first "
+        "N layers; greedy single-sequence requests decode several "
+        "tokens per target pass with identical output (0 = off)",
+    )
+    parser.add_argument(
+        "--speculate", type=int, default=4,
+        help="draft tokens proposed per verify round",
     )
     args = parser.parse_args()
 
@@ -316,7 +386,10 @@ def main() -> int:
             f"({before / param_bytes(params):.1f}x smaller)"
         )
 
-    server = InferenceServer(cfg, params, args.host, args.port, args.max_len)
+    server = InferenceServer(
+        cfg, params, args.host, args.port, args.max_len,
+        draft_layers=args.draft_layers, speculate=args.speculate,
+    )
 
     async def serve() -> None:
         import signal as signal_mod

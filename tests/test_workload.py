@@ -770,6 +770,84 @@ def test_generate_sampling_modes_and_eos():
                  top_p=1.5)
 
 
+def test_decode_chunk_matches_decode_steps():
+    """The multi-token incremental step (speculative verify) must be
+    numerically equivalent to sequential single-token steps."""
+    from containerpilot_tpu.models.decode import (
+        decode_chunk, decode_step, prefill,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=2, n_layers=2, d_ff=128,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab_size, jnp.int32
+    )
+    _logits, cache_a = prefill(params, tokens[:, :6], cfg, max_len=16)
+    _logits, cache_b = prefill(params, tokens[:, :6], cfg, max_len=16)
+    chunk_logits, cache_a = decode_chunk(params, cache_a, tokens[:, 6:12], cfg)
+    for i in range(6):
+        step_logits, cache_b = decode_step(params, cache_b, tokens[:, 6 + i], cfg)
+        np.testing.assert_allclose(
+            np.asarray(chunk_logits[:, i]), np.asarray(step_logits),
+            rtol=2e-4, atol=2e-4, err_msg=f"chunk position {i}",
+        )
+    assert int(cache_a["pos"]) == int(cache_b["pos"]) == 12
+    np.testing.assert_allclose(
+        np.asarray(cache_a["k"]), np.asarray(cache_b["k"]),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_speculative_matches_vanilla_greedy():
+    """Speculative decoding must reproduce the target's greedy output
+    EXACTLY for any draft — the draft changes speed, never content."""
+    from containerpilot_tpu.models.decode import generate
+    from containerpilot_tpu.models.speculative import (
+        layer_prefix_draft, speculative_generate,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=3, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (1, 5), 0, 64, jnp.int32
+    )
+    want = generate(params, prompt, cfg, max_new_tokens=20, max_len=40)
+
+    # weak draft: 1-layer prefix
+    dparams, dcfg = layer_prefix_draft(params, cfg, 1)
+    got, stats = speculative_generate(
+        params, dparams, prompt, cfg, dcfg,
+        max_new_tokens=20, max_len=40, speculate=4,
+    )
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+    assert stats["tokens"] == 20 and stats["rounds"] >= 5
+
+    # perfect draft (the target itself): every round fully accepts
+    got2, stats2 = speculative_generate(
+        params, params, prompt, cfg, cfg,
+        max_new_tokens=20, max_len=40, speculate=4,
+    )
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got2))
+    # token 1 comes from prefill; the remaining 19 emit in rounds of
+    # k=4,4,4,4,3 — a perfect draft fully accepts every round
+    assert stats2["rounds"] == 5
+    assert stats2["accepted_drafts"] == 19
+
+    with pytest.raises(ValueError, match="batch 1"):
+        speculative_generate(
+            params, dparams, jnp.ones((2, 3), jnp.int32), cfg, dcfg,
+            max_new_tokens=4, max_len=40,
+        )
+    with pytest.raises(ValueError, match="draft layers"):
+        layer_prefix_draft(params, cfg, 3)
+
+
 def test_inference_server_end_to_end(run):
     """The serving path: warmup -> health -> generate over HTTP."""
     import urllib.request
@@ -855,6 +933,83 @@ def test_inference_server_end_to_end(run):
         scored["sums"][0], sum(expect), rtol=1e-3, atol=1e-3
     )
     assert bad_score[0] == 422 and ">= 2 ids" in bad_score[1]
+
+
+def test_inference_server_speculative(run):
+    """Two servers, same weights, one speculative: identical greedy
+    output over HTTP; sampled and batched requests fall back."""
+    import urllib.request
+
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=3, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    vanilla = InferenceServer(cfg, params, "127.0.0.1", 0, max_len=64)
+    spec = InferenceServer(
+        cfg, params, "127.0.0.1", 0, max_len=64,
+        draft_layers=1, speculate=4,
+    )
+    with pytest.raises(ValueError, match="speculate"):
+        InferenceServer(cfg, params, "127.0.0.1", 0, max_len=64,
+                        draft_layers=1, speculate=0)
+
+    def fetch(port, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return json.loads(resp.read())
+
+    async def scenario():
+        import asyncio
+
+        await vanilla.run()
+        await spec.run()
+        loop = asyncio.get_event_loop()
+        greedy_body = {"tokens": [[3, 1, 4, 1, 5]], "max_new_tokens": 24}
+        a = await loop.run_in_executor(
+            None, lambda: fetch(vanilla.port, greedy_body)
+        )
+        b = await loop.run_in_executor(
+            None, lambda: fetch(spec.port, greedy_body)
+        )
+        # eos trim must agree between the padded and speculative paths
+        eos = a["tokens"][0][2]
+        eos_body = {**greedy_body, "eos_id": eos}
+        ae = await loop.run_in_executor(
+            None, lambda: fetch(vanilla.port, eos_body)
+        )
+        be = await loop.run_in_executor(
+            None, lambda: fetch(spec.port, eos_body)
+        )
+        sampled = await loop.run_in_executor(
+            None, lambda: fetch(spec.port, {
+                "tokens": [[3, 1, 4]], "max_new_tokens": 8,
+                "temperature": 1.0, "seed": 7,
+            })
+        )
+        batched = await loop.run_in_executor(
+            None, lambda: fetch(spec.port, {
+                "tokens": [[1, 2], [3, 4]], "max_new_tokens": 4,
+            })
+        )
+        await vanilla.stop()
+        await spec.stop()
+        return a, b, ae, be, sampled, batched
+
+    import json
+
+    a, b, ae, be, sampled, batched = run(scenario(), timeout=300)
+    assert a == b
+    assert ae == be
+    assert len(sampled["tokens"][0]) == 8
+    assert len(batched["tokens"]) == 2 and len(batched["tokens"][0]) == 4
 
 
 def test_moe_forward_and_training():
