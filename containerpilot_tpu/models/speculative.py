@@ -58,8 +58,11 @@ def layer_prefix_draft(
 
 @functools.lru_cache(maxsize=64)
 def _jit_draft_round(draft_cfg: TransformerConfig, k: int):
-    """k greedy draft steps from (cache, prev): returns the k proposed
-    tokens and the advanced draft cache."""
+    """k greedy proposals from (cache, prev), via k+1 decode steps: the
+    extra step consumes the last proposal so the draft cache ends
+    holding kv for ALL k proposals (rows pos..pos+k) — aligned with the
+    target's (k+1)-token verify chunk for every acceptance count. Its
+    own (k+1)-th proposal is discarded."""
 
     def fn(draft_params, cache: Cache, prev: jax.Array):
         def step(carry, _):
@@ -69,20 +72,19 @@ def _jit_draft_round(draft_cfg: TransformerConfig, k: int):
             return (cache, nxt), nxt
 
         (cache, _last), drafts = lax.scan(
-            step, (cache, prev), None, length=k
+            step, (cache, prev), None, length=k + 1
         )
-        return drafts[:, 0], cache  # [k] for batch 1
+        return drafts[:k, 0], cache  # [k] for batch 1
 
     return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=64)
-def _jit_verify_round(cfg: TransformerConfig, k: int):
-    """One chunked target forward over [prev, d_1..d_{k-1}] (k
-    tokens): returns the target's greedy prediction at each position —
-    its own choices for d_1..d_k. Both caches advance over exactly the
-    same k rows the draft wrote, which keeps their frontiers aligned
-    for every acceptance count."""
+def _jit_verify_round(cfg: TransformerConfig, m: int):
+    """One chunked target forward over the m = k+1 tokens
+    [prev, d_1..d_k]: returns the target's greedy prediction at each
+    position — its choices for d_1..d_k plus the bonus token that
+    follows a full accept."""
 
     def fn(params, cache: Cache, chunk: jax.Array):
         logits, cache = decode_chunk(params, cache, chunk, cfg)
@@ -130,39 +132,36 @@ def speculative_generate(
     accepted_total = 0
 
     while len(out) < max_new_tokens:
-        # the verify chunk [prev, d_1..d_{k-1}] writes k cache rows at
-        # pos..pos+k-1 (the draft wrote the same k rows), so the round
-        # needs pos + k <= max_len
-        k = min(speculate, max_new_tokens - len(out), max_len - pos)
-        if k < 1:
-            break  # cache exhausted (max_len reached): out is full anyway
+        # the verify chunk [prev, d_1..d_k] writes k+1 cache rows at
+        # pos..pos+k (the draft's k+1 steps write the same rows), so
+        # the round needs pos + k + 1 <= max_len
+        k = min(speculate, max_new_tokens - len(out), max_len - pos - 1)
+        # invariant: pos == prompt_len + len(out) - 1 and
+        # prompt_len + max_new_tokens <= max_len, so k >= 1 here
+        assert k >= 1, (pos, len(out))
         drafts, dcache = _jit_draft_round(draft_cfg, k)(
             draft_params, dcache, prev
         )
-        chunk = jnp.concatenate([prev, drafts[:-1]])[None, :]  # [1, k]
-        target_choice, cache = _jit_verify_round(cfg, k)(
+        chunk = jnp.concatenate([prev, drafts])[None, :]  # [1, k+1]
+        target_choice, cache = _jit_verify_round(cfg, k + 1)(
             params, cache, chunk
         )
         drafts_h = jax.device_get(drafts)
-        target_h = jax.device_get(target_choice)  # [k]
+        target_h = jax.device_get(target_choice)  # [k+1]
         n_acc = 0
         while n_acc < k and int(drafts_h[n_acc]) == int(target_h[n_acc]):
             n_acc += 1
-        if n_acc == k:
-            # full accept: every draft token IS the target's choice
-            emitted = [int(t) for t in drafts_h]
-        else:
-            emitted = (
-                [int(t) for t in drafts_h[:n_acc]] + [int(target_h[n_acc])]
-            )
+        # accepted prefix + one target-chosen token: the correction at
+        # the first mismatch, or the bonus token after a full accept
+        emitted = [int(t) for t in drafts_h[:n_acc]] + [int(target_h[n_acc])]
         out.extend(emitted)
         rounds += 1
         accepted_total += n_acc
         # roll back both caches to the accepted frontier: the last
         # emitted token is NOT processed yet — it is next round's prev.
-        # Both models processed rows pos..pos+k-1, and
-        # len(emitted) <= k, so the new frontier is always <= what each
-        # cache actually holds (stale rows beyond it get overwritten).
+        # Both models hold rows pos..pos+k, and len(emitted) <= k+1, so
+        # the new frontier never exceeds what each cache actually holds
+        # (stale rows beyond it get overwritten).
         pos += len(emitted)
         cache = {**cache, "pos": jnp.asarray(pos, jnp.int32)}
         dcache = {**dcache, "pos": jnp.asarray(pos, jnp.int32)}

@@ -89,28 +89,34 @@ def init_train_state(
     learning_rate: float = 3e-4,
     rules: Any = None,
     optimizer: optax.GradientTransformation = None,
+    zero1: bool = False,
 ) -> TrainState:
     """Initialize params already sharded onto the mesh. ``rules``
     overrides the tensor-parallel param specs (e.g. pipeline rules);
     ``optimizer`` overrides the default make_optimizer(learning_rate)
-    (pass the same one to make_train_step and abstract_train_state)."""
+    (pass the same one to make_train_step and abstract_train_state);
+    ``zero1`` shards adam moments over the data axis (see
+    train_state_shardings)."""
     params = shard_params(init_params(rng, cfg), mesh, cfg, rules=rules)
     optimizer = optimizer or make_optimizer(learning_rate)
     opt_state = optimizer.init(params)
-    # moment tensors inherit the param shardings; scalar leaves (adam
-    # count etc.) land on the default device — commit them replicated so
-    # checkpoint-restored states (which ARE committed) match exactly
-    replicated = NamedSharding(mesh, P())
+    # commit every piece of optimizer state to its canonical sharding
+    # (moments normally inherit the param placement — a no-op put —
+    # but zero1 re-shards them over data; scalars commit replicated so
+    # checkpoint-restored states match exactly)
+    shardings = train_state_shardings(
+        cfg, mesh, learning_rate, rules=rules, optimizer=optimizer,
+        zero1=zero1,
+    )
     opt_state = jax.tree.map(
-        lambda x: jax.device_put(x, replicated)
-        if getattr(x, "ndim", None) == 0
-        else x,
-        opt_state,
+        jax.device_put, opt_state, shardings.opt_state
     )
     return TrainState(
         params=params,
         opt_state=opt_state,
-        step=jax.device_put(jnp.zeros((), jnp.int32), replicated),
+        step=jax.device_put(
+            jnp.zeros((), jnp.int32), NamedSharding(mesh, P())
+        ),
     )
 
 
@@ -137,6 +143,7 @@ def train_state_shardings(
     abstract: "TrainState" = None,
     rules: Any = None,
     optimizer: optax.GradientTransformation = None,
+    zero1: bool = False,
 ) -> TrainState:
     """A TrainState-shaped pytree of NamedShardings: the canonical
     placement of every piece of training state on the mesh.
@@ -146,6 +153,15 @@ def train_state_shardings(
     so the same rules resolve; scalar leaves replicate. Used both as
     the train step's pinned in/out shardings (so state placement can
     never drift across steps) and as the checkpoint-restore target.
+
+    ``zero1`` additionally shards adam's mu/nu over the ``data`` axis
+    (ZeRO stage 1): optimizer moments — 2x the params in f32 — stop
+    being replicated across data-parallel replicas, dividing their
+    memory by the data-axis size. Params stay replicated over data;
+    XLA partitions the elementwise optimizer math over ``data`` and
+    all-gathers the updates (reduce-scatter/all-gather in place of the
+    plain grad all-reduce). Moment tensors whose dims don't divide stay
+    on the param sharding.
     """
     from .sharding import param_sharding_rules
 
@@ -156,15 +172,28 @@ def train_state_shardings(
     if rules is None:
         rules = param_sharding_rules(cfg, mesh)
     replicated = NamedSharding(mesh, P())
+    data_size = mesh.shape.get("data", 1)
+
+    def with_data_axis(spec: P, shape) -> P:
+        """Put ``data`` on the first unsharded dim that divides."""
+        entries = list(spec) + [None] * (len(shape) - len(spec))
+        for i, (entry, dim) in enumerate(zip(entries, shape)):
+            if entry is None and dim % data_size == 0 and dim > 0:
+                entries[i] = "data"
+                return P(*entries)
+        return spec  # nothing divides: keep the param sharding
 
     def resolve(path, leaf):
         if getattr(leaf, "ndim", None) == 0:
             return replicated
         cursor: Any = rules
+        in_moments = False
         for key in path:
             name = getattr(key, "key", getattr(key, "name", None))
             if not isinstance(name, str):
                 continue  # tuple/namedtuple positions carry no rule info
+            if name in ("mu", "nu"):
+                in_moments = True
             # descend first; re-anchor at the top only on a miss (mu/nu
             # subtrees mirror the param tree), so a nested param that
             # happens to share a top-level name can't mis-resolve
@@ -180,6 +209,8 @@ def train_state_shardings(
                 f"no sharding rule resolves for state leaf at path "
                 f"{jax.tree_util.keystr(path)} (shape {leaf.shape})"
             )
+        if zero1 and in_moments and data_size > 1:
+            cursor = with_data_axis(cursor, leaf.shape)
         return NamedSharding(mesh, cursor)
 
     return jax.tree_util.tree_map_with_path(resolve, abstract)
@@ -193,6 +224,7 @@ def abstract_train_state(
     shardings: "TrainState" = None,
     rules: Any = None,
     optimizer: optax.GradientTransformation = None,
+    zero1: bool = False,
 ) -> TrainState:
     """The shape/dtype/sharding skeleton of init_train_state's result,
     without materializing any arrays — the restore target for resuming
@@ -202,7 +234,7 @@ def abstract_train_state(
     abstract = _abstract_init(rng, cfg, learning_rate, optimizer)
     if shardings is None:
         shardings = train_state_shardings(
-            cfg, mesh, learning_rate, abstract, rules=rules
+            cfg, mesh, learning_rate, abstract, rules=rules, zero1=zero1
         )
     return jax.tree_util.tree_map(
         lambda leaf, s: jax.ShapeDtypeStruct(
@@ -219,8 +251,14 @@ def make_train_step(
     learning_rate: float = 3e-4,
     optimizer: optax.GradientTransformation = None,
     accum_steps: int = 1,
+    zero1: bool = False,
 ) -> Callable[[TrainState, jax.Array], Tuple[TrainState, jax.Array]]:
     """Build the jitted, donated, sharded train step.
+
+    ``zero1`` pins adam's moments sharded over the data axis (ZeRO
+    stage 1) — optimizer memory per device drops by the data-parallel
+    factor; XLA swaps the grad all-reduce for reduce-scatter +
+    all-gather around the partitioned optimizer math.
 
     ``accum_steps > 1`` runs gradient accumulation: the batch splits
     into that many sequential chunks inside one compiled step
@@ -242,7 +280,7 @@ def make_train_step(
     # pin the state's placement on both sides of the step so shardings
     # can never drift from the rules across steps/restores
     state_shardings = train_state_shardings(
-        cfg, mesh, learning_rate, optimizer=optimizer
+        cfg, mesh, learning_rate, optimizer=optimizer, zero1=zero1
     )
 
     def grads_of(params, tokens):

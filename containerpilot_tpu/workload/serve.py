@@ -294,9 +294,10 @@ class InferenceServer:
                         _jit_draft_round(self.draft_cfg, k)(
                             self.draft_params, dcache, prev
                         )
-                        _jit_verify_round(self.cfg, k)(
+                        # verify chunks are k+1 tokens ([prev, drafts])
+                        _jit_verify_round(self.cfg, k + 1)(
                             self.params, cache,
-                            jnp.zeros((1, k), jnp.int32),
+                            jnp.zeros((1, k + 1), jnp.int32),
                         )
 
         await asyncio.get_event_loop().run_in_executor(self._executor, run)

@@ -72,6 +72,10 @@ def main() -> int:
     parser.add_argument("--decay-steps", type=int, default=0,
                         help="cosine-decay the lr to 10%% of peak over "
                         "N post-warmup steps (0 = constant)")
+    parser.add_argument("--zero1", action="store_true",
+                        help="ZeRO-1: shard adam moments over the data "
+                        "axis; optimizer memory per device drops by "
+                        "the data-parallel factor")
     parser.add_argument("--accum-steps", type=int, default=1,
                         help="gradient accumulation: split each batch "
                         "into N sequential chunks inside the compiled "
@@ -136,6 +140,11 @@ def main() -> int:
                 "--accum-steps composes with the plain trainer only; "
                 "pipeline microbatching already bounds activations"
             )
+        if args.zero1:
+            raise SystemExit(
+                "--zero1 composes with the plain trainer only (pipeline "
+                "sharding rules already partition state over stages)"
+            )
         rules = pipeline_sharding_rules(cfg, mesh)
         train_step = make_pipeline_train_step(
             cfg, mesh, args.learning_rate, args.microbatches,
@@ -149,7 +158,7 @@ def main() -> int:
             )
         train_step = make_train_step(
             cfg, mesh, args.learning_rate, optimizer=optimizer,
-            accum_steps=args.accum_steps,
+            accum_steps=args.accum_steps, zero1=args.zero1,
         )
 
     state = None
@@ -165,7 +174,7 @@ def main() -> int:
         # double residency of model + optimizer state during resume
         abstract = abstract_train_state(
             rng, cfg, mesh, args.learning_rate, rules=rules,
-            optimizer=optimizer,
+            optimizer=optimizer, zero1=args.zero1,
         )
         state = restore_checkpoint(args.checkpoint_dir, abstract)
         if state is not None:
@@ -174,7 +183,7 @@ def main() -> int:
     if state is None:
         state = init_train_state(
             rng, cfg, mesh, args.learning_rate, rules=rules,
-            optimizer=optimizer,
+            optimizer=optimizer, zero1=args.zero1,
         )
 
     client = None
