@@ -200,74 +200,101 @@ def sample_logits(
 ) -> jax.Array:
     """Sample token ids from [batch, vocab] logits.
 
-    ``top_k`` and ``top_p`` may be traced scalars — both filters are
-    static-shape masks over one shared sorted copy of the logits, so
-    arbitrary per-request values run in a single compiled program.
-    top-k keeps the k highest logits (k <= 0 keeps all; ties at the
-    k-th value all survive); nucleus keeps the smallest set of tokens
-    whose probability mass reaches p (the top token always survives;
-    p outside (0,1) keeps all). ``None`` disables a filter statically,
-    skipping the sort when both are off.
+    Every sampling knob may be a traced scalar OR a per-row [batch]
+    array — both filters are static-shape masks over one shared sorted
+    copy of the logits, so arbitrary per-request values run in a single
+    compiled program, and co-batched requests can each carry their own
+    settings. A row whose temperature is <= 0 decodes greedily
+    (argmax). top-k keeps the k highest logits (k <= 0 keeps all; ties
+    at the k-th value all survive); nucleus keeps the smallest set of
+    tokens whose probability mass reaches p (the top token always
+    survives; p outside (0,1) keeps all). ``None`` disables a filter
+    statically, skipping the sort when both are off.
+
+    ``key`` is one PRNG key shared by the batch, or [batch] stacked
+    per-row keys (``jax.random.split`` output) — per-row keys make each
+    row's draw independent of what it is batched with.
     """
-    logits = logits.astype(jnp.float32) / temperature
+    b, vocab = logits.shape
+    t = jnp.broadcast_to(
+        jnp.asarray(temperature, jnp.float32), (b,)
+    )[:, None]
+    raw = logits.astype(jnp.float32)
+    x = raw / jnp.maximum(t, 1e-6)
     if top_k is not None or top_p is not None:
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        sorted_logits = jnp.sort(x, axis=-1)[:, ::-1]
         keep = jnp.ones(sorted_logits.shape, bool)
         if top_k is not None:
-            vocab = logits.shape[-1]
-            k = jnp.where(top_k > 0, top_k, vocab)
+            k = jnp.broadcast_to(
+                jnp.asarray(top_k, jnp.int32), (b,)
+            )[:, None]
+            k = jnp.where(k > 0, k, vocab)
             keep &= jnp.arange(vocab)[None, :] < k
         if top_p is not None:
-            p = jnp.where((top_p > 0.0) & (top_p < 1.0), top_p, 1.0)
+            p = jnp.broadcast_to(
+                jnp.asarray(top_p, jnp.float32), (b,)
+            )[:, None]
+            p = jnp.where((p > 0.0) & (p < 1.0), p, 1.0)
             probs = jax.nn.softmax(sorted_logits, axis=-1)
             keep &= (jnp.cumsum(probs, axis=-1) - probs) < p
         threshold = jnp.min(
             jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
         )
-        logits = jnp.where(logits < threshold, NEG_INF, logits)
-    return jax.random.categorical(key, logits, axis=-1)
+        x = jnp.where(x < threshold, NEG_INF, x)
+    if key.ndim > 1:  # stacked per-row keys
+        sampled = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row)
+        )(key, x)
+    else:
+        sampled = jax.random.categorical(key, x, axis=-1)
+    return jnp.where(t[:, 0] <= 0.0, jnp.argmax(raw, axis=-1), sampled)
 
 
 @functools.lru_cache(maxsize=32)
 def _jitted_generate(cfg: TransformerConfig, max_new_tokens: int,
                      max_len: int, greedy: bool, filtered: bool):
     """One compiled program per (config, lengths, sampling mode); jit's
-    own cache covers distinct prompt lengths. Everything
-    request-controlled that doesn't change shapes (temperature, top_k,
-    top_p, eos_id, pad_id) is a traced operand, so per-request
-    variation can't churn this cache."""
+    own cache covers distinct prompt lengths and batch sizes.
+    Everything request-controlled that doesn't change shapes
+    (temperature, top_k, top_p, eos_id, pad_id — all per-row arrays)
+    is a traced operand, so per-request variation can't churn this
+    cache, and co-batched requests keep independent settings. Each row
+    samples from its own key (fold_in per step), so a row's output
+    never depends on what it was batched with."""
 
-    def fn(params, prompt, rng, temperature, top_k, top_p, eos_id,
+    def fn(params, prompt, row_keys, temperature, top_k, top_p, eos_id,
            pad_id):
         logits, cache = prefill(params, prompt, cfg, max_len)
 
-        def sample(logits, key):
+        def sample(logits, step_idx):
             if greedy:
                 return jnp.argmax(logits, axis=-1)
+            keys = jax.vmap(
+                lambda k: jax.random.fold_in(k, step_idx)
+            )(row_keys)
             return sample_logits(
-                logits, key, temperature,
+                logits, keys, temperature,
                 top_k if filtered else None,
                 top_p if filtered else None,
             )
 
-        first_key, scan_key = jax.random.split(rng)
-        first = sample(logits, first_key).astype(jnp.int32)
+        first = sample(logits, jnp.int32(0)).astype(jnp.int32)
         # rows that have emitted eos keep decoding (static shapes) but
         # emit pad from then on; eos_id == -1 disables the early stop
         # dynamically (token ids are non-negative, so it never matches)
         done = first == eos_id
 
-        def step(carry, key):
+        def step(carry, step_idx):
             cache, token, done = carry
             logits, cache = decode_step(params, cache, token, cfg)
-            next_token = sample(logits, key).astype(jnp.int32)
+            next_token = sample(logits, step_idx).astype(jnp.int32)
             next_token = jnp.where(done, pad_id, next_token)
             done = done | (next_token == eos_id)
             return (cache, next_token, done), next_token
 
-        keys = jax.random.split(scan_key, max_new_tokens - 1)
         (_cache, _last, _done), rest = lax.scan(
-            step, (cache, first, done), keys
+            step, (cache, first, done),
+            jnp.arange(1, max_new_tokens, dtype=jnp.int32),
         )
         return jnp.concatenate([first[:, None], rest.T], axis=1)
 
@@ -280,20 +307,43 @@ def generate(
     cfg: TransformerConfig,
     max_new_tokens: int,
     max_len: int,
-    temperature: float = 0.0,
+    temperature=0.0,
     rng: jax.Array = None,
-    top_k: int = 0,
-    top_p: float = 0.0,
-    eos_id: int = -1,
-    pad_id: int = 0,
+    top_k=0,
+    top_p=0.0,
+    eos_id=-1,
+    pad_id=0,
 ) -> jax.Array:
     """Autoregressive generation. prompt: [batch, prompt_len] int32;
     returns [batch, max_new_tokens] int32.
 
-    ``top_k``/``top_p`` filter the sampling distribution (0 disables
-    either; both compose, top-k first). ``eos_id >= 0`` enables early
-    stop: once a row samples eos, the rest of that row is ``pad_id``.
+    Every sampling knob accepts a scalar or a per-row [batch] sequence
+    (so a serving batcher can coalesce requests with different
+    settings). ``top_k``/``top_p`` filter the sampling distribution
+    (0 disables either; both compose). A row with temperature <= 0
+    decodes greedily. ``eos_id >= 0`` enables early stop: once a row
+    samples eos, the rest of that row is ``pad_id``. ``rng`` is one
+    key (split per row internally) or [batch] stacked per-row keys —
+    per-row keys keep each row's output independent of co-batched
+    rows.
     """
+    import numpy as np
+
+    b = prompt.shape[0]
+
+    def row(v, dtype, name):
+        arr = np.asarray(jax.device_get(v), dtype)
+        if arr.ndim == 0:
+            arr = np.full((b,), arr)
+        if arr.shape != (b,):
+            raise ValueError(f"{name} must be a scalar or [batch] array")
+        return arr
+
+    t = row(temperature, np.float32, "temperature")
+    k_arr = row(top_k, np.int64, "top_k")
+    p_arr = row(top_p, np.float64, "top_p")
+    eos_arr = row(eos_id, np.int64, "eos_id")
+    pad_arr = row(pad_id, np.int64, "pad_id")
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
     if prompt.shape[1] + max_new_tokens > max_len:
@@ -303,27 +353,39 @@ def generate(
             f"prompt_len {prompt.shape[1]} + max_new_tokens "
             f"{max_new_tokens} exceeds max_len {max_len}"
         )
-    if not 0 <= top_k <= cfg.vocab_size or not 0.0 <= top_p <= 1.0:
+    if (
+        (k_arr < 0).any() or (k_arr > cfg.vocab_size).any()
+        or (p_arr < 0.0).any() or (p_arr > 1.0).any()
+    ):
         raise ValueError(
             f"top_k must be in [0, vocab {cfg.vocab_size}] and "
             "top_p in [0, 1]"
         )
-    if eos_id >= cfg.vocab_size or not 0 <= pad_id < cfg.vocab_size:
+    if (eos_arr >= cfg.vocab_size).any() or (
+        (pad_arr < 0) | (pad_arr >= cfg.vocab_size)
+    ).any():
         raise ValueError(
             f"eos_id (< 0 disables) and pad_id must be < vocab "
             f"{cfg.vocab_size}, pad_id non-negative"
         )
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    greedy = temperature <= 0.0
+    row_keys = rng if rng.ndim > 1 else jax.random.split(rng, b)
+    if row_keys.shape[0] != b:
+        raise ValueError(f"rng must be one key or {b} stacked keys")
+    greedy = bool((t <= 0.0).all())
     if greedy:
-        top_k, top_p = 0, 0.0  # dead under argmax; normalize the key
-    fn = _jitted_generate(
-        cfg, max_new_tokens, max_len, greedy,
-        top_k > 0 or 0.0 < top_p < 1.0,
+        # dead under argmax; normalize so the compile key can't churn
+        k_arr = np.zeros_like(k_arr)
+        p_arr = np.zeros_like(p_arr)
+    filtered = bool(
+        ((k_arr > 0) | ((p_arr > 0.0) & (p_arr < 1.0))).any()
     )
+    fn = _jitted_generate(cfg, max_new_tokens, max_len, greedy, filtered)
     return fn(
-        params, prompt, rng, jnp.float32(max(temperature, 1e-6)),
-        jnp.int32(top_k), jnp.float32(top_p),
-        jnp.int32(max(eos_id, -1)), jnp.int32(pad_id),
+        params, prompt, row_keys,
+        jnp.asarray(t, jnp.float32), jnp.asarray(k_arr, jnp.int32),
+        jnp.asarray(p_arr, jnp.float32),
+        jnp.asarray(np.maximum(eos_arr, -1), jnp.int32),
+        jnp.asarray(pad_arr, jnp.int32),
     )

@@ -26,7 +26,8 @@ import asyncio
 import json
 import logging
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, Optional
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -62,6 +63,21 @@ def _parse_token_rows(body: Dict[str, Any], vocab: int, min_row_len: int):
     return tokens, row_len
 
 
+@dataclass
+class _GenJob:
+    """One /v1/generate request waiting in the batcher queue."""
+
+    rows: List[List[int]]
+    prompt_len: int
+    max_new: int  # bucketed compiled length
+    temperature: float
+    top_k: int
+    top_p: float
+    eos_id: int
+    seed: int
+    future: "asyncio.Future[List[List[int]]]" = field(repr=False, default=None)
+
+
 class InferenceServer:
     def __init__(
         self,
@@ -72,6 +88,7 @@ class InferenceServer:
         max_len: int,
         draft_layers: int = 0,
         speculate: int = 4,
+        max_batch_rows: int = 16,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -101,6 +118,12 @@ class InferenceServer:
         self._server.route("POST", "/v1/generate", self._generate)
         self._server.route("POST", "/v1/score", self._score)
         self._score_fn = None  # jitted lazily; jit caches per length
+        # continuous batching: requests queue here and the batcher
+        # coalesces whatever accumulated while the device was busy
+        self.max_batch_rows = max_batch_rows
+        self._gen_queue: "asyncio.Queue[_GenJob]" = asyncio.Queue()
+        self._batcher: Optional["asyncio.Task[None]"] = None
+        self.batch_stats = {"calls": 0, "rows": 0}  # device-call count
 
     # -- handlers -------------------------------------------------------
 
@@ -158,41 +181,38 @@ class InferenceServer:
         except (ValueError, KeyError, TypeError) as exc:
             return Response(422, f"{exc}\n".encode())
 
-        def run() -> Any:
-            prompt = jnp.asarray(tokens, jnp.int32)
-            if (
-                self.draft_params is not None
-                and temperature <= 0.0
-                and prompt.shape[0] == 1
-            ):
-                # greedy single-sequence: draft-and-verify, identical
-                # output, ~accepted-per-round fewer target passes. An
-                # eos trim below applies the same truncation the
-                # padded greedy path would get.
+        if (
+            self.draft_params is not None
+            and temperature <= 0.0
+            and len(tokens) == 1
+        ):
+            # greedy single-sequence: draft-and-verify, identical
+            # output, ~accepted-per-round fewer target passes. An eos
+            # trim below applies the same truncation the padded greedy
+            # path would get.
+            def run() -> Any:
                 from ..models.speculative import speculative_generate
 
                 out, _stats = speculative_generate(
-                    self.params, self.draft_params, prompt, self.cfg,
+                    self.params, self.draft_params,
+                    jnp.asarray(tokens, jnp.int32), self.cfg,
                     self.draft_cfg, max_new_tokens=max_new,
                     max_len=self.max_len, speculate=self.speculate,
                 )
-            else:
-                out = generate(
-                    self.params,
-                    prompt,
-                    self.cfg,
-                    max_new_tokens=max_new,
-                    max_len=self.max_len,
-                    temperature=temperature,
-                    rng=jax.random.PRNGKey(seed),
-                    top_k=top_k,
-                    top_p=top_p,
-                    eos_id=eos_id,
-                )
-            return jax.device_get(out[:, :max_new_requested]).tolist()
+                return jax.device_get(out).tolist()
 
-        loop = asyncio.get_event_loop()
-        generated = await loop.run_in_executor(self._executor, run)
+            loop = asyncio.get_event_loop()
+            generated = await loop.run_in_executor(self._executor, run)
+        else:
+            job = _GenJob(
+                rows=tokens, prompt_len=prompt_len, max_new=max_new,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_id=eos_id, seed=seed,
+                future=asyncio.get_event_loop().create_future(),
+            )
+            await self._gen_queue.put(job)
+            generated = await job.future
+        generated = [r[:max_new_requested] for r in generated]
         if eos_id >= 0:
             # trim each row at its first eos (inclusive); the model
             # emitted pad beyond it anyway
@@ -252,6 +272,120 @@ class InferenceServer:
             content_type="application/json",
         )
 
+    # -- continuous batching -------------------------------------------
+
+    async def _batch_loop(self) -> None:
+        """Drain whatever requests queued while the device was busy,
+        group the compatible ones (same prompt length and compiled
+        decode length), and run each group as ONE device call with
+        per-row sampling params. Per-row PRNG keys derive from each
+        request's own seed, so a request's output never depends on
+        what it happened to be batched with (tested)."""
+        carry: Optional[_GenJob] = None
+        try:
+            while True:
+                first = (
+                    carry if carry is not None
+                    else await self._gen_queue.get()
+                )
+                carry = None
+                jobs = [first]
+                rows = len(first.rows)
+                # cap by ROW count (a request may carry several rows);
+                # a job that would overflow carries to the next drain
+                while (
+                    rows < self.max_batch_rows
+                    and not self._gen_queue.empty()
+                ):
+                    nxt = self._gen_queue.get_nowait()
+                    if rows + len(nxt.rows) > self.max_batch_rows:
+                        carry = nxt
+                        break
+                    jobs.append(nxt)
+                    rows += len(nxt.rows)
+                groups: Dict[Any, List[_GenJob]] = {}
+                for job in jobs:
+                    groups.setdefault(
+                        (job.prompt_len, job.max_new), []
+                    ).append(job)
+                for group in groups.values():
+                    await self._run_group(group)
+        finally:
+            # cancellation with a carried-over job in hand: fail it so
+            # its handler doesn't await forever
+            if carry is not None and not carry.future.done():
+                carry.future.set_exception(RuntimeError("server stopping"))
+
+    async def _run_group(self, jobs: List[_GenJob]) -> None:
+        def run() -> List[List[int]]:
+            rows: List[List[int]] = []
+            temps: List[float] = []
+            ks: List[int] = []
+            ps: List[float] = []
+            eoss: List[int] = []
+            keys = []
+            for job in jobs:
+                base = jax.random.PRNGKey(job.seed)
+                for i, r in enumerate(job.rows):
+                    rows.append(r)
+                    temps.append(job.temperature)
+                    ks.append(job.top_k)
+                    ps.append(job.top_p)
+                    eoss.append(job.eos_id)
+                    keys.append(jax.random.fold_in(base, i))
+            # bucket the batch dim to powers of two so concurrency
+            # spikes can't compile one program per row count
+            target = 1
+            while target < len(rows):
+                target *= 2
+            pad_rows = target - len(rows)
+            for _ in range(pad_rows):
+                rows.append([0] * len(rows[0]))
+                temps.append(0.0)
+                ks.append(0)
+                ps.append(0.0)
+                eoss.append(-1)
+                keys.append(jax.random.PRNGKey(0))
+            out = generate(
+                self.params,
+                jnp.asarray(rows, jnp.int32),
+                self.cfg,
+                max_new_tokens=jobs[0].max_new,
+                max_len=self.max_len,
+                temperature=temps,
+                rng=jnp.stack(keys),
+                top_k=ks,
+                top_p=ps,
+                eos_id=eoss,
+            )
+            n_real = len(rows) - pad_rows
+            return jax.device_get(out[:n_real]).tolist()
+
+        loop = asyncio.get_event_loop()
+        self.batch_stats["calls"] += 1
+        self.batch_stats["rows"] += sum(len(j.rows) for j in jobs)
+        try:
+            outs = await loop.run_in_executor(self._executor, run)
+        except asyncio.CancelledError:
+            # batcher cancelled mid-call (stop()): fail the waiters so
+            # their handlers don't hang forever, then propagate
+            for job in jobs:
+                if not job.future.done():
+                    job.future.set_exception(
+                        RuntimeError("server stopping")
+                    )
+            raise
+        except Exception as exc:  # surface as a per-request 500
+            for job in jobs:
+                if not job.future.done():
+                    job.future.set_exception(exc)
+            return
+        i = 0
+        for job in jobs:
+            if not job.future.done():  # waiter may have been cancelled
+                job.future.set_result(outs[i:i + len(job.rows)])
+            i += len(job.rows)
+
     # -- lifecycle ------------------------------------------------------
 
     async def warmup(self) -> None:
@@ -307,10 +441,26 @@ class InferenceServer:
     async def run(self) -> None:
         await self._server.start_tcp(self.host, self.port)
         self.port = self._server.bound_port or self.port
+        self._batcher = asyncio.get_event_loop().create_task(
+            self._batch_loop()
+        )
         log.info("serve: listening on %s:%d", self.host, self.port)
         await self.warmup()
 
     async def stop(self) -> None:
+        if self._batcher is not None:
+            self._batcher.cancel()
+            try:
+                await self._batcher
+            except asyncio.CancelledError:
+                pass
+            # fail anything still queued so no handler awaits forever
+            while not self._gen_queue.empty():
+                job = self._gen_queue.get_nowait()
+                if not job.future.done():
+                    job.future.set_exception(
+                        RuntimeError("server stopping")
+                    )
         await self._server.stop()
 
 
@@ -346,6 +496,11 @@ def main() -> int:
     parser.add_argument(
         "--speculate", type=int, default=4,
         help="draft tokens proposed per verify round",
+    )
+    parser.add_argument(
+        "--max-batch-rows", type=int, default=16,
+        help="continuous batching: max sequences coalesced into one "
+        "device call",
     )
     args = parser.parse_args()
 
@@ -390,6 +545,7 @@ def main() -> int:
     server = InferenceServer(
         cfg, params, args.host, args.port, args.max_len,
         draft_layers=args.draft_layers, speculate=args.speculate,
+        max_batch_rows=args.max_batch_rows,
     )
 
     async def serve() -> None:
