@@ -17,7 +17,9 @@ Two halves, matching what this framework is:
      the chip's bf16 peak);
    - pallas flash attention (fwd+bwd) vs the XLA einsum path at
      2k/4k/8k sequence lengths;
-   - int8 weight-quantized GEMM (pallas fused dequant) vs bf16.
+   - int8 weight-quantized GEMM (pallas fused dequant) vs bf16;
+   - KV-cache generation throughput at batch 1 vs batch 8 (the
+     continuous-batching multiplier).
 
 Prints ONE JSON line:
     {"metric": ..., "value": <median ms>, "unit": "ms",
@@ -264,6 +266,53 @@ def int8_bench() -> dict:
     }
 
 
+def decode_bench(cfg=None, max_new: int = 64, prompt_len: int = 128) -> dict:
+    """KV-cache generation throughput at serving shapes: batch 1 (the
+    latency regime) and batch 8 (the continuous-batching regime).
+    Decode streams the model's weights from HBM once per step no
+    matter how many rows ride along, so the b8/b1 ratio is the
+    throughput multiplier request coalescing buys. Each timed call is
+    a full generate(): prefill of the 128-token prompt + 64 greedy
+    decode steps through the jitted scan. ``cfg`` override exists for
+    the CPU plumbing test; the default is the measured config."""
+    import jax
+    import jax.numpy as jnp
+
+    from containerpilot_tpu.models.decode import generate
+    from containerpilot_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    label = "1.2B bf16"
+    if cfg is None:
+        cfg = TransformerConfig(
+            vocab_size=32768, d_model=2048, n_heads=16, n_layers=16,
+            d_ff=8192, max_seq_len=1024,
+        )  # ~1.2B params, ~2.4 GB bf16: decode is weight-streaming bound
+    else:
+        label = "override"
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    max_len = prompt_len + max_new * 2
+
+    def gen(prompt):
+        return generate(
+            params, prompt, cfg, max_new_tokens=max_new, max_len=max_len
+        )
+
+    out: dict = {
+        "model": f"{label}, prompt {prompt_len}, {max_new} new tokens"
+    }
+    for b in (1, 8):
+        prompt = jnp.ones((b, prompt_len), jnp.int32)
+        ms = _time_ms(gen, prompt, n=3)
+        out[f"b{b}_tok_s"] = round(b * max_new / (ms / 1e3), 1)
+    out["batch_throughput_x"] = round(
+        out["b8_tok_s"] / out["b1_tok_s"], 2
+    )
+    return out
+
+
 def _bench_subprocess(fn_name: str, timeout_s: int) -> dict:
     """Run one workload bench in its own interpreter with a hard
     timeout: TPU-tunnel wedges and compile-helper crashes then cost a
@@ -332,6 +381,7 @@ def workload_benches() -> dict:
         ("attention", "attention_bench", 900),
         ("int8_gemm", "int8_bench", 600),
         ("training", "training_bench", 1500),
+        ("decode", "decode_bench", 900),
     ):
         extras[name] = _bench_subprocess(fn_name, timeout_s)
     return extras

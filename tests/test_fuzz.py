@@ -138,3 +138,40 @@ def test_fuzz_token_shard_dataset(
     for row in a:
         deltas = np.diff(row.astype(np.int64)) % 97
         assert (deltas == 1).all()
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    seed=st.integers(0, 2**31 - 1),
+    top_k=st.integers(0, 12),
+    top_p=st.floats(0.0, 1.0),
+    temperature=st.floats(-1.0, 3.0),
+)
+def test_fuzz_sample_logits_invariants(seed, top_k, top_p, temperature):
+    """For any knob combination: the sampled id is in-vocab; a top-k
+    filter never yields an id ranked below the k-th logit (ties
+    allowed); temperature <= 0 is exactly argmax."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from containerpilot_tpu.models.decode import sample_logits
+
+    vocab = 12
+    key = jax.random.PRNGKey(seed)
+    logits = jax.random.normal(key, (3, vocab), jnp.float32) * 3.0
+    toks = np.asarray(
+        sample_logits(
+            logits, jax.random.PRNGKey(seed + 1),
+            jnp.float32(temperature),
+            top_k=jnp.int32(top_k), top_p=jnp.float32(top_p),
+        )
+    )
+    assert ((toks >= 0) & (toks < vocab)).all()
+    l_np = np.asarray(logits)
+    if temperature <= 0.0:
+        np.testing.assert_array_equal(toks, l_np.argmax(-1))
+    elif top_k > 0:
+        for row, tok in zip(l_np, toks):
+            kth = np.sort(row)[::-1][min(top_k, vocab) - 1]
+            assert row[tok] >= kth

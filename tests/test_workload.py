@@ -1157,6 +1157,27 @@ def test_inference_server_speculative(run):
     assert len(batched["tokens"]) == 2 and len(batched["tokens"][0]) == 4
 
 
+def test_decode_bench_plumbing():
+    """bench.py's decode benchmark must run end-to-end on the CPU
+    backend with an override config (the real run needs the chip, but
+    a broken bench should fail CI, not the round's bench artifact)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=2, n_layers=2, d_ff=128,
+        max_seq_len=512, dtype=jnp.float32,
+    )
+    out = bench.decode_bench(cfg, max_new=8, prompt_len=16)
+    assert out["b1_tok_s"] > 0 and out["b8_tok_s"] > 0
+    assert out["batch_throughput_x"] > 0
+    assert "override" in out["model"]
+
+
 def test_moe_forward_and_training():
     """Switch-MoE model: finite forward, aux loss present, loss drops
     under training, expert weights actually expert-parallel."""
