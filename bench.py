@@ -37,10 +37,8 @@ import logging
 import statistics
 import time
 
-logging.disable(logging.CRITICAL)
-
-from containerpilot_tpu.events import EventBus, GLOBAL_STARTUP  # noqa: E402
-from containerpilot_tpu.jobs import Job, JobConfig  # noqa: E402
+from containerpilot_tpu.events import EventBus, GLOBAL_STARTUP
+from containerpilot_tpu.jobs import Job, JobConfig
 
 BASELINE_MS = 35.0  # midpoint of the reference's documented 20-50ms
 CYCLES = 60
@@ -323,7 +321,8 @@ def _bench_subprocess(fn_name: str, timeout_s: int) -> dict:
     import sys
 
     code = (
-        "import json, bench; "
+        "import json, logging, bench; "
+        "logging.disable(logging.CRITICAL); "
         f"print('BENCH_RESULT ' + json.dumps(bench.{fn_name}()))"
     )
     try:
@@ -388,6 +387,10 @@ def workload_benches() -> dict:
 
 
 async def main() -> None:
+    # silence the supervisor's logging for the timed cycles — set here
+    # (not at import) so importing bench for tests has no global
+    # side effect on the host process's logging
+    logging.disable(logging.CRITICAL)
     median = await dispatch_bench()
     extras = workload_benches()
     print(

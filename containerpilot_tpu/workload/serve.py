@@ -141,6 +141,19 @@ class InferenceServer:
                 "n_kv_heads": self.cfg.kv_heads,
                 "n_layers": self.cfg.n_layers,
                 "max_len": self.max_len,
+                "speculative": (
+                    {
+                        "draft_layers": self.draft_cfg.n_layers,
+                        "speculate": self.speculate,
+                    }
+                    if self.draft_cfg is not None
+                    else None
+                ),
+                "batching": {
+                    "max_batch_rows": self.max_batch_rows,
+                    "device_calls": self.batch_stats["calls"],
+                    "rows": self.batch_stats["rows"],
+                },
             }
         ).encode()
         return Response(200, body, content_type="application/json")

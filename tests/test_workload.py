@@ -1144,29 +1144,35 @@ def test_inference_server_speculative(run):
                 "tokens": [[1, 2], [3, 4]], "max_new_tokens": 4,
             })
         )
+
+        def model_info():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{spec.port}/v1/model", timeout=5
+            ) as resp:
+                return json.loads(resp.read())
+
+        info = await loop.run_in_executor(None, model_info)
         await vanilla.stop()
         await spec.stop()
-        return a, b, ae, be, sampled, batched
+        return a, b, ae, be, sampled, batched, info
 
     import json
 
-    a, b, ae, be, sampled, batched = run(scenario(), timeout=300)
+    a, b, ae, be, sampled, batched, info = run(scenario(), timeout=300)
     assert a == b
     assert ae == be
     assert len(sampled["tokens"][0]) == 8
     assert len(batched["tokens"]) == 2 and len(batched["tokens"][0]) == 4
+    # observability: /v1/model reports the speculative + batching setup
+    assert info["speculative"] == {"draft_layers": 1, "speculate": 4}
+    assert info["batching"]["device_calls"] >= 2  # sampled + batched
 
 
 def test_decode_bench_plumbing():
     """bench.py's decode benchmark must run end-to-end on the CPU
     backend with an override config (the real run needs the chip, but
     a broken bench should fail CI, not the round's bench artifact)."""
-    import os
-    import sys
-
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    import bench
+    import bench  # conftest puts the repo root on sys.path
 
     cfg = TransformerConfig(
         vocab_size=128, d_model=64, n_heads=2, n_layers=2, d_ff=128,
