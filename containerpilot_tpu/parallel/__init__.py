@@ -26,6 +26,8 @@ from .train import (
     TrainState,
     abstract_train_state,
     init_train_state,
+    lora_abstract_state,
+    make_lora_train_step,
     make_optimizer,
     make_pipeline_train_step,
     make_train_step,
@@ -44,6 +46,8 @@ __all__ = [
     "abstract_train_state",
     "make_train_step",
     "init_train_state",
+    "lora_abstract_state",
+    "make_lora_train_step",
     "make_optimizer",
     "train_state_shardings",
     "save_checkpoint",

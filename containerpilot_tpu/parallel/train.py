@@ -343,6 +343,117 @@ def make_train_step(
     return run
 
 
+def lora_abstract_state(
+    cfg: TransformerConfig,
+    rank: int,
+    mesh: Mesh,
+    learning_rate: float = 1e-4,
+    optimizer: optax.GradientTransformation = None,
+) -> TrainState:
+    """Checkpoint-restore skeleton for a LoRA TrainState: adapter
+    pairs + optimizer state, every leaf replicated on ``mesh``. Used
+    by the trainer (resume) and by serve (params-only adapter
+    restore) — both must build it over the SAME mesh the base weights
+    live on, or the merge add commits to conflicting device sets."""
+    from ..models.lora import init_lora_params
+
+    optimizer = optimizer or make_optimizer(learning_rate)
+
+    def fresh(rng):
+        lora = init_lora_params(rng, cfg, rank)
+        return TrainState(
+            params=lora,
+            opt_state=optimizer.init(lora),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    replicated = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.ShapeDtypeStruct(
+            leaf.shape, leaf.dtype, sharding=replicated
+        ),
+        jax.eval_shape(fresh, jax.random.PRNGKey(0)),
+    )
+
+
+def make_lora_train_step(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    rank: int,
+    learning_rate: float = 1e-4,
+    optimizer: optax.GradientTransformation = None,
+    alpha: float = 2.0,
+):
+    """LoRA fine-tuning step: returns ``(init_fn, step_fn, abstract)``.
+
+    The TrainState's params are the (tiny, replicated) LoRA pairs;
+    the sharded base params ride along as a frozen operand —
+    ``step_fn(state, base_params, tokens)``. Gradients are taken only
+    w.r.t. the LoRA pytree (the base is frozen by construction), so
+    optimizer state is ~2*d*rank per target per layer instead of a
+    full model copy. ``abstract`` is the checkpoint-restore target for
+    resuming (same contract as abstract_train_state).
+    """
+    from ..models.lora import apply_lora, init_lora_params
+
+    if cfg.attention_fn is None and mesh.size > 1 and "seq" not in mesh.axis_names:
+        from .context import flash_parallel_config
+
+        cfg = flash_parallel_config(cfg, mesh)
+    optimizer = optimizer or make_optimizer(learning_rate)
+    data_sharding = NamedSharding(mesh, batch_spec())
+    abstract = lora_abstract_state(
+        cfg, rank, mesh, learning_rate, optimizer
+    )
+    state_shardings = jax.tree_util.tree_map(
+        lambda leaf: leaf.sharding, abstract
+    )
+
+    def init_fn(rng) -> TrainState:
+        lora = init_lora_params(rng, cfg, rank)
+        state = TrainState(
+            params=lora,
+            opt_state=optimizer.init(lora),
+            step=jnp.zeros((), jnp.int32),
+        )
+        return jax.tree_util.tree_map(
+            jax.device_put, state, state_shardings
+        )
+
+    def loss_of(lora, base, tokens):
+        return loss_fn(apply_lora(base, lora, cfg, alpha), tokens, cfg)
+
+    def step_fn(state: TrainState, base: Any, tokens: jax.Array):
+        loss, grads = jax.value_and_grad(loss_of)(
+            state.params, base, tokens
+        )
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        new_lora = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(
+                params=new_lora,
+                opt_state=new_opt_state,
+                step=state.step + 1,
+            ),
+            loss,
+        )
+
+    jitted = jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, None, data_sharding),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,),
+    )
+
+    def run(state: TrainState, base: Any, tokens: jax.Array):
+        with mesh:
+            return jitted(state, base, tokens)
+
+    return init_fn, run, abstract
+
+
 def make_pipeline_train_step(
     cfg: TransformerConfig,
     mesh: Mesh,

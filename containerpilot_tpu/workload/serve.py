@@ -501,6 +501,16 @@ def main() -> int:
         help="weight-only int8: ~4x smaller resident params",
     )
     parser.add_argument(
+        "--lora-dir", default="",
+        help="merge a trained LoRA adapter checkpoint into the base "
+        "weights at startup (zero runtime overhead); requires "
+        "--lora-rank to match the adapter",
+    )
+    parser.add_argument(
+        "--lora-rank", type=int, default=0,
+        help="rank of the adapter in --lora-dir",
+    )
+    parser.add_argument(
         "--draft-layers", type=int, default=0,
         help="self-speculative decoding: draft with the model's first "
         "N layers; greedy single-sequence requests decode several "
@@ -545,6 +555,34 @@ def main() -> int:
             print(f"serving checkpoint step {int(step)}")
     if params is None:
         params = init_params(jax.random.PRNGKey(0), cfg)
+    if args.lora_rank > 0 and not args.lora_dir:
+        raise SystemExit("--lora-rank without --lora-dir does nothing; "
+                         "pass the adapter checkpoint dir")
+    if args.lora_dir:
+        if args.lora_rank < 1:
+            raise SystemExit("--lora-dir requires --lora-rank")
+        from ..models.lora import apply_lora
+        from ..parallel import (
+            lora_abstract_state,
+            make_mesh,
+            restore_params,
+        )
+
+        # the adapter must land on the SAME mesh the base weights use
+        # (make_mesh() == all local devices, matching the
+        # --checkpoint-dir restore above); a mismatched device set
+        # makes the merge add uncompilable
+        restored_lora = restore_params(
+            args.lora_dir,
+            lora_abstract_state(cfg, args.lora_rank, make_mesh()),
+        )
+        if restored_lora is None:
+            raise SystemExit(f"no adapter checkpoint in {args.lora_dir}")
+        lora, lora_step_n = restored_lora
+        # merge BEFORE any quantization: int8 bases aren't adaptable
+        params = apply_lora(params, lora, cfg)
+        print(f"merged lora adapter (rank {args.lora_rank}, "
+              f"step {int(lora_step_n)})")
     if args.int8:
         from ..models.quantized import param_bytes, quantize_model_params
 

@@ -72,6 +72,14 @@ def main() -> int:
     parser.add_argument("--decay-steps", type=int, default=0,
                         help="cosine-decay the lr to 10%% of peak over "
                         "N post-warmup steps (0 = constant)")
+    parser.add_argument("--lora-rank", type=int, default=0,
+                        help="LoRA fine-tuning: train rank-R adapters "
+                        "on attention q/v with the base frozen "
+                        "(0 = full training)")
+    parser.add_argument("--base-checkpoint-dir", default="",
+                        help="with --lora-rank: frozen base weights "
+                        "from this checkpoint (params-only restore); "
+                        "default is a fresh init (demo)")
     parser.add_argument("--zero1", action="store_true",
                         help="ZeRO-1: shard adam moments over the data "
                         "axis; optimizer memory per device drops by "
@@ -132,7 +140,44 @@ def main() -> int:
         warmup_steps=args.warmup_steps,
         decay_steps=args.decay_steps,
     )
-    if args.pipeline_stages > 1:
+    lora_init = lora_abstract = None
+    if args.lora_rank > 0:
+        if args.pipeline_stages > 1 or args.zero1 or args.accum_steps > 1:
+            raise SystemExit(
+                "--lora-rank composes with the plain trainer only "
+                "(the adapter state is tiny; zero1/accum/pipeline "
+                "solve problems LoRA doesn't have)"
+            )
+        from ..models.transformer import init_params
+        from ..parallel import make_lora_train_step, restore_params
+        from ..parallel.sharding import shard_params
+
+        if args.base_checkpoint_dir:
+            from ..parallel import abstract_train_state
+
+            restored_base = restore_params(
+                args.base_checkpoint_dir,
+                abstract_train_state(rng, cfg, mesh, args.learning_rate),
+            )
+            if restored_base is None:
+                raise SystemExit(
+                    f"no checkpoint in {args.base_checkpoint_dir}"
+                )
+            base_params, base_step = restored_base
+            print(f"lora: frozen base from checkpoint step {int(base_step)}")
+        else:
+            base_params = shard_params(init_params(rng, cfg), mesh, cfg)
+            print("lora: fresh-init frozen base (demo mode)")
+        lora_init, lora_step, lora_abstract = make_lora_train_step(
+            cfg, mesh, args.lora_rank, args.learning_rate,
+            optimizer=optimizer,
+        )
+        print(f"lora: rank {args.lora_rank} adapters on attention q/v")
+
+        def train_step(state, tokens):
+            return lora_step(state, base_params, tokens)
+
+    elif args.pipeline_stages > 1:
         from ..parallel import pipeline_sharding_rules
 
         if args.accum_steps > 1:
@@ -172,18 +217,26 @@ def main() -> int:
 
         # restore into the eval_shape skeleton: no throwaway init, no
         # double residency of model + optimizer state during resume
-        abstract = abstract_train_state(
-            rng, cfg, mesh, args.learning_rate, rules=rules,
-            optimizer=optimizer, zero1=args.zero1,
+        abstract = (
+            lora_abstract
+            if lora_abstract is not None
+            else abstract_train_state(
+                rng, cfg, mesh, args.learning_rate, rules=rules,
+                optimizer=optimizer, zero1=args.zero1,
+            )
         )
         state = restore_checkpoint(args.checkpoint_dir, abstract)
         if state is not None:
             start_step = int(state.step)
             print(f"resumed from checkpoint at step {start_step}")
     if state is None:
-        state = init_train_state(
-            rng, cfg, mesh, args.learning_rate, rules=rules,
-            optimizer=optimizer, zero1=args.zero1,
+        state = (
+            lora_init(rng)
+            if lora_init is not None
+            else init_train_state(
+                rng, cfg, mesh, args.learning_rate, rules=rules,
+                optimizer=optimizer, zero1=args.zero1,
+            )
         )
 
     client = None
@@ -290,7 +343,14 @@ def main() -> int:
                 print(f"step {step + 1}: loss={float(loss):.4f} "
                       f"({rate:.1f} steps/s)")
             if eval_step is not None and (step + 1) % args.eval_every == 0:
-                eval_loss = run_eval(state.params)
+                if args.lora_rank > 0:
+                    from ..models.lora import apply_lora
+
+                    eval_loss = run_eval(
+                        apply_lora(base_params, state.params, cfg)
+                    )
+                else:
+                    eval_loss = run_eval(state.params)
                 print(f"step {step + 1}: eval_loss={eval_loss:.4f}")
                 if client is not None:
                     try:
