@@ -281,6 +281,31 @@ class CatalogServer:
             if entry.status == "passing" and entry.ttl > 0:
                 entry.expires = time.time() + entry.ttl
             return Response(200, b"")
+        if req.method == "GET" and req.path == "/metrics":
+            # prometheus exposition for the catalog daemon itself, so a
+            # supervised cp-catalogd is scrapeable like everything else
+            now = time.time()
+            by_status: Dict[str, int] = {}
+            for entry in self._entries.values():
+                status = entry.effective_status(now)
+                by_status[status] = by_status.get(status, 0) + 1
+            # one labeled family only: the total is sum(by status),
+            # so an unlabeled twin would double-count aggregations
+            lines = ["# TYPE cp_catalog_services gauge"]
+            for status in ("passing", "warning", "critical"):
+                lines.append(
+                    f'cp_catalog_services{{status="{status}"}} '
+                    f"{by_status.get(status, 0)}"
+                )
+            lines.append("# TYPE cp_catalog_snapshot_enabled gauge")
+            lines.append(
+                f"cp_catalog_snapshot_enabled "
+                f"{1 if self.snapshot_path else 0}"
+            )
+            return Response(
+                200, ("\n".join(lines) + "\n").encode(),
+                content_type="text/plain; version=0.0.4",
+            )
         if req.method == "GET" and req.path.startswith("/v1/health/service/"):
             name = urllib.parse.unquote(req.path.rsplit("/", 1)[-1])
             passing_only = req.query.get("passing", ["0"])[0] not in ("0", "")

@@ -2,8 +2,9 @@
 
 Fine-tuning the flagship model normally costs 3x its parameter memory
 (master weights + adam mu/nu). LoRA freezes the base weights and
-learns low-rank deltas `W' = W + (alpha/r) * A @ B` on the attention
-q/v projections (the classic target set): trainable state shrinks to
+learns low-rank deltas `W' = W + alpha * A @ B` on the attention
+q/v projections (the classic target set; A's 1/sqrt(r) init keeps the
+delta's starting scale rank-independent): trainable state shrinks to
 ~2*d*r per target per layer, so optimizer memory is negligible and
 many adapters can share one frozen base.
 

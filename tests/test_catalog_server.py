@@ -226,3 +226,33 @@ def test_snapshot_does_not_resurrect_expired_service(run, tmp_path):
             await reborn.stop()
 
     assert run(scenario(), timeout=30) == []
+
+
+def test_catalog_metrics_endpoint(run):
+    import urllib.request
+
+    async def scenario():
+        server = CatalogServer("127.0.0.1", PORT)
+        await server.run()
+        backend = ConsulBackend(address=f"127.0.0.1:{PORT}")
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(None, lambda: backend.service_register(
+            ServiceRegistration(id="m-h1", name="m", port=80,
+                                address="10.0.0.12", ttl=30),
+            status="passing",
+        ))
+
+        def fetch():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{PORT}/metrics", timeout=5
+            ) as resp:
+                return resp.read().decode()
+
+        body = await loop.run_in_executor(None, fetch)
+        await server.stop()
+        return body
+
+    body = run(scenario(), timeout=30)
+    assert 'cp_catalog_services{status="passing"} 1' in body
+    assert 'cp_catalog_services{status="critical"} 0' in body
+    assert "cp_catalog_snapshot_enabled 0" in body
