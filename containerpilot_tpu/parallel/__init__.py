@@ -21,7 +21,11 @@ from .pipeline import (
     pipeline_loss_fn,
     pipeline_sharding_rules,
 )
-from .sharding import param_sharding_rules, shard_params
+from .sharding import (
+    fsdp_sharding_rules,
+    param_sharding_rules,
+    shard_params,
+)
 from .train import (
     TrainState,
     abstract_train_state,
@@ -40,6 +44,7 @@ __all__ = [
     "flash_parallel_config",
     "make_pipeline_train_step",
     "make_mesh",
+    "fsdp_sharding_rules",
     "param_sharding_rules",
     "shard_params",
     "TrainState",

@@ -177,6 +177,8 @@ def train_state_shardings(
     def with_data_axis(spec: P, shape) -> P:
         """Put ``data`` on the first unsharded dim that divides."""
         entries = list(spec) + [None] * (len(shape) - len(spec))
+        if "data" in entries:
+            return spec  # fsdp rules already consumed the data axis
         for i, (entry, dim) in enumerate(zip(entries, shape)):
             if entry is None and dim % data_size == 0 and dim > 0:
                 entries[i] = "data"
@@ -252,6 +254,8 @@ def make_train_step(
     optimizer: optax.GradientTransformation = None,
     accum_steps: int = 1,
     zero1: bool = False,
+    fsdp: bool = False,
+    rules: Any = None,
 ) -> Callable[[TrainState, jax.Array], Tuple[TrainState, jax.Array]]:
     """Build the jitted, donated, sharded train step.
 
@@ -259,6 +263,12 @@ def make_train_step(
     stage 1) — optimizer memory per device drops by the data-parallel
     factor; XLA swaps the grad all-reduce for reduce-scatter +
     all-gather around the partitioned optimizer math.
+
+    ``fsdp`` shards params/grads/moments themselves over ``data``
+    (ZeRO-3; sharding.fsdp_sharding_rules) — per-device model state
+    drops by the dp factor and XLA all-gathers weights at each use.
+    ``rules`` overrides the param specs outright (rare; fsdp wins if
+    both are given).
 
     ``accum_steps > 1`` runs gradient accumulation: the batch splits
     into that many sequential chunks inside one compiled step
@@ -277,10 +287,15 @@ def make_train_step(
         raise ValueError("accum_steps must be >= 1")
     optimizer = optimizer or make_optimizer(learning_rate)
     data_sharding = NamedSharding(mesh, batch_spec())
+    if fsdp:
+        from .sharding import fsdp_sharding_rules
+
+        rules = fsdp_sharding_rules(cfg, mesh, rules)
     # pin the state's placement on both sides of the step so shardings
     # can never drift from the rules across steps/restores
     state_shardings = train_state_shardings(
-        cfg, mesh, learning_rate, optimizer=optimizer, zero1=zero1
+        cfg, mesh, learning_rate, optimizer=optimizer, zero1=zero1,
+        rules=rules,
     )
 
     def grads_of(params, tokens):

@@ -84,6 +84,11 @@ def main() -> int:
                         help="ZeRO-1: shard adam moments over the data "
                         "axis; optimizer memory per device drops by "
                         "the data-parallel factor")
+    parser.add_argument("--fsdp", action="store_true",
+                        help="FSDP (ZeRO-3): shard params, grads, AND "
+                        "moments over the data axis; per-device model "
+                        "state drops by the dp factor, XLA all-gathers "
+                        "weights at each use (subsumes --zero1)")
     parser.add_argument("--accum-steps", type=int, default=1,
                         help="gradient accumulation: split each batch "
                         "into N sequential chunks inside the compiled "
@@ -142,10 +147,11 @@ def main() -> int:
     )
     lora_init = lora_abstract = None
     if args.lora_rank > 0:
-        if args.pipeline_stages > 1 or args.zero1 or args.accum_steps > 1:
+        if (args.pipeline_stages > 1 or args.zero1 or args.fsdp
+                or args.accum_steps > 1):
             raise SystemExit(
                 "--lora-rank composes with the plain trainer only "
-                "(the adapter state is tiny; zero1/accum/pipeline "
+                "(the adapter state is tiny; zero1/fsdp/accum/pipeline "
                 "solve problems LoRA doesn't have)"
             )
         from ..models.transformer import init_params
@@ -185,10 +191,11 @@ def main() -> int:
                 "--accum-steps composes with the plain trainer only; "
                 "pipeline microbatching already bounds activations"
             )
-        if args.zero1:
+        if args.zero1 or args.fsdp:
             raise SystemExit(
-                "--zero1 composes with the plain trainer only (pipeline "
-                "sharding rules already partition state over stages)"
+                "--zero1/--fsdp compose with the plain trainer only "
+                "(pipeline sharding rules already partition state over "
+                "stages)"
             )
         rules = pipeline_sharding_rules(cfg, mesh)
         train_step = make_pipeline_train_step(
@@ -201,9 +208,14 @@ def main() -> int:
                 f"--batch {args.batch} not divisible by --accum-steps "
                 f"{args.accum_steps}"
             )
+        if args.fsdp:
+            from ..parallel import fsdp_sharding_rules
+
+            rules = fsdp_sharding_rules(cfg, mesh)
         train_step = make_train_step(
             cfg, mesh, args.learning_rate, optimizer=optimizer,
             accum_steps=args.accum_steps, zero1=args.zero1,
+            rules=rules,
         )
 
     state = None
