@@ -354,6 +354,14 @@ def _teardown_cli(proc, timeout=30):
     proc._log_f.close()
 
 
+def _free_port():
+    import socket as socketlib
+
+    with socketlib.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def _wait_for(path, deadline_s=30, what="sentinel"):
     deadline = time.monotonic() + deadline_s
     while not os.path.exists(str(path)):
@@ -464,15 +472,8 @@ def test_two_supervisors_discover_via_catalog(tmp_path):
     health-checked service, B's watch observes it appear and fires the
     dependent job (reference:
     integration_tests/tests/test_discovery_consul)."""
-    import socket as socketlib
-
-    def free_port():
-        with socketlib.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
-
-    catalog_port = free_port()
-    svc_port = free_port()
+    catalog_port = _free_port()
+    svc_port = _free_port()
     seen = tmp_path / "seen"
     a_started = tmp_path / "a_started"
 
@@ -552,15 +553,9 @@ def test_catalog_server_snapshot_survives_restart(tmp_path):
     the supervised-catalog self-heal story (a catalog restart no longer
     blanks the pod's view until every host re-heartbeats)."""
     import json as json_mod
-    import socket as socketlib
     import urllib.request
 
-    def free_port():
-        with socketlib.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
-
-    port = free_port()
+    port = _free_port()
     snap = tmp_path / "catalog.json"
 
     def spawn():
@@ -649,5 +644,118 @@ def test_periodic_task_through_cli(tmp_path):
             time.sleep(0.05)
         proc.terminate()
         assert proc.wait(timeout=30) == 0
+    finally:
+        _teardown_cli(proc)
+
+
+def test_telemetry_metrics_e2e(tmp_path):
+    """Reference integration test_telemetry: a sensor job reports a
+    custom metric through `-putmetric`, and /metrics (real HTTP, real
+    CLI) exposes it alongside the built-in supervisor metrics;
+    /status reports the jobs (reference:
+    integration_tests/tests/test_telemetry/check.sh)."""
+    import json as jsonlib
+    import urllib.request
+
+    port = _free_port()
+    socket_path = str(tmp_path / "cp.socket")
+    started = tmp_path / "started"
+    cfg = write_config(
+        tmp_path,
+        """
+        {
+          consul: "file:%s",
+          stopTimeout: "1ms",
+          control: { socket: "%s" },
+          telemetry: {
+            port: %d,
+            interfaces: ["static:127.0.0.1"],
+            metrics: [
+              { name: "sensor_reading", help: "fake sensor",
+                type: "gauge" },
+            ],
+          },
+          jobs: [
+            { name: "main",
+              exec: ["/bin/sh", "-c", "touch %s; exec sleep 60"] },
+            { name: "sensor",
+              exec: ["%s", "-m", "containerpilot_tpu",
+                     "-putmetric", "sensor_reading=42.5",
+                     "-config", "{{ .CP_CONFIG }}"] },
+          ],
+        }
+        """
+        % (tmp_path / "catalog", socket_path, port, started,
+           sys.executable),
+    )
+    proc = _spawn_cli(cfg, tmp_path / "sup.log",
+                      env={"CP_CONFIG": cfg})
+    try:
+        _wait_for(started, what="main job")
+
+        def fetch(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ) as resp:
+                return resp.read().decode()
+
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                body = fetch("/metrics")
+                if "sensor_reading 42.5" in body:
+                    break
+            except OSError:
+                pass
+            assert time.monotonic() < deadline, (
+                "sensor metric never appeared on /metrics"
+            )
+            time.sleep(0.2)
+        # built-in supervisor metrics ride the same exposition
+        assert "containerpilot_events" in body
+        status = jsonlib.loads(fetch("/status"))
+        names = {j["Name"] for j in status["Jobs"]}
+        assert "main" in names and "sensor" in names
+    finally:
+        _teardown_cli(proc)
+
+
+def test_logging_json_format_e2e(tmp_path):
+    """Reference integration test_logging: the supervisor logs in the
+    configured format — every line of json-format output parses as a
+    JSON object with time/level/msg (reference:
+    integration_tests/tests/test_logging + config/logger)."""
+    import json as jsonlib
+
+    log_file = tmp_path / "cp.json.log"
+    started = tmp_path / "started"
+    cfg = write_config(
+        tmp_path,
+        """
+        {
+          stopTimeout: "1ms",
+          logging: { level: "DEBUG", format: "json", output: "%s" },
+          jobs: [
+            { name: "main",
+              exec: ["/bin/sh", "-c", "touch %s; exit 0"] },
+          ],
+        }
+        """
+        % (log_file, started),
+    )
+    proc = _spawn_cli(cfg, tmp_path / "stdout.log")
+    try:
+        _wait_for(started, what="main job")
+        # all jobs complete -> the supervisor exits on its own
+        assert proc.wait(timeout=30) == 0
+        lines = [
+            ln for ln in log_file.read_text().splitlines() if ln.strip()
+        ]
+        assert lines, "json log file is empty"
+        for ln in lines:
+            entry = jsonlib.loads(ln)
+            assert {"time", "level", "msg"} <= set(entry)
+        # the event flow is visible in the structured log
+        assert any("main" in e for e in lines)
     finally:
         _teardown_cli(proc)
