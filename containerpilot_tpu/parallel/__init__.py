@@ -29,6 +29,8 @@ from .sharding import (
 from .train import (
     TrainState,
     abstract_train_state,
+    ema_params,
+    with_ema,
     init_train_state,
     lora_abstract_state,
     make_lora_train_step,
@@ -49,6 +51,8 @@ __all__ = [
     "shard_params",
     "TrainState",
     "abstract_train_state",
+    "ema_params",
+    "with_ema",
     "make_train_step",
     "init_train_state",
     "lora_abstract_state",

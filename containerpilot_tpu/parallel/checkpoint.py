@@ -107,7 +107,58 @@ def restore_checkpoint(directory: str, state_like: Any) -> Optional[Any]:
     return restored
 
 
-def restore_params(directory: str, state_like: Any) -> Optional[Any]:
+def _swap_in_ema(node: Any, replacement: Any):
+    """Replace the EMA shadow subtree (an EmaState namedtuple, or the
+    single-key {"ema": ...} mapping orbax metadata renders it as) with
+    ``replacement``. Returns (new_node, found)."""
+    fields = getattr(node, "_fields", None)
+    if fields == ("ema",):
+        return type(node)(ema=replacement), True
+    if isinstance(node, dict):
+        if set(node) == {"ema"}:
+            return {"ema": replacement}, True
+        out, found = {}, False
+        for k, v in node.items():
+            out[k], f = _swap_in_ema(v, replacement)
+            found = found or f
+        return out, found
+    if isinstance(node, (tuple, list)):
+        out, found = [], False
+        for v in node:
+            nv, f = _swap_in_ema(v, replacement)
+            out.append(nv)
+            found = found or f
+        if fields is not None:  # other namedtuples: rebuild by position
+            return type(node)(*out), found
+        return type(node)(out) if isinstance(node, list) else tuple(out), found
+    return node, False
+
+
+def _extract_ema(node: Any) -> Optional[Any]:
+    """The EMA subtree's contents from a restored opt_state, whichever
+    container shape the restore produced it in."""
+    fields = getattr(node, "_fields", None)
+    if fields == ("ema",):
+        return node.ema
+    if isinstance(node, dict):
+        if set(node) == {"ema"}:
+            return node["ema"]
+        for v in node.values():
+            found = _extract_ema(v)
+            if found is not None:
+                return found
+        return None
+    if isinstance(node, (tuple, list)):
+        for v in node:
+            found = _extract_ema(v)
+            if found is not None:
+                return found
+    return None
+
+
+def restore_params(
+    directory: str, state_like: Any, prefer_ema: bool = False
+) -> Optional[Any]:
     """Restore ONLY the params (and step) of the latest train-state
     checkpoint — optimizer moments are orbax PLACEHOLDERs and never
     leave disk. Serving pays params-sized memory instead of the full
@@ -116,6 +167,13 @@ def restore_params(directory: str, state_like: Any) -> Optional[Any]:
     ``state_like`` is a TrainState-shaped pytree of arrays or
     ShapeDtypeStructs (e.g. from abstract_train_state). Returns
     (params, step) or None when no checkpoint exists.
+
+    ``prefer_ema``: when the checkpoint was written by a with_ema
+    optimizer (train.with_ema), return the EMA shadow weights instead
+    of the raw params — still params-sized (the shadow mirrors the
+    param tree and restores onto the same shardings; adam's mu/nu stay
+    on disk). Falls back to the raw params with a warning if the
+    checkpoint carries no EMA.
     """
     step = latest_step(directory)
     if step is None:
@@ -144,8 +202,26 @@ def restore_params(directory: str, state_like: Any) -> Optional[Any]:
         opt_skeleton = jax.tree.map(
             lambda _: ocp.PLACEHOLDER, abstract.opt_state
         )
+    ema_found = False
+    if prefer_ema:
+        # materialize the EMA shadow (param-shaped, param-sharded)
+        # while every other optimizer leaf stays a placeholder
+        opt_skeleton, ema_found = _swap_in_ema(
+            opt_skeleton, abstract.params
+        )
+        if not ema_found:
+            log.warning(
+                "checkpoint: prefer_ema requested but %s step %d has "
+                "no EMA shadow; restoring raw params", directory, step,
+            )
+    # with the EMA materialized the raw params stay on disk too, so the
+    # restore is params-sized either way
+    params_target = (
+        jax.tree.map(lambda _: ocp.PLACEHOLDER, abstract.params)
+        if ema_found else abstract.params
+    )
     target = TrainState(
-        params=abstract.params,
+        params=params_target,
         opt_state=opt_skeleton,
         step=abstract.step,
     )
@@ -168,4 +244,11 @@ def restore_params(directory: str, state_like: Any) -> Optional[Any]:
     log.info(
         "checkpoint: restored params-only step %d from %s", step, directory
     )
+    if ema_found:
+        ema = _extract_ema(restored.opt_state)
+        if ema is not None:
+            return ema, restored.step
+        log.warning(
+            "checkpoint: EMA subtree lost in restore; returning raw params"
+        )
     return restored.params, restored.step

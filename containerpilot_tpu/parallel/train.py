@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -53,6 +53,62 @@ def make_optimizer(
             b1=0.9, b2=0.95, weight_decay=0.1,
         ),
     )
+
+
+class EmaState(NamedTuple):
+    """Shadow (exponential-moving-average) copy of the params."""
+
+    ema: Any
+
+
+def with_ema(
+    inner: optax.GradientTransformation, decay: float
+) -> optax.GradientTransformation:
+    """Wrap an optimizer so its state also carries an EMA of the
+    *updated* params (``ema = decay*ema + (1-decay)*params_next``).
+
+    Living inside ``opt_state`` keeps the TrainState pytree structure
+    unchanged — checkpoints, sharding resolution (the ema subtree
+    mirrors the param tree, so param rules resolve), and the donated
+    train step all work untouched. Extract with ``ema_params(state)``.
+    """
+    if not 0.0 < decay < 1.0:
+        raise ValueError(f"ema decay must be in (0, 1), got {decay}")
+
+    def init(params):
+        return (
+            inner.init(params),
+            EmaState(jax.tree_util.tree_map(jnp.array, params)),
+        )
+
+    def update(grads, state, params=None):
+        inner_state, ema_state = state
+        updates, inner_state = inner.update(grads, inner_state, params)
+        new_params = optax.apply_updates(params, updates)
+        ema = jax.tree_util.tree_map(
+            lambda e, p: decay * e + (1.0 - decay) * p,
+            ema_state.ema, new_params,
+        )
+        return updates, (inner_state, EmaState(ema))
+
+    return optax.GradientTransformation(init, update)
+
+
+def ema_params(state: "TrainState") -> Any:
+    """The EMA shadow params from a with_ema-wrapped state (None if
+    the optimizer has no EMA)."""
+    found = []
+
+    def visit(node):
+        if isinstance(node, EmaState):
+            found.append(node.ema)
+            return
+        if isinstance(node, (tuple, list)):
+            for child in node:
+                visit(child)
+
+    visit(state.opt_state)
+    return found[0] if found else None
 
 
 def lr_schedule(

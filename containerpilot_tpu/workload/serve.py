@@ -497,6 +497,11 @@ def main() -> int:
         help="load trained params from the latest checkpoint",
     )
     parser.add_argument(
+        "--use-ema", action="store_true",
+        help="serve the EMA shadow weights from the checkpoint "
+        "(trained with --ema-decay) instead of the raw params",
+    )
+    parser.add_argument(
         "--int8", action="store_true",
         help="weight-only int8: ~4x smaller resident params",
     )
@@ -549,10 +554,13 @@ def main() -> int:
         # params-only restore: optimizer moments stay PLACEHOLDERs on
         # disk, so the server never pays train-state memory
         abstract = abstract_train_state(jax.random.PRNGKey(0), cfg, mesh)
-        restored = restore_params(args.checkpoint_dir, abstract)
+        restored = restore_params(
+            args.checkpoint_dir, abstract, prefer_ema=args.use_ema
+        )
         if restored is not None:
             params, step = restored
-            print(f"serving checkpoint step {int(step)}")
+            print(f"serving checkpoint step {int(step)}"
+                  + (" (EMA weights)" if args.use_ema else ""))
     if params is None:
         params = init_params(jax.random.PRNGKey(0), cfg)
     if args.lora_rank > 0 and not args.lora_dir:

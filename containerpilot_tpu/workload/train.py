@@ -84,6 +84,10 @@ def main() -> int:
                         help="ZeRO-1: shard adam moments over the data "
                         "axis; optimizer memory per device drops by "
                         "the data-parallel factor")
+    parser.add_argument("--ema-decay", type=float, default=0.0,
+                        help="maintain an EMA shadow of the params "
+                        "(e.g. 0.999); eval and the checkpoint carry "
+                        "it; 0 = off")
     parser.add_argument("--fsdp", action="store_true",
                         help="FSDP (ZeRO-3): shard params, grads, AND "
                         "moments over the data axis; per-device model "
@@ -145,6 +149,10 @@ def main() -> int:
         warmup_steps=args.warmup_steps,
         decay_steps=args.decay_steps,
     )
+    if args.ema_decay:
+        from ..parallel import with_ema
+
+        optimizer = with_ema(optimizer, args.ema_decay)
     lora_init = lora_abstract = None
     if args.lora_rank > 0:
         if (args.pipeline_stages > 1 or args.zero1 or args.fsdp
@@ -357,10 +365,19 @@ def main() -> int:
             if eval_step is not None and (step + 1) % args.eval_every == 0:
                 if args.lora_rank > 0:
                     from ..models.lora import apply_lora
+                    from ..parallel import ema_params
 
-                    eval_loss = run_eval(
-                        apply_lora(base_params, state.params, cfg)
+                    adapters = (
+                        ema_params(state) if args.ema_decay
+                        else state.params
                     )
+                    eval_loss = run_eval(
+                        apply_lora(base_params, adapters, cfg)
+                    )
+                elif args.ema_decay:
+                    from ..parallel import ema_params
+
+                    eval_loss = run_eval(ema_params(state))
                 else:
                     eval_loss = run_eval(state.params)
                 print(f"step {step + 1}: eval_loss={eval_loss:.4f}")
