@@ -48,10 +48,17 @@ Cache = Dict[str, jax.Array]
 def init_cache(
     cfg: TransformerConfig, batch: int, max_len: int
 ) -> Cache:
-    """Zeroed KV cache: k/v are [layers, batch, max_len, kv_heads,
+    """Zeroed KV cache: k/v are [layers, batch, length, kv_heads,
     head_dim] — under GQA the cache holds only the kv heads, which is
-    the whole point (n_heads/kv_heads smaller cache)."""
-    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    the whole point (n_heads/kv_heads smaller cache).
+
+    With sliding-window attention (cfg.window > 0) the cache is a RING
+    of ``min(window, max_len)`` entries — position p lives at slot
+    ``p % length`` and old entries are overwritten as the window
+    slides, so decode KV memory is bounded by the window, not the
+    generation length."""
+    length = max_len if cfg.window <= 0 else min(cfg.window, max_len)
+    shape = (cfg.n_layers, batch, length, cfg.kv_heads, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -86,15 +93,24 @@ def prefill(
     # long prompts go through the pallas flash kernels, same threshold
     # as training; short prompts stay einsum. The flash path is
     # GQA-native: it reads the unrepeated kv heads straight from the
-    # cache layout, skipping the repeat_kv copy.
+    # cache layout, skipping the repeat_kv copy. Sliding windows ride
+    # both paths (the flash kernels block-skip old KV; the einsum path
+    # masks).
     gqa_flash = cfg.attention_fn is None and flash_eligible(cfg, s)
-    attn_fn = cfg.attention_fn or causal_attention
+    if cfg.attention_fn is not None:
+        attn_fn = cfg.attention_fn
+    elif cfg.window > 0:
+        import functools as _ft
+
+        attn_fn = _ft.partial(causal_attention, window=cfg.window)
+    else:
+        attn_fn = causal_attention
 
     def body(carry, layer_params):
         layer_params = maybe_dequant_layer(layer_params, cfg.dtype)
         q, k, v = _qkv(carry, layer_params, cfg)
         if gqa_flash:
-            attn = flash_attention_forward(q, k, v)
+            attn = flash_attention_forward(q, k, v, window=cfg.window)
         else:
             attn = attn_fn(
                 q, repeat_kv(k, cfg.n_heads), repeat_kv(v, cfg.n_heads)
@@ -106,8 +122,22 @@ def prefill(
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])
     cache = init_cache(cfg, b, max_len)
-    cache["k"] = lax.dynamic_update_slice(cache["k"], ks, (0, 0, 0, 0, 0))
-    cache["v"] = lax.dynamic_update_slice(cache["v"], vs, (0, 0, 0, 0, 0))
+    length = cache["k"].shape[2]
+    if s > length:
+        # ring cache smaller than the prompt: keep the last `length`
+        # positions, each at its slot p % length (static scatter)
+        import numpy as _np
+
+        slots = _np.arange(s - length, s) % length
+        cache["k"] = cache["k"].at[:, :, slots].set(ks[:, :, s - length:])
+        cache["v"] = cache["v"].at[:, :, slots].set(vs[:, :, s - length:])
+    else:
+        cache["k"] = lax.dynamic_update_slice(
+            cache["k"], ks, (0, 0, 0, 0, 0)
+        )
+        cache["v"] = lax.dynamic_update_slice(
+            cache["v"], vs, (0, 0, 0, 0, 0)
+        )
     cache["pos"] = jnp.asarray(s, jnp.int32)
     logits = _logits(params, x[:, -1:, :], cfg)
     return logits[:, 0, :], cache
@@ -139,11 +169,37 @@ def decode_chunk(
     """
     pos = cache["pos"]
     b, m = tokens.shape
-    max_len = cache["k"].shape[2]
+    length = cache["k"].shape[2]
+    ring = cfg.window > 0
+    if ring and m > length:
+        raise ValueError(
+            f"decode chunk of {m} tokens exceeds the {length}-slot "
+            "window ring; chunk at most `window` tokens"
+        )
     x = embed_lookup(params, tokens, cfg.dtype)  # [b, m, d]
-    key_pos = jnp.arange(max_len)
-    q_pos = pos + jnp.arange(m)
-    valid = key_pos[None, :] <= q_pos[:, None]  # [m, max_len]
+    q_idx = jnp.arange(m)
+    q_pos = pos + q_idx
+    if ring:
+        # ring slot j holds the newest position p < pos with
+        # p % length == j (negative = never written); a query at
+        # pos+i sees ring entries inside its window plus the chunk's
+        # own causal prefix — the chunk k/v are CONCATENATED after the
+        # ring so in-chunk keys are never read from slots they are
+        # about to overwrite
+        j = jnp.arange(length)
+        ring_pos = pos - 1 - jnp.mod(pos - 1 - j, length)
+        ring_ok = (
+            (ring_pos[None, :] >= 0)
+            & (ring_pos[None, :] > q_pos[:, None] - cfg.window)
+        )
+        chunk_ok = (
+            (q_idx[None, :] <= q_idx[:, None])
+            & (q_idx[:, None] - q_idx[None, :] < cfg.window)
+        )
+        valid = jnp.concatenate([ring_ok, chunk_ok], axis=1)
+    else:
+        key_pos = jnp.arange(length)
+        valid = key_pos[None, :] <= q_pos[:, None]  # [m, length]
     # int8-quantized dense models run their projections through the
     # fused dequant pallas GEMM: decode is weight-streaming bound, so
     # reading int8 instead of dequantized bf16 halves the HBM traffic
@@ -157,16 +213,28 @@ def decode_chunk(
         else:
             layer_params = maybe_dequant_layer(layer_params, cfg.dtype)
             q, k, v = _qkv(x, layer_params, cfg, offset=pos)
-        k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-        v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-        k_full = repeat_kv(k_cache, cfg.n_heads)
-        v_full = repeat_kv(v_cache, cfg.n_heads)
+        if ring:
+            keys = jnp.concatenate([k_cache, k], axis=1)
+            values = jnp.concatenate([v_cache, v], axis=1)
+            slots = jnp.mod(pos + q_idx, length)
+            k_cache = k_cache.at[:, slots].set(k)
+            v_cache = v_cache.at[:, slots].set(v)
+        else:
+            k_cache = lax.dynamic_update_slice(
+                k_cache, k, (0, pos, 0, 0)
+            )
+            v_cache = lax.dynamic_update_slice(
+                v_cache, v, (0, pos, 0, 0)
+            )
+            keys, values = k_cache, v_cache
+        k_full = repeat_kv(keys, cfg.n_heads)
+        v_full = repeat_kv(values, cfg.n_heads)
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk",
             q.astype(jnp.float32) * cfg.head_dim ** -0.5,
             k_full.astype(jnp.float32),
             preferred_element_type=jnp.float32,
-        )  # [b, h, m, max_len]
+        )  # [b, h, m, length(+m)]
         scores = jnp.where(valid[None, None, :, :], scores, NEG_INF)
         weights = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
         attn = jnp.einsum(

@@ -113,6 +113,17 @@ def speculative_generate(
         raise ValueError("speculative decoding serves batch 1")
     if speculate < 1:
         raise ValueError("speculate must be >= 1")
+    if cfg.window > 0 or draft_cfg.window > 0:
+        # the rollback contract ("stale cache rows beyond pos are
+        # masked/overwritten") does not hold for a ring cache: the
+        # verify chunk overwrites the OLDEST in-window slots before
+        # the accept decision, so a rejected round would permanently
+        # corrupt window context
+        raise ValueError(
+            "speculative decoding does not compose with sliding-"
+            "window attention (ring-cache writes are destructive; "
+            "rollback would leave rejected k/v in live slots)"
+        )
     if cfg.vocab_size != draft_cfg.vocab_size:
         raise ValueError("draft and target must share a vocab")
     if max_new_tokens < 1:

@@ -57,6 +57,11 @@ class TransformerConfig:
     # for HBM. Without it the scan-over-layers saves every layer's MLP
     # hiddens ([L, b, s, d_ff]) and real model sizes blow the 16GB HBM.
     remat: bool = True
+    # sliding-window attention (Mistral-style): each position attends
+    # only the last `window` positions. 0 = full causal. Bounds the
+    # decode KV cache to a ring of `window` entries (models/decode.py)
+    # and the attention FLOPs to O(s*window).
+    window: int = 0
     # mixture-of-experts: 0 = dense SwiGLU; >0 replaces the MLP with
     # switch-routed experts (models/moe.py — drop-free routing, expert
     # axis sharded over the mesh's "model" axis for expert parallelism)
@@ -93,19 +98,27 @@ FLASH_BLOCK = 128
 
 def flash_eligible(cfg: "TransformerConfig", seq: int) -> bool:
     """True when the auto-selected attention should be the pallas flash
-    path: at/above the threshold and block-aligned."""
+    path: at/above the threshold and block-aligned. A sliding window
+    must itself be block-aligned for the kernels' block-skip logic."""
     return (
         cfg.flash_min_seq > 0
         and seq >= cfg.flash_min_seq
         and seq % FLASH_BLOCK == 0
+        and (cfg.window == 0 or cfg.window % FLASH_BLOCK == 0)
     )
 
 
 def _auto_attention(cfg: "TransformerConfig", seq: int) -> Any:
+    import functools
+
     if flash_eligible(cfg, seq):
         from ..ops.flash import flash_attention
 
+        if cfg.window > 0:
+            return functools.partial(flash_attention, window=cfg.window)
         return flash_attention
+    if cfg.window > 0:
+        return functools.partial(causal_attention, window=cfg.window)
     return causal_attention
 
 

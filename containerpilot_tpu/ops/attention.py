@@ -17,15 +17,23 @@ __all__ = ["NEG_INF", "causal_attention", "flash_attention_forward"]
 
 
 def causal_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array
+    q: jax.Array, k: jax.Array, v: jax.Array, window: int = 0
 ) -> jax.Array:
-    """[batch, seq, heads, head_dim] -> same; causal masked softmax."""
+    """[batch, seq, heads, head_dim] -> same; causal masked softmax.
+
+    ``window > 0`` limits each query to the last ``window`` keys
+    (sliding-window / Mistral-style local attention): position i
+    attends j iff ``i - window < j <= i``.
+    """
     *_b, s, _h, hd = q.shape
     scale = hd ** -0.5
     scores = jnp.einsum(
         "bqhk,bshk->bhqs", q, k, preferred_element_type=jnp.float32
     ) * scale
     mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    if window > 0:
+        idx = jnp.arange(s)
+        mask &= idx[None, :] > idx[:, None] - window
     scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum(

@@ -41,19 +41,34 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _contributes(qi: jax.Array, ki: jax.Array, block_q: int, block_k: int):
-    """True iff kv block ki overlaps the causal past of q block qi."""
-    return ki * block_k <= qi * block_q + (block_q - 1)
+def _contributes(
+    qi: jax.Array, ki: jax.Array, block_q: int, block_k: int,
+    window: int = 0,
+):
+    """True iff kv block ki overlaps the causal past of q block qi —
+    and, with a sliding window, is not entirely older than the window
+    (the skip that makes windowed attention O(s*window) not O(s^2))."""
+    causal = ki * block_k <= qi * block_q + (block_q - 1)
+    if window <= 0:
+        return causal
+    newest_k = ki * block_k + (block_k - 1)
+    oldest_needed = qi * block_q - (window - 1)
+    return jnp.logical_and(causal, newest_k >= oldest_needed)
 
 
-def _causal_mask(qi, ki, block_q: int, block_k: int) -> jax.Array:
+def _causal_mask(
+    qi, ki, block_q: int, block_k: int, window: int = 0
+) -> jax.Array:
     q_pos = qi * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
     k_pos = ki * block_k + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
-    return q_pos >= k_pos
+    mask = q_pos >= k_pos
+    if window > 0:
+        mask = jnp.logical_and(mask, q_pos - k_pos < window)
+    return mask
 
 
 def _dot(a: jax.Array, b: jax.Array) -> jax.Array:
@@ -84,7 +99,7 @@ def _dot_tt(a: jax.Array, b: jax.Array) -> jax.Array:
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, block_q: int, block_k: int, scale: float,
+    *, block_q: int, block_k: int, scale: float, window: int = 0,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -96,14 +111,14 @@ def _fwd_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    @pl.when(_contributes(qi, ki, block_q, block_k))
+    @pl.when(_contributes(qi, ki, block_q, block_k, window))
     def _compute():
         q = q_ref[0].astype(jnp.float32) * scale
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         scores = _dot_t(q, k)  # [block_q, block_k]
         scores = jnp.where(
-            _causal_mask(qi, ki, block_q, block_k), scores, NEG_INF
+            _causal_mask(qi, ki, block_q, block_k, window), scores, NEG_INF
         )
         m_prev = m_ref[...]
         l_prev = l_ref[...]
@@ -125,7 +140,7 @@ def _fwd_kernel(
 
 def _fwd_rows(
     qr: jax.Array, kr: jax.Array, vr: jax.Array,
-    block_q: int, block_k: int, interpret: bool,
+    block_q: int, block_k: int, interpret: bool, window: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """[rows, s, hd] x3 -> (out [rows, s, hd], lse [rows, s, 1] f32).
 
@@ -148,7 +163,8 @@ def _fwd_rows(
         )
     group = rows // kv_rows
     kernel = functools.partial(
-        _fwd_kernel, block_q=block_q, block_k=block_k, scale=hd ** -0.5
+        _fwd_kernel, block_q=block_q, block_k=block_k, scale=hd ** -0.5,
+        window=window,
     )
     return pl.pallas_call(
         kernel,
@@ -189,7 +205,7 @@ def _fwd_rows(
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref, acc_ref,
-    *, block_q: int, block_k: int, scale: float,
+    *, block_q: int, block_k: int, scale: float, window: int = 0,
 ):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -199,7 +215,7 @@ def _dq_kernel(
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    @pl.when(_contributes(qi, ki, block_q, block_k))
+    @pl.when(_contributes(qi, ki, block_q, block_k, window))
     def _compute():
         q = q_ref[0].astype(jnp.float32) * scale
         k = k_ref[0].astype(jnp.float32)
@@ -207,7 +223,7 @@ def _dq_kernel(
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0]       # [block_q, 1]
         d_rows = d_ref[0]      # [block_q, 1]
-        mask = _causal_mask(qi, ki, block_q, block_k)
+        mask = _causal_mask(qi, ki, block_q, block_k, window)
         # p_ij = exp(s_ij - lse_i), exactly the forward's normalized
         # weights (lse folds in the running max and sum)
         s = _dot_t(q, k)
@@ -224,6 +240,7 @@ def _dq_kernel(
 def _dkdv_kernel(
     k_ref, v_ref, q_ref, do_ref, lse_ref, d_ref, dk_ref, dv_ref,
     dk_acc, dv_acc, *, block_q: int, block_k: int, scale: float,
+    window: int = 0,
 ):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -234,7 +251,7 @@ def _dkdv_kernel(
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    @pl.when(_contributes(qi, ki, block_q, block_k))
+    @pl.when(_contributes(qi, ki, block_q, block_k, window))
     def _compute():
         q = q_ref[0].astype(jnp.float32) * scale
         k = k_ref[0].astype(jnp.float32)
@@ -242,7 +259,7 @@ def _dkdv_kernel(
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0]       # [block_q, 1]
         d_rows = d_ref[0]      # [block_q, 1]
-        mask = _causal_mask(qi, ki, block_q, block_k)
+        mask = _causal_mask(qi, ki, block_q, block_k, window)
         s = _dot_t(q, k)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dv_acc[...] = dv_acc[...] + _dot_tt(p, do)
@@ -259,13 +276,14 @@ def _dkdv_kernel(
 
 def _bwd_rows(
     qr, kr, vr, do_r, lse, d_rows, block_q: int, block_k: int,
-    interpret: bool,
+    interpret: bool, window: int = 0,
 ):
     rows, s, hd = qr.shape
     scale = hd ** -0.5
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, block_q=block_q, block_k=block_k, scale=scale
+            _dq_kernel, block_q=block_q, block_k=block_k, scale=scale,
+            window=window,
         ),
         grid=(rows, s // block_q, s // block_k),
         in_specs=[
@@ -286,7 +304,8 @@ def _bwd_rows(
     )(qr, kr, vr, do_r, lse, d_rows)
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkdv_kernel, block_q=block_q, block_k=block_k, scale=scale
+            _dkdv_kernel, block_q=block_q, block_k=block_k, scale=scale,
+            window=window,
         ),
         grid=(rows, s // block_k, s // block_q),
         in_specs=[
@@ -346,7 +365,22 @@ def _check_shapes(q, block_q: int, block_k: int) -> None:
         )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_core(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block_q: int,
+    block_k: int,
+    interpret: Optional[bool],
+    window: int,
+) -> jax.Array:
+    out, _lse = _flash_fwd_impl(
+        q, k, v, block_q, block_k, interpret, window
+    )
+    return out
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -354,18 +388,25 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    window: int = 0,
 ) -> jax.Array:
     """Causal flash attention, differentiable, all-pallas.
 
     [batch, seq, heads, head_dim] layout, same contract as
     ``causal_attention``; seq must be a multiple of both block sizes
     (pad upstream — static shapes keep the MXU tiling clean).
+
+    ``window > 0`` = sliding-window attention: kv blocks entirely
+    older than the window are skipped in all three kernels, so fwd
+    AND bwd FLOPs are O(s*window). A plain wrapper so callers may use
+    keywords; the custom_vjp core takes positions only.
     """
-    out, _lse = _flash_fwd_impl(q, k, v, block_q, block_k, interpret)
-    return out
+    return _flash_attention_core(
+        q, k, v, block_q, block_k, interpret, window
+    )
 
 
-def _flash_fwd_impl(q, k, v, block_q, block_k, interpret):
+def _flash_fwd_impl(q, k, v, block_q, block_k, interpret, window=0):
     _check_shapes(q, block_q, block_k)
     if k.shape != q.shape or v.shape != q.shape:
         # the backward kernels index k/v by q-row; grouped (GQA) kv
@@ -378,17 +419,20 @@ def _flash_fwd_impl(q, k, v, block_q, block_k, interpret):
     b, s, h, hd = q.shape
     interp = _resolve_interpret(interpret)
     out, lse = _fwd_rows(
-        _to_rows(q), _to_rows(k), _to_rows(v), block_q, block_k, interp
+        _to_rows(q), _to_rows(k), _to_rows(v), block_q, block_k, interp,
+        window,
     )
     return _from_rows(out, b, h), lse
 
 
-def _flash_fwd(q, k, v, block_q, block_k, interpret):
-    out, lse = _flash_fwd_impl(q, k, v, block_q, block_k, interpret)
+def _flash_fwd(q, k, v, block_q, block_k, interpret, window):
+    out, lse = _flash_fwd_impl(
+        q, k, v, block_q, block_k, interpret, window
+    )
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(block_q, block_k, interpret, residuals, d_out):
+def _flash_bwd(block_q, block_k, interpret, window, residuals, d_out):
     q, k, v, out, lse = residuals
     b, s, h, hd = q.shape
     interp = _resolve_interpret(interpret)
@@ -402,7 +446,7 @@ def _flash_bwd(block_q, block_k, interpret, residuals, d_out):
     )
     dq, dk, dv = _bwd_rows(
         _to_rows(q), _to_rows(k), _to_rows(v), do_r, lse, d_rows,
-        block_q, block_k, interp,
+        block_q, block_k, interp, window,
     )
     return (
         _from_rows(dq, b, h).astype(q.dtype),
@@ -411,11 +455,11 @@ def _flash_bwd(block_q, block_k, interpret, residuals, d_out):
     )
 
 
-flash_attention.defvjp(_flash_fwd, _flash_bwd)
+_flash_attention_core.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_q", "block_k", "interpret")
+    jax.jit, static_argnames=("block_q", "block_k", "interpret", "window")
 )
 def flash_attention_forward(
     q: jax.Array,
@@ -424,6 +468,7 @@ def flash_attention_forward(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    window: int = 0,
 ) -> jax.Array:
     """Forward-only entry point (inference/serving). Same kernel as the
     differentiable path, KV grid-streamed: VMEM use is O(block) per
@@ -445,6 +490,6 @@ def flash_attention_forward(
         )
     out, _lse = _fwd_rows(
         _to_rows(q), _to_rows(k), _to_rows(v), block_q, block_k,
-        _resolve_interpret(interpret),
+        _resolve_interpret(interpret), window,
     )
     return _from_rows(out, b, h)

@@ -248,7 +248,11 @@ def restore_params(
         ema = _extract_ema(restored.opt_state)
         if ema is not None:
             return ema, restored.step
+        # restored.params are placeholders here (swapped out above);
+        # re-restore the raw params rather than hand back sentinels
         log.warning(
-            "checkpoint: EMA subtree lost in restore; returning raw params"
+            "checkpoint: EMA subtree lost in restore; re-restoring "
+            "raw params"
         )
+        return restore_params(directory, state_like, prefer_ema=False)
     return restored.params, restored.step

@@ -37,11 +37,11 @@ def flash_parallel_config(
         if not flash_eligible(cfg, q.shape[1]):
             from ..ops.attention import causal_attention
 
-            return causal_attention(q, k, v)
+            return causal_attention(q, k, v, window=cfg.window)
         from ..ops.flash import flash_attention
 
         f = shard_map(
-            lambda q, k, v: flash_attention(q, k, v),
+            lambda q, k, v: flash_attention(q, k, v, window=cfg.window),
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
@@ -58,6 +58,13 @@ def context_parallel_config(
     if axis_name not in mesh.axis_names:
         raise ValueError(
             f"mesh has no {axis_name!r} axis: {mesh.axis_names}"
+        )
+    if cfg.window > 0:
+        raise ValueError(
+            "sliding-window attention does not compose with ring "
+            "attention yet: a window shorter than the shard makes "
+            "most ring hops no-ops — use the flash window path on a "
+            "(data, model) mesh instead"
         )
 
     def attn(q, k, v):

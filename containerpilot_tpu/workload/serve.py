@@ -103,6 +103,11 @@ class InferenceServer:
         if draft_layers > 0 and speculate < 1:
             # fail at startup, not as request-time 500s
             raise ValueError("speculate must be >= 1")
+        if draft_layers > 0 and cfg.window > 0:
+            raise ValueError(
+                "--draft-layers does not compose with --window "
+                "(speculative rollback cannot undo ring-cache writes)"
+            )
         if draft_layers > 0:
             from ..models.speculative import layer_prefix_draft
 
@@ -491,6 +496,10 @@ def main() -> int:
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="switch-MoE experts; must match the "
                         "checkpoint being served")
+    parser.add_argument("--window", type=int, default=0,
+                        help="sliding-window attention; must match the "
+                        "checkpoint being served. Decode KV memory "
+                        "becomes a ring of `window` slots")
     parser.add_argument("--vocab", type=int, default=1024)
     parser.add_argument(
         "--checkpoint-dir", default="",
@@ -541,6 +550,7 @@ def main() -> int:
         d_ff=args.d_model * 3 // 128 * 128 or 128,
         max_seq_len=args.max_len,
         moe_experts=args.moe_experts,
+        window=args.window,
     )
     params = None
     if args.checkpoint_dir:

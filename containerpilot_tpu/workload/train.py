@@ -36,6 +36,11 @@ def main() -> int:
     parser.add_argument("--n-heads", type=int, default=4)
     parser.add_argument("--n-kv-heads", type=int, default=0,
                         help="GQA kv heads (0 = full multi-head)")
+    parser.add_argument("--window", type=int, default=0,
+                        help="sliding-window attention: each position "
+                        "attends the last N positions only (0 = full "
+                        "causal); bounds attention FLOPs and the "
+                        "serving KV cache")
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="switch-MoE experts (0 = dense MLP)")
     parser.add_argument("--moe-capacity", type=float, default=0.0,
@@ -122,6 +127,7 @@ def main() -> int:
         max_seq_len=args.seq_len,
         moe_experts=args.moe_experts,
         moe_train_capacity=args.moe_capacity,
+        window=args.window,
     )
     rules = None
     if args.pipeline_stages > 1:
