@@ -94,14 +94,47 @@ def _sync(x) -> None:
     np.asarray(jnp.ravel(x)[:1].astype(jnp.float32))
 
 
-def _time_ms(fn, *args, n: int = 5) -> float:
+_SYNC_FLOOR_MS = None
+
+
+def _sync_floor_ms() -> float:
+    """The fixed dispatch+fetch roundtrip through the device tunnel
+    (~tens of ms on axon), measured once with a trivial program. Real
+    kernel timings subtract it so numbers reflect device time, not
+    tunnel latency."""
+    global _SYNC_FLOOR_MS
+    if _SYNC_FLOOR_MS is None:
+        import jax
+        import jax.numpy as jnp
+
+        trivial = jax.jit(lambda x: x + 1.0)
+        x = jnp.zeros((8,), jnp.float32)
+        _sync(trivial(x))
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            _sync(trivial(x))
+            best = min(best, (time.perf_counter() - t0) * 1e3)
+        _SYNC_FLOOR_MS = best
+    return _SYNC_FLOOR_MS
+
+
+def _time_ms(fn, *args, n: int = 5, reps: int = 3) -> float:
+    """Amortized timing: n back-to-back dispatches, one sync
+    (in-order execution makes the final fetch wait for all), the
+    tunnel's fixed roundtrip subtracted once; min over ``reps``
+    repetitions discards tunnel latency spikes."""
+    floor = _sync_floor_ms()
     _sync(fn(*args))  # warm / compile
-    t0 = time.perf_counter()
-    r = None
-    for _ in range(n):
-        r = fn(*args)
-    _sync(r)
-    return (time.perf_counter() - t0) / n * 1e3
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(n):
+            r = fn(*args)
+        _sync(r)
+        best = min(best, (time.perf_counter() - t0) * 1e3)
+    return max(best - floor, 1e-3) / n
 
 
 def _peak_flops(device_kind: str) -> float:
@@ -228,6 +261,20 @@ def attention_bench() -> dict:
     out["grad_speedup_8k"] = round(
         e8k["xla_grad_ms"] / e8k["flash_grad_ms"], 2
     )
+    # sliding window at 8k (window 1024): the kernels' kv-grid shrinks
+    # to the contributing span, so fwd+bwd cost tracks O(s*window)
+    ks = jax.random.split(jax.random.PRNGKey(81920), 3)
+    q, k, v = (
+        jax.random.normal(kk, (b, 8192, h, hd), jnp.bfloat16)
+        for kk in ks
+    )
+    win_f = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, 128, 128, None, 1024)
+    )
+    win_ms = _time_ms(win_f, q, k, v, n=3)
+    out["win1024_fwd_8k_ms"] = round(win_ms, 2)
+    # ratio from the unrounded value: the display rounding can hit 0.0
+    out["win_fwd_speedup_8k"] = round(e8k["flash_fwd_ms"] / win_ms, 2)
     return out
 
 

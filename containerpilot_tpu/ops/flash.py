@@ -71,6 +71,48 @@ def _causal_mask(
     return mask
 
 
+def _kv_block_base(qi, block_q: int, block_k: int, window: int,
+                   total_kv: int, n_grid):
+    """First kv block the windowed grid visits for q block qi (0 when
+    no window). Clipped so the n_grid visited blocks are always
+    in-range AND unique; non-contributing visits are masked off."""
+    if window <= 0 or not total_kv:
+        return 0
+    first = lax.div(qi * block_q - (window - 1), block_k)
+    return jnp.clip(first, 0, total_kv - n_grid)
+
+
+def _windowed_kv_grid(total_kv: int, block_q: int, block_k: int,
+                      window: int) -> int:
+    """Number of kv blocks a q block can overlap under a window: the
+    needed key span has length window + block_q - 1 and arbitrary
+    alignment, so worst-case it touches
+    (len + block_k - 2)//block_k + 1 blocks."""
+    if window <= 0:
+        return total_kv
+    span = window + block_q - 1
+    return min(total_kv, (span + block_k - 2) // block_k + 1)
+
+
+def _q_block_base(ki, block_q: int, block_k: int, window: int,
+                  total_q: int, n_grid):
+    """First q block the windowed dk/dv grid visits for kv block ki
+    (queries attending kv block ki span [ki*bk, ki*bk+bk-1+window-1])."""
+    if window <= 0 or not total_q:
+        return 0
+    return jnp.clip(lax.div(ki * block_k, block_q), 0, total_q - n_grid)
+
+
+def _windowed_q_grid(total_q: int, block_q: int, block_k: int,
+                     window: int) -> int:
+    """Number of q blocks a kv block can influence under a window
+    (query span length window + block_k - 1, arbitrary alignment)."""
+    if window <= 0:
+        return total_q
+    span = window + block_k - 1
+    return min(total_q, (span + block_q - 2) // block_q + 1)
+
+
 def _dot(a: jax.Array, b: jax.Array) -> jax.Array:
     """f32 matmul on the MXU."""
     return lax.dot_general(
@@ -100,12 +142,17 @@ def _dot_tt(a: jax.Array, b: jax.Array) -> jax.Array:
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, block_q: int, block_k: int, scale: float, window: int = 0,
+    total_kv: int = 0,
 ):
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    j = pl.program_id(2)
     n_kv = pl.num_programs(2)
+    # windowed grids only span the contributing kv blocks; the real
+    # block index is the per-q-block offset (same formula as the
+    # BlockSpec index_map) plus the grid position
+    ki = _kv_block_base(qi, block_q, block_k, window, total_kv, n_kv) + j
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _init():
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
@@ -131,7 +178,7 @@ def _fwd_kernel(
         l_ref[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[...] = acc_ref[...] * corr + _dot(p, v)
 
-    @pl.when(ki == n_kv - 1)
+    @pl.when(j == n_kv - 1)
     def _finalize():
         l = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
@@ -162,21 +209,25 @@ def _fwd_rows(
             f"q rows {rows} not a multiple of kv rows {kv_rows}"
         )
     group = rows // kv_rows
+    total_kv = s // block_k
+    n_kv_grid = _windowed_kv_grid(total_kv, block_q, block_k, window)
     kernel = functools.partial(
         _fwd_kernel, block_q=block_q, block_k=block_k, scale=hd ** -0.5,
-        window=window,
+        window=window, total_kv=total_kv,
     )
+
+    def kv_map(r, i, j):
+        base = _kv_block_base(i, block_q, block_k, window, total_kv,
+                              n_kv_grid)
+        return (r // group, base + j, 0)
+
     return pl.pallas_call(
         kernel,
-        grid=(rows, s // block_q, s // block_k),
+        grid=(rows, s // block_q, n_kv_grid),
         in_specs=[
             pl.BlockSpec((1, block_q, hd), lambda r, i, j: (r, i, 0)),
-            pl.BlockSpec(
-                (1, block_k, hd), lambda r, i, j: (r // group, j, 0)
-            ),
-            pl.BlockSpec(
-                (1, block_k, hd), lambda r, i, j: (r // group, j, 0)
-            ),
+            pl.BlockSpec((1, block_k, hd), kv_map),
+            pl.BlockSpec((1, block_k, hd), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, hd), lambda r, i, j: (r, i, 0)),
@@ -206,12 +257,14 @@ def _fwd_rows(
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref, acc_ref,
     *, block_q: int, block_k: int, scale: float, window: int = 0,
+    total_kv: int = 0,
 ):
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    j = pl.program_id(2)
     n_kv = pl.num_programs(2)
+    ki = _kv_block_base(qi, block_q, block_k, window, total_kv, n_kv) + j
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
@@ -232,7 +285,7 @@ def _dq_kernel(
         ds = p * (dp - d_rows)
         acc_ref[...] = acc_ref[...] + _dot(ds, k)
 
-    @pl.when(ki == n_kv - 1)
+    @pl.when(j == n_kv - 1)
     def _finalize():
         dq_ref[0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
 
@@ -240,13 +293,14 @@ def _dq_kernel(
 def _dkdv_kernel(
     k_ref, v_ref, q_ref, do_ref, lse_ref, d_ref, dk_ref, dv_ref,
     dk_acc, dv_acc, *, block_q: int, block_k: int, scale: float,
-    window: int = 0,
+    window: int = 0, total_q: int = 0,
 ):
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    j = pl.program_id(2)
     n_q = pl.num_programs(2)
+    qi = _q_block_base(ki, block_q, block_k, window, total_q, n_q) + j
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
@@ -268,7 +322,7 @@ def _dkdv_kernel(
         # d(s_scaled)/dk = q*scale, already folded into q above
         dk_acc[...] = dk_acc[...] + _dot_tt(ds, q)
 
-    @pl.when(qi == n_q - 1)
+    @pl.when(j == n_q - 1)
     def _finalize():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
@@ -280,16 +334,26 @@ def _bwd_rows(
 ):
     rows, s, hd = qr.shape
     scale = hd ** -0.5
+    total_kv = s // block_k
+    total_q = s // block_q
+    n_kv_grid = _windowed_kv_grid(total_kv, block_q, block_k, window)
+    n_q_grid = _windowed_q_grid(total_q, block_q, block_k, window)
+
+    def kv_map(r, i, j):
+        base = _kv_block_base(i, block_q, block_k, window, total_kv,
+                              n_kv_grid)
+        return (r, base + j, 0)
+
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, block_q=block_q, block_k=block_k, scale=scale,
-            window=window,
+            window=window, total_kv=total_kv,
         ),
-        grid=(rows, s // block_q, s // block_k),
+        grid=(rows, s // block_q, n_kv_grid),
         in_specs=[
             pl.BlockSpec((1, block_q, hd), lambda r, i, j: (r, i, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda r, i, j: (r, j, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda r, i, j: (r, j, 0)),
+            pl.BlockSpec((1, block_k, hd), kv_map),
+            pl.BlockSpec((1, block_k, hd), kv_map),
             pl.BlockSpec((1, block_q, hd), lambda r, i, j: (r, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda r, i, j: (r, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda r, i, j: (r, i, 0)),
@@ -302,19 +366,24 @@ def _bwd_rows(
         ),
         interpret=interpret,
     )(qr, kr, vr, do_r, lse, d_rows)
+    def q_map(r, kj, i):
+        base = _q_block_base(kj, block_q, block_k, window, total_q,
+                             n_q_grid)
+        return (r, base + i, 0)
+
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkdv_kernel, block_q=block_q, block_k=block_k, scale=scale,
-            window=window,
+            window=window, total_q=total_q,
         ),
-        grid=(rows, s // block_k, s // block_q),
+        grid=(rows, s // block_k, n_q_grid),
         in_specs=[
             pl.BlockSpec((1, block_k, hd), lambda r, j, i: (r, j, 0)),
             pl.BlockSpec((1, block_k, hd), lambda r, j, i: (r, j, 0)),
-            pl.BlockSpec((1, block_q, hd), lambda r, j, i: (r, i, 0)),
-            pl.BlockSpec((1, block_q, hd), lambda r, j, i: (r, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda r, j, i: (r, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda r, j, i: (r, i, 0)),
+            pl.BlockSpec((1, block_q, hd), q_map),
+            pl.BlockSpec((1, block_q, hd), q_map),
+            pl.BlockSpec((1, block_q, 1), q_map),
+            pl.BlockSpec((1, block_q, 1), q_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, hd), lambda r, j, i: (r, j, 0)),

@@ -231,3 +231,33 @@ def test_window_rejects_speculative_and_ring_contexts():
             params, draft_params, prompt, cfg, draft_cfg,
             max_new_tokens=4, max_len=32,
         )
+
+
+@pytest.mark.parametrize("bq,bk,W", [(64, 128, 300), (128, 64, 300)])
+def test_windowed_flash_mismatched_blocks_span_coverage(bq, bk, W):
+    """Unequal block sizes with a window that is not block-aligned:
+    the visited-block span must still cover every contributing block
+    (regression: the original span formulas undercounted here,
+    silently dropping in-window kv blocks)."""
+    rng = jax.random.PRNGKey(7)
+    q, k, v = (
+        jax.random.normal(kk, (1, 1024, 2, 64), jnp.float32)
+        for kk in jax.random.split(rng, 3)
+    )
+    ref = causal_attention(q, k, v, window=W)
+    got = flash_attention(q, k, v, block_q=bq, block_k=bk, window=W)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(got), rtol=1e-4, atol=1e-5
+    )
+    ga = jax.grad(
+        lambda k_: (causal_attention(q, k_, v, window=W) ** 2).sum()
+    )(k)
+    gb = jax.grad(
+        lambda k_: (
+            flash_attention(q, k_, v, block_q=bq, block_k=bk, window=W)
+            ** 2
+        ).sum()
+    )(k)
+    np.testing.assert_allclose(
+        np.asarray(ga), np.asarray(gb), rtol=2e-4, atol=2e-4
+    )
