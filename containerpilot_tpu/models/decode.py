@@ -318,22 +318,14 @@ def sample_logits(
     return jnp.where(t[:, 0] <= 0.0, jnp.argmax(raw, axis=-1), sampled)
 
 
-@functools.lru_cache(maxsize=32)
-def _jitted_generate(cfg: TransformerConfig, max_new_tokens: int,
-                     max_len: int, greedy: bool, filtered: bool):
-    """One compiled program per (config, lengths, sampling mode); jit's
-    own cache covers distinct prompt lengths and batch sizes.
-    Everything request-controlled that doesn't change shapes
-    (temperature, top_k, top_p, eos_id, pad_id — all per-row arrays)
-    is a traced operand, so per-request variation can't churn this
-    cache, and co-batched requests keep independent settings. Each row
-    samples from its own key (fold_in per step), so a row's output
-    never depends on what it was batched with."""
+def _sampling_scan(cfg, max_new_tokens: int, greedy: bool,
+                   filtered: bool):
+    """The shared decode loop: from (cache, next-token logits) sample
+    max_new_tokens with eos/pad handling. Used by the prefill-fused
+    generate program and the prefix-cache extend path."""
 
-    def fn(params, prompt, row_keys, temperature, top_k, top_p, eos_id,
-           pad_id):
-        logits, cache = prefill(params, prompt, cfg, max_len)
-
+    def scan(params, cache, logits, row_keys, temperature, top_k,
+             top_p, eos_id, pad_id):
         def sample(logits, step_idx):
             if greedy:
                 return jnp.argmax(logits, axis=-1)
@@ -366,7 +358,57 @@ def _jitted_generate(cfg: TransformerConfig, max_new_tokens: int,
         )
         return jnp.concatenate([first[:, None], rest.T], axis=1)
 
+    return scan
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_generate(cfg: TransformerConfig, max_new_tokens: int,
+                     max_len: int, greedy: bool, filtered: bool):
+    """One compiled program per (config, lengths, sampling mode); jit's
+    own cache covers distinct prompt lengths and batch sizes.
+    Everything request-controlled that doesn't change shapes
+    (temperature, top_k, top_p, eos_id, pad_id — all per-row arrays)
+    is a traced operand, so per-request variation can't churn this
+    cache, and co-batched requests keep independent settings. Each row
+    samples from its own key (fold_in per step), so a row's output
+    never depends on what it was batched with."""
+    scan = _sampling_scan(cfg, max_new_tokens, greedy, filtered)
+
+    def fn(params, prompt, row_keys, temperature, top_k, top_p, eos_id,
+           pad_id):
+        logits, cache = prefill(params, prompt, cfg, max_len)
+        return scan(params, cache, logits, row_keys, temperature,
+                    top_k, top_p, eos_id, pad_id)
+
     return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_prefill(cfg: TransformerConfig, max_len: int):
+    """Standalone jitted prefill returning (last logits, cache) — the
+    prefix-cache entry point (generate's fused program never exposes
+    its cache)."""
+    return jax.jit(lambda p, t: prefill(p, t, cfg, max_len))
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_extend(cfg: TransformerConfig):
+    """Jitted cache extension: consume a token chunk against a cache
+    (decode_chunk) and return (last logits, cache). jit re-specializes
+    per chunk length; serving buckets those."""
+
+    def fn(params, cache, chunk):
+        logits, cache = decode_chunk(params, cache, chunk, cfg)
+        return logits[:, -1, :], cache
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_decode_from_cache(cfg: TransformerConfig,
+                              max_new_tokens: int, greedy: bool,
+                              filtered: bool):
+    return jax.jit(_sampling_scan(cfg, max_new_tokens, greedy, filtered))
 
 
 def generate(
@@ -395,9 +437,28 @@ def generate(
     per-row keys keep each row's output independent of co-batched
     rows.
     """
-    import numpy as np
+    operands = _normalize_sampling(
+        cfg, prompt.shape[0], max_new_tokens, temperature, rng, top_k,
+        top_p, eos_id, pad_id,
+    )
+    if prompt.shape[1] + max_new_tokens > max_len:
+        # an overflowing decode would silently clamp cache writes onto
+        # the last slot and return garbage — fail loudly instead
+        raise ValueError(
+            f"prompt_len {prompt.shape[1]} + max_new_tokens "
+            f"{max_new_tokens} exceeds max_len {max_len}"
+        )
+    greedy, filtered, op_arrays = operands
+    fn = _jitted_generate(cfg, max_new_tokens, max_len, greedy, filtered)
+    return fn(params, prompt, *op_arrays)
 
-    b = prompt.shape[0]
+
+def _normalize_sampling(cfg, b, max_new_tokens, temperature, rng,
+                        top_k, top_p, eos_id, pad_id):
+    """Validate/broadcast the per-row sampling knobs exactly as
+    ``generate`` documents; returns (greedy, filtered, operand arrays
+    in _sampling_scan order after the cache/logits)."""
+    import numpy as np
 
     def row(v, dtype, name):
         arr = np.asarray(jax.device_get(v), dtype)
@@ -414,13 +475,6 @@ def generate(
     pad_arr = row(pad_id, np.int64, "pad_id")
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
-    if prompt.shape[1] + max_new_tokens > max_len:
-        # an overflowing decode would silently clamp cache writes onto
-        # the last slot and return garbage — fail loudly instead
-        raise ValueError(
-            f"prompt_len {prompt.shape[1]} + max_new_tokens "
-            f"{max_new_tokens} exceeds max_len {max_len}"
-        )
     if (
         (k_arr < 0).any() or (k_arr > cfg.vocab_size).any()
         or (p_arr < 0.0).any() or (p_arr > 1.0).any()
@@ -449,11 +503,52 @@ def generate(
     filtered = bool(
         ((k_arr > 0) | ((p_arr > 0.0) & (p_arr < 1.0))).any()
     )
-    fn = _jitted_generate(cfg, max_new_tokens, max_len, greedy, filtered)
-    return fn(
-        params, prompt, row_keys,
+    return greedy, filtered, (
+        row_keys,
         jnp.asarray(t, jnp.float32), jnp.asarray(k_arr, jnp.int32),
         jnp.asarray(p_arr, jnp.float32),
         jnp.asarray(np.maximum(eos_arr, -1), jnp.int32),
         jnp.asarray(pad_arr, jnp.int32),
     )
+
+
+def generate_from_cache(
+    params: Params,
+    cache: Cache,
+    logits: jax.Array,
+    cfg: TransformerConfig,
+    max_new_tokens: int,
+    temperature=0.0,
+    rng: jax.Array = None,
+    top_k=0,
+    top_p=0.0,
+    eos_id=-1,
+    pad_id=0,
+    pos: int = None,
+) -> jax.Array:
+    """``generate`` starting from an existing (cache, next-token
+    logits) pair — the prefix-cache serving path: the caller restored
+    or extended a cached prompt prefix (prefill/_jitted_extend) and
+    only the new tokens decode here. Same sampling contract as
+    ``generate``.
+
+    ``pos`` is the host-known value of cache['pos'] (tokens already
+    cached); pass it to get the same loud overflow check ``generate``
+    does without a device fetch. When omitted, the scalar is fetched —
+    correctness over latency."""
+    length = cache["k"].shape[2]
+    if pos is None:
+        pos = int(jax.device_get(cache["pos"]))
+    if pos + max_new_tokens > length:
+        # an overflowing decode would silently clamp cache writes onto
+        # the last slot and return garbage — same contract as generate
+        raise ValueError(
+            f"cache pos {pos} + max_new_tokens {max_new_tokens} "
+            f"exceeds cache length {length}"
+        )
+    greedy, filtered, op_arrays = _normalize_sampling(
+        cfg, logits.shape[0], max_new_tokens, temperature, rng, top_k,
+        top_p, eos_id, pad_id,
+    )
+    fn = _jitted_decode_from_cache(cfg, max_new_tokens, greedy, filtered)
+    return fn(params, cache, logits, *op_arrays)

@@ -89,6 +89,7 @@ class InferenceServer:
         draft_layers: int = 0,
         speculate: int = 4,
         max_batch_rows: int = 16,
+        prefix_cache_entries: int = 0,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -108,6 +109,24 @@ class InferenceServer:
                 "--draft-layers does not compose with --window "
                 "(speculative rollback cannot undo ring-cache writes)"
             )
+        if prefix_cache_entries > 0 and cfg.window > 0:
+            raise ValueError(
+                "--prefix-cache does not compose with --window (a "
+                "ring cache's stale rows are live window context, so "
+                "a shorter-prefix rewind cannot reuse them)"
+            )
+        # prefix KV reuse: completed prompts' caches, keyed by their
+        # token tuple, LRU-bounded. A new single-row request reuses
+        # the longest common prefix and only prefills the (bucketed)
+        # suffix — the chat/agent regime where every turn re-sends a
+        # long shared history.
+        from collections import OrderedDict
+
+        self._prefix_cache: Optional[OrderedDict] = (
+            OrderedDict() if prefix_cache_entries > 0 else None
+        )
+        self._prefix_cache_entries = prefix_cache_entries
+        self.prefix_stats = {"hits": 0, "misses": 0, "tokens_reused": 0}
         if draft_layers > 0:
             from ..models.speculative import layer_prefix_draft
 
@@ -159,6 +178,14 @@ class InferenceServer:
                     "device_calls": self.batch_stats["calls"],
                     "rows": self.batch_stats["rows"],
                 },
+                "prefix_cache": (
+                    {
+                        "entries": self._prefix_cache_entries,
+                        **self.prefix_stats,
+                    }
+                    if self._prefix_cache is not None
+                    else None
+                ),
             }
         ).encode()
         return Response(200, body, content_type="application/json")
@@ -221,6 +248,30 @@ class InferenceServer:
 
             loop = asyncio.get_event_loop()
             generated = await loop.run_in_executor(self._executor, run)
+        elif (
+            self._prefix_cache is not None
+            and len(tokens) == 1
+            and (
+                self._prefix_match_len(tokens[0])
+                >= self._PREFIX_MIN_REUSE
+                or self._gen_queue.empty()
+            )
+        ):
+            # hit -> reuse; miss -> still seed the cache, but only when
+            # nothing is queued (otherwise continuous batching would
+            # have coalesced this request — don't trade batching
+            # throughput for a cold-path seed)
+
+            def run_prefix() -> Any:
+                return self._generate_with_prefix(
+                    tokens[0], max_new, temperature, top_k, top_p,
+                    eos_id, seed,
+                )
+
+            loop = asyncio.get_event_loop()
+            generated = await loop.run_in_executor(
+                self._executor, run_prefix
+            )
         else:
             job = _GenJob(
                 rows=tokens, prompt_len=prompt_len, max_new=max_new,
@@ -289,6 +340,96 @@ class InferenceServer:
             ).encode(),
             content_type="application/json",
         )
+
+    # -- prefix KV reuse ------------------------------------------------
+
+    _PREFIX_MIN_REUSE = 16  # shorter matches aren't worth a device call
+    _PREFIX_BUCKET = 16     # suffix lengths compile in these steps
+
+    def _prefix_match_len(self, row: List[int]) -> int:
+        """Longest common prefix between ``row`` and any cached prompt
+        (host-side scan; cheap relative to a device call)."""
+        best = 0
+        for stored in self._prefix_cache:
+            n = min(len(stored), len(row))
+            i = 0
+            while i < n and stored[i] == row[i]:
+                i += 1
+            best = max(best, i)
+        return best
+
+    def _generate_with_prefix(
+        self, row: List[int], max_new: int, temperature: float,
+        top_k: int, top_p: float, eos_id: int, seed: int,
+    ) -> List[List[int]]:
+        """Single-row generation reusing the longest cached prompt
+        prefix. The recomputed suffix is bucketed (a little of the
+        matched prefix is re-prefilled) so jit compiles one extend
+        program per bucket, not per suffix length. Stale cache rows
+        beyond pos are masked/overwritten by design (models/decode.py),
+        which is what makes the rewind sound — and why --window (ring
+        cache) refuses this feature."""
+        from ..models.decode import (
+            _jitted_extend,
+            _jitted_prefill,
+            generate_from_cache,
+        )
+
+        key_row = tuple(row)
+        plen = len(row)
+        best_len, best_key = 0, None
+        for stored in self._prefix_cache:
+            n = min(len(stored), plen)
+            i = 0
+            while i < n and stored[i] == row[i]:
+                i += 1
+            if i > best_len:
+                best_len, best_key = i, stored
+
+        if best_len >= self._PREFIX_MIN_REUSE:
+            suffix = plen - best_len
+            bucket = max(
+                1, -(-suffix // self._PREFIX_BUCKET) * self._PREFIX_BUCKET
+            ) if suffix > 0 else 1
+            reuse = plen - min(bucket, plen)
+        else:
+            reuse = 0
+        if reuse > 0:
+            base = self._prefix_cache[best_key]
+            self._prefix_cache.move_to_end(best_key)
+            cache = {
+                "k": base["k"], "v": base["v"],
+                "pos": jnp.asarray(reuse, jnp.int32),
+            }
+            chunk = jnp.asarray([row[reuse:]], jnp.int32)
+            logits, cache = _jitted_extend(self.cfg)(
+                self.params, cache, chunk
+            )
+            self.prefix_stats["hits"] += 1
+            self.prefix_stats["tokens_reused"] += reuse
+        else:
+            logits, cache = _jitted_prefill(self.cfg, self.max_len)(
+                self.params, jnp.asarray([row], jnp.int32)
+            )
+            self.prefix_stats["misses"] += 1
+        # store the completed prompt's cache for future turns
+        self._prefix_cache[key_row] = cache
+        self._prefix_cache.move_to_end(key_row)
+        while len(self._prefix_cache) > self._prefix_cache_entries:
+            self._prefix_cache.popitem(last=False)
+        # the prefix path is a device call too — keep /v1/model's
+        # batching telemetry honest when this path serves the traffic
+        self.batch_stats["calls"] += 1
+        self.batch_stats["rows"] += 1
+        out = generate_from_cache(
+            self.params, cache, logits, self.cfg,
+            max_new_tokens=max_new, temperature=temperature,
+            rng=jnp.stack([jax.random.fold_in(
+                jax.random.PRNGKey(seed), 0)]),
+            top_k=top_k, top_p=top_p, eos_id=eos_id,
+            pos=plen,
+        )
+        return jax.device_get(out).tolist()
 
     # -- continuous batching -------------------------------------------
 
@@ -539,6 +680,12 @@ def main() -> int:
         help="continuous batching: max sequences coalesced into one "
         "device call",
     )
+    parser.add_argument(
+        "--prefix-cache", type=int, default=0,
+        help="prefix KV reuse: keep the KV caches of the last N "
+        "prompts and re-prefill only the unseen suffix of single-row "
+        "requests sharing a prefix (the chat/agent regime); 0 = off",
+    )
     args = parser.parse_args()
 
     cfg = TransformerConfig(
@@ -615,6 +762,7 @@ def main() -> int:
         cfg, params, args.host, args.port, args.max_len,
         draft_layers=args.draft_layers, speculate=args.speculate,
         max_batch_rows=args.max_batch_rows,
+        prefix_cache_entries=args.prefix_cache,
     )
 
     async def serve() -> None:

@@ -2163,3 +2163,77 @@ def test_ema_tracks_params_and_checkpoints(tmp_path):
 
     with pytest.raises(ValueError, match="decay"):
         with_ema(make_optimizer(1e-2), 1.5)
+
+
+def test_inference_server_prefix_cache(run):
+    """Prefix KV reuse: a second request sharing a long prompt prefix
+    hits the cache, reuses most of the prefill, and produces EXACTLY
+    the same tokens as an uncached server; LRU bounds the entries."""
+    import urllib.request
+
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=128, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    cached = InferenceServer(
+        cfg, params, "127.0.0.1", 0, max_len=128,
+        prefix_cache_entries=2,
+    )
+    plain = InferenceServer(cfg, params, "127.0.0.1", 0, max_len=128)
+
+    shared = list(range(1, 41))  # 40-token shared history
+    turn2 = shared + [50, 51, 52]
+    other = [9] * 40
+
+    def fetch(server, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read().decode())["tokens"]
+
+    async def scenario():
+        import asyncio
+
+        await cached.run()
+        await plain.run()
+        loop = asyncio.get_event_loop()
+
+        async def gen(server, toks, **kw):
+            body = {"tokens": [toks], "max_new_tokens": 8, **kw}
+            return await loop.run_in_executor(
+                None, lambda: fetch(server, body)
+            )
+
+        r1c = await gen(cached, shared)
+        r1p = await gen(plain, shared)
+        r2c = await gen(cached, turn2)   # shares the 40-token prefix
+        r2p = await gen(plain, turn2)
+        # sampled request through the prefix path too (same seed)
+        r3c = await gen(cached, turn2, temperature=0.8, seed=7)
+        r3p = await gen(plain, turn2, temperature=0.8, seed=7)
+        # a third distinct prompt evicts the oldest entry (LRU cap 2)
+        await gen(cached, other)
+        stats = dict(cached.prefix_stats)
+        n_entries = len(cached._prefix_cache)
+        await cached.stop()
+        await plain.stop()
+        return r1c, r1p, r2c, r2p, r3c, r3p, stats, n_entries
+
+    import json
+
+    r1c, r1p, r2c, r2p, r3c, r3p, stats, n_entries = run(
+        scenario(), timeout=180
+    )
+    assert r1c == r1p, "cold-path output must match the uncached server"
+    assert r2c == r2p, "prefix-hit output must match the uncached server"
+    assert r3c == r3p, "sampled prefix-hit must match (same seed)"
+    assert stats["hits"] >= 2, stats
+    assert stats["tokens_reused"] >= 40, stats
+    assert n_entries == 2  # LRU evicted down to the cap
