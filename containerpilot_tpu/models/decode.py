@@ -143,6 +143,50 @@ def prefill(
     return logits[:, 0, :], cache
 
 
+def chunked_prefill(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    max_len: int,
+    chunk_len: int = 512,
+) -> Tuple[jax.Array, Cache]:
+    """``prefill`` in fixed-size pieces: the prompt streams through
+    ``decode_chunk`` ``chunk_len`` tokens at a time, so peak
+    activation memory is O(chunk) instead of O(prompt) — the serving
+    answer to prompts long enough that one-shot prefill attention
+    blows HBM. Numerics match ``prefill`` (same masked paths).
+
+    Compile churn is bounded by construction: the ragged remainder is
+    processed first as (at most) one sub-16 piece plus 16-token
+    pieces, then full chunks — so piece lengths come from
+    {1..15, 16, chunk_len} regardless of prompt length, instead of a
+    fresh program per distinct ``prompt_len % chunk_len``. With a
+    sliding window, pieces are capped at the ring length.
+    """
+    b, s = tokens.shape
+    if chunk_len < 1:
+        raise ValueError("chunk_len must be >= 1")
+    cache = init_cache(cfg, b, max_len)
+    if cfg.window > 0:
+        chunk_len = min(chunk_len, cache["k"].shape[2])
+    bucket = min(16, chunk_len)
+    lead = s % chunk_len
+    plan = []
+    if lead % bucket:
+        plan.append(lead % bucket)
+    plan += [bucket] * (lead // bucket)
+    plan += [chunk_len] * (s // chunk_len)
+    extend = _jitted_extend(cfg)
+    logits = None
+    start = 0
+    for piece in plan:
+        logits, cache = extend(
+            params, cache, tokens[:, start:start + piece]
+        )
+        start += piece
+    return logits, cache
+
+
 def decode_step(
     params: Params, cache: Cache, token: jax.Array, cfg: TransformerConfig
 ) -> Tuple[jax.Array, Cache]:
@@ -536,16 +580,20 @@ def generate_from_cache(
     cached); pass it to get the same loud overflow check ``generate``
     does without a device fetch. When omitted, the scalar is fetched —
     correctness over latency."""
-    length = cache["k"].shape[2]
-    if pos is None:
-        pos = int(jax.device_get(cache["pos"]))
-    if pos + max_new_tokens > length:
-        # an overflowing decode would silently clamp cache writes onto
-        # the last slot and return garbage — same contract as generate
-        raise ValueError(
-            f"cache pos {pos} + max_new_tokens {max_new_tokens} "
-            f"exceeds cache length {length}"
-        )
+    if cfg.window <= 0:
+        # a ring cache legally decodes past its length (positions wrap
+        # by design); only a linear cache can overflow
+        length = cache["k"].shape[2]
+        if pos is None:
+            pos = int(jax.device_get(cache["pos"]))
+        if pos + max_new_tokens > length:
+            # an overflowing decode would silently clamp cache writes
+            # onto the last slot and return garbage — same contract as
+            # generate
+            raise ValueError(
+                f"cache pos {pos} + max_new_tokens {max_new_tokens} "
+                f"exceeds cache length {length}"
+            )
     greedy, filtered, op_arrays = _normalize_sampling(
         cfg, logits.shape[0], max_new_tokens, temperature, rng, top_k,
         top_p, eos_id, pad_id,

@@ -90,6 +90,7 @@ class InferenceServer:
         speculate: int = 4,
         max_batch_rows: int = 16,
         prefix_cache_entries: int = 0,
+        prefill_chunk: int = 0,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -127,6 +128,9 @@ class InferenceServer:
         )
         self._prefix_cache_entries = prefix_cache_entries
         self.prefix_stats = {"hits": 0, "misses": 0, "tokens_reused": 0}
+        # prompts longer than this stream through decode_chunk pieces
+        # (peak prefill activations O(chunk) instead of O(prompt))
+        self.prefill_chunk = prefill_chunk
         if draft_layers > 0:
             from ..models.speculative import layer_prefix_draft
 
@@ -272,6 +276,39 @@ class InferenceServer:
             generated = await loop.run_in_executor(
                 self._executor, run_prefix
             )
+        elif (
+            self.prefill_chunk > 0
+            and len(tokens) == 1
+            and prompt_len > self.prefill_chunk
+        ):
+            # long single-row prompt: stream the prefill in chunks
+
+            def run_chunked() -> Any:
+                from ..models.decode import (
+                    chunked_prefill,
+                    generate_from_cache,
+                )
+
+                logits, cache = chunked_prefill(
+                    self.params, jnp.asarray(tokens, jnp.int32),
+                    self.cfg, self.max_len, self.prefill_chunk,
+                )
+                self.batch_stats["calls"] += 1
+                self.batch_stats["rows"] += 1
+                out = generate_from_cache(
+                    self.params, cache, logits, self.cfg,
+                    max_new_tokens=max_new, temperature=temperature,
+                    rng=jnp.stack([jax.random.fold_in(
+                        jax.random.PRNGKey(seed), 0)]),
+                    top_k=top_k, top_p=top_p, eos_id=eos_id,
+                    pos=prompt_len,
+                )
+                return jax.device_get(out).tolist()
+
+            loop = asyncio.get_event_loop()
+            generated = await loop.run_in_executor(
+                self._executor, run_chunked
+            )
         else:
             job = _GenJob(
                 rows=tokens, prompt_len=prompt_len, max_new=max_new,
@@ -407,6 +444,16 @@ class InferenceServer:
             )
             self.prefix_stats["hits"] += 1
             self.prefix_stats["tokens_reused"] += reuse
+        elif self.prefill_chunk and plen > self.prefill_chunk:
+            # cold long prompt: seed the prefix cache via the chunked
+            # stream so the configured prefill HBM bound still holds
+            from ..models.decode import chunked_prefill
+
+            logits, cache = chunked_prefill(
+                self.params, jnp.asarray([row], jnp.int32), self.cfg,
+                self.max_len, self.prefill_chunk,
+            )
+            self.prefix_stats["misses"] += 1
         else:
             logits, cache = _jitted_prefill(self.cfg, self.max_len)(
                 self.params, jnp.asarray([row], jnp.int32)
@@ -681,6 +728,12 @@ def main() -> int:
         "device call",
     )
     parser.add_argument(
+        "--prefill-chunk", type=int, default=0,
+        help="stream prompts longer than N through chunked prefill "
+        "(peak prefill activations O(N) instead of O(prompt)); 0 = "
+        "one-shot prefill",
+    )
+    parser.add_argument(
         "--prefix-cache", type=int, default=0,
         help="prefix KV reuse: keep the KV caches of the last N "
         "prompts and re-prefill only the unseen suffix of single-row "
@@ -763,6 +816,7 @@ def main() -> int:
         draft_layers=args.draft_layers, speculate=args.speculate,
         max_batch_rows=args.max_batch_rows,
         prefix_cache_entries=args.prefix_cache,
+        prefill_chunk=args.prefill_chunk,
     )
 
     async def serve() -> None:

@@ -2237,3 +2237,47 @@ def test_inference_server_prefix_cache(run):
     assert stats["hits"] >= 2, stats
     assert stats["tokens_reused"] >= 40, stats
     assert n_entries == 2  # LRU evicted down to the cap
+
+
+def test_chunked_prefill_matches_prefill():
+    """Streaming the prompt through decode_chunk pieces must produce
+    the same cache and last-position logits as one-shot prefill —
+    dense, GQA, ragged final chunk, and windowed ring."""
+    from containerpilot_tpu.models.decode import chunked_prefill, prefill
+
+    for kw in (
+        {},
+        {"n_kv_heads": 2},
+        {"window": 8},
+    ):
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=128,
+            max_seq_len=64, dtype=jnp.float32, flash_min_seq=0, **kw
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 23), 0, cfg.vocab_size, jnp.int32
+        )  # 23 = 3 chunks of 7 + ragged 2
+        ref_logits, ref_cache = prefill(params, tokens, cfg, 64)
+        got_logits, got_cache = chunked_prefill(
+            params, tokens, cfg, 64, chunk_len=7
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_logits), np.asarray(ref_logits),
+            rtol=2e-3, atol=2e-3, err_msg=str(kw),
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_cache["k"]), np.asarray(ref_cache["k"]),
+            rtol=1e-4, atol=1e-5, err_msg=str(kw),
+        )
+        assert int(got_cache["pos"]) == int(ref_cache["pos"]) == 23
+        # decode continues identically from either cache
+        from containerpilot_tpu.models.decode import decode_step
+
+        la, _ = decode_step(params, got_cache, tokens[:, 0], cfg)
+        lb, _ = decode_step(params, ref_cache, tokens[:, 0], cfg)
+        np.testing.assert_allclose(
+            np.asarray(la), np.asarray(lb), rtol=2e-3, atol=2e-3
+        )
+    with pytest.raises(ValueError, match="chunk_len"):
+        chunked_prefill(params, tokens, cfg, 64, chunk_len=0)
