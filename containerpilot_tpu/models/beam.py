@@ -1,0 +1,163 @@
+"""Beam-search decoding over the KV cache.
+
+TPU-first shape discipline: the beam IS the batch axis. The prompt
+prefills once (batch 1), the cache tiles to ``beam_width`` rows, and
+every step is one batched ``decode_step`` over the beams — so the MXU
+sees a [beam, ...] matmul, not beam sequential decodes. Beam
+reordering is a gather along the cache's batch axis inside the same
+compiled scan (no host roundtrips per step).
+
+Finished beams (emitted eos) are frozen: they can only extend with
+``pad_id`` at zero added log-probability, the standard trick that
+keeps shapes static while finished candidates compete on their final
+scores. ``length_penalty`` rescales scores by
+``((5 + len) / 6) ** alpha`` (GNMT); 0 disables.
+
+No reference analog (the reference is a process supervisor —
+SURVEY.md §2); this is workload-half decoding breadth next to
+greedy/sampled ``generate`` and speculative decoding.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .decode import prefill
+from .transformer import TransformerConfig, Params
+from ..ops.attention import NEG_INF
+
+
+def _gather_beams(tree, idx):
+    """Reorder the beam axis of every cache leaf: k/v are
+    [layers, beam, len, kv, hd] (gather axis 1), pos is scalar."""
+    return {
+        "k": tree["k"][:, idx],
+        "v": tree["v"][:, idx],
+        "pos": tree["pos"],
+    }
+
+
+@functools.lru_cache(maxsize=16)
+def _jitted_beam(cfg: TransformerConfig, max_new_tokens: int,
+                 max_len: int, beam_width: int,
+                 length_penalty: float):
+    from .decode import decode_step
+
+    def penalize(scores, length):
+        if length_penalty <= 0.0:
+            return scores
+        return scores / (((5.0 + length) / 6.0) ** length_penalty)
+
+    def fn(params, prompt, eos_id, pad_id):
+        logits, cache = prefill(params, prompt, cfg, max_len)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        # first expansion: top beam_width continuations of the prompt
+        scores, first = lax.top_k(logp[0], beam_width)  # [beam]
+        first = first.astype(jnp.int32)
+        cache = _gather_beams(
+            cache, jnp.zeros((beam_width,), jnp.int32)
+        )  # tile batch 1 -> beam rows
+        done = first == eos_id
+        tokens0 = jnp.full(
+            (beam_width, max_new_tokens), pad_id, jnp.int32
+        ).at[:, 0].set(first)
+
+        def step(carry, step_idx):
+            cache, tokens, scores, done, last = carry
+            logits, cache = decode_step(params, cache, last, cfg)
+            logp = jax.nn.log_softmax(
+                logits.astype(jnp.float32), axis=-1
+            )  # [beam, vocab]
+            vocab = logp.shape[-1]
+            # finished beams: only pad survives, score unchanged
+            frozen = jnp.full((vocab,), NEG_INF).at[pad_id].set(0.0)
+            logp = jnp.where(done[:, None], frozen[None, :], logp)
+            total = scores[:, None] + logp  # [beam, vocab]
+            flat_scores, flat_idx = lax.top_k(
+                total.reshape(-1), beam_width
+            )
+            parent = (flat_idx // vocab).astype(jnp.int32)
+            token = (flat_idx % vocab).astype(jnp.int32)
+            cache = _gather_beams(cache, parent)
+            tokens = tokens[parent].at[:, step_idx].set(token)
+            done = done[parent] | (token == eos_id)
+            return (cache, tokens, flat_scores, done, token), None
+
+        (cache, tokens, scores, done, _last), _ = lax.scan(
+            step, (cache, tokens0, scores, done, first),
+            jnp.arange(1, max_new_tokens, dtype=jnp.int32),
+        )
+        lengths = jnp.where(
+            done,
+            jnp.argmax(tokens == eos_id, axis=1) + 1,
+            max_new_tokens,
+        ).astype(jnp.float32)
+        final = penalize(scores, lengths)
+        best = jnp.argmax(final)
+        return tokens[best], final[best]
+
+    return jax.jit(fn)
+
+
+def validate_beam_args(
+    cfg: TransformerConfig, n_rows: int, beam_width: int
+) -> None:
+    """The request-shape rules shared by ``beam_search`` and the
+    serving handler (one wording, no drift): single row, width within
+    the vocab, no sliding-window configs (the beam gather permutes
+    cache rows; the frozen-beam bookkeeping has not been validated
+    against ring wraparound — refuse rather than risk silent
+    divergence)."""
+    if n_rows != 1:
+        raise ValueError("beam search decodes one prompt at a time")
+    if not 1 <= beam_width <= cfg.vocab_size:
+        raise ValueError(
+            f"beam_width must be in [1, vocab {cfg.vocab_size}]"
+        )
+    if cfg.window > 0:
+        raise ValueError(
+            "beam search does not support sliding-window configs yet"
+        )
+
+
+def beam_search(
+    params: Params,
+    prompt: jax.Array,
+    cfg: TransformerConfig,
+    max_new_tokens: int,
+    max_len: int,
+    beam_width: int = 4,
+    eos_id: int = -1,
+    pad_id: int = 0,
+    length_penalty: float = 0.0,
+) -> Tuple[jax.Array, float]:
+    """Deterministic beam search; prompt is [1, prompt_len] int32.
+    Returns (tokens [max_new_tokens] int32, score float) — the
+    highest-scoring beam, padded with ``pad_id`` past its eos.
+    ``beam_width=1`` reduces exactly to greedy ``generate``."""
+    validate_beam_args(cfg, prompt.shape[0], beam_width)
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if prompt.shape[1] + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt_len {prompt.shape[1]} + max_new_tokens "
+            f"{max_new_tokens} exceeds max_len {max_len}"
+        )
+    if not 0 <= pad_id < cfg.vocab_size or eos_id >= cfg.vocab_size:
+        # an out-of-range pad would be silently clamped by the jitted
+        # scatter and pad finished beams with a garbage token
+        raise ValueError(
+            f"pad_id must be in [0, vocab {cfg.vocab_size}) and "
+            f"eos_id < vocab (eos < 0 disables)"
+        )
+    fn = _jitted_beam(
+        cfg, max_new_tokens, max_len, beam_width, float(length_penalty)
+    )
+    tokens, score = fn(
+        params, prompt, jnp.int32(eos_id), jnp.int32(pad_id)
+    )
+    return tokens, float(score)

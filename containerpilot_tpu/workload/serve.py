@@ -206,6 +206,17 @@ class InferenceServer:
             top_k = int(body.get("top_k", 0))
             top_p = float(body.get("top_p", 0.0))
             eos_id = int(body.get("eos_id", -1))
+            beam_width = int(body.get("beam_width", 0))
+            length_penalty = float(body.get("length_penalty", 0.0))
+            if beam_width:
+                from ..models.beam import validate_beam_args
+
+                if temperature > 0.0 or top_k or top_p:
+                    raise ValueError(
+                        "beam search is deterministic; drop "
+                        "temperature/top_k/top_p"
+                    )
+                validate_beam_args(self.cfg, len(tokens), beam_width)
             if (not 0 <= top_k <= self.cfg.vocab_size
                     or not 0.0 <= top_p <= 1.0):
                 raise ValueError(
@@ -230,7 +241,31 @@ class InferenceServer:
         except (ValueError, KeyError, TypeError) as exc:
             return Response(422, f"{exc}\n".encode())
 
-        if (
+        if beam_width:
+
+            def run_beam() -> Any:
+                from ..models.beam import beam_search
+
+                # beam search is NOT prefix-consistent: the best
+                # 16-token beam's first 6 tokens are not the best
+                # 6-token continuation, so the compiled horizon is the
+                # REQUESTED length, not the bucketed one (beams are
+                # explicit requests; the compile churn is theirs)
+                out, score = beam_search(
+                    self.params, jnp.asarray(tokens, jnp.int32),
+                    self.cfg, max_new_tokens=max_new_requested,
+                    max_len=self.max_len, beam_width=beam_width,
+                    eos_id=eos_id, length_penalty=length_penalty,
+                )
+                self.batch_stats["calls"] += 1
+                self.batch_stats["rows"] += 1
+                return [jax.device_get(out).tolist()]
+
+            loop = asyncio.get_event_loop()
+            generated = await loop.run_in_executor(
+                self._executor, run_beam
+            )
+        elif (
             self.draft_params is not None
             and temperature <= 0.0
             and len(tokens) == 1

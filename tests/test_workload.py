@@ -2281,3 +2281,133 @@ def test_chunked_prefill_matches_prefill():
         )
     with pytest.raises(ValueError, match="chunk_len"):
         chunked_prefill(params, tokens, cfg, 64, chunk_len=0)
+
+
+def test_beam_search_width1_equals_greedy_and_exhaustive_optimum():
+    """beam_width=1 reproduces greedy generate exactly; a beam wide
+    enough to be exhaustive finds the brute-force argmax sequence."""
+    from containerpilot_tpu.models.beam import beam_search
+    from containerpilot_tpu.models.decode import generate
+    from containerpilot_tpu.models.transformer import forward
+
+    cfg = TransformerConfig(
+        vocab_size=8, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32, flash_min_seq=0,
+    )
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+
+    greedy = np.asarray(generate(params, prompt, cfg, 4, 32))[0]
+    b1, _ = beam_search(params, prompt, cfg, 4, 32, beam_width=1)
+    np.testing.assert_array_equal(np.asarray(b1), greedy)
+
+    # exhaustive optimum over 2 steps: beam_width == vocab keeps every
+    # possible first token, so no prefix of the best pair is pruned
+    best_beam, best_score = beam_search(
+        params, prompt, cfg, 2, 32, beam_width=8
+    )
+
+    def seq_logprob(cont):
+        toks = jnp.asarray([[1, 2, 3] + list(cont)], jnp.int32)
+        logits = forward(params, toks, cfg)
+        logp = jax.nn.log_softmax(
+            logits.astype(jnp.float32), axis=-1
+        )
+        return sum(
+            float(logp[0, 2 + i, cont[i]]) for i in range(len(cont))
+        )
+
+    brute = max(
+        ((a, b) for a in range(8) for b in range(8)),
+        key=seq_logprob,
+    )
+    assert tuple(np.asarray(best_beam)) == brute
+    np.testing.assert_allclose(best_score, seq_logprob(brute), rtol=1e-5)
+
+
+def test_beam_search_eos_and_validation():
+    """Finished beams freeze (pad after eos, score keeps competing);
+    invalid arguments fail loudly."""
+    from containerpilot_tpu.models.beam import beam_search
+
+    cfg = TransformerConfig(
+        vocab_size=16, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32, flash_min_seq=0,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[1, 2]], jnp.int32)
+    toks, _ = beam_search(
+        params, prompt, cfg, 6, 32, beam_width=3, eos_id=5, pad_id=0
+    )
+    toks = list(np.asarray(toks))
+    if 5 in toks:
+        after = toks[toks.index(5) + 1:]
+        assert all(t == 0 for t in after), toks
+    with pytest.raises(ValueError, match="beam_width"):
+        beam_search(params, prompt, cfg, 4, 32, beam_width=0)
+    with pytest.raises(ValueError, match="one prompt"):
+        beam_search(
+            params, jnp.ones((2, 3), jnp.int32), cfg, 4, 32
+        )
+    with pytest.raises(ValueError, match="sliding-window"):
+        import dataclasses
+
+        beam_search(
+            params, prompt, dataclasses.replace(cfg, window=8), 4, 32
+        )
+
+
+def test_inference_server_beam_search(run):
+    """/v1/generate beam_width: beam-1 equals greedy over HTTP; wider
+    beams return a (length-trimmed) deterministic result; invalid
+    combinations 422."""
+    import urllib.error
+    import urllib.request
+
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server = InferenceServer(cfg, params, "127.0.0.1", 0, max_len=64)
+
+    def fetch(body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            return exc.code, exc.read().decode()
+
+    async def scenario():
+        import asyncio
+
+        await server.run()
+        loop = asyncio.get_event_loop()
+
+        async def gen(body):
+            return await loop.run_in_executor(None, lambda: fetch(body))
+
+        base = {"tokens": [[1, 2, 3]], "max_new_tokens": 6}
+        greedy = await gen(base)
+        b1 = await gen({**base, "beam_width": 1})
+        b4a = await gen({**base, "beam_width": 4})
+        b4b = await gen({**base, "beam_width": 4})
+        bad = await gen({**base, "beam_width": 4, "temperature": 0.7})
+        await server.stop()
+        return greedy, b1, b4a, b4b, bad
+
+    import json
+
+    greedy, b1, b4a, b4b, bad = run(scenario(), timeout=180)
+    assert greedy[0] == b1[0] == 200
+    assert b1[1]["tokens"] == greedy[1]["tokens"]
+    assert b4a[0] == 200 and b4a[1] == b4b[1]  # deterministic
+    assert bad[0] == 422 and "deterministic" in bad[1]
