@@ -44,16 +44,8 @@ BASELINE_MS = 35.0  # midpoint of the reference's documented 20-50ms
 CYCLES = 60
 WARMUP = 5
 
-# bf16 peak FLOP/s by TPU generation (public spec sheets), keyed by
-# substrings of jax Device.device_kind; used for the MFU denominator
-_PEAK_BF16 = [
-    ("v6", 918e12),   # Trillium / v6e
-    ("v5p", 459e12),
-    ("v5", 197e12),   # v5e / "TPU v5 lite"
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 46e12),
-]
+# MFU denominator lives with the workload half; see
+# containerpilot_tpu/workload/flops.py for the per-generation table
 
 
 async def one_cycle() -> float:
@@ -138,11 +130,9 @@ def _time_ms(fn, *args, n: int = 5, reps: int = 3) -> float:
 
 
 def _peak_flops(device_kind: str) -> float:
-    kind = device_kind.lower()
-    for key, peak in _PEAK_BF16:
-        if key in kind:
-            return peak
-    return 197e12  # assume v5e-class if unrecognized
+    from containerpilot_tpu.workload.flops import peak_flops
+
+    return peak_flops(device_kind)
 
 
 def training_bench() -> dict:
@@ -182,21 +172,24 @@ def training_bench() -> dict:
         jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size, jnp.int32
     )
 
-    # warm-up/compile + 2 steps, then timed steps
+    # warm-up/compile + 2 steps, then timed steps (tunnel roundtrip
+    # subtracted once — the sync floor would otherwise inflate every
+    # step by floor/n ms)
     for _ in range(2):
         state, loss = step(state, tokens)
     _sync(loss)
+    floor = _sync_floor_ms() / 1e3
     n = 5
     t0 = time.perf_counter()
     for _ in range(n):
         state, loss = step(state, tokens)
     _sync(loss)
-    step_s = (time.perf_counter() - t0) / n
+    step_s = max(time.perf_counter() - t0 - floor, 1e-6) / n
 
     tokens_per_sec = batch * seq / step_s
-    # PaLM-style accounting: 6N per token (fwd+bwd matmuls) plus the
-    # attention score/value matmuls 12*L*d*s per token
-    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
+    from containerpilot_tpu.workload.flops import train_flops_per_token
+
+    flops_per_token = train_flops_per_token(cfg, n_params, seq)
     device_kind = jax.devices()[0].device_kind
     mfu = flops_per_token * tokens_per_sec / _peak_flops(device_kind)
     return {

@@ -43,8 +43,7 @@ def _gather_beams(tree, idx):
 
 @functools.lru_cache(maxsize=16)
 def _jitted_beam(cfg: TransformerConfig, max_new_tokens: int,
-                 max_len: int, beam_width: int,
-                 length_penalty: float):
+                 beam_width: int, length_penalty: float):
     from .decode import decode_step
 
     def penalize(scores, length):
@@ -52,8 +51,8 @@ def _jitted_beam(cfg: TransformerConfig, max_new_tokens: int,
             return scores
         return scores / (((5.0 + length) / 6.0) ** length_penalty)
 
-    def fn(params, prompt, eos_id, pad_id):
-        logits, cache = prefill(params, prompt, cfg, max_len)
+    def fn(params, cache, logits, eos_id, pad_id):
+        # cache/logits come from prefill OR chunked_prefill (batch 1)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         # first expansion: top beam_width continuations of the prompt
         scores, first = lax.top_k(logp[0], beam_width)  # [beam]
@@ -134,11 +133,15 @@ def beam_search(
     eos_id: int = -1,
     pad_id: int = 0,
     length_penalty: float = 0.0,
+    prefill_chunk: int = 0,
 ) -> Tuple[jax.Array, float]:
     """Deterministic beam search; prompt is [1, prompt_len] int32.
     Returns (tokens [max_new_tokens] int32, score float) — the
     highest-scoring beam, padded with ``pad_id`` past its eos.
-    ``beam_width=1`` reduces exactly to greedy ``generate``."""
+    ``beam_width=1`` reduces exactly to greedy ``generate``.
+    ``prefill_chunk > 0`` streams the prompt through chunked_prefill
+    (peak prefill activations O(chunk)) — the long-prompt regime that
+    asks for beams is exactly the one that needs the bound."""
     validate_beam_args(cfg, prompt.shape[0], beam_width)
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
@@ -154,10 +157,18 @@ def beam_search(
             f"pad_id must be in [0, vocab {cfg.vocab_size}) and "
             f"eos_id < vocab (eos < 0 disables)"
         )
+    if prefill_chunk > 0 and prompt.shape[1] > prefill_chunk:
+        from .decode import chunked_prefill
+
+        logits, cache = chunked_prefill(
+            params, prompt, cfg, max_len, prefill_chunk
+        )
+    else:
+        logits, cache = prefill(params, prompt, cfg, max_len)
     fn = _jitted_beam(
-        cfg, max_new_tokens, max_len, beam_width, float(length_penalty)
+        cfg, max_new_tokens, beam_width, float(length_penalty)
     )
     tokens, score = fn(
-        params, prompt, jnp.int32(eos_id), jnp.int32(pad_id)
+        params, cache, logits, jnp.int32(eos_id), jnp.int32(pad_id)
     )
     return tokens, float(score)

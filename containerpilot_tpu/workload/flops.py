@@ -1,0 +1,71 @@
+"""Model-FLOPs accounting shared by bench.py and the trainer.
+
+PaLM-style: a training step costs ~6 FLOPs per parameter per token
+(fwd matmul + 2x bwd) plus the attention score/value matmuls, which
+the 6N term misses because they scale with sequence length, not
+parameter count: 12 * L * d_model * seq per token (fwd+bwd, causal
+halving folded in). MFU = achieved FLOP/s over the chip's published
+bf16 peak — the honest utilization number, not a hardware counter.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+# bf16 peak FLOP/s by TPU generation (public spec sheets), matched by
+# substring of jax Device.device_kind
+PEAK_BF16 = [
+    ("v6", 918e12),   # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5", 197e12),   # v5e / "TPU v5 lite"
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
+
+
+def peak_flops(device_kind: str) -> float:
+    kind = device_kind.lower()
+    for key, peak in PEAK_BF16:
+        if key in kind:
+            return peak
+    return 197e12  # assume v5e-class if unrecognized
+
+
+def count_params(params: Any) -> int:
+    import jax
+
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))
+
+
+def train_flops_per_token(
+    cfg: Any, n_params: int, seq: int, n_frozen: int = 0
+) -> float:
+    """FLOPs one training step spends per token.
+
+    - sliding window: the attention term scales with
+      min(seq, window) — the kernels skip out-of-window blocks;
+    - MoE: only 1 of E experts executes per token (top-1 switch
+      routing), so the inactive experts' parameters don't bill;
+    - ``n_frozen`` (LoRA base): frozen params do forward + grad
+      propagation but no weight-gradient matmul — 4 FLOPs/param
+      instead of 6. Without these corrections the MFU gauge reads a
+      fictitious number for exactly those configs.
+    """
+    attn_span = seq if cfg.window <= 0 else min(seq, cfg.window)
+    active = float(n_params)
+    if getattr(cfg, "moe_experts", 0) > 1:
+        expert_total = (
+            2.0 * cfg.n_layers * cfg.moe_experts * cfg.d_model * cfg.d_ff
+        )
+        active -= expert_total * (1.0 - 1.0 / cfg.moe_experts)
+    frozen = min(float(n_frozen), active)
+    return (
+        6.0 * (active - frozen)
+        + 4.0 * frozen
+        + 12.0 * cfg.n_layers * cfg.d_model * attn_span
+    )
+
+
+def train_step_flops(cfg: Any, n_params: int, batch: int,
+                     seq: int) -> float:
+    return train_flops_per_token(cfg, n_params, seq) * batch * seq

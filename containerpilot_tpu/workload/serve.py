@@ -217,6 +217,13 @@ class InferenceServer:
                         "temperature/top_k/top_p"
                     )
                 validate_beam_args(self.cfg, len(tokens), beam_width)
+                if beam_width > self.max_batch_rows:
+                    # beams tile the KV cache: one request must not
+                    # exceed the server's configured device-row budget
+                    raise ValueError(
+                        f"beam_width capped at --max-batch-rows "
+                        f"({self.max_batch_rows})"
+                    )
             if (not 0 <= top_k <= self.cfg.vocab_size
                     or not 0.0 <= top_p <= 1.0):
                 raise ValueError(
@@ -256,6 +263,7 @@ class InferenceServer:
                     self.cfg, max_new_tokens=max_new_requested,
                     max_len=self.max_len, beam_width=beam_width,
                     eos_id=eos_id, length_penalty=length_penalty,
+                    prefill_chunk=self.prefill_chunk,
                 )
                 self.batch_stats["calls"] += 1
                 self.batch_stats["rows"] += 1

@@ -327,6 +327,27 @@ def main() -> int:
         )
     profiling = False
 
+    # throughput accounting: tokens/s from wall clock, MFU against the
+    # chip generation's bf16 peak (workload/flops.py) — the numbers an
+    # operator watches on the supervisor's Prometheus endpoint
+    from .flops import count_params, peak_flops, train_flops_per_token
+
+    if args.lora_rank > 0:
+        # the frozen base forwards + carries grads but trains nothing
+        n_base = count_params(base_params)
+        n_params = n_base + count_params(state.params)
+        flops_per_token = train_flops_per_token(
+            cfg, n_params, args.seq_len, n_frozen=n_base
+        )
+    else:
+        n_params = count_params(state.params)
+        flops_per_token = train_flops_per_token(
+            cfg, n_params, args.seq_len
+        )
+    chip_peak = peak_flops(jax.devices()[0].device_kind) * len(
+        jax.devices()
+    )
+
     data_rng = jax.random.PRNGKey(1)
     t0 = time.monotonic()
     try:
@@ -358,16 +379,25 @@ def main() -> int:
                     json.dump({"step": step + 1, "loss": float(loss),
                                "time": time.time()}, f)
                 os.replace(tmp, args.progress_file)
-            if client is not None and (step + 1) % 10 == 0:
-                try:
-                    client.put_metric({"training_steps_total": 10,
-                                       "training_loss": float(loss)})
-                except Exception:
-                    pass  # supervisor may be reloading; never die for this
             if (step + 1) % 10 == 0 or step == start_step:
+                # one throughput computation feeds BOTH the metric
+                # export and the log line, so they can never disagree
                 rate = (step + 1 - start_step) / (time.monotonic() - t0)
+                tokens_s = rate * args.batch * args.seq_len
+                mfu = tokens_s * flops_per_token / chip_peak
+                if client is not None and (step + 1) % 10 == 0:
+                    try:
+                        client.put_metric({
+                            "training_steps_total": 10,
+                            "training_loss": float(loss),
+                            "training_tokens_per_sec": tokens_s,
+                            "training_mfu": mfu,
+                        })
+                    except Exception:
+                        pass  # supervisor may be reloading; never die
                 print(f"step {step + 1}: loss={float(loss):.4f} "
-                      f"({rate:.1f} steps/s)")
+                      f"({rate:.1f} steps/s, {tokens_s:.0f} tok/s, "
+                      f"mfu={mfu:.3f})")
             if eval_step is not None and (step + 1) % args.eval_every == 0:
                 if args.lora_rank > 0:
                     from ..models.lora import apply_lora

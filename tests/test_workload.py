@@ -2336,13 +2336,20 @@ def test_beam_search_eos_and_validation():
     )
     params = init_params(jax.random.PRNGKey(0), cfg)
     prompt = jnp.asarray([[1, 2]], jnp.int32)
+    # beam_width=1 follows the greedy path exactly, so declaring the
+    # greedy second token as eos GUARANTEES the freeze logic fires
+    from containerpilot_tpu.models.decode import generate
+
+    greedy = list(np.asarray(generate(params, prompt, cfg, 6, 32))[0])
+    eos = int(greedy[1])
     toks, _ = beam_search(
-        params, prompt, cfg, 6, 32, beam_width=3, eos_id=5, pad_id=0
+        params, prompt, cfg, 6, 32, beam_width=1, eos_id=eos, pad_id=0
     )
     toks = list(np.asarray(toks))
-    if 5 in toks:
-        after = toks[toks.index(5) + 1:]
-        assert all(t == 0 for t in after), toks
+    assert eos in toks, (toks, greedy)
+    after = toks[toks.index(eos) + 1:]
+    # eos fires by step 2 at the latest, so pads definitely follow
+    assert len(after) >= 4 and all(t == 0 for t in after), toks
     with pytest.raises(ValueError, match="beam_width"):
         beam_search(params, prompt, cfg, 4, 32, beam_width=0)
     with pytest.raises(ValueError, match="one prompt"):
