@@ -8,6 +8,7 @@ annotate shardings, and let XLA insert the collectives over ICI/DCN
 (SURVEY.md §5 distributed-backend mapping).
 """
 from .checkpoint import (
+    wait_for_checkpoints,
     latest_step,
     restore_checkpoint,
     restore_params,
@@ -60,6 +61,7 @@ __all__ = [
     "make_optimizer",
     "train_state_shardings",
     "save_checkpoint",
+    "wait_for_checkpoints",
     "restore_checkpoint",
     "restore_params",
     "latest_step",

@@ -29,6 +29,7 @@ _STEP_DIR = re.compile(r"^step_(\d+)$")
 KEEP_CHECKPOINTS = 2
 
 _checkpointer = None
+_async_checkpointer = None
 
 
 def _get_checkpointer():
@@ -40,6 +41,27 @@ def _get_checkpointer():
 
         _checkpointer = ocp.StandardCheckpointer()
     return _checkpointer
+
+
+def _get_async_checkpointer():
+    """The async variant: ``save`` copies device arrays to host
+    synchronously (so the caller may donate/overwrite its buffers
+    immediately) and writes to disk on a background thread."""
+    global _async_checkpointer
+    if _async_checkpointer is None:
+        import orbax.checkpoint as ocp
+
+        _async_checkpointer = ocp.AsyncCheckpointer(
+            ocp.StandardCheckpointHandler()
+        )
+    return _async_checkpointer
+
+
+def wait_for_checkpoints() -> None:
+    """Block until every in-flight async save has committed. Call
+    before process exit (or before reading back a just-saved step)."""
+    if _async_checkpointer is not None:
+        _async_checkpointer.wait_until_finished()
 
 
 def _step_path(directory: str, step: int) -> str:
@@ -77,13 +99,36 @@ def _prune(directory: str, keep: int) -> None:
 
 
 def save_checkpoint(
-    directory: str, step: int, state: Any, keep: int = KEEP_CHECKPOINTS
+    directory: str, step: int, state: Any, keep: int = KEEP_CHECKPOINTS,
+    wait: bool = True,
 ) -> None:
-    ckptr = _get_checkpointer()
-    ckptr.save(_step_path(directory, step), state, force=True)
-    ckptr.wait_until_finished()
+    """Write a checkpoint. ``wait=False`` returns as soon as the
+    device->host copy is done and commits to disk on a background
+    thread — the train loop overlaps the write with the next steps
+    (donating its buffers is safe; the copy already happened). A
+    second async save first drains the previous one; incomplete saves
+    never match latest_step's anchored step_<n> regex, so a crash
+    mid-write cannot corrupt the resume point."""
+    if wait:
+        ckptr = _get_checkpointer()
+        ckptr.save(_step_path(directory, step), state, force=True)
+        ckptr.wait_until_finished()
+        _prune(directory, keep)
+        log.info("checkpoint: saved step %d to %s", step, directory)
+        return
+    import orbax.checkpoint as ocp
+
+    ckptr = _get_async_checkpointer()
+    ckptr.save(
+        _step_path(directory, step), args=ocp.args.StandardSave(state),
+        force=True,
+    )
+    # prune committed older steps now (different dirs; the in-flight
+    # write is untouched)
     _prune(directory, keep)
-    log.info("checkpoint: saved step %d to %s", step, directory)
+    log.info(
+        "checkpoint: async save of step %d to %s started", step, directory
+    )
 
 
 def _to_abstract(x: Any) -> Any:

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import logging
 import os
 import time
 
@@ -105,6 +106,11 @@ def main() -> int:
                         "stages, whose microbatching already does this)")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=50)
+    parser.add_argument("--checkpoint-async", action="store_true",
+                        help="commit checkpoints on a background "
+                        "thread: the loop resumes after the "
+                        "device->host copy instead of waiting for "
+                        "disk")
     args = parser.parse_args()
 
     from ..models.transformer import TransformerConfig
@@ -372,7 +378,8 @@ def main() -> int:
                 profiling = False
                 print(f"profiler trace written to {args.profile_dir}")
             if args.checkpoint_dir and (step + 1) % args.checkpoint_every == 0:
-                save_checkpoint(args.checkpoint_dir, step + 1, state)
+                save_checkpoint(args.checkpoint_dir, step + 1, state,
+                                wait=not args.checkpoint_async)
             if args.progress_file:
                 tmp = args.progress_file + ".tmp"
                 with open(tmp, "w") as f:
@@ -433,6 +440,18 @@ def main() -> int:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
+        if args.checkpoint_async and args.checkpoint_dir:
+            # an in-flight background save must commit before exit —
+            # but a deferred write error must not mask whatever
+            # exception is already propagating out of the train loop
+            from ..parallel import wait_for_checkpoints
+
+            try:
+                wait_for_checkpoints()
+            except Exception:
+                logging.getLogger("containerpilot.train").exception(
+                    "async checkpoint commit failed"
+                )
     return 0
 
 

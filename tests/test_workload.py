@@ -2418,3 +2418,44 @@ def test_inference_server_beam_search(run):
     assert b1[1]["tokens"] == greedy[1]["tokens"]
     assert b4a[0] == 200 and b4a[1] == b4b[1]  # deterministic
     assert bad[0] == 422 and "deterministic" in bad[1]
+
+
+def test_async_checkpoint_commits_and_restores(tmp_path):
+    """save_checkpoint(wait=False) returns before the disk commit but
+    captures the state at call time: stepping (and donating) right
+    after the call cannot corrupt the write, and after
+    wait_for_checkpoints the restore equals the saved-step state."""
+    from containerpilot_tpu.parallel import (
+        abstract_train_state,
+        restore_checkpoint,
+        save_checkpoint,
+        wait_for_checkpoints,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    mesh = make_mesh(jax.devices()[:8])
+    state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (4, 33), 0, cfg.vocab_size, jnp.int32
+    )
+    state, _ = step(state, tokens)
+    saved_wq = np.asarray(state.params["layers"]["wq"]).copy()
+    save_checkpoint(str(tmp_path), 1, state, wait=False)
+    # keep training immediately — the donated buffers get overwritten
+    # while the background write is (possibly) still in flight
+    for _ in range(3):
+        state, _ = step(state, tokens)
+    assert not np.allclose(
+        np.asarray(state.params["layers"]["wq"]), saved_wq
+    )
+    wait_for_checkpoints()
+    abstract = abstract_train_state(jax.random.PRNGKey(0), cfg, mesh)
+    restored = restore_checkpoint(str(tmp_path), abstract)
+    assert int(restored.step) == 1
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["layers"]["wq"]), saved_wq
+    )
