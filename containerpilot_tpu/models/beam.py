@@ -32,12 +32,12 @@ from ..ops.attention import NEG_INF
 
 
 def _gather_beams(tree, idx):
-    """Reorder the beam axis of every cache leaf: k/v are
-    [layers, beam, len, kv, hd] (gather axis 1), pos is scalar."""
+    """Reorder the beam axis of every cache leaf: all array entries
+    (k/v and, under kv_int8, their scales) carry the batch/beam on
+    axis 1; pos is scalar."""
     return {
-        "k": tree["k"][:, idx],
-        "v": tree["v"][:, idx],
-        "pos": tree["pos"],
+        name: (arr if name == "pos" else arr[:, idx])
+        for name, arr in tree.items()
     }
 
 

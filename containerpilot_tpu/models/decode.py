@@ -56,14 +56,39 @@ def init_cache(
     of ``min(window, max_len)`` entries — position p lives at slot
     ``p % length`` and old entries are overwritten as the window
     slides, so decode KV memory is bounded by the window, not the
-    generation length."""
+    generation length.
+
+    With ``cfg.kv_int8`` k/v store as int8 with a per-(token, head)
+    scale over the head_dim axis — KV memory halves vs bf16,
+    composing with both levers above."""
     length = max_len if cfg.window <= 0 else min(cfg.window, max_len)
     shape = (cfg.n_layers, batch, length, cfg.kv_heads, cfg.head_dim)
-    return {
-        "k": jnp.zeros(shape, cfg.dtype),
-        "v": jnp.zeros(shape, cfg.dtype),
+    cache: Cache = {
         "pos": jnp.zeros((), jnp.int32),  # number of tokens cached
     }
+    if cfg.kv_int8:
+        cache["k"] = jnp.zeros(shape, jnp.int8)
+        cache["v"] = jnp.zeros(shape, jnp.int8)
+        cache["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        cache["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+    else:
+        cache["k"] = jnp.zeros(shape, cfg.dtype)
+        cache["v"] = jnp.zeros(shape, cfg.dtype)
+    return cache
+
+
+def _kv_quant(x: jax.Array):
+    """Symmetric int8 over the head_dim axis via the codebase's one
+    quantization formula (ops/quant.py); returns (q int8, scale f32
+    without the trailing axis)."""
+    from ..ops.quant import quantize_int8_axes
+
+    q, scale = quantize_int8_axes(x, (-1,))
+    return q, scale[..., 0]
+
+
+def _kv_dequant(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 def _logits(params: Params, x: jax.Array, cfg: TransformerConfig) -> jax.Array:
@@ -109,6 +134,12 @@ def prefill(
     def body(carry, layer_params):
         layer_params = maybe_dequant_layer(layer_params, cfg.dtype)
         q, k, v = _qkv(carry, layer_params, cfg)
+        if cfg.kv_int8:
+            # attention reads the quantization roundtrip, exactly what
+            # any later decode reads from the cache — prefill,
+            # chunked_prefill, and decode stay numerically consistent
+            k = _kv_dequant(*_kv_quant(k), cfg.dtype)
+            v = _kv_dequant(*_kv_quant(v), cfg.dtype)
         if gqa_flash:
             attn = flash_attention_forward(q, k, v, window=cfg.window)
         else:
@@ -123,21 +154,25 @@ def prefill(
     x, (ks, vs) = lax.scan(body, x, params["layers"])
     cache = init_cache(cfg, b, max_len)
     length = cache["k"].shape[2]
+    writes = {"k": ks, "v": vs}
+    if cfg.kv_int8:
+        writes["k"], writes["k_scale"] = _kv_quant(ks)
+        writes["v"], writes["v_scale"] = _kv_quant(vs)
     if s > length:
         # ring cache smaller than the prompt: keep the last `length`
         # positions, each at its slot p % length (static scatter)
         import numpy as _np
 
         slots = _np.arange(s - length, s) % length
-        cache["k"] = cache["k"].at[:, :, slots].set(ks[:, :, s - length:])
-        cache["v"] = cache["v"].at[:, :, slots].set(vs[:, :, s - length:])
+        for name, arr in writes.items():
+            cache[name] = cache[name].at[:, :, slots].set(
+                arr[:, :, s - length:]
+            )
     else:
-        cache["k"] = lax.dynamic_update_slice(
-            cache["k"], ks, (0, 0, 0, 0, 0)
-        )
-        cache["v"] = lax.dynamic_update_slice(
-            cache["v"], vs, (0, 0, 0, 0, 0)
-        )
+        for name, arr in writes.items():
+            cache[name] = lax.dynamic_update_slice(
+                cache[name], arr, (0,) * cache[name].ndim
+            )
     cache["pos"] = jnp.asarray(s, jnp.int32)
     logits = _logits(params, x[:, -1:, :], cfg)
     return logits[:, 0, :], cache
@@ -249,28 +284,75 @@ def decode_chunk(
     # reading int8 instead of dequantized bf16 halves the HBM traffic
     fused = can_fuse_int8(params["layers"], cfg, rows=b * m)
 
+    kv_int8 = cfg.kv_int8
+
     def body(carry, inputs):
         x = carry
-        layer_params, k_cache, v_cache = inputs
+        layer_params, kv_layer = inputs
+        k_cache, v_cache = kv_layer["k"], kv_layer["v"]
         if fused:
             q, k, v = fused_qkv(x, layer_params, cfg, offset=pos)
         else:
             layer_params = maybe_dequant_layer(layer_params, cfg.dtype)
             q, k, v = _qkv(x, layer_params, cfg, offset=pos)
+        if kv_int8:
+            k_q, k_s = _kv_quant(k)
+            v_q, v_s = _kv_quant(v)
         if ring:
-            keys = jnp.concatenate([k_cache, k], axis=1)
-            values = jnp.concatenate([v_cache, v], axis=1)
+            # the chunk's own k/v also read through the quantization
+            # roundtrip, so chunked decode matches sequential steps
+            # (which read their keys back from the quantized ring)
+            cached_k = (
+                _kv_dequant(k_cache, kv_layer["k_scale"], cfg.dtype)
+                if kv_int8 else k_cache
+            )
+            cached_v = (
+                _kv_dequant(v_cache, kv_layer["v_scale"], cfg.dtype)
+                if kv_int8 else v_cache
+            )
+            chunk_k = _kv_dequant(k_q, k_s, cfg.dtype) if kv_int8 else k
+            chunk_v = _kv_dequant(v_q, v_s, cfg.dtype) if kv_int8 else v
+            keys = jnp.concatenate([cached_k, chunk_k], axis=1)
+            values = jnp.concatenate([cached_v, chunk_v], axis=1)
             slots = jnp.mod(pos + q_idx, length)
-            k_cache = k_cache.at[:, slots].set(k)
-            v_cache = v_cache.at[:, slots].set(v)
+            new_kv = dict(kv_layer)
+            if kv_int8:
+                new_kv["k"] = k_cache.at[:, slots].set(k_q)
+                new_kv["v"] = v_cache.at[:, slots].set(v_q)
+                new_kv["k_scale"] = kv_layer["k_scale"].at[:, slots].set(k_s)
+                new_kv["v_scale"] = kv_layer["v_scale"].at[:, slots].set(v_s)
+            else:
+                new_kv["k"] = k_cache.at[:, slots].set(k)
+                new_kv["v"] = v_cache.at[:, slots].set(v)
         else:
-            k_cache = lax.dynamic_update_slice(
-                k_cache, k, (0, pos, 0, 0)
-            )
-            v_cache = lax.dynamic_update_slice(
-                v_cache, v, (0, pos, 0, 0)
-            )
-            keys, values = k_cache, v_cache
+            new_kv = dict(kv_layer)
+            if kv_int8:
+                new_kv["k"] = lax.dynamic_update_slice(
+                    k_cache, k_q, (0, pos, 0, 0)
+                )
+                new_kv["v"] = lax.dynamic_update_slice(
+                    v_cache, v_q, (0, pos, 0, 0)
+                )
+                new_kv["k_scale"] = lax.dynamic_update_slice(
+                    kv_layer["k_scale"], k_s, (0, pos, 0)
+                )
+                new_kv["v_scale"] = lax.dynamic_update_slice(
+                    kv_layer["v_scale"], v_s, (0, pos, 0)
+                )
+                keys = _kv_dequant(
+                    new_kv["k"], new_kv["k_scale"], cfg.dtype
+                )
+                values = _kv_dequant(
+                    new_kv["v"], new_kv["v_scale"], cfg.dtype
+                )
+            else:
+                new_kv["k"] = lax.dynamic_update_slice(
+                    k_cache, k, (0, pos, 0, 0)
+                )
+                new_kv["v"] = lax.dynamic_update_slice(
+                    v_cache, v, (0, pos, 0, 0)
+                )
+                keys, values = new_kv["k"], new_kv["v"]
         k_full = repeat_kv(keys, cfg.n_heads)
         v_full = repeat_kv(values, cfg.n_heads)
         scores = jnp.einsum(
@@ -291,13 +373,14 @@ def decode_chunk(
         else:
             x = _attn_out(x, attn, layer_params, cfg)
             x, _aux = _ffn(x, layer_params, cfg)
-        return x, (k_cache, v_cache)
+        return x, new_kv
 
-    x, (new_k, new_v) = lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"])
-    )
+    kv_in = {
+        name: cache[name] for name in cache if name != "pos"
+    }
+    x, new_kv = lax.scan(body, x, (params["layers"], kv_in))
     logits = _logits(params, x, cfg)  # [b, m, vocab]
-    return logits, {"k": new_k, "v": new_v, "pos": pos + m}
+    return logits, {**new_kv, "pos": pos + m}
 
 
 import functools

@@ -57,6 +57,11 @@ class TransformerConfig:
     # for HBM. Without it the scan-over-layers saves every layer's MLP
     # hiddens ([L, b, s, d_ff]) and real model sizes blow the 16GB HBM.
     remat: bool = True
+    # int8 KV cache for serving (models/decode.py): k/v quantize
+    # per-(token, head) on write and dequantize on read — KV memory
+    # halves vs bf16, composing with GQA and the window ring. Training
+    # is unaffected (no cache there).
+    kv_int8: bool = False
     # sliding-window attention (Mistral-style): each position attends
     # only the last `window` positions. 0 = full causal. Bounds the
     # decode KV cache to a ring of `window` entries (models/decode.py)

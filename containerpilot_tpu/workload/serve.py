@@ -477,10 +477,8 @@ class InferenceServer:
         if reuse > 0:
             base = self._prefix_cache[best_key]
             self._prefix_cache.move_to_end(best_key)
-            cache = {
-                "k": base["k"], "v": base["v"],
-                "pos": jnp.asarray(reuse, jnp.int32),
-            }
+            # rewind: same arrays (incl. kv_int8 scales), earlier pos
+            cache = {**base, "pos": jnp.asarray(reuse, jnp.int32)}
             chunk = jnp.asarray([row[reuse:]], jnp.int32)
             logits, cache = _jitted_extend(self.cfg)(
                 self.params, cache, chunk
@@ -746,6 +744,11 @@ def main() -> int:
         help="weight-only int8: ~4x smaller resident params",
     )
     parser.add_argument(
+        "--kv-int8", action="store_true",
+        help="int8 KV cache: halves decode KV memory vs bf16 "
+        "(per-token-per-head scales; composes with GQA and --window)",
+    )
+    parser.add_argument(
         "--lora-dir", default="",
         help="merge a trained LoRA adapter checkpoint into the base "
         "weights at startup (zero runtime overhead); requires "
@@ -794,6 +797,7 @@ def main() -> int:
         max_seq_len=args.max_len,
         moe_experts=args.moe_experts,
         window=args.window,
+        kv_int8=args.kv_int8,
     )
     params = None
     if args.checkpoint_dir:

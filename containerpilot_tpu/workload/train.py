@@ -442,13 +442,20 @@ def main() -> int:
                 pass
         if args.checkpoint_async and args.checkpoint_dir:
             # an in-flight background save must commit before exit —
-            # but a deferred write error must not mask whatever
-            # exception is already propagating out of the train loop
+            # but a deferred write error must not mask an exception
+            # already propagating out of the train loop. On a CLEAN
+            # exit the failure must surface (a swallowed commit error
+            # would return 0 with the final checkpoint silently lost).
+            import sys as _sys
+
             from ..parallel import wait_for_checkpoints
 
+            propagating = _sys.exc_info()[0] is not None
             try:
                 wait_for_checkpoints()
             except Exception:
+                if not propagating:
+                    raise
                 logging.getLogger("containerpilot.train").exception(
                     "async checkpoint commit failed"
                 )
