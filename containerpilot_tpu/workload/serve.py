@@ -91,6 +91,7 @@ class InferenceServer:
         max_batch_rows: int = 16,
         prefix_cache_entries: int = 0,
         prefill_chunk: int = 0,
+        text: bool = False,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -145,6 +146,15 @@ class InferenceServer:
         self._server.route("GET", "/v1/model", self._model_info)
         self._server.route("POST", "/v1/generate", self._generate)
         self._server.route("POST", "/v1/score", self._score)
+        # text surface: byte-level tokenizer, zero external assets
+        self.tokenizer = None
+        if text:
+            from .text import ByteTokenizer
+
+            self.tokenizer = ByteTokenizer(cfg.vocab_size)
+            self._server.route(
+                "POST", "/v1/completions", self._completions
+            )
         self._score_fn = None  # jitted lazily; jit caches per length
         # continuous batching: requests queue here and the batcher
         # coalesces whatever accumulated while the device was busy

@@ -1,0 +1,44 @@
+"""Byte-level tokenizer: text in/out for the serving API with zero
+external dependencies.
+
+The framework's API is token-level by design (tokenization is the
+caller's concern — workload/serve.py); this adapter gives any model
+with ``vocab_size >= 259`` a text surface: UTF-8 bytes map to ids
+3..258 with pad/bos/eos at 0/1/2. Byte-level means no vocabulary
+file, no external assets, and perfect reversibility — the ByT5/byte-LM
+recipe. Serve exposes it as ``POST /v1/completions`` behind ``--text``.
+"""
+from __future__ import annotations
+
+from typing import List
+
+
+class ByteTokenizer:
+    PAD = 0
+    BOS = 1
+    EOS = 2
+    OFFSET = 3
+    N_IDS = 259  # 3 specials + 256 byte values
+
+    def __init__(self, vocab_size: int) -> None:
+        if vocab_size < self.N_IDS:
+            raise ValueError(
+                f"byte tokenizer needs vocab_size >= {self.N_IDS}, "
+                f"got {vocab_size}"
+            )
+        self.vocab_size = vocab_size
+
+    def encode(self, text: str, bos: bool = True) -> List[int]:
+        ids = [b + self.OFFSET for b in text.encode("utf-8")]
+        return [self.BOS] + ids if bos else ids
+
+    def decode(self, ids: List[int]) -> str:
+        """Ids back to text; specials and out-of-byte-range ids (a
+        model may emit any id < vocab_size) are dropped, invalid UTF-8
+        sequences become replacement characters."""
+        raw = bytes(
+            i - self.OFFSET
+            for i in ids
+            if self.OFFSET <= i < self.OFFSET + 256
+        )
+        return raw.decode("utf-8", errors="replace")
