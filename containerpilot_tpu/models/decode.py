@@ -663,10 +663,15 @@ def generate_from_cache(
     cached); pass it to get the same loud overflow check ``generate``
     does without a device fetch. When omitted, the scalar is fetched —
     correctness over latency."""
-    if cfg.window <= 0:
-        # a ring cache legally decodes past its length (positions wrap
-        # by design); only a linear cache can overflow
-        length = cache["k"].shape[2]
+    length = cache["k"].shape[2]
+    if cfg.window <= 0 or length < cfg.window:
+        # a FULL ring cache (length == window) legally decodes past
+        # its length: positions wrap by design and every overwritten
+        # slot is already outside the attention window. A linear cache
+        # overflows, and so does a TRUNCATED ring (window > max_len at
+        # init_cache shrinks the ring to max_len slots): wrapping there
+        # overwrites keys still inside the window — in-window context
+        # silently dropped.
         if pos is None:
             pos = int(jax.device_get(cache["pos"]))
         if pos + max_new_tokens > length:

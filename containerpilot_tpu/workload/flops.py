@@ -3,9 +3,13 @@
 PaLM-style: a training step costs ~6 FLOPs per parameter per token
 (fwd matmul + 2x bwd) plus the attention score/value matmuls, which
 the 6N term misses because they scale with sequence length, not
-parameter count: 12 * L * d_model * seq per token (fwd+bwd, causal
-halving folded in). MFU = achieved FLOP/s over the chip's published
-bf16 peak — the honest utilization number, not a hardware counter.
+parameter count: 12 * L * d_model * span per token (fwd+bwd), where
+``span`` is the AVERAGE number of keys a query actually attends to —
+(seq+1)/2 for full causal (the halving the flash kernels realize by
+skipping the dead half), ~window for sliding-window. MFU = achieved
+FLOP/s over the chip's published bf16 peak — the honest utilization
+number, not a hardware counter; billing the skipped causal half would
+flatter MFU ~2x on exactly the configs where the kernels skip it.
 """
 from __future__ import annotations
 
@@ -50,8 +54,14 @@ def train_flops_per_token(
       propagation but no weight-gradient matmul — 4 FLOPs/param
       instead of 6. Without these corrections the MFU gauge reads a
       fictitious number for exactly those configs.
+
+    The attention span is the exact mean over positions of
+    min(pos+1, window): sum_{p<s} min(p+1, w) / s = w - w*(w-1)/(2s)
+    with w = min(seq, window or seq). Full causal (w == s) reduces to
+    (s+1)/2 — the causal halving the kernels actually realize.
     """
-    attn_span = seq if cfg.window <= 0 else min(seq, cfg.window)
+    w = float(seq if cfg.window <= 0 else min(seq, cfg.window))
+    attn_span = w - w * (w - 1.0) / (2.0 * seq)
     active = float(n_params)
     if getattr(cfg, "moe_experts", 0) > 1:
         expert_total = (

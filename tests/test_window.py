@@ -261,3 +261,44 @@ def test_windowed_flash_mismatched_blocks_span_coverage(bq, bk, W):
     np.testing.assert_allclose(
         np.asarray(ga), np.asarray(gb), rtol=2e-4, atol=2e-4
     )
+
+
+def test_truncated_ring_overflow_rejected():
+    """window > max_len truncates the ring to max_len slots; wrapping
+    such a ring would overwrite keys still inside the attention
+    window, so generate_from_cache must apply the linear-cache
+    overflow guard instead of the full-ring wrap exemption."""
+    from containerpilot_tpu.models.decode import generate_from_cache
+
+    cfg = _cfg(window=128)  # window wider than the serving max_len
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    max_len = 16  # ring truncated: length = min(window, max_len) = 16
+    prompt = jnp.ones((1, 8), jnp.int32)
+    logits, cache = prefill(params, prompt, cfg, max_len)
+    assert cache["k"].shape[2] == max_len  # truncated ring
+    with pytest.raises(ValueError, match="exceeds cache length"):
+        generate_from_cache(
+            params, cache, logits, cfg, max_new_tokens=12, pos=8
+        )
+    # in-bounds decode still works
+    out = generate_from_cache(
+        params, cache, logits, cfg, max_new_tokens=4, pos=8
+    )
+    assert out.shape == (1, 4)
+
+
+def test_full_ring_decodes_past_length():
+    """A FULL ring (length == window) legally wraps: every overwritten
+    slot is already outside the window."""
+    from containerpilot_tpu.models.decode import generate_from_cache
+
+    cfg = _cfg(window=8)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    max_len = 32  # ring length = window = 8 (full ring)
+    prompt = jnp.ones((1, 4), jnp.int32)
+    logits, cache = prefill(params, prompt, cfg, max_len)
+    assert cache["k"].shape[2] == 8
+    out = generate_from_cache(
+        params, cache, logits, cfg, max_new_tokens=16, pos=4
+    )
+    assert out.shape == (1, 16)
