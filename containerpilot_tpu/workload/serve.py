@@ -13,30 +13,38 @@ API (token-level; tokenization is the caller's concern):
     POST /v1/score    {"tokens": [[1,2,3,4]]}
         -> {"logprobs": [[lp(t1|t0), lp(t2|t0..1), ...]],
             "sums": [total lp per row]}   (teacher-forced scoring)
+    POST /v1/completions {"prompt": "text", ...}   (behind --text)
+        -> {"text": "...", "tokens": [...]}  (byte-level tokenizer)
     GET /health   -> 200 once the model is compiled and warm
     GET /v1/model -> config summary
 
 Generation runs on a worker thread so the asyncio loop (health checks
-included) never blocks on TPU execution.
+included) never blocks on TPU execution. The serving concerns live in
+sibling modules: serve_batcher (continuous batching), serve_prefix
+(prefix KV reuse), serve_strategies (beam/speculative/chunked),
+serve_cli (flags + model loading).
 """
 from __future__ import annotations
 
-import argparse
 import asyncio
 import json
 import logging
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 
-from ..models.decode import generate
-from ..models.transformer import TransformerConfig, init_params
+from ..models.transformer import TransformerConfig
 from ..utils.http import HTTPServer, Request, Response
+from . import serve_strategies
+from .serve_batcher import Batcher, GenJob
+from .serve_cli import main  # noqa: F401  (one import path for the CLI)
+from .serve_prefix import MIN_REUSE, PrefixCache, generate_with_prefix
 
 log = logging.getLogger("containerpilot.serve")
+
+_GenJob = GenJob  # pre-split name, kept for importers
 
 
 def _parse_token_rows(body: Dict[str, Any], vocab: int, min_row_len: int):
@@ -61,21 +69,6 @@ def _parse_token_rows(body: Dict[str, Any], vocab: int, min_row_len: int):
     ):
         raise ValueError(f"token ids must be integers in [0, {vocab})")
     return tokens, row_len
-
-
-@dataclass
-class _GenJob:
-    """One /v1/generate request waiting in the batcher queue."""
-
-    rows: List[List[int]]
-    prompt_len: int
-    max_new: int  # bucketed compiled length
-    temperature: float
-    top_k: int
-    top_p: float
-    eos_id: int
-    seed: int
-    future: "asyncio.Future[List[List[int]]]" = field(repr=False, default=None)
 
 
 class InferenceServer:
@@ -117,18 +110,10 @@ class InferenceServer:
                 "ring cache's stale rows are live window context, so "
                 "a shorter-prefix rewind cannot reuse them)"
             )
-        # prefix KV reuse: completed prompts' caches, keyed by their
-        # token tuple, LRU-bounded. A new single-row request reuses
-        # the longest common prefix and only prefills the (bucketed)
-        # suffix — the chat/agent regime where every turn re-sends a
-        # long shared history.
-        from collections import OrderedDict
-
-        self._prefix_cache: Optional[OrderedDict] = (
-            OrderedDict() if prefix_cache_entries > 0 else None
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(prefix_cache_entries)
+            if prefix_cache_entries > 0 else None
         )
-        self._prefix_cache_entries = prefix_cache_entries
-        self.prefix_stats = {"hits": 0, "misses": 0, "tokens_reused": 0}
         # prompts longer than this stream through decode_chunk pieces
         # (peak prefill activations O(chunk) instead of O(prompt))
         self.prefill_chunk = prefill_chunk
@@ -152,16 +137,15 @@ class InferenceServer:
             from .text import ByteTokenizer
 
             self.tokenizer = ByteTokenizer(cfg.vocab_size)
-            self._server.route(
-                "POST", "/v1/completions", self._completions
-            )
+            self._server.route("POST", "/v1/completions", self._completions)
         self._score_fn = None  # jitted lazily; jit caches per length
         # continuous batching: requests queue here and the batcher
         # coalesces whatever accumulated while the device was busy
         self.max_batch_rows = max_batch_rows
-        self._gen_queue: "asyncio.Queue[_GenJob]" = asyncio.Queue()
-        self._batcher: Optional["asyncio.Task[None]"] = None
-        self.batch_stats = {"calls": 0, "rows": 0}  # device-call count
+        self._batcher = Batcher(
+            params, cfg, max_len, max_batch_rows, self._executor
+        )
+        self.batch_stats = self._batcher.stats
 
     # -- handlers -------------------------------------------------------
 
@@ -179,6 +163,7 @@ class InferenceServer:
                 "n_kv_heads": self.cfg.kv_heads,
                 "n_layers": self.cfg.n_layers,
                 "max_len": self.max_len,
+                "text": self.tokenizer is not None,
                 "speculative": (
                     {
                         "draft_layers": self.draft_cfg.n_layers,
@@ -194,183 +179,135 @@ class InferenceServer:
                 },
                 "prefix_cache": (
                     {
-                        "entries": self._prefix_cache_entries,
-                        **self.prefix_stats,
+                        "entries": self.prefix_cache.entries,
+                        **self.prefix_cache.stats,
                     }
-                    if self._prefix_cache is not None
+                    if self.prefix_cache is not None
                     else None
                 ),
             }
         ).encode()
         return Response(200, body, content_type="application/json")
 
-    async def _generate(self, req: Request) -> Response:
-        try:
-            body = json.loads(req.body.decode() or "{}")
-            tokens, prompt_len = _parse_token_rows(
-                body, self.cfg.vocab_size, min_row_len=1
-            )
-            max_new_requested = int(body.get("max_new_tokens", 16))
-            temperature = float(body.get("temperature", 0.0))
-            seed = int(body.get("seed", 0))
-            top_k = int(body.get("top_k", 0))
-            top_p = float(body.get("top_p", 0.0))
-            eos_id = int(body.get("eos_id", -1))
-            beam_width = int(body.get("beam_width", 0))
-            length_penalty = float(body.get("length_penalty", 0.0))
-            if beam_width:
-                from ..models.beam import validate_beam_args
+    def _parse_sampling(
+        self, body: Dict[str, Any], tokens: List[List[int]],
+        prompt_len: int, default_eos: int = -1,
+    ) -> Dict[str, Any]:
+        """Validate the sampling/decode knobs shared by /v1/generate
+        and /v1/completions. Raises ValueError for a 422."""
+        p = {
+            "max_new_requested": int(body.get("max_new_tokens", 16)),
+            "temperature": float(body.get("temperature", 0.0)),
+            "seed": int(body.get("seed", 0)),
+            "top_k": int(body.get("top_k", 0)),
+            "top_p": float(body.get("top_p", 0.0)),
+            "eos_id": int(body.get("eos_id", default_eos)),
+            "beam_width": int(body.get("beam_width", 0)),
+            "length_penalty": float(body.get("length_penalty", 0.0)),
+        }
+        if p["beam_width"]:
+            from ..models.beam import validate_beam_args
 
-                if temperature > 0.0 or top_k or top_p:
-                    raise ValueError(
-                        "beam search is deterministic; drop "
-                        "temperature/top_k/top_p"
-                    )
-                validate_beam_args(self.cfg, len(tokens), beam_width)
-                if beam_width > self.max_batch_rows:
-                    # beams tile the KV cache: one request must not
-                    # exceed the server's configured device-row budget
-                    raise ValueError(
-                        f"beam_width capped at --max-batch-rows "
-                        f"({self.max_batch_rows})"
-                    )
-            if (not 0 <= top_k <= self.cfg.vocab_size
-                    or not 0.0 <= top_p <= 1.0):
+            if p["temperature"] > 0.0 or p["top_k"] or p["top_p"]:
                 raise ValueError(
-                    f"top_k must be in [0, vocab {self.cfg.vocab_size}] "
-                    "and top_p in [0, 1]"
+                    "beam search is deterministic; drop "
+                    "temperature/top_k/top_p"
                 )
-            if eos_id >= self.cfg.vocab_size:
-                raise ValueError(f"eos_id must be < vocab {self.cfg.vocab_size}")
-            if prompt_len + max_new_requested > self.max_len:
+            validate_beam_args(self.cfg, len(tokens), p["beam_width"])
+            if p["beam_width"] > self.max_batch_rows:
+                # beams tile the KV cache: one request must not exceed
+                # the server's configured device-row budget
                 raise ValueError(
-                    f"prompt_len + max_new_tokens exceeds max_len "
-                    f"{self.max_len}"
+                    f"beam_width capped at --max-batch-rows "
+                    f"({self.max_batch_rows})"
                 )
-            if max_new_requested < 1:
-                raise ValueError("max_new_tokens must be >= 1")
-            # bucket the compiled decode length to multiples of 16 so
-            # per-request max_new variation can't churn the jit cache
-            max_new = min(
-                -(-max_new_requested // 16) * 16,
-                self.max_len - prompt_len,
+        if (not 0 <= p["top_k"] <= self.cfg.vocab_size
+                or not 0.0 <= p["top_p"] <= 1.0):
+            raise ValueError(
+                f"top_k must be in [0, vocab {self.cfg.vocab_size}] "
+                "and top_p in [0, 1]"
             )
-        except (ValueError, KeyError, TypeError) as exc:
-            return Response(422, f"{exc}\n".encode())
-
-        if beam_width:
-
-            def run_beam() -> Any:
-                from ..models.beam import beam_search
-
-                # beam search is NOT prefix-consistent: the best
-                # 16-token beam's first 6 tokens are not the best
-                # 6-token continuation, so the compiled horizon is the
-                # REQUESTED length, not the bucketed one (beams are
-                # explicit requests; the compile churn is theirs)
-                out, score = beam_search(
-                    self.params, jnp.asarray(tokens, jnp.int32),
-                    self.cfg, max_new_tokens=max_new_requested,
-                    max_len=self.max_len, beam_width=beam_width,
-                    eos_id=eos_id, length_penalty=length_penalty,
-                    prefill_chunk=self.prefill_chunk,
-                )
-                self.batch_stats["calls"] += 1
-                self.batch_stats["rows"] += 1
-                return [jax.device_get(out).tolist()]
-
-            loop = asyncio.get_event_loop()
-            generated = await loop.run_in_executor(
-                self._executor, run_beam
+        if p["eos_id"] >= self.cfg.vocab_size:
+            raise ValueError(f"eos_id must be < vocab {self.cfg.vocab_size}")
+        if prompt_len + p["max_new_requested"] > self.max_len:
+            raise ValueError(
+                f"prompt_len + max_new_tokens exceeds max_len "
+                f"{self.max_len}"
             )
-        elif (
+        if p["max_new_requested"] < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        # bucket the compiled decode length to multiples of 16 so
+        # per-request max_new variation can't churn the jit cache
+        p["max_new"] = min(
+            -(-p["max_new_requested"] // 16) * 16,
+            self.max_len - prompt_len,
+        )
+        return p
+
+    async def _dispatch_generate(
+        self, tokens: List[List[int]], prompt_len: int, p: Dict[str, Any]
+    ) -> List[List[int]]:
+        """Route a validated generate request to the right decode
+        strategy and return the (untrimmed) generated rows."""
+        loop = asyncio.get_event_loop()
+        in_exec = loop.run_in_executor
+        if p["beam_width"]:
+            return await in_exec(
+                self._executor, serve_strategies.run_beam, self, tokens,
+                p["max_new_requested"], p["beam_width"], p["eos_id"],
+                p["length_penalty"],
+            )
+        if (
             self.draft_params is not None
-            and temperature <= 0.0
+            and p["temperature"] <= 0.0
             and len(tokens) == 1
         ):
             # greedy single-sequence: draft-and-verify, identical
-            # output, ~accepted-per-round fewer target passes. An eos
-            # trim below applies the same truncation the padded greedy
-            # path would get.
-            def run() -> Any:
-                from ..models.speculative import speculative_generate
-
-                out, _stats = speculative_generate(
-                    self.params, self.draft_params,
-                    jnp.asarray(tokens, jnp.int32), self.cfg,
-                    self.draft_cfg, max_new_tokens=max_new,
-                    max_len=self.max_len, speculate=self.speculate,
-                )
-                return jax.device_get(out).tolist()
-
-            loop = asyncio.get_event_loop()
-            generated = await loop.run_in_executor(self._executor, run)
-        elif (
-            self._prefix_cache is not None
+            # output. The eos trim afterwards applies the same
+            # truncation the padded greedy path would get.
+            return await in_exec(
+                self._executor, serve_strategies.run_speculative, self,
+                tokens, p["max_new"],
+            )
+        if (
+            self.prefix_cache is not None
             and len(tokens) == 1
             and (
-                self._prefix_match_len(tokens[0])
-                >= self._PREFIX_MIN_REUSE
-                or self._gen_queue.empty()
+                self.prefix_cache.match_len(tokens[0]) >= MIN_REUSE
+                or self._batcher.idle()
             )
         ):
             # hit -> reuse; miss -> still seed the cache, but only when
             # nothing is queued (otherwise continuous batching would
             # have coalesced this request — don't trade batching
             # throughput for a cold-path seed)
-
-            def run_prefix() -> Any:
-                return self._generate_with_prefix(
-                    tokens[0], max_new, temperature, top_k, top_p,
-                    eos_id, seed,
-                )
-
-            loop = asyncio.get_event_loop()
-            generated = await loop.run_in_executor(
-                self._executor, run_prefix
+            return await in_exec(
+                self._executor, generate_with_prefix, self, tokens[0],
+                p["max_new"], p["temperature"], p["top_k"], p["top_p"],
+                p["eos_id"], p["seed"],
             )
-        elif (
+        if (
             self.prefill_chunk > 0
             and len(tokens) == 1
             and prompt_len > self.prefill_chunk
         ):
-            # long single-row prompt: stream the prefill in chunks
-
-            def run_chunked() -> Any:
-                from ..models.decode import (
-                    chunked_prefill,
-                    generate_from_cache,
-                )
-
-                logits, cache = chunked_prefill(
-                    self.params, jnp.asarray(tokens, jnp.int32),
-                    self.cfg, self.max_len, self.prefill_chunk,
-                )
-                self.batch_stats["calls"] += 1
-                self.batch_stats["rows"] += 1
-                out = generate_from_cache(
-                    self.params, cache, logits, self.cfg,
-                    max_new_tokens=max_new, temperature=temperature,
-                    rng=jnp.stack([jax.random.fold_in(
-                        jax.random.PRNGKey(seed), 0)]),
-                    top_k=top_k, top_p=top_p, eos_id=eos_id,
-                    pos=prompt_len,
-                )
-                return jax.device_get(out).tolist()
-
-            loop = asyncio.get_event_loop()
-            generated = await loop.run_in_executor(
-                self._executor, run_chunked
+            return await in_exec(
+                self._executor, serve_strategies.run_chunked, self,
+                tokens, prompt_len, p["max_new"], p["temperature"],
+                p["top_k"], p["top_p"], p["eos_id"], p["seed"],
             )
-        else:
-            job = _GenJob(
-                rows=tokens, prompt_len=prompt_len, max_new=max_new,
-                temperature=temperature, top_k=top_k, top_p=top_p,
-                eos_id=eos_id, seed=seed,
-                future=asyncio.get_event_loop().create_future(),
-            )
-            await self._gen_queue.put(job)
-            generated = await job.future
+        job = GenJob(
+            rows=tokens, prompt_len=prompt_len, max_new=p["max_new"],
+            temperature=p["temperature"], top_k=p["top_k"],
+            top_p=p["top_p"], eos_id=p["eos_id"], seed=p["seed"],
+            future=loop.create_future(),
+        )
+        return await self._batcher.submit(job)
+
+    @staticmethod
+    def _trim(
+        generated: List[List[int]], max_new_requested: int, eos_id: int
+    ) -> List[List[int]]:
         generated = [r[:max_new_requested] for r in generated]
         if eos_id >= 0:
             # trim each row at its first eos (inclusive); the model
@@ -379,9 +316,59 @@ class InferenceServer:
                 row[: row.index(eos_id) + 1] if eos_id in row else row
                 for row in generated
             ]
+        return generated
+
+    async def _generate(self, req: Request) -> Response:
+        try:
+            body = json.loads(req.body.decode() or "{}")
+            tokens, prompt_len = _parse_token_rows(
+                body, self.cfg.vocab_size, min_row_len=1
+            )
+            p = self._parse_sampling(body, tokens, prompt_len)
+        except (ValueError, KeyError, TypeError) as exc:
+            return Response(422, f"{exc}\n".encode())
+
+        generated = await self._dispatch_generate(tokens, prompt_len, p)
+        generated = self._trim(generated, p["max_new_requested"], p["eos_id"])
         return Response(
             200,
             json.dumps({"tokens": generated}).encode(),
+            content_type="application/json",
+        )
+
+    async def _completions(self, req: Request) -> Response:
+        """Text in/out over the built-in byte-level tokenizer: encode
+        the prompt, run the exact same decode dispatch as
+        /v1/generate, decode the generated ids back to text. eos
+        defaults to the tokenizer's EOS so generation stops naturally;
+        pass "eos_id": -1 to disable."""
+        try:
+            body = json.loads(req.body.decode() or "{}")
+            prompt = body.get("prompt")
+            if not isinstance(prompt, str) or not prompt:
+                raise ValueError("'prompt' must be a non-empty string")
+            row = self.tokenizer.encode(prompt)
+            if len(row) >= self.max_len:
+                raise ValueError(
+                    f"prompt encodes to {len(row)} ids; max_len is "
+                    f"{self.max_len}"
+                )
+            p = self._parse_sampling(
+                body, [row], len(row), default_eos=self.tokenizer.EOS
+            )
+        except (ValueError, KeyError, TypeError) as exc:
+            return Response(422, f"{exc}\n".encode())
+
+        generated = await self._dispatch_generate([row], len(row), p)
+        generated = self._trim(generated, p["max_new_requested"], p["eos_id"])
+        return Response(
+            200,
+            json.dumps(
+                {
+                    "text": self.tokenizer.decode(generated[0]),
+                    "tokens": generated[0],
+                }
+            ).encode(),
             content_type="application/json",
         )
 
@@ -431,218 +418,6 @@ class InferenceServer:
             content_type="application/json",
         )
 
-    # -- prefix KV reuse ------------------------------------------------
-
-    _PREFIX_MIN_REUSE = 16  # shorter matches aren't worth a device call
-    _PREFIX_BUCKET = 16     # suffix lengths compile in these steps
-
-    def _prefix_match_len(self, row: List[int]) -> int:
-        """Longest common prefix between ``row`` and any cached prompt
-        (host-side scan; cheap relative to a device call)."""
-        best = 0
-        for stored in self._prefix_cache:
-            n = min(len(stored), len(row))
-            i = 0
-            while i < n and stored[i] == row[i]:
-                i += 1
-            best = max(best, i)
-        return best
-
-    def _generate_with_prefix(
-        self, row: List[int], max_new: int, temperature: float,
-        top_k: int, top_p: float, eos_id: int, seed: int,
-    ) -> List[List[int]]:
-        """Single-row generation reusing the longest cached prompt
-        prefix. The recomputed suffix is bucketed (a little of the
-        matched prefix is re-prefilled) so jit compiles one extend
-        program per bucket, not per suffix length. Stale cache rows
-        beyond pos are masked/overwritten by design (models/decode.py),
-        which is what makes the rewind sound — and why --window (ring
-        cache) refuses this feature."""
-        from ..models.decode import (
-            _jitted_extend,
-            _jitted_prefill,
-            generate_from_cache,
-        )
-
-        key_row = tuple(row)
-        plen = len(row)
-        best_len, best_key = 0, None
-        for stored in self._prefix_cache:
-            n = min(len(stored), plen)
-            i = 0
-            while i < n and stored[i] == row[i]:
-                i += 1
-            if i > best_len:
-                best_len, best_key = i, stored
-
-        if best_len >= self._PREFIX_MIN_REUSE:
-            suffix = plen - best_len
-            bucket = max(
-                1, -(-suffix // self._PREFIX_BUCKET) * self._PREFIX_BUCKET
-            ) if suffix > 0 else 1
-            reuse = plen - min(bucket, plen)
-        else:
-            reuse = 0
-        if reuse > 0:
-            base = self._prefix_cache[best_key]
-            self._prefix_cache.move_to_end(best_key)
-            # rewind: same arrays (incl. kv_int8 scales), earlier pos
-            cache = {**base, "pos": jnp.asarray(reuse, jnp.int32)}
-            chunk = jnp.asarray([row[reuse:]], jnp.int32)
-            logits, cache = _jitted_extend(self.cfg)(
-                self.params, cache, chunk
-            )
-            self.prefix_stats["hits"] += 1
-            self.prefix_stats["tokens_reused"] += reuse
-        elif self.prefill_chunk and plen > self.prefill_chunk:
-            # cold long prompt: seed the prefix cache via the chunked
-            # stream so the configured prefill HBM bound still holds
-            from ..models.decode import chunked_prefill
-
-            logits, cache = chunked_prefill(
-                self.params, jnp.asarray([row], jnp.int32), self.cfg,
-                self.max_len, self.prefill_chunk,
-            )
-            self.prefix_stats["misses"] += 1
-        else:
-            logits, cache = _jitted_prefill(self.cfg, self.max_len)(
-                self.params, jnp.asarray([row], jnp.int32)
-            )
-            self.prefix_stats["misses"] += 1
-        # store the completed prompt's cache for future turns
-        self._prefix_cache[key_row] = cache
-        self._prefix_cache.move_to_end(key_row)
-        while len(self._prefix_cache) > self._prefix_cache_entries:
-            self._prefix_cache.popitem(last=False)
-        # the prefix path is a device call too — keep /v1/model's
-        # batching telemetry honest when this path serves the traffic
-        self.batch_stats["calls"] += 1
-        self.batch_stats["rows"] += 1
-        out = generate_from_cache(
-            self.params, cache, logits, self.cfg,
-            max_new_tokens=max_new, temperature=temperature,
-            rng=jnp.stack([jax.random.fold_in(
-                jax.random.PRNGKey(seed), 0)]),
-            top_k=top_k, top_p=top_p, eos_id=eos_id,
-            pos=plen,
-        )
-        return jax.device_get(out).tolist()
-
-    # -- continuous batching -------------------------------------------
-
-    async def _batch_loop(self) -> None:
-        """Drain whatever requests queued while the device was busy,
-        group the compatible ones (same prompt length and compiled
-        decode length), and run each group as ONE device call with
-        per-row sampling params. Per-row PRNG keys derive from each
-        request's own seed, so a request's output never depends on
-        what it happened to be batched with (tested)."""
-        carry: Optional[_GenJob] = None
-        try:
-            while True:
-                first = (
-                    carry if carry is not None
-                    else await self._gen_queue.get()
-                )
-                carry = None
-                jobs = [first]
-                rows = len(first.rows)
-                # cap by ROW count (a request may carry several rows);
-                # a job that would overflow carries to the next drain
-                while (
-                    rows < self.max_batch_rows
-                    and not self._gen_queue.empty()
-                ):
-                    nxt = self._gen_queue.get_nowait()
-                    if rows + len(nxt.rows) > self.max_batch_rows:
-                        carry = nxt
-                        break
-                    jobs.append(nxt)
-                    rows += len(nxt.rows)
-                groups: Dict[Any, List[_GenJob]] = {}
-                for job in jobs:
-                    groups.setdefault(
-                        (job.prompt_len, job.max_new), []
-                    ).append(job)
-                for group in groups.values():
-                    await self._run_group(group)
-        finally:
-            # cancellation with a carried-over job in hand: fail it so
-            # its handler doesn't await forever
-            if carry is not None and not carry.future.done():
-                carry.future.set_exception(RuntimeError("server stopping"))
-
-    async def _run_group(self, jobs: List[_GenJob]) -> None:
-        def run() -> List[List[int]]:
-            rows: List[List[int]] = []
-            temps: List[float] = []
-            ks: List[int] = []
-            ps: List[float] = []
-            eoss: List[int] = []
-            keys = []
-            for job in jobs:
-                base = jax.random.PRNGKey(job.seed)
-                for i, r in enumerate(job.rows):
-                    rows.append(r)
-                    temps.append(job.temperature)
-                    ks.append(job.top_k)
-                    ps.append(job.top_p)
-                    eoss.append(job.eos_id)
-                    keys.append(jax.random.fold_in(base, i))
-            # bucket the batch dim to powers of two so concurrency
-            # spikes can't compile one program per row count
-            target = 1
-            while target < len(rows):
-                target *= 2
-            pad_rows = target - len(rows)
-            for _ in range(pad_rows):
-                rows.append([0] * len(rows[0]))
-                temps.append(0.0)
-                ks.append(0)
-                ps.append(0.0)
-                eoss.append(-1)
-                keys.append(jax.random.PRNGKey(0))
-            out = generate(
-                self.params,
-                jnp.asarray(rows, jnp.int32),
-                self.cfg,
-                max_new_tokens=jobs[0].max_new,
-                max_len=self.max_len,
-                temperature=temps,
-                rng=jnp.stack(keys),
-                top_k=ks,
-                top_p=ps,
-                eos_id=eoss,
-            )
-            n_real = len(rows) - pad_rows
-            return jax.device_get(out[:n_real]).tolist()
-
-        loop = asyncio.get_event_loop()
-        self.batch_stats["calls"] += 1
-        self.batch_stats["rows"] += sum(len(j.rows) for j in jobs)
-        try:
-            outs = await loop.run_in_executor(self._executor, run)
-        except asyncio.CancelledError:
-            # batcher cancelled mid-call (stop()): fail the waiters so
-            # their handlers don't hang forever, then propagate
-            for job in jobs:
-                if not job.future.done():
-                    job.future.set_exception(
-                        RuntimeError("server stopping")
-                    )
-            raise
-        except Exception as exc:  # surface as a per-request 500
-            for job in jobs:
-                if not job.future.done():
-                    job.future.set_exception(exc)
-            return
-        i = 0
-        for job in jobs:
-            if not job.future.done():  # waiter may have been cancelled
-                job.future.set_result(outs[i:i + len(job.rows)])
-            i += len(job.rows)
-
     # -- lifecycle ------------------------------------------------------
 
     async def warmup(self) -> None:
@@ -651,6 +426,7 @@ class InferenceServer:
         Requests with other prompt lengths still compile on first use
         (shapes are static); the bucketed max_new keeps that churn
         bounded."""
+        from ..models.decode import generate
 
         def run() -> None:
             for prompt_len in (4, 16):
@@ -698,197 +474,13 @@ class InferenceServer:
     async def run(self) -> None:
         await self._server.start_tcp(self.host, self.port)
         self.port = self._server.bound_port or self.port
-        self._batcher = asyncio.get_event_loop().create_task(
-            self._batch_loop()
-        )
+        self._batcher.start()
         log.info("serve: listening on %s:%d", self.host, self.port)
         await self.warmup()
 
     async def stop(self) -> None:
-        if self._batcher is not None:
-            self._batcher.cancel()
-            try:
-                await self._batcher
-            except asyncio.CancelledError:
-                pass
-            # fail anything still queued so no handler awaits forever
-            while not self._gen_queue.empty():
-                job = self._gen_queue.get_nowait()
-                if not job.future.done():
-                    job.future.set_exception(
-                        RuntimeError("server stopping")
-                    )
+        await self._batcher.stop()
         await self._server.stop()
-
-
-def main() -> int:
-    parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--host", default="0.0.0.0")
-    parser.add_argument("--port", type=int, default=8000)
-    parser.add_argument("--max-len", type=int, default=512)
-    parser.add_argument("--d-model", type=int, default=256)
-    parser.add_argument("--n-layers", type=int, default=2)
-    parser.add_argument("--n-heads", type=int, default=4)
-    parser.add_argument("--n-kv-heads", type=int, default=0,
-                        help="GQA kv heads (0 = full multi-head); must "
-                        "match the checkpoint being served")
-    parser.add_argument("--moe-experts", type=int, default=0,
-                        help="switch-MoE experts; must match the "
-                        "checkpoint being served")
-    parser.add_argument("--window", type=int, default=0,
-                        help="sliding-window attention; must match the "
-                        "checkpoint being served. Decode KV memory "
-                        "becomes a ring of `window` slots")
-    parser.add_argument("--vocab", type=int, default=1024)
-    parser.add_argument(
-        "--checkpoint-dir", default="",
-        help="load trained params from the latest checkpoint",
-    )
-    parser.add_argument(
-        "--use-ema", action="store_true",
-        help="serve the EMA shadow weights from the checkpoint "
-        "(trained with --ema-decay) instead of the raw params",
-    )
-    parser.add_argument(
-        "--int8", action="store_true",
-        help="weight-only int8: ~4x smaller resident params",
-    )
-    parser.add_argument(
-        "--kv-int8", action="store_true",
-        help="int8 KV cache: halves decode KV memory vs bf16 "
-        "(per-token-per-head scales; composes with GQA and --window)",
-    )
-    parser.add_argument(
-        "--lora-dir", default="",
-        help="merge a trained LoRA adapter checkpoint into the base "
-        "weights at startup (zero runtime overhead); requires "
-        "--lora-rank to match the adapter",
-    )
-    parser.add_argument(
-        "--lora-rank", type=int, default=0,
-        help="rank of the adapter in --lora-dir",
-    )
-    parser.add_argument(
-        "--draft-layers", type=int, default=0,
-        help="self-speculative decoding: draft with the model's first "
-        "N layers; greedy single-sequence requests decode several "
-        "tokens per target pass with identical output (0 = off)",
-    )
-    parser.add_argument(
-        "--speculate", type=int, default=4,
-        help="draft tokens proposed per verify round",
-    )
-    parser.add_argument(
-        "--max-batch-rows", type=int, default=16,
-        help="continuous batching: max sequences coalesced into one "
-        "device call",
-    )
-    parser.add_argument(
-        "--prefill-chunk", type=int, default=0,
-        help="stream prompts longer than N through chunked prefill "
-        "(peak prefill activations O(N) instead of O(prompt)); 0 = "
-        "one-shot prefill",
-    )
-    parser.add_argument(
-        "--prefix-cache", type=int, default=0,
-        help="prefix KV reuse: keep the KV caches of the last N "
-        "prompts and re-prefill only the unseen suffix of single-row "
-        "requests sharing a prefix (the chat/agent regime); 0 = off",
-    )
-    args = parser.parse_args()
-
-    cfg = TransformerConfig(
-        vocab_size=args.vocab,
-        d_model=args.d_model,
-        n_heads=args.n_heads,
-        n_kv_heads=args.n_kv_heads,
-        n_layers=args.n_layers,
-        d_ff=args.d_model * 3 // 128 * 128 or 128,
-        max_seq_len=args.max_len,
-        moe_experts=args.moe_experts,
-        window=args.window,
-        kv_int8=args.kv_int8,
-    )
-    params = None
-    if args.checkpoint_dir:
-        from ..parallel import (
-            abstract_train_state,
-            make_mesh,
-            restore_params,
-        )
-
-        mesh = make_mesh()
-        # params-only restore: optimizer moments stay PLACEHOLDERs on
-        # disk, so the server never pays train-state memory
-        abstract = abstract_train_state(jax.random.PRNGKey(0), cfg, mesh)
-        restored = restore_params(
-            args.checkpoint_dir, abstract, prefer_ema=args.use_ema
-        )
-        if restored is not None:
-            params, step = restored
-            print(f"serving checkpoint step {int(step)}"
-                  + (" (EMA weights)" if args.use_ema else ""))
-    if params is None:
-        params = init_params(jax.random.PRNGKey(0), cfg)
-    if args.lora_rank > 0 and not args.lora_dir:
-        raise SystemExit("--lora-rank without --lora-dir does nothing; "
-                         "pass the adapter checkpoint dir")
-    if args.lora_dir:
-        if args.lora_rank < 1:
-            raise SystemExit("--lora-dir requires --lora-rank")
-        from ..models.lora import apply_lora
-        from ..parallel import (
-            lora_abstract_state,
-            make_mesh,
-            restore_params,
-        )
-
-        # the adapter must land on the SAME mesh the base weights use
-        # (make_mesh() == all local devices, matching the
-        # --checkpoint-dir restore above); a mismatched device set
-        # makes the merge add uncompilable
-        restored_lora = restore_params(
-            args.lora_dir,
-            lora_abstract_state(cfg, args.lora_rank, make_mesh()),
-        )
-        if restored_lora is None:
-            raise SystemExit(f"no adapter checkpoint in {args.lora_dir}")
-        lora, lora_step_n = restored_lora
-        # merge BEFORE any quantization: int8 bases aren't adaptable
-        params = apply_lora(params, lora, cfg)
-        print(f"merged lora adapter (rank {args.lora_rank}, "
-              f"step {int(lora_step_n)})")
-    if args.int8:
-        from ..models.quantized import param_bytes, quantize_model_params
-
-        before = param_bytes(params)
-        params = quantize_model_params(params)
-        print(
-            f"int8: params {before} -> {param_bytes(params)} bytes "
-            f"({before / param_bytes(params):.1f}x smaller)"
-        )
-
-    server = InferenceServer(
-        cfg, params, args.host, args.port, args.max_len,
-        draft_layers=args.draft_layers, speculate=args.speculate,
-        max_batch_rows=args.max_batch_rows,
-        prefix_cache_entries=args.prefix_cache,
-        prefill_chunk=args.prefill_chunk,
-    )
-
-    async def serve() -> None:
-        import signal as signal_mod
-
-        await server.run()
-        stop = asyncio.Event()
-        loop = asyncio.get_event_loop()
-        for sig in (signal_mod.SIGTERM, signal_mod.SIGINT):
-            loop.add_signal_handler(sig, stop.set)
-        await stop.wait()
-        await server.stop()
-
-    asyncio.run(serve())
-    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,175 @@
+"""Continuous batching for the inference server.
+
+Requests queue here and the batcher coalesces whatever accumulated
+while the device was busy into ONE device call with per-row sampling
+params. Per-row PRNG keys derive from each request's own seed, so a
+request's output never depends on what it happened to be batched with
+(tested). Split out of serve.py (round-2 review: one module per
+serving concern).
+"""
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.decode import generate
+
+
+@dataclass
+class GenJob:
+    """One /v1/generate request waiting in the batcher queue."""
+
+    rows: List[List[int]]
+    prompt_len: int
+    max_new: int  # bucketed compiled length
+    temperature: float
+    top_k: int
+    top_p: float
+    eos_id: int
+    seed: int
+    future: "asyncio.Future[List[List[int]]]" = field(repr=False, default=None)
+
+
+class Batcher:
+    """Owns the request queue and the drain loop; one device call per
+    compatible group (same prompt length and compiled decode length)."""
+
+    def __init__(self, params: Any, cfg: Any, max_len: int,
+                 max_batch_rows: int, executor: Any) -> None:
+        self.params = params
+        self.cfg = cfg
+        self.max_len = max_len
+        self.max_batch_rows = max_batch_rows
+        self._executor = executor
+        self.queue: "asyncio.Queue[GenJob]" = asyncio.Queue()
+        self._task: Optional["asyncio.Task[None]"] = None
+        self.stats = {"calls": 0, "rows": 0}  # device-call count
+
+    def idle(self) -> bool:
+        return self.queue.empty()
+
+    async def submit(self, job: GenJob) -> List[List[int]]:
+        await self.queue.put(job)
+        return await job.future
+
+    def start(self) -> None:
+        self._task = asyncio.get_event_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            # fail anything still queued so no handler awaits forever
+            while not self.queue.empty():
+                job = self.queue.get_nowait()
+                if not job.future.done():
+                    job.future.set_exception(RuntimeError("server stopping"))
+
+    async def _loop(self) -> None:
+        """Drain whatever requests queued while the device was busy,
+        group the compatible ones, run each group as one device call."""
+        carry: Optional[GenJob] = None
+        try:
+            while True:
+                first = (
+                    carry if carry is not None else await self.queue.get()
+                )
+                carry = None
+                jobs = [first]
+                rows = len(first.rows)
+                # cap by ROW count (a request may carry several rows);
+                # a job that would overflow carries to the next drain
+                while rows < self.max_batch_rows and not self.queue.empty():
+                    nxt = self.queue.get_nowait()
+                    if rows + len(nxt.rows) > self.max_batch_rows:
+                        carry = nxt
+                        break
+                    jobs.append(nxt)
+                    rows += len(nxt.rows)
+                groups: Dict[Any, List[GenJob]] = {}
+                for job in jobs:
+                    groups.setdefault(
+                        (job.prompt_len, job.max_new), []
+                    ).append(job)
+                for group in groups.values():
+                    await self._run_group(group)
+        finally:
+            # cancellation with a carried-over job in hand: fail it so
+            # its handler doesn't await forever
+            if carry is not None and not carry.future.done():
+                carry.future.set_exception(RuntimeError("server stopping"))
+
+    async def _run_group(self, jobs: List[GenJob]) -> None:
+        def run() -> List[List[int]]:
+            rows: List[List[int]] = []
+            temps: List[float] = []
+            ks: List[int] = []
+            ps: List[float] = []
+            eoss: List[int] = []
+            keys = []
+            for job in jobs:
+                base = jax.random.PRNGKey(job.seed)
+                for i, r in enumerate(job.rows):
+                    rows.append(r)
+                    temps.append(job.temperature)
+                    ks.append(job.top_k)
+                    ps.append(job.top_p)
+                    eoss.append(job.eos_id)
+                    keys.append(jax.random.fold_in(base, i))
+            # bucket the batch dim to powers of two so concurrency
+            # spikes can't compile one program per row count
+            target = 1
+            while target < len(rows):
+                target *= 2
+            pad_rows = target - len(rows)
+            for _ in range(pad_rows):
+                rows.append([0] * len(rows[0]))
+                temps.append(0.0)
+                ks.append(0)
+                ps.append(0.0)
+                eoss.append(-1)
+                keys.append(jax.random.PRNGKey(0))
+            out = generate(
+                self.params,
+                jnp.asarray(rows, jnp.int32),
+                self.cfg,
+                max_new_tokens=jobs[0].max_new,
+                max_len=self.max_len,
+                temperature=temps,
+                rng=jnp.stack(keys),
+                top_k=ks,
+                top_p=ps,
+                eos_id=eoss,
+            )
+            n_real = len(rows) - pad_rows
+            return jax.device_get(out[:n_real]).tolist()
+
+        loop = asyncio.get_event_loop()
+        self.stats["calls"] += 1
+        self.stats["rows"] += sum(len(j.rows) for j in jobs)
+        try:
+            outs = await loop.run_in_executor(self._executor, run)
+        except asyncio.CancelledError:
+            # batcher cancelled mid-call (stop()): fail the waiters so
+            # their handlers don't hang forever, then propagate
+            for job in jobs:
+                if not job.future.done():
+                    job.future.set_exception(RuntimeError("server stopping"))
+            raise
+        except Exception as exc:  # surface as a per-request 500
+            for job in jobs:
+                if not job.future.done():
+                    job.future.set_exception(exc)
+            return
+        i = 0
+        for job in jobs:
+            if not job.future.done():  # waiter may have been cancelled
+                job.future.set_result(outs[i:i + len(job.rows)])
+            i += len(job.rows)

@@ -1,0 +1,204 @@
+"""CLI for the supervised inference server: argument surface, model
+loading (checkpoint / EMA / LoRA merge / int8), and the serve loop.
+
+``python -m containerpilot_tpu.workload.serve`` lands here via
+serve.main (kept there so supervisor job configs and docs keep one
+import path).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+import jax
+
+from ..models.transformer import TransformerConfig, init_params
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="supervised inference server"
+    )
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--max-len", type=int, default=512)
+    parser.add_argument("--d-model", type=int, default=256)
+    parser.add_argument("--n-layers", type=int, default=2)
+    parser.add_argument("--n-heads", type=int, default=4)
+    parser.add_argument("--n-kv-heads", type=int, default=0,
+                        help="GQA kv heads (0 = full multi-head); must "
+                        "match the checkpoint being served")
+    parser.add_argument("--moe-experts", type=int, default=0,
+                        help="switch-MoE experts; must match the "
+                        "checkpoint being served")
+    parser.add_argument("--window", type=int, default=0,
+                        help="sliding-window attention; must match the "
+                        "checkpoint being served. Decode KV memory "
+                        "becomes a ring of `window` slots")
+    parser.add_argument("--vocab", type=int, default=1024)
+    parser.add_argument(
+        "--checkpoint-dir", default="",
+        help="load trained params from the latest checkpoint",
+    )
+    parser.add_argument(
+        "--use-ema", action="store_true",
+        help="serve the EMA shadow weights from the checkpoint "
+        "(trained with --ema-decay) instead of the raw params",
+    )
+    parser.add_argument(
+        "--int8", action="store_true",
+        help="weight-only int8: ~4x smaller resident params",
+    )
+    parser.add_argument(
+        "--kv-int8", action="store_true",
+        help="int8 KV cache: halves decode KV memory vs bf16 "
+        "(per-token-per-head scales; composes with GQA and --window)",
+    )
+    parser.add_argument(
+        "--lora-dir", default="",
+        help="merge a trained LoRA adapter checkpoint into the base "
+        "weights at startup (zero runtime overhead); requires "
+        "--lora-rank to match the adapter",
+    )
+    parser.add_argument(
+        "--lora-rank", type=int, default=0,
+        help="rank of the adapter in --lora-dir",
+    )
+    parser.add_argument(
+        "--draft-layers", type=int, default=0,
+        help="self-speculative decoding: draft with the model's first "
+        "N layers; greedy single-sequence requests decode several "
+        "tokens per target pass with identical output (0 = off)",
+    )
+    parser.add_argument(
+        "--speculate", type=int, default=4,
+        help="draft tokens proposed per verify round",
+    )
+    parser.add_argument(
+        "--max-batch-rows", type=int, default=16,
+        help="continuous batching: max sequences coalesced into one "
+        "device call",
+    )
+    parser.add_argument(
+        "--prefill-chunk", type=int, default=0,
+        help="stream prompts longer than N through chunked prefill "
+        "(peak prefill activations O(N) instead of O(prompt)); 0 = "
+        "one-shot prefill",
+    )
+    parser.add_argument(
+        "--prefix-cache", type=int, default=0,
+        help="prefix KV reuse: keep the KV caches of the last N "
+        "prompts and re-prefill only the unseen suffix of single-row "
+        "requests sharing a prefix (the chat/agent regime); 0 = off",
+    )
+    parser.add_argument(
+        "--text", action="store_true",
+        help="enable the text surface: POST /v1/completions encodes "
+        "prompts with the built-in byte-level tokenizer (requires "
+        "--vocab >= 259)",
+    )
+    return parser
+
+
+def load_model(args: argparse.Namespace):
+    """Build the config and load/transform params per the flags."""
+    cfg = TransformerConfig(
+        vocab_size=args.vocab,
+        d_model=args.d_model,
+        n_heads=args.n_heads,
+        n_kv_heads=args.n_kv_heads,
+        n_layers=args.n_layers,
+        d_ff=args.d_model * 3 // 128 * 128 or 128,
+        max_seq_len=args.max_len,
+        moe_experts=args.moe_experts,
+        window=args.window,
+        kv_int8=args.kv_int8,
+    )
+    params = None
+    if args.checkpoint_dir:
+        from ..parallel import (
+            abstract_train_state,
+            make_mesh,
+            restore_params,
+        )
+
+        mesh = make_mesh()
+        # params-only restore: optimizer moments stay PLACEHOLDERs on
+        # disk, so the server never pays train-state memory
+        abstract = abstract_train_state(jax.random.PRNGKey(0), cfg, mesh)
+        restored = restore_params(
+            args.checkpoint_dir, abstract, prefer_ema=args.use_ema
+        )
+        if restored is not None:
+            params, step = restored
+            print(f"serving checkpoint step {int(step)}"
+                  + (" (EMA weights)" if args.use_ema else ""))
+    if params is None:
+        params = init_params(jax.random.PRNGKey(0), cfg)
+    if args.lora_rank > 0 and not args.lora_dir:
+        raise SystemExit("--lora-rank without --lora-dir does nothing; "
+                         "pass the adapter checkpoint dir")
+    if args.lora_dir:
+        if args.lora_rank < 1:
+            raise SystemExit("--lora-dir requires --lora-rank")
+        from ..models.lora import apply_lora
+        from ..parallel import (
+            lora_abstract_state,
+            make_mesh,
+            restore_params,
+        )
+
+        # the adapter must land on the SAME mesh the base weights use
+        # (make_mesh() == all local devices, matching the
+        # --checkpoint-dir restore above); a mismatched device set
+        # makes the merge add uncompilable
+        restored_lora = restore_params(
+            args.lora_dir,
+            lora_abstract_state(cfg, args.lora_rank, make_mesh()),
+        )
+        if restored_lora is None:
+            raise SystemExit(f"no adapter checkpoint in {args.lora_dir}")
+        lora, lora_step_n = restored_lora
+        # merge BEFORE any quantization: int8 bases aren't adaptable
+        params = apply_lora(params, lora, cfg)
+        print(f"merged lora adapter (rank {args.lora_rank}, "
+              f"step {int(lora_step_n)})")
+    if args.int8:
+        from ..models.quantized import param_bytes, quantize_model_params
+
+        before = param_bytes(params)
+        params = quantize_model_params(params)
+        print(
+            f"int8: params {before} -> {param_bytes(params)} bytes "
+            f"({before / param_bytes(params):.1f}x smaller)"
+        )
+    return cfg, params
+
+
+def main() -> int:
+    from .serve import InferenceServer
+
+    args = build_arg_parser().parse_args()
+    cfg, params = load_model(args)
+    server = InferenceServer(
+        cfg, params, args.host, args.port, args.max_len,
+        draft_layers=args.draft_layers, speculate=args.speculate,
+        max_batch_rows=args.max_batch_rows,
+        prefix_cache_entries=args.prefix_cache,
+        prefill_chunk=args.prefill_chunk,
+        text=args.text,
+    )
+
+    async def serve() -> None:
+        import signal as signal_mod
+
+        await server.run()
+        stop = asyncio.Event()
+        loop = asyncio.get_event_loop()
+        for sig in (signal_mod.SIGTERM, signal_mod.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await server.stop()
+
+    asyncio.run(serve())
+    return 0

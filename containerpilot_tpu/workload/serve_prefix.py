@@ -1,0 +1,139 @@
+"""Prefix KV reuse for the inference server.
+
+Completed prompts' KV caches, keyed by their token tuple, LRU-bounded.
+A new single-row request reuses the longest common prefix and only
+prefills the (bucketed) suffix — the chat/agent regime where every
+turn re-sends a long shared history.
+
+Thread safety: ``match_len`` runs on the asyncio event-loop thread
+(the /v1/generate dispatch condition) while the store/evict side runs
+on the inference executor thread, so every OrderedDict access holds
+``_lock`` (round-2 review: a concurrent request could previously hit
+"OrderedDict mutated during iteration" and surface as a 500).
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, List, Optional, Tuple
+
+MIN_REUSE = 16   # shorter matches aren't worth a device call
+BUCKET = 16      # suffix lengths compile in these steps
+
+
+class PrefixCache:
+    def __init__(self, entries: int) -> None:
+        self.entries = entries
+        self._cache: "OrderedDict[Tuple[int, ...], Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.stats = {"hits": 0, "misses": 0, "tokens_reused": 0}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._cache)
+
+    def match_len(self, row: List[int]) -> int:
+        """Longest common prefix between ``row`` and any cached prompt
+        (host-side scan; cheap relative to a device call)."""
+        return self.best_match(row)[0]
+
+    def best_match(
+        self, row: List[int]
+    ) -> Tuple[int, Optional[Tuple[int, ...]]]:
+        best_len, best_key = 0, None
+        with self._lock:
+            for stored in self._cache:
+                n = min(len(stored), len(row))
+                i = 0
+                while i < n and stored[i] == row[i]:
+                    i += 1
+                if i > best_len:
+                    best_len, best_key = i, stored
+        return best_len, best_key
+
+    def get(self, key: Tuple[int, ...]) -> Optional[Any]:
+        """Fetch a stored cache and mark it most-recently-used. Returns
+        None if it was evicted between match and fetch."""
+        with self._lock:
+            cache = self._cache.get(key)
+            if cache is not None:
+                self._cache.move_to_end(key)
+            return cache
+
+    def store(self, key: Tuple[int, ...], cache: Any) -> None:
+        with self._lock:
+            self._cache[key] = cache
+            self._cache.move_to_end(key)
+            while len(self._cache) > self.entries:
+                self._cache.popitem(last=False)
+
+
+def generate_with_prefix(
+    srv: Any, row: List[int], max_new: int, temperature: float,
+    top_k: int, top_p: float, eos_id: int, seed: int,
+) -> List[List[int]]:
+    """Single-row generation reusing the longest cached prompt prefix.
+
+    The recomputed suffix is bucketed (a little of the matched prefix
+    is re-prefilled) so jit compiles one extend program per bucket, not
+    per suffix length. Stale cache rows beyond pos are masked or
+    overwritten by design (models/decode.py), which is what makes the
+    rewind sound — and why --window (ring cache) refuses this feature.
+    Runs on the inference executor thread.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.decode import (
+        _jitted_extend,
+        _jitted_prefill,
+        generate_from_cache,
+    )
+
+    pc: PrefixCache = srv.prefix_cache
+    key_row = tuple(row)
+    plen = len(row)
+    best_len, best_key = pc.best_match(row)
+
+    reuse = 0
+    if best_len >= MIN_REUSE:
+        suffix = plen - best_len
+        bucket = max(1, -(-suffix // BUCKET) * BUCKET) if suffix > 0 else 1
+        reuse = plen - min(bucket, plen)
+    base = pc.get(best_key) if reuse > 0 and best_key is not None else None
+    if base is not None:
+        # rewind: same arrays (incl. kv_int8 scales), earlier pos
+        cache = {**base, "pos": jnp.asarray(reuse, jnp.int32)}
+        chunk = jnp.asarray([row[reuse:]], jnp.int32)
+        logits, cache = _jitted_extend(srv.cfg)(srv.params, cache, chunk)
+        pc.stats["hits"] += 1
+        pc.stats["tokens_reused"] += reuse
+    elif srv.prefill_chunk and plen > srv.prefill_chunk:
+        # cold long prompt: seed the prefix cache via the chunked
+        # stream so the configured prefill HBM bound still holds
+        from ..models.decode import chunked_prefill
+
+        logits, cache = chunked_prefill(
+            srv.params, jnp.asarray([row], jnp.int32), srv.cfg,
+            srv.max_len, srv.prefill_chunk,
+        )
+        pc.stats["misses"] += 1
+    else:
+        logits, cache = _jitted_prefill(srv.cfg, srv.max_len)(
+            srv.params, jnp.asarray([row], jnp.int32)
+        )
+        pc.stats["misses"] += 1
+    # store the completed prompt's cache for future turns
+    pc.store(key_row, cache)
+    # the prefix path is a device call too — keep /v1/model's batching
+    # telemetry honest when this path serves the traffic
+    srv.batch_stats["calls"] += 1
+    srv.batch_stats["rows"] += 1
+    out = generate_from_cache(
+        srv.params, cache, logits, srv.cfg,
+        max_new_tokens=max_new, temperature=temperature,
+        rng=jnp.stack([jax.random.fold_in(jax.random.PRNGKey(seed), 0)]),
+        top_k=top_k, top_p=top_p, eos_id=eos_id,
+        pos=plen,
+    )
+    return jax.device_get(out).tolist()

@@ -1,0 +1,75 @@
+"""Non-batched decode strategies for the inference server.
+
+Each runner executes on the inference executor thread and returns the
+generated token rows; the server's /v1/generate dispatch picks one
+based on the request (beam / speculative / chunked prefill — the
+continuous batcher and prefix cache live in their own modules).
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+
+
+def run_beam(
+    srv: Any, tokens: List[List[int]], max_new_requested: int,
+    beam_width: int, eos_id: int, length_penalty: float,
+) -> List[List[int]]:
+    from ..models.beam import beam_search
+
+    # beam search is NOT prefix-consistent: the best 16-token beam's
+    # first 6 tokens are not the best 6-token continuation, so the
+    # compiled horizon is the REQUESTED length, not the bucketed one
+    # (beams are explicit requests; the compile churn is theirs)
+    out, _score = beam_search(
+        srv.params, jnp.asarray(tokens, jnp.int32),
+        srv.cfg, max_new_tokens=max_new_requested,
+        max_len=srv.max_len, beam_width=beam_width,
+        eos_id=eos_id, length_penalty=length_penalty,
+        prefill_chunk=srv.prefill_chunk,
+    )
+    srv.batch_stats["calls"] += 1
+    srv.batch_stats["rows"] += 1
+    return [jax.device_get(out).tolist()]
+
+
+def run_speculative(
+    srv: Any, tokens: List[List[int]], max_new: int
+) -> List[List[int]]:
+    """Greedy single-sequence draft-and-verify: identical output,
+    ~accepted-per-round fewer target passes."""
+    from ..models.speculative import speculative_generate
+
+    out, _stats = speculative_generate(
+        srv.params, srv.draft_params,
+        jnp.asarray(tokens, jnp.int32), srv.cfg,
+        srv.draft_cfg, max_new_tokens=max_new,
+        max_len=srv.max_len, speculate=srv.speculate,
+    )
+    return jax.device_get(out).tolist()
+
+
+def run_chunked(
+    srv: Any, tokens: List[List[int]], prompt_len: int, max_new: int,
+    temperature: float, top_k: int, top_p: float, eos_id: int, seed: int,
+) -> List[List[int]]:
+    """Long single-row prompt: stream the prefill in chunks (peak
+    prefill activations O(chunk) instead of O(prompt))."""
+    from ..models.decode import chunked_prefill, generate_from_cache
+
+    logits, cache = chunked_prefill(
+        srv.params, jnp.asarray(tokens, jnp.int32),
+        srv.cfg, srv.max_len, srv.prefill_chunk,
+    )
+    srv.batch_stats["calls"] += 1
+    srv.batch_stats["rows"] += 1
+    out = generate_from_cache(
+        srv.params, cache, logits, srv.cfg,
+        max_new_tokens=max_new, temperature=temperature,
+        rng=jnp.stack([jax.random.fold_in(jax.random.PRNGKey(seed), 0)]),
+        top_k=top_k, top_p=top_p, eos_id=eos_id,
+        pos=prompt_len,
+    )
+    return jax.device_get(out).tolist()

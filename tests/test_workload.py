@@ -2220,8 +2220,8 @@ def test_inference_server_prefix_cache(run):
         r3p = await gen(plain, turn2, temperature=0.8, seed=7)
         # a third distinct prompt evicts the oldest entry (LRU cap 2)
         await gen(cached, other)
-        stats = dict(cached.prefix_stats)
-        n_entries = len(cached._prefix_cache)
+        stats = dict(cached.prefix_cache.stats)
+        n_entries = len(cached.prefix_cache)
         await cached.stop()
         await plain.stop()
         return r1c, r1p, r2c, r2p, r3c, r3p, stats, n_entries
@@ -2519,3 +2519,105 @@ def test_kv_int8_cache_decode_parity():
         np.testing.assert_array_equal(
             np.asarray(ga), np.asarray(gb), err_msg=str(kw)
         )
+
+
+def test_inference_server_text_completions(run):
+    """The text surface (--text): /v1/completions encodes the prompt
+    through the byte tokenizer, decodes generated ids back to text,
+    and agrees exactly with the token-level /v1/generate path."""
+    import urllib.error
+    import urllib.request
+
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload.serve import InferenceServer
+    from containerpilot_tpu.workload.text import ByteTokenizer
+
+    cfg = TransformerConfig(
+        vocab_size=512, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server = InferenceServer(
+        cfg, params, "127.0.0.1", 0, max_len=64, text=True
+    )
+    tok = ByteTokenizer(cfg.vocab_size)
+
+    def fetch(path, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            return exc.code, exc.read().decode()
+
+    async def scenario():
+        import asyncio
+
+        await server.run()
+        loop = asyncio.get_event_loop()
+        comp = await loop.run_in_executor(
+            None,
+            lambda: fetch(
+                "/v1/completions",
+                {"prompt": "hi", "max_new_tokens": 6},
+            ),
+        )
+        # token-level equivalent: same encoding, explicit EOS default
+        gen = await loop.run_in_executor(
+            None,
+            lambda: fetch(
+                "/v1/generate",
+                {"tokens": [tok.encode("hi")], "max_new_tokens": 6,
+                 "eos_id": tok.EOS},
+            ),
+        )
+        bad = await loop.run_in_executor(
+            None, lambda: fetch("/v1/completions", {"prompt": ""})
+        )
+        too_long = await loop.run_in_executor(
+            None,
+            lambda: fetch("/v1/completions",
+                          {"prompt": "x", "max_new_tokens": 999}),
+        )
+        await server.stop()
+        return comp, gen, bad, too_long
+
+    import json
+
+    comp, gen, bad, too_long = run(scenario(), timeout=120)
+    assert comp[0] == 200, comp
+    assert gen[0] == 200, gen
+    assert comp[1]["tokens"] == gen[1]["tokens"][0]
+    assert comp[1]["text"] == tok.decode(comp[1]["tokens"])
+    assert bad[0] == 422
+    assert too_long[0] == 422
+
+
+def test_serve_text_requires_byte_vocab():
+    """--text with a vocab too small for the byte tokenizer fails at
+    construction, not as request-time 500s."""
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="vocab_size >= 259"):
+        InferenceServer(
+            cfg, params, "127.0.0.1", 0, max_len=32, text=True
+        )
+
+
+def test_serve_cli_text_flag():
+    """The --text flag exists and routes into InferenceServer."""
+    from containerpilot_tpu.workload.serve_cli import build_arg_parser
+
+    args = build_arg_parser().parse_args(["--text", "--vocab", "512"])
+    assert args.text is True and args.vocab == 512
+    assert build_arg_parser().parse_args([]).text is False
