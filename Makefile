@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all build test integration bench lint clean image
+.PHONY: all build test test-fast test-workload integration bench lint clean image
 
 all: build test
 
@@ -16,6 +16,14 @@ bin/cpsup: native/sup.cpp
 
 test:
 	$(PYTHON) -m pytest tests/ -q
+
+# supervisor tier only (~2 min): all host-side packages, no JAX compiles
+test-fast:
+	$(PYTHON) -m pytest tests/ -q -m supervisor
+
+# the JAX models/ops/parallel tier (dominates full-suite wall time)
+test-workload:
+	$(PYTHON) -m pytest tests/ -q -m workload
 
 # the integration-grade scenarios only (real CLI, real processes)
 integration: build

@@ -29,6 +29,44 @@ import logging
 
 import pytest
 
+# ---------------------------------------------------------------------------
+# Test tiers. The supervisor tier (all host-side packages: events, jobs,
+# watches, config, control, discovery, telemetry, core, CLI) runs in
+# ~2 minutes; the workload tier (models/ops/parallel on the virtual
+# 8-device CPU mesh) dominates the full suite's wall time. Mirrors the
+# reference's unit/integration split (its makefile runs
+# scripts/unit_test.sh separately):
+#     pytest -m supervisor      # fast tier (make test-fast)
+#     pytest -m workload        # JAX tier
+#     pytest                    # everything (make test)
+# ---------------------------------------------------------------------------
+
+_WORKLOAD_MODULES = {
+    "test_workload", "test_window", "test_data", "test_flops",
+    "test_capstone",
+}
+_WORKLOAD_TESTS = {"test_fuzz_sample_logits_invariants"}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "supervisor: host-side supervisor tier (fast, no JAX)"
+    )
+    config.addinivalue_line(
+        "markers", "workload: JAX models/ops/parallel tier (slow)"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.module.__name__.rpartition(".")[2]
+        if mod in _WORKLOAD_MODULES or (
+            item.originalname or item.name
+        ) in _WORKLOAD_TESTS:
+            item.add_marker(pytest.mark.workload)
+        else:
+            item.add_marker(pytest.mark.supervisor)
+
 
 @pytest.fixture(autouse=True)
 def restore_containerpilot_logger():
