@@ -6,9 +6,11 @@ Two tiers, mirroring the reference's posture:
    Backend method must emit exactly the method/path/query/body the
    Consul agent HTTP API specifies (the reference gets this for free by
    vendoring the official client; we assert it explicitly).
-2. **Real-Consul tests** that shell out to a `consul agent -dev` binary
-   when one is on $PATH and skip otherwise (reference:
-   discovery/test_server.go:19-56).
+2. **Live-agent tests** against a real `consul agent -dev` binary when
+   one is on $PATH, else against the wire-compatible emulator
+   (discovery/consul_emulator.py) — they run either way (reference:
+   discovery/test_server.go:19-56, which `make tools` fetches; this
+   environment has no egress, hence the emulator fallback).
 """
 import http.server
 import json
@@ -172,14 +174,49 @@ def test_weird_service_names_are_encoded(recorder):
 
 
 # ---------------------------------------------------------------------------
-# real consul agent (skip when absent, like the reference's test server)
+# live agent: a real consul binary when one is on $PATH, else the
+# framework's own consul-wire-compatible catalog-server daemon — either
+# way the lifecycle tests below run against a live agent with real
+# TTL-check state transitions (expiry -> critical, critical-too-long ->
+# reaped), mirroring the reference's consul test server
+# (discovery/test_server.go:19-56, fetched by `make tools`; this
+# environment has no egress, hence the built-in daemon fallback).
 # ---------------------------------------------------------------------------
 
 
 @pytest.fixture(scope="module")
 def consul_agent():
     if shutil.which("consul") is None:
-        pytest.skip("consul binary not on $PATH")
+        import os
+        import urllib.request
+
+        port = free_port()
+        repo = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "containerpilot_tpu",
+             "-catalog-server", f"127.0.0.1:{port}"],
+            cwd=repo, env=dict(os.environ, PYTHONPATH=repo),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/v1/health/service/none",
+                    timeout=1,
+                )
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    proc.terminate()
+                    pytest.skip("catalog server never became ready")
+                time.sleep(0.2)
+        yield port
+        proc.terminate()
+        proc.wait(timeout=10)
+        return
     port = free_port()
     proc = subprocess.Popen(
         ["consul", "agent", "-dev", f"-http-port={port}",
@@ -226,3 +263,63 @@ def test_register_heartbeat_query_against_real_consul(consul_agent):
     while backend.instances("trainer"):
         assert time.monotonic() < deadline, "deregister never took effect"
         time.sleep(0.2)
+
+
+def test_ttl_expiry_goes_critical_then_deregisters(consul_agent):
+    """Agent-side TTL semantics: a service whose TTL check is not
+    refreshed leaves the passing set, and one critical longer than
+    DeregisterCriticalServiceAfter is dropped entirely — the behavior
+    the supervisor's health loop and watches depend on. Runs against
+    whichever live agent the fixture provided."""
+    backend = ConsulBackend(address=f"127.0.0.1:{consul_agent}")
+    backend.service_register(
+        ServiceRegistration(
+            id="flaky-1", name="flaky", port=4100, address="127.0.0.1",
+            ttl=1, deregister_critical_service_after="2s",
+        ),
+        status="passing",
+    )
+    assert [i.id for i in backend.instances("flaky")] == ["flaky-1"]
+    # no heartbeat: past the TTL the passing filter must exclude it
+    deadline = time.monotonic() + 10
+    while backend.instances("flaky"):
+        assert time.monotonic() < deadline, "TTL expiry never took effect"
+        time.sleep(0.3)
+    if shutil.which("consul") is not None:
+        # real Consul clamps DeregisterCriticalServiceAfter to a
+        # 1-minute minimum and reaps on a 30s cycle — the fast
+        # reap below would wait minutes; TTL->critical is the part
+        # asserted against the real agent
+        backend.service_deregister("flaky-1")
+        return
+    # critical past DeregisterCriticalServiceAfter: gone from the agent
+    deadline = time.monotonic() + 15
+    while True:
+        changed, healthy = backend.check_for_upstream_changes("flaky")
+        if not healthy:
+            sweep = backend.instances("flaky")
+            if not sweep:
+                break
+        assert time.monotonic() < deadline, "dereg-after never took effect"
+        time.sleep(0.3)
+
+
+def test_heartbeat_keeps_service_passing(consul_agent):
+    """Refreshed TTLs stay passing across several TTL windows."""
+    backend = ConsulBackend(address=f"127.0.0.1:{consul_agent}")
+    backend.service_register(
+        ServiceRegistration(
+            id="steady-1", name="steady", port=4200,
+            address="127.0.0.1", ttl=1,
+        ),
+        status="passing",
+    )
+    try:
+        for _ in range(4):
+            time.sleep(0.5)
+            backend.update_ttl("service:steady-1", "ok", "pass")
+            assert [i.id for i in backend.instances("steady")] == [
+                "steady-1"
+            ]
+    finally:
+        backend.service_deregister("steady-1")
