@@ -16,6 +16,7 @@ from .checkpoint import (
 )
 from .context import context_parallel_config, flash_parallel_config
 from .distributed import initialize_from_catalog, initialize_from_env
+from .watchdog import StepWatchdog
 from .mesh import MeshPlan, make_mesh
 from .pipeline import (
     pipeline_forward_with_aux,
@@ -67,6 +68,7 @@ __all__ = [
     "latest_step",
     "initialize_from_catalog",
     "initialize_from_env",
+    "StepWatchdog",
     "pipeline_forward_with_aux",
     "pipeline_loss_fn",
     "pipeline_sharding_rules",

@@ -9,6 +9,16 @@ reference: SURVEY.md §5 checkpoint/resume row).
 Layout: <dir>/step_<n>/ orbax checkpoints; ``latest_step`` scans for
 the newest complete one. Saves are atomic (orbax writes to a tmp dir
 and renames), so a crash mid-save can't corrupt the resume point.
+
+Multi-process pods: orbax is a GLOBAL checkpointer under
+``jax.distributed`` — every process must call save/restore in lockstep
+on the SAME directory (shared storage; on real pods, GCS). Data for
+replicated arrays is written by the primary process only and save
+holds cross-process barriers, so per-process directories would leave
+the non-primary dirs empty — and a later lopsided restore (one process
+finds a checkpoint, its peer finds none and skips) deadlocks the pod
+before any step runs. One directory per pod makes the resume-step
+decision identical everywhere by construction.
 """
 from __future__ import annotations
 

@@ -1,0 +1,95 @@
+"""StepWatchdog: step-deadline failure detection for distributed
+training (parallel/watchdog.py). The exit path is ``os._exit``, so the
+firing tests run the dog in a subprocess and assert on its exit code.
+
+The module is deliberately stdlib-only; it is loaded here by file path
+(not through ``containerpilot_tpu.parallel``, whose __init__ imports
+jax/orbax) so these tests stay in the fast no-JAX supervisor tier.
+"""
+import importlib.util
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+_WATCHDOG_PY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "containerpilot_tpu", "parallel", "watchdog.py",
+)
+_spec = importlib.util.spec_from_file_location("_watchdog", _WATCHDOG_PY)
+_watchdog = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_watchdog)
+EXIT_CODE = _watchdog.EXIT_CODE
+StepWatchdog = _watchdog.StepWatchdog
+
+
+def _run_dog(body: str, timeout: float = 30) -> subprocess.CompletedProcess:
+    prog = (
+        "import importlib.util\n"
+        f"spec = importlib.util.spec_from_file_location("
+        f"'_watchdog', {_WATCHDOG_PY!r})\n"
+        "m = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(m)\n"
+        "StepWatchdog = m.StepWatchdog\n"
+        "import time\n" + body
+    )
+    return subprocess.run(
+        [sys.executable, "-c", prog], capture_output=True, text=True,
+        timeout=timeout,
+    )
+
+
+def test_beats_keep_it_alive():
+    # generous deadline: a short scheduler pause between beats must
+    # not os._exit the whole pytest run
+    dog = StepWatchdog(5.0).start()
+    for _ in range(3):
+        time.sleep(0.2)
+        dog.beat()
+    dog.stop()  # never fired: we are still here to say so
+
+
+def test_fires_without_beats():
+    res = _run_dog(
+        "StepWatchdog(0.3).start()\n"
+        "time.sleep(30)\n"
+    )
+    assert res.returncode == EXIT_CODE, res.stderr
+
+
+def test_stop_disarms():
+    dog = StepWatchdog(0.3).start()
+    dog.stop()
+    time.sleep(0.6)  # would have fired (and killed pytest) if armed
+
+
+def test_startup_grace_covers_first_beat_only():
+    # deadline 0.3s but grace 2s: silence at t=0.6 must NOT fire;
+    # after the first beat the tight deadline applies and fires
+    res = _run_dog(
+        "dog = StepWatchdog(0.3).start(grace_s=2.0)\n"
+        "time.sleep(0.6)\n"      # inside grace: survives
+        "dog.beat()\n"           # grace over; deadline now 0.3
+        "time.sleep(30)\n"
+    )
+    assert res.returncode == EXIT_CODE, res.stderr
+
+
+def test_grace_eventually_fires():
+    res = _run_dog(
+        "StepWatchdog(0.2).start(grace_s=0.5)\n"
+        "time.sleep(30)\n"
+    )
+    assert res.returncode == EXIT_CODE, res.stderr
+
+
+def test_grace_below_timeout_rejected():
+    with pytest.raises(ValueError):
+        StepWatchdog(5.0).start(grace_s=1.0)
+
+
+def test_nonpositive_timeout_rejected():
+    with pytest.raises(ValueError):
+        StepWatchdog(0.0)
