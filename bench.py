@@ -160,7 +160,10 @@ def training_bench() -> dict:
         n_layers=8,
         d_ff=4096,
         max_seq_len=seq,
-        flash_min_seq=1024,  # the step trains through the pallas kernels
+        # AUTO: the measured crossover decides flash vs XLA per shape,
+        # and tuned blocks apply (ops/tuning.py) — the MFU recorded
+        # here is the framework's best honest number, not a fixed path
+        flash_min_seq=-1,
     )
     mesh = make_mesh(jax.devices()[:1], plan=MeshPlan(1, 1))
     state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
@@ -209,6 +212,7 @@ def attention_bench() -> dict:
     import jax.numpy as jnp
 
     from containerpilot_tpu.ops import causal_attention, flash_attention
+    from containerpilot_tpu.ops import tuning
 
     out: dict = {}
     b, h, hd = 2, 8, 128
@@ -220,12 +224,21 @@ def attention_bench() -> dict:
         )
         cot = jax.random.normal(ks[3], (b, s, h, hd), jnp.bfloat16)
 
-        flash_f = jax.jit(lambda q, k, v: flash_attention(q, k, v))
+        # blocks from the platform's tuned table (ops/tuning.py;
+        # 128/128 when none is shipped) — fwd and train tuned apart
+        fq, fk = tuning.pick_blocks("fwd", s)
+        tq, tk = tuning.pick_blocks("train", s)
+        flash_f = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, block_q=fq, block_k=fk)
+        )
         xla_f = jax.jit(causal_attention)
         flash_g = jax.jit(
             jax.grad(
                 lambda q, k, v: jnp.sum(
-                    (flash_attention(q, k, v) * cot).astype(jnp.float32)
+                    (
+                        flash_attention(q, k, v, block_q=tq, block_k=tk)
+                        * cot
+                    ).astype(jnp.float32)
                 ),
                 argnums=(0, 1, 2),
             )
@@ -240,6 +253,8 @@ def attention_bench() -> dict:
         )
         n = 5 if s < 8192 else 3
         out[str(s)] = {
+            "blocks_fwd": [fq, fk],
+            "blocks_train": [tq, tk],
             "flash_fwd_ms": round(_time_ms(flash_f, q, k, v, n=n), 2),
             "xla_fwd_ms": round(_time_ms(xla_f, q, k, v, n=n), 2),
             "flash_grad_ms": round(

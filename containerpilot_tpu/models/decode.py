@@ -121,7 +121,9 @@ def prefill(
     # cache layout, skipping the repeat_kv copy. Sliding windows ride
     # both paths (the flash kernels block-skip old KV; the einsum path
     # masks).
-    gqa_flash = cfg.attention_fn is None and flash_eligible(cfg, s)
+    gqa_flash = cfg.attention_fn is None and flash_eligible(
+        cfg, s, kind="fwd"
+    )
     if cfg.attention_fn is not None:
         attn_fn = cfg.attention_fn
     elif cfg.window > 0:
@@ -141,7 +143,12 @@ def prefill(
             k = _kv_dequant(*_kv_quant(k), cfg.dtype)
             v = _kv_dequant(*_kv_quant(v), cfg.dtype)
         if gqa_flash:
-            attn = flash_attention_forward(q, k, v, window=cfg.window)
+            from ..ops import tuning as _tuning
+
+            fq, fk = _tuning.pick_blocks("fwd", s)
+            attn = flash_attention_forward(
+                q, k, v, block_q=fq, block_k=fk, window=cfg.window
+            )
         else:
             attn = attn_fn(
                 q, repeat_kv(k, cfg.n_heads), repeat_kv(v, cfg.n_heads)

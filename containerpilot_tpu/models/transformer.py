@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops import tuning
 from ..ops.attention import causal_attention
 from .quantized import embed_lookup, maybe_dequant_layer, maybe_dequant_top
 
@@ -47,11 +48,14 @@ class TransformerConfig:
     # ring_attention for context parallelism (parallel/context.py)
     attention_fn: Any = None
     # sequences at/above this length (and 128-aligned) run the pallas
-    # flash kernels — fwd AND bwd (ops/flash.py); 0 disables auto-flash.
+    # flash kernels — fwd AND bwd (ops/flash.py); 0 disables auto-flash;
+    # -1 (AUTO) takes the measured flash/XLA crossover from the
+    # platform's tuned table (ops/tuning.py), falling back to 1024
+    # when none is shipped. Block sizes come from the same table.
     # Mesh-parallel trainers bind the shard_map-wrapped equivalent via
     # parallel.context.flash_parallel_config (pallas calls don't
     # partition under automatic pjit sharding).
-    flash_min_seq: int = 1024
+    flash_min_seq: int = tuning.AUTO
     # rematerialize each layer in the backward pass instead of saving
     # its activations: the standard TPU trade of MXU FLOPs (~1/3 extra)
     # for HBM. Without it the scan-over-layers saves every layer's MLP
@@ -101,13 +105,19 @@ Params = Dict[str, Any]
 FLASH_BLOCK = 128
 
 
-def flash_eligible(cfg: "TransformerConfig", seq: int) -> bool:
+def flash_eligible(
+    cfg: "TransformerConfig", seq: int, kind: str = "train"
+) -> bool:
     """True when the auto-selected attention should be the pallas flash
-    path: at/above the threshold and block-aligned. A sliding window
-    must itself be block-aligned for the kernels' block-skip logic."""
+    path: at/above the (possibly table-resolved) threshold and
+    block-aligned. ``kind`` picks which measured crossover an AUTO
+    threshold resolves through — 'train' for the differentiable path,
+    'fwd' for inference prefill. A sliding window must itself be
+    block-aligned for the kernels' block-skip logic."""
+    min_seq = tuning.resolve_min_seq(cfg.flash_min_seq, kind=kind)
     return (
-        cfg.flash_min_seq > 0
-        and seq >= cfg.flash_min_seq
+        min_seq > 0
+        and seq >= min_seq
         and seq % FLASH_BLOCK == 0
         and (cfg.window == 0 or cfg.window % FLASH_BLOCK == 0)
     )
@@ -119,9 +129,12 @@ def _auto_attention(cfg: "TransformerConfig", seq: int) -> Any:
     if flash_eligible(cfg, seq):
         from ..ops.flash import flash_attention
 
-        if cfg.window > 0:
-            return functools.partial(flash_attention, window=cfg.window)
-        return flash_attention
+        # 'train' blocks: forward() is the differentiable path, so one
+        # custom_vjp call carries fwd AND bwd through these blocks
+        bq, bk = tuning.pick_blocks("train", seq)
+        return functools.partial(
+            flash_attention, block_q=bq, block_k=bk, window=cfg.window
+        )
     if cfg.window > 0:
         return functools.partial(causal_attention, window=cfg.window)
     return causal_attention

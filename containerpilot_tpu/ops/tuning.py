@@ -1,0 +1,137 @@
+"""Flash-attention block tuning: measured, per-platform, persistent.
+
+The pallas kernels' performance hinges on (block_q, block_k) — the
+right choice varies with sequence length and mode (a training step
+runs fwd+bwd through one custom_vjp call, so blocks are chosen per
+call, not per direction). Hardcoded 128/128 left the 2k-4k training
+range losing to plain XLA attention. This module holds a small tuned
+table, produced by ``python -m containerpilot_tpu.ops.autotune`` on
+the actual device (ops/autotune.py) and shipped per platform under
+``ops/tuned/<platform>.json``:
+
+    {"platform": "tpu-v5-lite",
+     "flash_min_seq": {"train": 2048, "fwd": 1024},
+     "blocks": {"train": {"2048": [256, 128], ...},
+                "fwd":   {"8192": [256, 256], ...}}}
+
+Consumers:
+- ``pick_blocks(kind, seq)`` -> (block_q, block_k) for the flash call
+  (exact seq entry, else the nearest tuned seq at/below, else the
+  128/128 default), clamped to divisors of seq so the kernels' static
+  grids stay exact.
+- ``auto_min_seq(kind)`` -> the measured flash/XLA crossover:
+  sequences shorter than this run faster through XLA's fused
+  attention than through the pallas kernels, so the model's
+  ``flash_min_seq: AUTO`` resolves here (models/transformer.py
+  flash_eligible).
+
+No table (fresh checkout, unknown platform) degrades to the previous
+behavior exactly: 128/128 blocks, crossover 1024. Override the table
+path with CONTAINERPILOT_FLASH_TABLE; ``set_table(None)`` reverts to
+auto-discovery.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+from typing import Dict, Optional, Tuple
+
+log = logging.getLogger("containerpilot.tuning")
+
+DEFAULT_BLOCK = 128
+DEFAULT_MIN_SEQ = 1024  # pre-tuning crossover default
+AUTO = -1               # TransformerConfig.flash_min_seq sentinel
+
+_TUNED_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tuned")
+
+# module state: the active table, and whether discovery already ran
+_table: Optional[dict] = None
+_loaded = False
+
+
+def platform_slug() -> str:
+    """Normalized device kind of the default backend, e.g.
+    'tpu-v5-lite'; 'cpu' on the test mesh."""
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    return re.sub(r"[^a-z0-9]+", "-", kind.lower()).strip("-")
+
+
+def _table_path() -> Optional[str]:
+    override = os.environ.get("CONTAINERPILOT_FLASH_TABLE")
+    if override:
+        return override
+    try:
+        path = os.path.join(_TUNED_DIR, f"{platform_slug()}.json")
+    except Exception:  # no backend at all
+        return None
+    return path if os.path.exists(path) else None
+
+
+def set_table(table: Optional[dict]) -> None:
+    """Install a table dict directly (tests, autotune); None reverts
+    to on-disk auto-discovery at the next lookup."""
+    global _table, _loaded
+    _table = table
+    _loaded = table is not None
+
+
+def _get_table() -> Optional[dict]:
+    global _table, _loaded
+    if not _loaded:
+        _loaded = True
+        path = _table_path()
+        if path:
+            try:
+                with open(path) as fh:
+                    _table = json.load(fh)
+                log.info("flash tuning table: %s", path)
+            except (OSError, ValueError) as exc:
+                log.warning("flash tuning table unreadable (%s): %s",
+                            path, exc)
+                _table = None
+    return _table
+
+
+def _largest_divisor_block(seq: int, block: int) -> int:
+    """The largest power-of-two block <= ``block`` dividing seq (the
+    kernels require exact grids); floors at the minimum tile."""
+    b = block
+    while b > DEFAULT_BLOCK and seq % b != 0:
+        b //= 2
+    return max(b, min(DEFAULT_BLOCK, seq))
+
+
+def pick_blocks(kind: str, seq: int) -> Tuple[int, int]:
+    """(block_q, block_k) for a flash call of ``kind`` ('train' = the
+    differentiable fwd+bwd path, 'fwd' = inference/prefill) at ``seq``."""
+    bq, bk = DEFAULT_BLOCK, DEFAULT_BLOCK
+    table = _get_table()
+    if table is not None:
+        entries: Dict[str, list] = table.get("blocks", {}).get(kind, {})
+        tuned_seqs = sorted(int(s) for s in entries)
+        at_or_below = [s for s in tuned_seqs if s <= seq]
+        if at_or_below:
+            bq, bk = entries[str(at_or_below[-1])]
+    return _largest_divisor_block(seq, bq), _largest_divisor_block(seq, bk)
+
+
+def auto_min_seq(kind: str = "train") -> int:
+    """The measured crossover below which XLA attention wins; the
+    pre-tuning default when no table is shipped for this platform."""
+    table = _get_table()
+    if table is not None:
+        value = table.get("flash_min_seq", {}).get(kind)
+        if isinstance(value, int) and value >= 0:
+            return value
+    return DEFAULT_MIN_SEQ
+
+
+def resolve_min_seq(configured: int, kind: str = "train") -> int:
+    """Map a TransformerConfig.flash_min_seq to an effective threshold:
+    AUTO (-1) asks the tuned table; explicit values win unchanged
+    (0 keeps meaning 'never use flash')."""
+    return auto_min_seq(kind) if configured == AUTO else configured

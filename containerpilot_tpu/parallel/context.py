@@ -38,10 +38,16 @@ def flash_parallel_config(
             from ..ops.attention import causal_attention
 
             return causal_attention(q, k, v, window=cfg.window)
+        from ..ops import tuning
         from ..ops.flash import flash_attention
 
+        # seq is unsharded here (spec leaves axis 1 unpartitioned), so
+        # the tuned 'train' blocks for the global seq apply locally too
+        bq, bk = tuning.pick_blocks("train", q.shape[1])
         f = shard_map(
-            lambda q, k, v: flash_attention(q, k, v, window=cfg.window),
+            lambda q, k, v: flash_attention(
+                q, k, v, block_q=bq, block_k=bk, window=cfg.window
+            ),
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,

@@ -1,0 +1,138 @@
+"""Flash block tuning: table lookup, routing, and the autotuner
+(ops/tuning.py, ops/autotune.py)."""
+import jax.numpy as jnp
+import pytest
+
+from containerpilot_tpu.models.transformer import (
+    TransformerConfig,
+    flash_eligible,
+)
+from containerpilot_tpu.ops import tuning
+from containerpilot_tpu.ops.autotune import build_table, measure
+
+
+@pytest.fixture(autouse=True)
+def reset_table():
+    yield
+    tuning.set_table(None)
+    tuning._loaded = False  # rediscover from disk next lookup
+
+
+SAMPLE = {
+    "platform": "test",
+    "flash_min_seq": {"train": 2048, "fwd": 1024},
+    "blocks": {
+        "train": {"2048": [256, 128], "8192": [512, 256]},
+        "fwd": {"1024": [128, 128]},
+    },
+}
+
+
+def test_pick_blocks_exact_and_nearest_below():
+    tuning.set_table(SAMPLE)
+    assert tuning.pick_blocks("train", 2048) == (256, 128)
+    # 4096 has no entry: nearest tuned seq at/below is 2048
+    assert tuning.pick_blocks("train", 4096) == (256, 128)
+    assert tuning.pick_blocks("train", 8192) == (512, 256)
+
+
+def test_pick_blocks_clamps_to_divisors():
+    tuning.set_table(SAMPLE)
+    # 2176 = 17*128 (odd multiple): 256 does not divide it; the tuned
+    # 256 clamps down to 128
+    bq, bk = tuning.pick_blocks("train", 2176)
+    assert 2176 % bq == 0 and 2176 % bk == 0
+    assert (bq, bk) == (128, 128)
+
+
+def test_pick_blocks_default_without_table():
+    tuning.set_table(None)
+    tuning._loaded = True  # simulate: discovery ran, nothing found
+    assert tuning.pick_blocks("train", 4096) == (128, 128)
+    assert tuning.auto_min_seq("train") == tuning.DEFAULT_MIN_SEQ
+
+
+def test_resolve_min_seq_sentinels():
+    tuning.set_table(SAMPLE)
+    assert tuning.resolve_min_seq(tuning.AUTO, "train") == 2048
+    assert tuning.resolve_min_seq(tuning.AUTO, "fwd") == 1024
+    # explicit values win unchanged; 0 still means never
+    assert tuning.resolve_min_seq(512, "train") == 512
+    assert tuning.resolve_min_seq(0, "train") == 0
+
+
+def test_flash_eligible_resolves_auto_through_table():
+    tuning.set_table(SAMPLE)
+    cfg = TransformerConfig(
+        d_model=64, n_heads=2, n_layers=1, d_ff=128,
+        max_seq_len=8192, dtype=jnp.float32,  # flash_min_seq = AUTO
+    )
+    assert not flash_eligible(cfg, 1024)   # below tuned train crossover
+    assert flash_eligible(cfg, 2048)
+    # inference prefill resolves through the separately tuned 'fwd'
+    # crossover (models/decode.py passes kind="fwd")
+    assert flash_eligible(cfg, 1024, kind="fwd")
+    # explicit config still wins over the table
+    cfg_explicit = TransformerConfig(
+        d_model=64, n_heads=2, n_layers=1, d_ff=128,
+        max_seq_len=8192, dtype=jnp.float32, flash_min_seq=1024,
+    )
+    assert flash_eligible(cfg_explicit, 1024)
+
+
+def test_build_table_crossover_requires_wins_through_the_top():
+    # flash loses at 4096: the crossover must sit above it even though
+    # 2048 nominally won
+    results = {
+        "2048": {"xla_fwd_ms": 10, "xla_train_ms": 30,
+                 "flash": {"128x128": {"fwd_ms": 8, "train_ms": 25}}},
+        "4096": {"xla_fwd_ms": 40, "xla_train_ms": 120,
+                 "flash": {"128x128": {"fwd_ms": 50, "train_ms": 130}}},
+        "8192": {"xla_fwd_ms": 160, "xla_train_ms": 500,
+                 "flash": {"128x128": {"fwd_ms": 20, "train_ms": 100}}},
+    }
+    table = build_table(results, "test")
+    assert table["flash_min_seq"]["train"] == 8192
+    assert table["flash_min_seq"]["fwd"] == 8192
+    assert table["blocks"]["train"]["2048"] == [128, 128]
+
+
+def test_build_table_flash_never_wins():
+    results = {
+        "2048": {"xla_fwd_ms": 1, "xla_train_ms": 1,
+                 "flash": {"128x128": {"fwd_ms": 2, "train_ms": 2}}},
+    }
+    table = build_table(results, "test")
+    # above every measured seq: flash stays available for the
+    # unmeasured long tail but never claims a measured loss
+    assert table["flash_min_seq"]["train"] == 2049
+
+
+def test_build_table_picks_fastest_blocks_per_kind():
+    results = {
+        "2048": {
+            "xla_fwd_ms": 100, "xla_train_ms": 100,
+            "flash": {
+                "128x128": {"fwd_ms": 5, "train_ms": 9},
+                "256x128": {"fwd_ms": 7, "train_ms": 3},
+            },
+        },
+    }
+    table = build_table(results, "test")
+    assert table["blocks"]["fwd"]["2048"] == [128, 128]
+    assert table["blocks"]["train"]["2048"] == [256, 128]
+
+
+def test_autotune_measure_smoke():
+    """End-to-end measure() on the CPU backend (interpret-mode pallas):
+    tiny shapes, one candidate — asserts structure and positivity."""
+    results = measure(
+        [256], blocks=[128], batch=1, heads=1, head_dim=64, n=1, reps=1
+    )
+    entry = results["256"]
+    assert entry["xla_fwd_ms"] > 0 and entry["xla_train_ms"] > 0
+    flash = entry["flash"]["128x128"]
+    assert flash["fwd_ms"] > 0 and flash["train_ms"] > 0
+    table = build_table(results, "cpu-test")
+    assert table["blocks"]["train"]["256"] == [128, 128]
+    assert set(table["flash_min_seq"]) == {"train", "fwd"}
