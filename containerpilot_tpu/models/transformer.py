@@ -60,7 +60,10 @@ class TransformerConfig:
     # its activations: the standard TPU trade of MXU FLOPs (~1/3 extra)
     # for HBM. Without it the scan-over-layers saves every layer's MLP
     # hiddens ([L, b, s, d_ff]) and real model sizes blow the 16GB HBM.
-    remat: bool = True
+    # True/"full" = discard everything per layer; "dots" = keep matmul
+    # outputs, recompute only elementwise (less HBM saved, almost no
+    # recompute FLOPs); False = save everything.
+    remat: Any = True
     # int8 KV cache for serving (models/decode.py): k/v quantize
     # per-(token, head) on write and dequantize on read — KV memory
     # halves vs bf16, composing with GQA and the window ring. Training
@@ -328,8 +331,24 @@ def forward_with_aux(
         x, layer_aux = _layer(x, layer_params, cfg)
         return (x, aux + layer_aux), None
 
-    if cfg.remat:
-        body = jax.checkpoint(body)
+    if cfg.remat not in (True, False, "full", "dots", "none"):
+        raise ValueError(
+            f"remat must be True/False/'full'/'dots'/'none', "
+            f"got {cfg.remat!r}"
+        )
+    if cfg.remat and cfg.remat != "none":
+        # remat="dots" keeps the MXU outputs (the expensive matmuls)
+        # and recomputes only elementwise work in the backward pass —
+        # most of full remat's memory win at a fraction of its ~1/3
+        # recompute FLOPs. True/"full" discards everything per layer.
+        if cfg.remat == "dots":
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable,
+            )
+        else:
+            body = jax.checkpoint(body)
     (x, aux), _ = lax.scan(
         body, (x, jnp.zeros((), jnp.float32)), params["layers"]
     )

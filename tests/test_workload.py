@@ -2621,3 +2621,42 @@ def test_serve_cli_text_flag():
     args = build_arg_parser().parse_args(["--text", "--vocab", "512"])
     assert args.text is True and args.vocab == 512
     assert build_arg_parser().parse_args([]).text is False
+
+
+def test_remat_policies_equivalent():
+    """remat=True (full), remat="dots" (keep matmul outputs), and
+    remat=False trade memory for recompute only — loss and grads must
+    be bitwise-identical choices of the same math."""
+    import numpy as np
+
+    from containerpilot_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+        loss_fn,
+    )
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 17), 0, 64, jnp.int32
+    )
+    results = {}
+    for remat in (True, "dots", False):
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+            max_seq_len=16, dtype=jnp.float32, remat=remat,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        loss, grads = jax.jit(
+            jax.value_and_grad(lambda p: loss_fn(p, tokens, cfg))
+        )(params)
+        results[str(remat)] = (
+            float(loss),
+            [np.asarray(g) for g in jax.tree.leaves(grads)],
+        )
+    base_loss, base_grads = results["True"]
+    for name, (loss, grads) in results.items():
+        np.testing.assert_allclose(loss, base_loss, rtol=1e-6, err_msg=name)
+        assert len(grads) == len(base_grads)
+        for got, want in zip(grads, base_grads):
+            np.testing.assert_allclose(
+                got, want, rtol=1e-5, atol=1e-6, err_msg=name
+            )
