@@ -209,14 +209,23 @@ def training_bench() -> dict:
         }
 
     # remat policies trade HBM for recompute; measure what fits and
-    # headline the best. OOM on a variant (RESOURCE_EXHAUSTED) is a
-    # data point, not a failure.
+    # headline the best. ONLY deterministic failures are swallowed
+    # per-variant (OOM is a data point, not a failure); anything else
+    # (e.g. a transient tunnel RPC error) propagates so the caller's
+    # wedge retry still applies.
     variants: dict = {}
     for name, remat in (("full", True), ("dots", "dots"), ("none", False)):
         try:
             variants[name] = measure_variant(remat)
-        except Exception as exc:  # noqa: BLE001 — record and move on
-            variants[name] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+        except Exception as exc:  # noqa: BLE001
+            msg = f"{type(exc).__name__}: {exc}"
+            deterministic = (
+                "RESOURCE_EXHAUSTED" in msg
+                or isinstance(exc, (ValueError, TypeError))
+            )
+            if not deterministic:
+                raise
+            variants[name] = {"error": msg[:300]}
     ok = {k: v for k, v in variants.items() if "mfu" in v}
     if not ok:
         # deliberately NOT the top-level "error" key: per-variant

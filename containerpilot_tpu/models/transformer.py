@@ -90,6 +90,11 @@ class TransformerConfig:
             raise ValueError(
                 "moe_train_capacity requires moe_experts > 0"
             )
+        if self.remat not in (True, False, "full", "dots", "none"):
+            raise ValueError(
+                f"remat must be True/False/'full'/'dots'/'none', "
+                f"got {self.remat!r}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -331,11 +336,6 @@ def forward_with_aux(
         x, layer_aux = _layer(x, layer_params, cfg)
         return (x, aux + layer_aux), None
 
-    if cfg.remat not in (True, False, "full", "dots", "none"):
-        raise ValueError(
-            f"remat must be True/False/'full'/'dots'/'none', "
-            f"got {cfg.remat!r}"
-        )
     if cfg.remat and cfg.remat != "none":
         # remat="dots" keeps the MXU outputs (the expensive matmuls)
         # and recomputes only elementwise work in the backward pass —

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# One-shot TPU measurement session: run the moment the device tunnel
+# is healthy. Strict ordering — ONE TPU-touching process at a time
+# (the tunnel serves a single client):
+#   1. flash block autotune  -> containerpilot_tpu/ops/tuned/<platform>.json
+#   2. full bench.py         -> docs/bench-snapshots/round3-<platform>.json
+# Both artifacts are meant to be committed: the tuned table changes
+# routing (ops/tuning.py), the snapshot is the round's evidence.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== probe =="
+timeout 300 python -c "
+import jax
+ds = jax.devices()
+assert any(d.platform != 'cpu' for d in ds), ds
+print('backend:', ds[0].platform, ds[0].device_kind)
+"
+
+echo "== autotune (writes ops/tuned/<platform>.json) =="
+timeout 3600 python -m containerpilot_tpu.ops.autotune \
+  --seqs 1024,2048,4096,8192 --blocks 128,256,512 --write
+
+echo "== bench (full, with tuned routing) =="
+SNAP="docs/bench-snapshots/round3-$(python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from containerpilot_tpu.ops.tuning import platform_slug
+print(platform_slug())
+EOF
+).json"
+timeout 7200 python bench.py > /tmp/bench_out.json
+cp /tmp/bench_out.json "$SNAP"
+echo "snapshot: $SNAP"
+tail -c 2000 "$SNAP"

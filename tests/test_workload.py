@@ -2625,8 +2625,9 @@ def test_serve_cli_text_flag():
 
 def test_remat_policies_equivalent():
     """remat=True (full), remat="dots" (keep matmul outputs), and
-    remat=False trade memory for recompute only — loss and grads must
-    be bitwise-identical choices of the same math."""
+    remat=False (plus the "full"/"none" string aliases) trade memory
+    for recompute only — loss and grads must agree to tight numerical
+    tolerance across policies."""
     import numpy as np
 
     from containerpilot_tpu.models.transformer import (
@@ -2639,7 +2640,7 @@ def test_remat_policies_equivalent():
         jax.random.PRNGKey(1), (2, 17), 0, 64, jnp.int32
     )
     results = {}
-    for remat in (True, "dots", False):
+    for remat in (True, "dots", False, "full", "none"):
         cfg = TransformerConfig(
             vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
             max_seq_len=16, dtype=jnp.float32, remat=remat,
@@ -2652,6 +2653,11 @@ def test_remat_policies_equivalent():
             float(loss),
             [np.asarray(g) for g in jax.tree.leaves(grads)],
         )
+    # the string aliases must be exact synonyms of their booleans
+    for alias, boolean in (("full", "True"), ("none", "False")):
+        assert results[alias][0] == results[boolean][0]
+        for a, b in zip(results[alias][1], results[boolean][1]):
+            np.testing.assert_array_equal(a, b)
     base_loss, base_grads = results["True"]
     for name, (loss, grads) in results.items():
         np.testing.assert_allclose(loss, base_loss, rtol=1e-6, err_msg=name)
@@ -2660,3 +2666,11 @@ def test_remat_policies_equivalent():
             np.testing.assert_allclose(
                 got, want, rtol=1e-5, atol=1e-6, err_msg=name
             )
+
+
+def test_remat_invalid_value_rejected_at_construction():
+    with pytest.raises(ValueError, match="remat"):
+        TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+            max_seq_len=16, remat="Dots",
+        )
