@@ -154,6 +154,20 @@ class InferenceServer:
             return Response(503, b"warming up\n")
         return Response(200, b"ok\n")
 
+    def _mesh_info(self) -> Optional[Dict[str, int]]:
+        """The device mesh the params actually live on (axis -> size),
+        None for single-device serving — derived from the shardings,
+        so it reports the truth regardless of how loading happened."""
+        for leaf in jax.tree_util.tree_leaves(self.params):
+            sharding = getattr(leaf, "sharding", None)
+            mesh = getattr(sharding, "mesh", None)
+            if mesh is not None and mesh.size > 1:
+                return {
+                    str(name): int(size)
+                    for name, size in mesh.shape.items()
+                }
+        return None
+
     async def _model_info(self, _req: Request) -> Response:
         body = json.dumps(
             {
@@ -163,6 +177,7 @@ class InferenceServer:
                 "n_kv_heads": self.cfg.kv_heads,
                 "n_layers": self.cfg.n_layers,
                 "max_len": self.max_len,
+                "mesh": self._mesh_info(),
                 "text": self.tokenizer is not None,
                 "speculative": (
                     {

@@ -97,7 +97,48 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "prompts with the built-in byte-level tokenizer (requires "
         "--vocab >= 259)",
     )
+    parser.add_argument(
+        "--tp", type=int, default=1,
+        help="tensor-parallel ways: shard the model over the first N "
+        "local devices (heads/ffn/vocab partitioned, XLA inserts the "
+        "collectives); 1 = single device",
+    )
     return parser
+
+
+def _serving_mesh(tp: int):
+    """The mesh model loading/sharding lands on: an explicit --tp N
+    builds a pure tensor-parallel mesh over the first N local devices;
+    otherwise the default factoring over all local devices."""
+    from ..parallel import MeshPlan, make_mesh
+
+    if tp <= 1:
+        return make_mesh()
+    devices = jax.devices()
+    if tp > len(devices):
+        raise SystemExit(
+            f"--tp {tp} exceeds the {len(devices)} local devices"
+        )
+    return make_mesh(devices[:tp], plan=MeshPlan(data=1, model=tp))
+
+
+def _validate_tp(cfg: TransformerConfig, tp: int) -> None:
+    """Every axis the partition rules put on the model axis must
+    divide by tp — fail with a clean message at startup, not a raw
+    ValueError deep inside device_put/orbax (sharding.py
+    param_sharding_rules: heads, d_ff, vocab, and MoE experts are
+    model-sharded; GQA KV replicates when tp does not divide it)."""
+    for name, size in (
+        ("n_heads", cfg.n_heads),
+        ("d_ff", cfg.d_ff),
+        ("vocab", cfg.vocab_size),
+    ):
+        if size % tp:
+            raise SystemExit(f"--tp {tp} must divide {name} ({size})")
+    if cfg.moe_experts > 1 and cfg.moe_experts % tp:
+        raise SystemExit(
+            f"--tp {tp} must divide moe_experts ({cfg.moe_experts})"
+        )
 
 
 def load_model(args: argparse.Namespace):
@@ -114,15 +155,19 @@ def load_model(args: argparse.Namespace):
         window=args.window,
         kv_int8=args.kv_int8,
     )
+    tp = getattr(args, "tp", 1) or 1
+    if tp > 1:
+        _validate_tp(cfg, tp)
+    # ONE mesh for everything loaded here: checkpoint restore, the
+    # fresh-init shard, and the LoRA adapter must share a device set
+    # or the merge add is uncompilable
+    mesh = _serving_mesh(tp)
     params = None
     if args.checkpoint_dir:
         from ..parallel import (
             abstract_train_state,
-            make_mesh,
             restore_params,
         )
-
-        mesh = make_mesh()
         # params-only restore: optimizer moments stay PLACEHOLDERs on
         # disk, so the server never pays train-state memory
         abstract = abstract_train_state(jax.random.PRNGKey(0), cfg, mesh)
@@ -135,6 +180,10 @@ def load_model(args: argparse.Namespace):
                   + (" (EMA weights)" if args.use_ema else ""))
     if params is None:
         params = init_params(jax.random.PRNGKey(0), cfg)
+        if tp > 1:
+            from ..parallel import shard_params
+
+            params = shard_params(params, mesh, cfg)
     if args.lora_rank > 0 and not args.lora_dir:
         raise SystemExit("--lora-rank without --lora-dir does nothing; "
                          "pass the adapter checkpoint dir")
@@ -144,17 +193,12 @@ def load_model(args: argparse.Namespace):
         from ..models.lora import apply_lora
         from ..parallel import (
             lora_abstract_state,
-            make_mesh,
             restore_params,
         )
 
-        # the adapter must land on the SAME mesh the base weights use
-        # (make_mesh() == all local devices, matching the
-        # --checkpoint-dir restore above); a mismatched device set
-        # makes the merge add uncompilable
         restored_lora = restore_params(
             args.lora_dir,
-            lora_abstract_state(cfg, args.lora_rank, make_mesh()),
+            lora_abstract_state(cfg, args.lora_rank, mesh),
         )
         if restored_lora is None:
             raise SystemExit(f"no adapter checkpoint in {args.lora_dir}")
