@@ -1,0 +1,164 @@
+"""Slot-based continuous decode: the static-shape TPU analog of
+in-flight batching.
+
+``generate`` batches rows that start together; a serving system wants
+rows that start WHENEVER — a new request should join the decode loop
+at the next chunk boundary instead of queueing behind the current
+batch's full generation. The XLA-friendly shape for that is a fixed
+pool of S slots: every slot owns one cache row and its own position,
+the decode step is the single-row ``decode_step`` vmapped over the
+slot axis (XLA still batches the matmuls — weights stream from HBM
+once per step for all slots), and admission/harvest happen between
+fixed-size chunks on the host. All shapes are static: one compiled
+chunk program per (config, S, K), no recompiles as traffic changes.
+
+Sampling reproduces ``generate``'s schedule exactly: per-row key =
+``jax.random.split(PRNGKey(seed), 1)[0]``, sample i uses
+``fold_in(row_key, i)`` with sample 0 drawn from the prefill logits —
+so a request's output is byte-identical to a solo ``generate`` call
+no matter what it shared the pool with (tested).
+
+Dead slots (finished rows not yet reused) keep decoding garbage —
+static shapes — but their writes are harmless: a linear cache's
+dynamic_update_slice clamps at the boundary and the row is wholesale
+overwritten by the next admission. Emitted tokens are masked to pad
+after eos, same as ``generate``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .decode import Cache, decode_step, init_cache, sample_logits
+from .transformer import Params, TransformerConfig
+
+
+def slot_cache(cfg: TransformerConfig, slots: int, max_len: int) -> Cache:
+    """A pool of ``slots`` single-row caches, stacked on a leading
+    slot axis (k/v: [S, layers, 1, length, kv_heads, head_dim];
+    pos: [S])."""
+    row = init_cache(cfg, 1, max_len)
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(
+            x[None], (slots,) + x.shape
+        ).copy() if x.ndim else jnp.zeros((slots,), x.dtype),
+        row,
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_insert(cfg: TransformerConfig):
+    """(pool, row_cache, slot) -> pool with the row written at slot.
+    donate the pool: insertion must not copy S full cache rows."""
+
+    def insert(pool: Cache, row: Cache, slot: jax.Array) -> Cache:
+        def put(big, small):
+            if big.ndim == 1:  # pos: [S] <- scalar
+                return lax.dynamic_update_slice(
+                    big, small[None].astype(big.dtype), (slot,)
+                )
+            return lax.dynamic_update_slice(
+                big, small[None].astype(big.dtype),
+                (slot,) + (0,) * small.ndim,
+            )
+
+        return jax.tree.map(put, pool, row)
+
+    return jax.jit(insert, donate_argnums=(0,))
+
+
+def insert_row(pool: Cache, row: Cache, slot: int,
+               cfg: TransformerConfig) -> Cache:
+    """Write a freshly prefilled single-row cache into the pool.
+    The pool buffer is donated (in-place update)."""
+    return _jitted_insert(cfg)(pool, row, jnp.asarray(slot, jnp.int32))
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_chunk(cfg: TransformerConfig, slots: int, chunk: int):
+    """One compiled program advancing every slot ``chunk`` tokens.
+
+    Operands (all [S] unless noted): pool cache (donated), last
+    sampled token, stacked row keys [S, 2], next sample index,
+    temperature/top_k/top_p/eos/pad, done mask. Returns (pool, last,
+    done, tokens [S, chunk]).
+    """
+    vstep = jax.vmap(
+        lambda params, cache, token: decode_step(
+            params, cache, token, cfg
+        ),
+        in_axes=(None, 0, 0),
+    )
+
+    def run(params, pool, last, row_keys, step_idx, temperature,
+            top_k, top_p, eos_id, pad_id, done):
+        def body(carry, _):
+            pool, tok, done, idx = carry
+            logits, pool = vstep(params, pool, tok[:, None])  # [S,1,V]
+            keys = jax.vmap(jax.random.fold_in)(row_keys, idx)
+            nxt = sample_logits(
+                logits[:, 0, :], keys, temperature, top_k, top_p
+            ).astype(jnp.int32)
+            nxt = jnp.where(done, pad_id, nxt)
+            done = done | (nxt == eos_id)
+            return (pool, nxt, done, idx + 1), nxt
+
+        (pool, last, done, _), toks = lax.scan(
+            body, (pool, last, done, step_idx), None, length=chunk
+        )
+        return pool, last, done, toks.T  # [S, chunk]
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+def decode_slots_chunk(
+    params: Params,
+    pool: Cache,
+    last: jax.Array,
+    row_keys: jax.Array,
+    step_idx: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+    eos_id: jax.Array,
+    pad_id: jax.Array,
+    done: jax.Array,
+    cfg: TransformerConfig,
+    chunk: int,
+) -> Tuple[Cache, jax.Array, jax.Array, jax.Array]:
+    """Advance the whole pool ``chunk`` tokens; see _jitted_chunk."""
+    slots = int(last.shape[0])
+    return _jitted_chunk(cfg, slots, chunk)(
+        params, pool, last, row_keys, step_idx, temperature, top_k,
+        top_p, eos_id, pad_id, done,
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_first_sample(cfg: TransformerConfig):
+    """Sample token 0 from prefill logits with generate's key
+    schedule (fold_in(row_key, 0))."""
+
+    def first(logits, row_key, temperature, top_k, top_p):
+        key = jax.random.fold_in(row_key, jnp.int32(0))
+        return sample_logits(
+            logits, key[None], temperature[None], top_k[None],
+            top_p[None],
+        )[0].astype(jnp.int32)
+
+    return jax.jit(first)
+
+
+def first_sample(logits, row_key, temperature, top_k, top_p,
+                 cfg: TransformerConfig) -> jax.Array:
+    """logits: [1, vocab] from prefill -> token 0 (scalar)."""
+    return _jitted_first_sample(cfg)(
+        logits, row_key,
+        jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(top_k, jnp.int32),
+        jnp.asarray(top_p, jnp.float32),
+    )

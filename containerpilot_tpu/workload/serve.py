@@ -85,6 +85,8 @@ class InferenceServer:
         prefix_cache_entries: int = 0,
         prefill_chunk: int = 0,
         text: bool = False,
+        slots: int = 0,
+        slot_chunk: int = 8,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -114,6 +116,28 @@ class InferenceServer:
             PrefixCache(prefix_cache_entries)
             if prefix_cache_entries > 0 else None
         )
+        # continuous decode admission: single-row requests join a
+        # running K-token chunk loop over a fixed slot pool instead of
+        # queueing behind whole generations (serve_slots.py)
+        self.slot_engine = None
+        if slots > 0:
+            if prefix_cache_entries > 0:
+                raise ValueError(
+                    "--slots does not compose with --prefix-cache "
+                    "(slot rows are recycled wholesale; there is no "
+                    "cache to reuse a prefix from)"
+                )
+            if prefill_chunk > 0:
+                raise ValueError(
+                    "--slots does not compose with --prefill-chunk "
+                    "(slot admission prefills one-shot; chunked "
+                    "admission is future work)"
+                )
+            from .serve_slots import SlotEngine
+
+            self.slot_engine = SlotEngine(
+                cfg, params, max_len, slots=slots, chunk=slot_chunk
+            )
         # prompts longer than this stream through decode_chunk pieces
         # (peak prefill activations O(chunk) instead of O(prompt))
         self.prefill_chunk = prefill_chunk
@@ -200,6 +224,10 @@ class InferenceServer:
                     if self.prefix_cache is not None
                     else None
                 ),
+                "slot_engine": (
+                    self.slot_engine.stats
+                    if self.slot_engine is not None else None
+                ),
             }
         ).encode()
         return Response(200, body, content_type="application/json")
@@ -284,6 +312,16 @@ class InferenceServer:
                 self._executor, serve_strategies.run_speculative, self,
                 tokens, p["max_new"],
             )
+        if self.slot_engine is not None and len(tokens) == 1:
+            # joins the running chunk loop at the next boundary; output
+            # is already pad-trimmed at eos (the _trim downstream is
+            # idempotent on it)
+            fut = self.slot_engine.submit(
+                tokens[0], p["max_new_requested"],
+                temperature=p["temperature"], top_k=p["top_k"],
+                top_p=p["top_p"], eos_id=p["eos_id"], seed=p["seed"],
+            )
+            return [await asyncio.wrap_future(fut)]
         if (
             self.prefix_cache is not None
             and len(tokens) == 1
@@ -483,6 +521,15 @@ class InferenceServer:
                         )
 
         await asyncio.get_event_loop().run_in_executor(self._executor, run)
+        if self.slot_engine is not None:
+            # one dummy request through the engine compiles its whole
+            # program set (standalone prefill, first-sample, insert,
+            # and the (S, K) chunk) so the first live request doesn't
+            # stall on multi-second compilation behind a 200 /health
+            fut = self.slot_engine.submit(
+                [0, 0, 0, 0], max_new=self.slot_engine.chunk + 1
+            )
+            await asyncio.wrap_future(fut)
         self.ready = True
         log.info("serve: default shapes warm; accepting traffic")
 
@@ -495,6 +542,12 @@ class InferenceServer:
 
     async def stop(self) -> None:
         await self._batcher.stop()
+        if self.slot_engine is not None:
+            # joins the worker thread; run off-loop so in-flight
+            # chunks can't block the event loop
+            await asyncio.get_event_loop().run_in_executor(
+                None, self.slot_engine.stop
+            )
         await self._server.stop()
 
 

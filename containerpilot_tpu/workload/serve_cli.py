@@ -98,6 +98,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--vocab >= 259)",
     )
     parser.add_argument(
+        "--slots", type=int, default=0,
+        help="continuous decode admission: single-row requests join a "
+        "running chunked decode over a pool of N slots instead of "
+        "queueing behind whole generations; 0 = off (does not "
+        "compose with --prefix-cache or --window)",
+    )
+    parser.add_argument(
+        "--slot-chunk", type=int, default=8,
+        help="tokens decoded per slot-engine chunk between admissions",
+    )
+    parser.add_argument(
         "--tp", type=int, default=1,
         help="tensor-parallel ways: shard the model over the first N "
         "local devices (heads/ffn/vocab partitioned, XLA inserts the "
@@ -230,6 +241,7 @@ def main() -> int:
         max_batch_rows=args.max_batch_rows,
         prefix_cache_entries=args.prefix_cache,
         prefill_chunk=args.prefill_chunk,
+        slots=args.slots, slot_chunk=args.slot_chunk,
         text=args.text,
     )
 

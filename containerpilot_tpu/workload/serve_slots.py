@@ -1,0 +1,294 @@
+"""The slot engine: continuous decode admission for serving.
+
+``Batcher`` (serve_batcher.py) coalesces requests that ARRIVE
+together; this engine lets requests JOIN a running decode. A fixed
+pool of S slots decodes in K-token chunks (models/slots.py — one
+compiled program, static shapes); between chunks the engine harvests
+finished rows and admits queued requests into free slots, so a short
+request lands mid-flight next to a long one instead of waiting for
+the whole batch generation to finish.
+
+Per-request output is byte-identical to a solo ``generate`` call with
+the same arguments (the key schedule is reproduced exactly; each
+slot's draw depends only on its own key and step index) — tested
+against staggered concurrent traffic.
+
+One engine per server process; it owns a worker thread and the pool
+buffers (chunk/insert donate them). ``submit`` is thread-safe and
+returns a concurrent.futures.Future resolving to the generated ids
+(pad-trimmed after eos, capped at the request's max_new_tokens).
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.decode import _jitted_prefill
+from ..models.slots import (
+    decode_slots_chunk,
+    first_sample,
+    insert_row,
+    slot_cache,
+)
+from ..models.transformer import TransformerConfig
+
+log = logging.getLogger("containerpilot.serve.slots")
+
+
+@dataclass
+class _Request:
+    tokens: List[int]
+    max_new: int
+    temperature: float
+    top_k: int
+    top_p: float
+    eos_id: int
+    pad_id: int
+    seed: int
+    future: Future = field(default_factory=Future)
+
+
+@dataclass
+class _Slot:
+    req: _Request
+    emitted: List[int] = field(default_factory=list)
+    finished: bool = False  # eos seen (pads follow) or max_new reached
+
+
+class SlotEngine:
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        params,
+        max_len: int,
+        slots: int = 8,
+        chunk: int = 8,
+    ) -> None:
+        if slots < 1 or chunk < 1:
+            raise ValueError("slots and chunk must be >= 1")
+        if cfg.window > 0:
+            # a freed ring slot still holds live window context for
+            # its old row; re-admission would need a ring reset per
+            # slot — same reason the prefix cache rejects windows
+            raise ValueError(
+                "slot engine does not compose with sliding windows"
+            )
+        self.cfg = cfg
+        self.params = params
+        self.max_len = max_len
+        self.slots = slots
+        self.chunk = chunk
+        self._pool = slot_cache(cfg, slots, max_len)
+        self._last = jnp.zeros((slots,), jnp.int32)
+        self._keys = jnp.zeros((slots, 2), jnp.uint32)
+        self._step_idx = np.zeros((slots,), np.int32)
+        self._temp = np.zeros((slots,), np.float32)
+        self._top_k = np.zeros((slots,), np.int32)
+        self._top_p = np.zeros((slots,), np.float32)
+        self._eos = np.full((slots,), -1, np.int32)
+        self._pad = np.zeros((slots,), np.int32)
+        self._done = np.ones((slots,), bool)  # empty slots are "done"
+        self._active: List[Optional[_Slot]] = [None] * slots
+        self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        self._submit_lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="slot-engine", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- API
+
+    def submit(
+        self,
+        tokens: List[int],
+        max_new: int,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 0.0,
+        eos_id: int = -1,
+        pad_id: int = 0,
+        seed: int = 0,
+    ) -> Future:
+        """Queue one sequence; resolves to its generated ids."""
+        if max_new < 1:
+            raise ValueError("max_new must be >= 1")
+        if not tokens or len(tokens) >= self.max_len:
+            raise ValueError(
+                f"prompt must be 1..{self.max_len - 1} tokens"
+            )
+        if len(tokens) + max_new > self.max_len:
+            raise ValueError(
+                f"prompt {len(tokens)} + max_new {max_new} exceeds "
+                f"max_len {self.max_len}"
+            )
+        req = _Request(
+            tokens=list(tokens), max_new=int(max_new),
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p), eos_id=int(eos_id), pad_id=int(pad_id),
+            seed=int(seed),
+        )
+        # atomic with stop()'s drain: either this put lands before the
+        # drain (and gets cancelled there) or the stopped check raises
+        with self._submit_lock:
+            if self._stopped.is_set():
+                raise RuntimeError("engine is stopped")
+            self._queue.put(req)
+        return req.future
+
+    def stop(self) -> None:
+        with self._submit_lock:
+            self._stopped.set()
+        self._queue.put(None)  # wake the worker
+        self._thread.join(timeout=30)
+        for slot in self._active:
+            if slot is not None and not slot.req.future.done():
+                slot.req.future.cancel()
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None and not req.future.done():
+                req.future.cancel()
+
+    @property
+    def stats(self) -> dict:
+        return {
+            "slots": self.slots,
+            "chunk": self.chunk,
+            "active": sum(s is not None for s in self._active),
+            "queued": self._queue.qsize(),
+        }
+
+    # ----------------------------------------------------------- worker
+
+    def _admit(self, slot_id: int, req: _Request) -> None:
+        """Prefill the prompt into the slot and sample token 0 with
+        generate's exact key schedule."""
+        cfg = self.cfg
+        prompt = jnp.asarray([req.tokens], jnp.int32)
+        logits, row_cache = _jitted_prefill(cfg, self.max_len)(
+            self.params, prompt
+        )
+        # the server-wide convention: row i of a request samples from
+        # fold_in(PRNGKey(seed), i) — single-row here, so i = 0
+        # (serve_batcher/serve_prefix/serve_strategies do the same),
+        # keeping seeded output identical across serving configs
+        row_key = jax.random.fold_in(
+            jax.random.PRNGKey(req.seed), 0
+        )
+        first = first_sample(
+            logits, row_key, req.temperature, req.top_k, req.top_p, cfg
+        )
+        first_host = int(jax.device_get(first))
+        self._pool = insert_row(self._pool, row_cache, slot_id, cfg)
+        self._last = self._last.at[slot_id].set(first)
+        self._keys = self._keys.at[slot_id].set(row_key)
+        self._step_idx[slot_id] = 1
+        self._temp[slot_id] = req.temperature
+        self._top_k[slot_id] = req.top_k
+        self._top_p[slot_id] = req.top_p
+        self._eos[slot_id] = req.eos_id
+        self._pad[slot_id] = req.pad_id
+        state = _Slot(req=req, emitted=[first_host])
+        if first_host == req.eos_id or req.max_new <= 1:
+            state.finished = True
+        self._done[slot_id] = state.finished
+        self._active[slot_id] = state
+
+    def _harvest(self, slot_id: int) -> None:
+        state = self._active[slot_id]
+        req = state.req
+        out = state.emitted[: req.max_new]
+        if req.eos_id >= 0 and req.eos_id in out:
+            # keep the eos, pad-trim what follows (generate's contract
+            # after its own trim step)
+            out = out[: out.index(req.eos_id) + 1]
+        self._active[slot_id] = None
+        self._done[slot_id] = True
+        if not req.future.done():
+            req.future.set_result(out)
+
+    def _run(self) -> None:
+        while not self._stopped.is_set():
+            free = [i for i, s in enumerate(self._active) if s is None]
+            any_active = any(s is not None for s in self._active)
+            # block for work only when fully idle; otherwise drain
+            # whatever is queued into free slots and keep decoding
+            try:
+                block = not any_active
+                while free:
+                    req = self._queue.get(block=block, timeout=None)
+                    if req is None:  # stop sentinel
+                        return
+                    block = False
+                    try:
+                        self._admit(free.pop(0), req)
+                    except Exception as exc:  # noqa: BLE001
+                        if not req.future.done():
+                            req.future.set_exception(exc)
+            except queue.Empty:
+                pass
+            # harvest admissions that finished at token 0
+            for i, s in enumerate(self._active):
+                if s is not None and s.finished:
+                    self._harvest(i)
+            if not any(s is not None for s in self._active):
+                continue
+            try:
+                self._pool, self._last, done_dev, toks = (
+                    decode_slots_chunk(
+                        self.params, self._pool, self._last,
+                        self._keys, jnp.asarray(self._step_idx),
+                        jnp.asarray(self._temp),
+                        jnp.asarray(self._top_k),
+                        jnp.asarray(self._top_p),
+                        jnp.asarray(self._eos),
+                        jnp.asarray(self._pad),
+                        jnp.asarray(self._done),
+                        self.cfg, self.chunk,
+                    )
+                )
+            except Exception as exc:  # noqa: BLE001 — fail loud, once
+                log.exception("slot chunk failed")
+                for i, s in enumerate(self._active):
+                    if s is not None and not s.req.future.done():
+                        s.req.future.set_exception(exc)
+                    self._active[i] = None
+                    self._done[i] = True
+                # the failed call DONATED the pool buffer; rebuild it
+                # (all slots are free now) or every later admission
+                # would die on a deleted array while /health stays 200
+                self._pool = slot_cache(
+                    self.cfg, self.slots, self.max_len
+                )
+                self._last = jnp.zeros((self.slots,), jnp.int32)
+                self._keys = jnp.zeros((self.slots, 2), jnp.uint32)
+                continue
+            toks_host = np.asarray(jax.device_get(toks))
+            self._step_idx += self.chunk
+            for i, state in enumerate(self._active):
+                if state is None:
+                    continue
+                req = state.req
+                for t in toks_host[i]:
+                    if len(state.emitted) >= req.max_new:
+                        break
+                    state.emitted.append(int(t))
+                    if int(t) == req.eos_id:
+                        break
+                ended = (
+                    len(state.emitted) >= req.max_new
+                    or (req.eos_id >= 0 and req.eos_id in state.emitted)
+                )
+                if ended:
+                    self._harvest(i)
