@@ -9,7 +9,15 @@ training process per host, supervised by containerpilot-tpu:
 - posts step/loss metrics to the supervisor's control socket
   (``--control-socket``) for the Prometheus endpoint;
 - trains the flagship transformer on synthetic data over the local
-  (data, model) mesh.
+  (data, model) mesh;
+- handles preemption gracefully: on SIGTERM (TPU maintenance events,
+  the supervisor's stopTimeout window, `docker stop`) it finishes the
+  in-flight step, saves a checkpoint, and exits 0 — the supervisor's
+  restart brings it back at exactly that step. Single-process only:
+  a multi-process pod cannot checkpoint from one signal handler
+  (orbax saves hold cross-process barriers), so there the process
+  exits cleanly and the pod resumes from the last periodic
+  checkpoint.
 
 Run it stand-alone:
     python -m containerpilot_tpu.workload.train --steps 20
@@ -284,6 +292,19 @@ def main() -> int:
             "--eval-every requires --data-dir and --eval-holdout"
         )
 
+    # graceful preemption: the handler only sets a flag; the train
+    # loop checks it at the step boundary. Installed BEFORE any
+    # resource (prefetcher thread, device buffers) exists so a
+    # non-main-thread caller fails here, with nothing yet to leak;
+    # the train loop's finally restores the previous disposition.
+    import signal as signal_mod
+    import threading
+
+    preempted = threading.Event()
+    prev_term = signal_mod.signal(
+        signal_mod.SIGTERM, lambda s, f: preempted.set()
+    )
+
     prefetcher = None
     if args.data_dir:
         from jax.sharding import NamedSharding
@@ -358,6 +379,22 @@ def main() -> int:
     t0 = time.monotonic()
     try:
         for step in range(start_step, args.steps):
+            if preempted.is_set():
+                if args.checkpoint_dir and jax.process_count() == 1:
+                    from ..parallel import wait_for_checkpoints
+
+                    wait_for_checkpoints()  # drain async saves first
+                    save_checkpoint(args.checkpoint_dir, step, state)
+                    print(f"preempted: checkpoint saved at step {step}; "
+                          "exiting for the supervisor to resume",
+                          flush=True)
+                else:
+                    # a multi-process pod can't checkpoint from one
+                    # signal (orbax barriers span processes): exit
+                    # clean, resume from the last periodic save
+                    print("preempted: exiting (resume from last "
+                          "periodic checkpoint)", flush=True)
+                return 0
             if step == profile_start:
                 jax.profiler.start_trace(args.profile_dir)
                 profiling = True
@@ -432,7 +469,9 @@ def main() -> int:
     finally:
         # a failed step must not leak the staging thread (in-process
         # callers would otherwise keep a live worker + device buffers),
-        # and a dangling profiler window must be closed
+        # a dangling profiler window must be closed, and in-process
+        # callers (tests) must get their SIGTERM disposition back
+        signal_mod.signal(signal_mod.SIGTERM, prev_term)
         if prefetcher is not None:
             prefetcher.stop()
         if profiling:
