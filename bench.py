@@ -171,8 +171,10 @@ def training_bench() -> dict:
     device_kind = jax.devices()[0].device_kind
     floor = _sync_floor_ms() / 1e3
 
-    def measure_variant(remat) -> dict:
-        cfg = TransformerConfig(remat=remat, **base)
+    def measure_variant(remat, loss_chunk: int = 0) -> dict:
+        cfg = TransformerConfig(
+            remat=remat, loss_chunk=loss_chunk, **base
+        )
         state = init_train_state(jax.random.PRNGKey(0), cfg, mesh)
         step = make_train_step(cfg, mesh)
         n_params = sum(
@@ -214,9 +216,17 @@ def training_bench() -> dict:
     # (e.g. a transient tunnel RPC error) propagates so the caller's
     # wedge retry still applies.
     variants: dict = {}
-    for name, remat in (("full", True), ("dots", "dots"), ("none", False)):
+    for name, remat, loss_chunk in (
+        ("full", True, 0),
+        ("dots", "dots", 0),
+        ("none", False, 0),
+        # chunked cross-entropy: the 32k-vocab logits tensor is the
+        # single biggest activation at this config (~2 GB f32);
+        # streaming the loss head may buy more than it recomputes
+        ("dots+xent512", "dots", 512),
+    ):
         try:
-            variants[name] = measure_variant(remat)
+            variants[name] = measure_variant(remat, loss_chunk)
         except Exception as exc:  # noqa: BLE001
             msg = f"{type(exc).__name__}: {exc}"
             deterministic = (

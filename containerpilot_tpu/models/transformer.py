@@ -64,6 +64,12 @@ class TransformerConfig:
     # outputs, recompute only elementwise (less HBM saved, almost no
     # recompute FLOPs); False = save everything.
     remat: Any = True
+    # >0: the training loss streams the unembed projection +
+    # log-softmax over sequence chunks of this size instead of
+    # materializing [batch, seq, vocab] logits (gigabytes at real
+    # vocab sizes); the backward recomputes each chunk's logits.
+    # 0 = whole-logits loss.
+    loss_chunk: int = 0
     # int8 KV cache for serving (models/decode.py): k/v quantize
     # per-(token, head) on write and dequantize on read — KV memory
     # halves vs bf16, composing with GQA and the window ring. Training
@@ -94,6 +100,10 @@ class TransformerConfig:
             raise ValueError(
                 f"remat must be True/False/'full'/'dots'/'none', "
                 f"got {self.remat!r}"
+            )
+        if self.loss_chunk < 0:
+            raise ValueError(
+                f"loss_chunk must be >= 0, got {self.loss_chunk}"
             )
 
     @property
@@ -320,11 +330,14 @@ def _layer(
     return _ffn(x, layer_params, cfg)
 
 
-def forward_with_aux(
+def forward_hidden(
     params: Params, tokens: jax.Array, cfg: TransformerConfig
 ):
-    """tokens: [batch, seq] int32 -> (logits [batch, seq, vocab] f32,
-    aux_loss scalar — MoE load balance; zero for dense models).
+    """tokens: [batch, seq] int32 -> (final normed hidden
+    [batch, seq, d_model], aux_loss scalar) — everything up to (not
+    including) the unembed projection, so losses may stream the vocab
+    projection in pieces (chunked cross-entropy) instead of
+    materializing [batch, seq, vocab] logits.
 
     The layer stack is a lax.scan over stacked layer params: one
     compiled block body, L iterations, rematerialization-friendly.
@@ -352,7 +365,15 @@ def forward_with_aux(
     (x, aux), _ = lax.scan(
         body, (x, jnp.zeros((), jnp.float32)), params["layers"]
     )
-    x = _rms_norm(x, params["norm_out"])
+    return _rms_norm(x, params["norm_out"]), aux
+
+
+def forward_with_aux(
+    params: Params, tokens: jax.Array, cfg: TransformerConfig
+):
+    """tokens: [batch, seq] int32 -> (logits [batch, seq, vocab] f32,
+    aux_loss scalar — MoE load balance; zero for dense models)."""
+    x, aux = forward_hidden(params, tokens, cfg)
     logits = jnp.einsum(
         "bsd,dv->bsv", x, maybe_dequant_top(params, "unembed", cfg.dtype),
         preferred_element_type=jnp.float32,
@@ -367,6 +388,15 @@ def forward(
     return forward_with_aux(params, tokens, cfg)[0]
 
 
+def _ce_nll(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Per-position negative log-likelihood — the ONE cross-entropy
+    core shared by the whole-logits and chunked losses, so a change
+    to the objective (z-loss, label smoothing, soft-capping) cannot
+    silently apply to only one path."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+
+
 def next_token_loss(
     logits: jax.Array,
     aux: jax.Array,
@@ -375,15 +405,58 @@ def next_token_loss(
 ) -> jax.Array:
     """Next-token CE over logits for tokens[:, :-1], plus weighted MoE
     aux — shared by the plain and pipelined losses."""
+    return jnp.mean(_ce_nll(logits, tokens[:, 1:])) + (
+        cfg.moe_aux_weight * aux
+    )
+
+
+def _chunked_next_token_loss(
+    params: Params, tokens: jax.Array, cfg: TransformerConfig
+) -> jax.Array:
+    """CE without ever materializing the full [b, s, vocab] logits:
+    the unembed projection + log-softmax + gather run over sequence
+    chunks inside a remat'd scan, so peak activation memory for the
+    loss head is [b, loss_chunk, vocab] (the backward recomputes each
+    chunk's logits — one extra unembed matmul, a few percent of step
+    FLOPs, against gigabytes of saved HBM at real vocab sizes)."""
+    x, aux = forward_hidden(params, tokens[:, :-1], cfg)
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll) + cfg.moe_aux_weight * aux
+    b, s, d = x.shape
+    chunk = min(cfg.loss_chunk, s)
+    n = -(-s // chunk)
+    pad = n * chunk - s
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+    mask = (jnp.arange(n * chunk) < s)[None, :]  # [1, n*chunk]
+    unembed = maybe_dequant_top(params, "unembed", cfg.dtype)
+
+    x_chunks = x.reshape(b, n, chunk, d).swapaxes(0, 1)  # [n,b,c,d]
+    t_chunks = targets.reshape(b, n, chunk).swapaxes(0, 1)
+    m_chunks = mask.reshape(1, n, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def piece(total, inputs):
+        xc, tc, mc = inputs
+        logits = jnp.einsum(
+            "bcd,dv->bcv", xc, unembed,
+            preferred_element_type=jnp.float32,
+        )
+        return total + jnp.sum(_ce_nll(logits, tc) * mc), None
+
+    total, _ = lax.scan(
+        piece, jnp.zeros((), jnp.float32), (x_chunks, t_chunks, m_chunks)
+    )
+    return total / (b * s) + cfg.moe_aux_weight * aux
 
 
 def loss_fn(
     params: Params, tokens: jax.Array, cfg: TransformerConfig
 ) -> jax.Array:
-    """Next-token cross-entropy (+ weighted MoE aux loss when routed)."""
+    """Next-token cross-entropy (+ weighted MoE aux loss when routed).
+    ``cfg.loss_chunk > 0`` streams the vocab projection in sequence
+    chunks instead of materializing full logits."""
+    if cfg.loss_chunk > 0:
+        return _chunked_next_token_loss(params, tokens, cfg)
     logits, aux = forward_with_aux(params, tokens[:, :-1], cfg)
     return next_token_loss(logits, aux, tokens, cfg)

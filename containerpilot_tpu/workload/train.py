@@ -50,6 +50,11 @@ def main() -> int:
                         "attends the last N positions only (0 = full "
                         "causal); bounds attention FLOPs and the "
                         "serving KV cache")
+    parser.add_argument("--loss-chunk", type=int, default=0,
+                        help="stream the vocab projection + softmax "
+                        "over sequence chunks of N instead of "
+                        "materializing [batch, seq, vocab] logits "
+                        "(0 = whole-logits loss)")
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="switch-MoE experts (0 = dense MLP)")
     parser.add_argument("--moe-capacity", type=float, default=0.0,
@@ -142,9 +147,15 @@ def main() -> int:
         moe_experts=args.moe_experts,
         moe_train_capacity=args.moe_capacity,
         window=args.window,
+        loss_chunk=args.loss_chunk,
     )
     rules = None
     if args.pipeline_stages > 1:
+        if args.loss_chunk:
+            raise SystemExit(
+                "--loss-chunk does not apply to the pipelined loss "
+                "(pipeline_loss_fn computes its own whole-logits CE)"
+            )
         # dp x pp x tp: layers shard over pipe stages, tensor
         # parallelism stays live inside each stage (parallel/pipeline.py)
         n_dev = len(jax.devices())

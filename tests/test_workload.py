@@ -2847,3 +2847,54 @@ def test_trainer_graceful_preemption(tmp_path):
     assert f"resumed from checkpoint at step {saved}" in finish.stdout, (
         finish.stdout[-2000:]
     )
+
+
+@pytest.mark.parametrize("seq", [16, 17])  # 17: chunk-padding path
+def test_chunked_loss_matches_whole_logits(seq):
+    """loss_chunk streams the vocab projection in pieces; loss and
+    grads must match the whole-logits loss to f32 tolerance, including
+    when the sequence does not divide by the chunk."""
+    import dataclasses
+
+    base = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, seq + 1), 0, base.vocab_size,
+        jnp.int32,
+    )
+    params = init_params(jax.random.PRNGKey(0), base)
+    whole_loss, whole_grads = jax.jit(
+        jax.value_and_grad(lambda p: loss_fn(p, tokens, base))
+    )(params)
+    chunked = dataclasses.replace(base, loss_chunk=8)
+    c_loss, c_grads = jax.jit(
+        jax.value_and_grad(lambda p: loss_fn(p, tokens, chunked))
+    )(params)
+    np.testing.assert_allclose(
+        float(c_loss), float(whole_loss), rtol=1e-6
+    )
+    for got, want in zip(
+        jax.tree.leaves(c_grads), jax.tree.leaves(whole_grads)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=1e-6
+        )
+
+
+def test_chunked_loss_matches_with_moe_aux():
+    import dataclasses
+
+    base = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32, moe_experts=2,
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(2), (2, 13), 0, base.vocab_size, jnp.int32
+    )
+    params = init_params(jax.random.PRNGKey(0), base)
+    whole = float(jax.jit(lambda p: loss_fn(p, tokens, base))(params))
+    chunked = dataclasses.replace(base, loss_chunk=4)
+    got = float(jax.jit(lambda p: loss_fn(p, tokens, chunked))(params))
+    np.testing.assert_allclose(got, whole, rtol=1e-6)
