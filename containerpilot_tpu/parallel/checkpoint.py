@@ -211,6 +211,31 @@ def _extract_ema(node: Any) -> Optional[Any]:
     return None
 
 
+def checkpoint_has_ema(directory: str) -> bool:
+    """True when the latest checkpoint's optimizer state carries an
+    EMA shadow subtree — i.e. restore_params(prefer_ema=True) would
+    return shadow weights rather than silently falling back to the
+    raw params. Lets CLI consumers report what they actually scored."""
+    step = latest_step(directory)
+    if step is None:
+        return False
+    import orbax.checkpoint as ocp
+
+    try:
+        meta = ocp.PyTreeCheckpointer().metadata(
+            _step_path(directory, step)
+        ).item_metadata
+        meta_tree = meta.tree if hasattr(meta, "tree") else meta
+        opt_meta = meta_tree[1]
+    except (KeyError, IndexError, TypeError, AttributeError):
+        return False
+    marker = object()
+    _, found = _swap_in_ema(
+        jax.tree.map(lambda _: None, opt_meta), marker
+    )
+    return found
+
+
 def restore_params(
     directory: str, state_like: Any, prefer_ema: bool = False
 ) -> Optional[Any]:

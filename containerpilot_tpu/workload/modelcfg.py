@@ -1,0 +1,99 @@
+"""Shared CLI plumbing for the workload triad (train/evaluate/serve):
+the flag->config derivations and the checkpoint-restore/LoRA-merge
+sequence must be ONE implementation, or the three entry points drift
+apart and score/serve a differently-shaped model than was trained.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+
+
+def derive_d_ff(d_model: int) -> int:
+    """The triad's shared SwiGLU width rule: ~3x d_model, floored to
+    a 128 multiple (MXU tile), never 0."""
+    return d_model * 3 // 128 * 128 or 128
+
+
+def restore_params_only(
+    cfg: Any, mesh: Any, checkpoint_dir: str, use_ema: bool = False
+) -> Optional[Tuple[Any, int]]:
+    """Params-only restore (optionally the EMA shadow) landing on
+    ``mesh`` — optimizer moments stay PLACEHOLDERs on disk. Returns
+    (params, checkpoint_step) or None when no checkpoint exists."""
+    from ..parallel import abstract_train_state, restore_params
+
+    restored = restore_params(
+        checkpoint_dir,
+        abstract_train_state(jax.random.PRNGKey(0), cfg, mesh),
+        prefer_ema=use_ema,
+    )
+    if restored is None:
+        return None
+    params, step = restored
+    return params, int(step)
+
+
+def validate_lora_flags(lora_dir: str, lora_rank: int) -> None:
+    """Clean SystemExit for the flag-misuse cases every CLI shares."""
+    if lora_rank > 0 and not lora_dir:
+        raise SystemExit("--lora-rank without --lora-dir does nothing; "
+                         "pass the adapter checkpoint dir")
+    if lora_dir and lora_rank < 1:
+        raise SystemExit("--lora-dir requires --lora-rank")
+
+
+def merge_lora(
+    params: Any, cfg: Any, mesh: Any, lora_dir: str, lora_rank: int
+) -> Tuple[Any, int]:
+    """Restore a trained adapter from ``lora_dir`` (on the SAME mesh
+    the base lives on — a mismatched device set makes the merge add
+    uncompilable) and fold it into the base weights. Merge BEFORE any
+    quantization: int8 bases aren't adaptable."""
+    from ..models.lora import apply_lora
+    from ..parallel import lora_abstract_state, restore_params
+
+    adapter = restore_params(
+        lora_dir, lora_abstract_state(cfg, lora_rank, mesh)
+    )
+    if adapter is None:
+        raise SystemExit(f"no adapter checkpoint in {lora_dir}")
+    return apply_lora(params, adapter[0], cfg), int(adapter[1])
+
+
+def restore_merged_params(
+    cfg: Any,
+    mesh: Any,
+    checkpoint_dir: str,
+    use_ema: bool = False,
+    lora_dir: str = "",
+    lora_rank: int = 0,
+) -> Optional[Tuple[Any, int]]:
+    """restore_params_only + optional merge_lora, the composition the
+    evaluate CLI scores. Returns (params, checkpoint_step) or None
+    when no checkpoint exists."""
+    validate_lora_flags(lora_dir, lora_rank)
+    restored = restore_params_only(cfg, mesh, checkpoint_dir, use_ema)
+    if restored is None:
+        return None
+    params, step = restored
+    if lora_dir:
+        params, _ = merge_lora(params, cfg, mesh, lora_dir, lora_rank)
+    return params, step
+
+
+def average_eval_loss(params, cfg, n: int, batch_at) -> float:
+    """The one eval-loss computation (jitted loss_fn averaged over n
+    batches) shared by the trainer's in-loop eval and the standalone
+    evaluate CLI — the comparability of their numbers is structural,
+    not a convention."""
+    import jax.numpy as jnp
+
+    from ..models.transformer import loss_fn
+
+    step = jax.jit(lambda p, t: loss_fn(p, t, cfg))
+    total = 0.0
+    for i in range(n):
+        total += float(step(params, jnp.asarray(batch_at(i))))
+    return total / n

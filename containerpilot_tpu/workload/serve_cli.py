@@ -13,6 +13,12 @@ import asyncio
 import jax
 
 from ..models.transformer import TransformerConfig, init_params
+from .modelcfg import (
+    derive_d_ff,
+    merge_lora,
+    restore_params_only,
+    validate_lora_flags,
+)
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -160,7 +166,7 @@ def load_model(args: argparse.Namespace):
         n_heads=args.n_heads,
         n_kv_heads=args.n_kv_heads,
         n_layers=args.n_layers,
-        d_ff=args.d_model * 3 // 128 * 128 or 128,
+        d_ff=derive_d_ff(args.d_model),
         max_seq_len=args.max_len,
         moe_experts=args.moe_experts,
         window=args.window,
@@ -175,19 +181,15 @@ def load_model(args: argparse.Namespace):
     mesh = _serving_mesh(tp)
     params = None
     if args.checkpoint_dir:
-        from ..parallel import (
-            abstract_train_state,
-            restore_params,
-        )
-        # params-only restore: optimizer moments stay PLACEHOLDERs on
-        # disk, so the server never pays train-state memory
-        abstract = abstract_train_state(jax.random.PRNGKey(0), cfg, mesh)
-        restored = restore_params(
-            args.checkpoint_dir, abstract, prefer_ema=args.use_ema
+        # shared with the evaluate CLI (workload/modelcfg.py):
+        # params-only restore, so the server never pays train-state
+        # memory
+        restored = restore_params_only(
+            cfg, mesh, args.checkpoint_dir, use_ema=args.use_ema
         )
         if restored is not None:
             params, step = restored
-            print(f"serving checkpoint step {int(step)}"
+            print(f"serving checkpoint step {step}"
                   + (" (EMA weights)" if args.use_ema else ""))
     if params is None:
         params = init_params(jax.random.PRNGKey(0), cfg)
@@ -195,29 +197,13 @@ def load_model(args: argparse.Namespace):
             from ..parallel import shard_params
 
             params = shard_params(params, mesh, cfg)
-    if args.lora_rank > 0 and not args.lora_dir:
-        raise SystemExit("--lora-rank without --lora-dir does nothing; "
-                         "pass the adapter checkpoint dir")
+    validate_lora_flags(args.lora_dir, args.lora_rank)
     if args.lora_dir:
-        if args.lora_rank < 1:
-            raise SystemExit("--lora-dir requires --lora-rank")
-        from ..models.lora import apply_lora
-        from ..parallel import (
-            lora_abstract_state,
-            restore_params,
+        params, lora_step_n = merge_lora(
+            params, cfg, mesh, args.lora_dir, args.lora_rank
         )
-
-        restored_lora = restore_params(
-            args.lora_dir,
-            lora_abstract_state(cfg, args.lora_rank, mesh),
-        )
-        if restored_lora is None:
-            raise SystemExit(f"no adapter checkpoint in {args.lora_dir}")
-        lora, lora_step_n = restored_lora
-        # merge BEFORE any quantization: int8 bases aren't adaptable
-        params = apply_lora(params, lora, cfg)
         print(f"merged lora adapter (rank {args.lora_rank}, "
-              f"step {int(lora_step_n)})")
+              f"step {lora_step_n})")
     if args.int8:
         from ..models.quantized import param_bytes, quantize_model_params
 

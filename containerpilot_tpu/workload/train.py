@@ -127,6 +127,7 @@ def main() -> int:
     args = parser.parse_args()
 
     from ..models.transformer import TransformerConfig
+    from .modelcfg import derive_d_ff
     from ..parallel import (
         MeshPlan,
         init_train_state,
@@ -142,7 +143,7 @@ def main() -> int:
         n_heads=args.n_heads,
         n_kv_heads=args.n_kv_heads,
         n_layers=args.n_layers,
-        d_ff=args.d_model * 3 // 128 * 128 or 128,
+        d_ff=derive_d_ff(args.d_model),
         max_seq_len=args.seq_len,
         moe_experts=args.moe_experts,
         moe_train_capacity=args.moe_capacity,
@@ -339,17 +340,17 @@ def main() -> int:
         print(f"data: {dataset.n_windows} train windows "
               f"(+{dataset.holdout_windows} held out) from {args.data_dir}")
 
-    eval_step = None
-    if args.eval_every > 0:
-        from ..models.transformer import loss_fn as _loss_fn
-
-        eval_step = jax.jit(lambda p, t: _loss_fn(p, t, cfg))
+    eval_enabled = args.eval_every > 0
 
     def run_eval(params) -> float:
-        total = 0.0
-        for i in range(dataset.n_eval_batches):
-            total += float(eval_step(params, dataset.eval_batch(i)))
-        return total / dataset.n_eval_batches
+        # the ONE eval-loss computation, shared with the standalone
+        # evaluate CLI (workload/modelcfg.py) so their numbers are
+        # comparable by construction
+        from .modelcfg import average_eval_loss
+
+        return average_eval_loss(
+            params, cfg, dataset.n_eval_batches, dataset.eval_batch
+        )
 
     # profiler window: skip step 1 (compile) and capture a few steady
     # steps — the standard "pick a mesh, profile, iterate" loop
@@ -453,7 +454,7 @@ def main() -> int:
                 print(f"step {step + 1}: loss={float(loss):.4f} "
                       f"({rate:.1f} steps/s, {tokens_s:.0f} tok/s, "
                       f"mfu={mfu:.3f})")
-            if eval_step is not None and (step + 1) % args.eval_every == 0:
+            if eval_enabled and (step + 1) % args.eval_every == 0:
                 if args.lora_rank > 0:
                     from ..models.lora import apply_lora
                     from ..parallel import ema_params

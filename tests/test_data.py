@@ -217,3 +217,116 @@ def test_trainer_eval_loop(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "eval_loss=" in out
     assert "+6 held out" in out
+
+
+def test_evaluate_cli_scores_checkpoint(tmp_path, capsys):
+    """Train a few steps with checkpointing, then score the
+    checkpoint with the standalone eval CLI: finite loss, matching
+    perplexity, and the chunked-loss path agrees with whole-logits."""
+    import json as json_mod
+    import sys
+
+    from containerpilot_tpu.workload.evaluate import main as eval_main
+    from containerpilot_tpu.workload.train import main as train_main
+
+    tokens = np.random.default_rng(1).integers(
+        0, 128, size=30_000, dtype=np.int32
+    )
+    data_dir = str(tmp_path / "data")
+    write_token_shards(tokens, data_dir, shard_size=10_000)
+    ckpt = str(tmp_path / "ckpt")
+    model_flags = [
+        "--batch", "2", "--seq-len", "32", "--d-model", "64",
+        "--n-layers", "1", "--n-heads", "4", "--vocab", "128",
+    ]
+    argv = sys.argv
+    sys.argv = [
+        "train", "--steps", "3", "--data-dir", data_dir,
+        "--checkpoint-dir", ckpt, "--checkpoint-every", "3",
+        "--eval-holdout", "8",
+    ] + model_flags
+    try:
+        assert train_main() == 0
+    finally:
+        sys.argv = argv
+    capsys.readouterr()
+
+    def run_eval(extra):
+        old = sys.argv
+        sys.argv = [
+            "evaluate", "--checkpoint-dir", ckpt, "--data-dir",
+            data_dir, "--eval-holdout", "8",
+        ] + model_flags + extra
+        try:
+            assert eval_main() == 0
+        finally:
+            sys.argv = old
+        return json_mod.loads(capsys.readouterr().out.strip())
+
+    report = run_eval([])
+    assert report["checkpoint_step"] == 3
+    assert report["split"] == "holdout" and report["batches"] >= 1
+    assert 0 < report["eval_loss"] < 20
+    np.testing.assert_allclose(
+        report["perplexity"], np.exp(report["eval_loss"]), rtol=1e-3
+    )
+    chunked = run_eval(["--loss-chunk", "8"])
+    np.testing.assert_allclose(
+        chunked["eval_loss"], report["eval_loss"], rtol=1e-5
+    )
+    head = run_eval(["--eval-holdout", "0", "--max-batches", "2"])
+    assert head["split"] == "head" and head["batches"] == 2
+
+
+def test_evaluate_cli_ema_honesty(tmp_path, capsys):
+    """--use-ema reports "ema": true only when the checkpoint really
+    carries a shadow; a non-EMA checkpoint falls back to raw params
+    and says so."""
+    import json as json_mod
+    import sys
+
+    from containerpilot_tpu.workload.evaluate import main as eval_main
+    from containerpilot_tpu.workload.train import main as train_main
+
+    tokens = np.random.default_rng(2).integers(
+        0, 128, size=20_000, dtype=np.int32
+    )
+    data_dir = str(tmp_path / "data")
+    write_token_shards(tokens, data_dir, shard_size=10_000)
+    model_flags = [
+        "--batch", "2", "--seq-len", "32", "--d-model", "64",
+        "--n-layers", "1", "--n-heads", "4", "--vocab", "128",
+    ]
+
+    def train(ckpt, extra):
+        old = sys.argv
+        sys.argv = [
+            "train", "--steps", "2", "--data-dir", data_dir,
+            "--checkpoint-dir", ckpt, "--checkpoint-every", "2",
+        ] + model_flags + extra
+        try:
+            assert train_main() == 0
+        finally:
+            sys.argv = old
+        capsys.readouterr()
+
+    def evaluate(ckpt):
+        old = sys.argv
+        sys.argv = [
+            "evaluate", "--checkpoint-dir", ckpt, "--data-dir",
+            data_dir, "--eval-holdout", "8", "--use-ema",
+            "--max-batches", "1",
+        ] + model_flags
+        try:
+            assert eval_main() == 0
+        finally:
+            sys.argv = old
+        return json_mod.loads(capsys.readouterr().out.strip())
+
+    ema_ckpt = str(tmp_path / "ema")
+    train(ema_ckpt, ["--ema-decay", "0.9"])
+    assert evaluate(ema_ckpt)["ema"] is True
+
+    raw_ckpt = str(tmp_path / "raw")
+    train(raw_ckpt, [])
+    assert evaluate(raw_ckpt)["ema"] is False
