@@ -232,6 +232,29 @@ class InferenceServer:
         ).encode()
         return Response(200, body, content_type="application/json")
 
+    def _parse_stops(self, raw: Any) -> List[List[int]]:
+        """Token-level stop sequences: a list of non-empty id rows
+        (the text surface converts strings before calling). Bounded so
+        a request can't smuggle in an O(stops*len) trim bill."""
+        if raw is None:
+            return []
+        if not isinstance(raw, list) or len(raw) > 8 or not all(
+            isinstance(s, list)
+            and 1 <= len(s) <= 32
+            and all(
+                isinstance(t, int)
+                and not isinstance(t, bool)
+                and 0 <= t < self.cfg.vocab_size
+                for t in s
+            )
+            for s in raw
+        ):
+            raise ValueError(
+                "'stop' must be a list of at most 8 sequences, each "
+                f"1..32 token ids in [0, {self.cfg.vocab_size})"
+            )
+        return raw
+
     def _parse_sampling(
         self, body: Dict[str, Any], tokens: List[List[int]],
         prompt_len: int, default_eos: int = -1,
@@ -247,6 +270,7 @@ class InferenceServer:
             "eos_id": int(body.get("eos_id", default_eos)),
             "beam_width": int(body.get("beam_width", 0)),
             "length_penalty": float(body.get("length_penalty", 0.0)),
+            "stop": self._parse_stops(body.get("stop")),
         }
         if p["beam_width"]:
             from ..models.beam import validate_beam_args
@@ -371,6 +395,28 @@ class InferenceServer:
             ]
         return generated
 
+    @staticmethod
+    def _trim_stops(
+        generated: List[List[int]], stops: List[List[int]]
+    ) -> List[List[int]]:
+        """Cut each row at the earliest occurrence of any stop
+        sequence, EXCLUDING the stop itself (the OpenAI convention).
+        Decode still ran to its compiled length — static shapes — so
+        this is response shaping, not an early exit."""
+        if not stops:
+            return generated
+        out = []
+        for row in generated:
+            cut = len(row)
+            for stop in stops:
+                n = len(stop)
+                for i in range(0, min(cut, len(row) - n + 1)):
+                    if row[i:i + n] == stop:
+                        cut = min(cut, i)
+                        break
+            out.append(row[:cut])
+        return out
+
     async def _generate(self, req: Request) -> Response:
         try:
             body = json.loads(req.body.decode() or "{}")
@@ -383,6 +429,7 @@ class InferenceServer:
 
         generated = await self._dispatch_generate(tokens, prompt_len, p)
         generated = self._trim(generated, p["max_new_requested"], p["eos_id"])
+        generated = self._trim_stops(generated, p["stop"])
         return Response(
             200,
             json.dumps({"tokens": generated}).encode(),
@@ -394,7 +441,9 @@ class InferenceServer:
         the prompt, run the exact same decode dispatch as
         /v1/generate, decode the generated ids back to text. eos
         defaults to the tokenizer's EOS so generation stops naturally;
-        pass "eos_id": -1 to disable."""
+        pass "eos_id": -1 to disable. "stop" takes STRINGS here (a
+        single string or a list); they are byte-encoded and applied
+        as token-level stop sequences, excluded from the output."""
         try:
             body = json.loads(req.body.decode() or "{}")
             prompt = body.get("prompt")
@@ -406,6 +455,29 @@ class InferenceServer:
                     f"prompt encodes to {len(row)} ids; max_len is "
                     f"{self.max_len}"
                 )
+            stop_raw = body.pop("stop", None)
+            if isinstance(stop_raw, str):
+                stop_raw = [stop_raw]
+            if stop_raw is not None:
+                # string-flavored validation BEFORE encoding, so the
+                # 422 speaks this endpoint's language (the id-level
+                # bounds in _parse_stops would otherwise leak through)
+                if (
+                    not isinstance(stop_raw, list)
+                    or len(stop_raw) > 8
+                    or not all(
+                        isinstance(s, str) and 1 <= len(s.encode()) <= 32
+                        for s in stop_raw
+                    )
+                ):
+                    raise ValueError(
+                        "'stop' must be a non-empty string (or a list "
+                        "of at most 8), each at most 32 UTF-8 bytes"
+                    )
+                body["stop"] = [
+                    self.tokenizer.encode(s, bos=False)
+                    for s in stop_raw
+                ]
             p = self._parse_sampling(
                 body, [row], len(row), default_eos=self.tokenizer.EOS
             )
@@ -414,6 +486,7 @@ class InferenceServer:
 
         generated = await self._dispatch_generate([row], len(row), p)
         generated = self._trim(generated, p["max_new_requested"], p["eos_id"])
+        generated = self._trim_stops(generated, p["stop"])
         return Response(
             200,
             json.dumps(

@@ -2898,3 +2898,155 @@ def test_chunked_loss_matches_with_moe_aux():
     chunked = dataclasses.replace(base, loss_chunk=4)
     got = float(jax.jit(lambda p: loss_fn(p, tokens, chunked))(params))
     np.testing.assert_allclose(got, whole, rtol=1e-6)
+
+
+def test_generate_stop_sequences(run):
+    """'stop' trims at the earliest stop-sequence occurrence,
+    excluding the stop itself; invalid specs 422."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=512, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server = InferenceServer(
+        cfg, params, "127.0.0.1", 0, max_len=64, text=True
+    )
+
+    def fetch(path, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            return exc.code, exc.read().decode()
+
+    async def scenario():
+        import asyncio
+
+        await server.run()
+        loop = asyncio.get_event_loop()
+
+        def go():
+            # free-run greedy to learn the deterministic continuation
+            _s, free = fetch(
+                "/v1/generate",
+                {"tokens": [[1, 2, 3]], "max_new_tokens": 8},
+            )
+            row = free["tokens"][0]
+            # stop at the first token whose value hasn't occurred
+            # before it: output = everything before that position
+            k = next(
+                (i for i in range(1, len(row))
+                 if row[i] not in row[:i]),
+                None,  # all-repeats continuation: nothing to stop on
+            )
+            if k is None:
+                return row, None, (200, {"tokens": [row]}), \
+                    (200, {"tokens": [row]}), 422, 422
+            s1, stopped = fetch(
+                "/v1/generate",
+                {"tokens": [[1, 2, 3]], "max_new_tokens": 8,
+                 "stop": [[row[k]]]},
+            )
+            # a stop that never occurs changes nothing
+            s2, untouched = fetch(
+                "/v1/generate",
+                {"tokens": [[1, 2, 3]], "max_new_tokens": 8,
+                 "stop": [[cfg.vocab_size - 1, cfg.vocab_size - 2]]},
+            )
+            s3, bad = fetch(
+                "/v1/generate",
+                {"tokens": [[1, 2, 3]], "max_new_tokens": 4,
+                 "stop": [[]]},
+            )
+            s4, bad_type = fetch(
+                "/v1/generate",
+                {"tokens": [[1, 2, 3]], "max_new_tokens": 4,
+                 "stop": "nope"},
+            )
+            return row, k, (s1, stopped), (s2, untouched), s3, s4
+
+        out = await loop.run_in_executor(None, go)
+        await server.stop()
+        return out
+
+    row, k, (s1, stopped), (s2, untouched), s3, s4 = run(scenario())
+    if k is None:
+        pytest.skip("greedy continuation has no first-unique token")
+    assert s1 == 200 and stopped["tokens"][0] == row[:k]
+    assert s2 == 200 and untouched["tokens"][0] == row
+    assert s3 == 422 and s4 == 422
+
+
+def test_completions_stop_strings(run):
+    """The text surface takes stop STRINGS and excludes them."""
+    import json
+    import urllib.request
+
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload.serve import InferenceServer
+    from containerpilot_tpu.workload.text import ByteTokenizer
+
+    cfg = TransformerConfig(
+        vocab_size=512, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server = InferenceServer(
+        cfg, params, "127.0.0.1", 0, max_len=64, text=True
+    )
+    tok = ByteTokenizer(cfg.vocab_size)
+
+    def fetch(body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read().decode())
+
+    async def scenario():
+        import asyncio
+
+        await server.run()
+        loop = asyncio.get_event_loop()
+
+        def go():
+            free = fetch({"prompt": "ab", "max_new_tokens": 6})
+            # stop at the text of the 2nd+3rd generated bytes
+            stop_text = tok.decode(free["tokens"][1:3])
+            # only meaningful when the text round-trips to exactly
+            # those ids (specials/out-of-range bytes are dropped by
+            # decode and would test a DIFFERENT stop sequence)
+            if (
+                not stop_text
+                or tok.encode(stop_text, bos=False)
+                != free["tokens"][1:3]
+            ):
+                return free, None, None
+            stopped = fetch(
+                {"prompt": "ab", "max_new_tokens": 6,
+                 "stop": stop_text}
+            )
+            return free, stop_text, stopped
+
+        out = await loop.run_in_executor(None, go)
+        await server.stop()
+        return out
+
+    free, stop_text, stopped = run(scenario())
+    if stop_text is not None:
+        assert stopped["tokens"] == free["tokens"][:1]
+        assert stop_text not in stopped["text"]
