@@ -32,7 +32,7 @@ def engine(params):
     eng.stop()
 
 
-def _solo(params, tokens, max_new, **kw):
+def _solo(params, tokens, max_new, cfg=CFG, **kw):
     """Reference: solo generate with the SERVER's key convention (row
     i of a request samples from fold_in(PRNGKey(seed), i) — the same
     derivation the batcher/prefix/strategies paths use, so seeded
@@ -41,7 +41,7 @@ def _solo(params, tokens, max_new, **kw):
     seed = kw.pop("seed", 0)
     eos = kw.pop("eos_id", -1)
     out = generate(
-        params, jnp.asarray([tokens], jnp.int32), CFG, max_new,
+        params, jnp.asarray([tokens], jnp.int32), cfg, max_new,
         MAX_LEN,
         rng=jnp.stack([jax.random.fold_in(jax.random.PRNGKey(seed), 0)]),
         eos_id=eos, **kw,
@@ -227,3 +227,32 @@ def test_slots_reject_prefix_cache(params):
             CFG, params, "127.0.0.1", 0, max_len=MAX_LEN, slots=2,
             prefix_cache_entries=2,
         )
+
+
+def test_slot_engine_composes_with_tensor_parallel():
+    """The slot pool rides TP-sharded params: the vmapped decode and
+    the insert/chunk programs partition under GSPMD, and output stays
+    byte-identical to the single-device solo run."""
+    import dataclasses
+
+    from containerpilot_tpu.parallel import (
+        MeshPlan,
+        make_mesh,
+        shard_params,
+    )
+
+    cfg = dataclasses.replace(CFG, d_model=64, n_heads=8, d_ff=128)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh(jax.devices()[:8], plan=MeshPlan(data=1, model=8))
+    sharded = shard_params(params, mesh, cfg)
+
+    eng = SlotEngine(cfg, sharded, MAX_LEN, slots=2, chunk=3)
+    try:
+        a = eng.submit([1, 2, 3], max_new=6, temperature=0.8, seed=4)
+        b = eng.submit([5, 6], max_new=4)
+        assert a.result(timeout=180) == _solo(
+            params, [1, 2, 3], 6, cfg=cfg, temperature=0.8, seed=4
+        )
+        assert b.result(timeout=180) == _solo(params, [5, 6], 4, cfg=cfg)
+    finally:
+        eng.stop()
