@@ -414,6 +414,51 @@ def decode_bench(cfg=None, max_new: int = 64, prompt_len: int = 128) -> dict:
     out["batch_throughput_x"] = round(
         out["b8_tok_s"] / out["b1_tok_s"], 2
     )
+
+    # slot-engine admission latency: a SHORT request arriving while a
+    # LONG one decodes. Sequentially it waits for the whole long
+    # generation; through the slot pool it joins at the next chunk
+    # boundary. Reported: the short request's completion latency both
+    # ways (the admission win is the ratio).
+    from containerpilot_tpu.workload.serve_slots import SlotEngine
+
+    short_new, long_new = 16, max_new * 2
+    slot_max_len = prompt_len + long_new
+    engine = SlotEngine(
+        cfg, params, slot_max_len, slots=2, chunk=8
+    )
+    try:
+        # warm both prompt-length prefills and the chunk program
+        engine.submit([1] * prompt_len, max_new=2).result(timeout=600)
+        engine.submit([1] * 8, max_new=2).result(timeout=600)
+        t0 = time.perf_counter()
+        long_fut = engine.submit([1] * prompt_len, max_new=long_new)
+        short_fut = engine.submit([2] * 8, max_new=short_new)
+        short_fut.result(timeout=600)
+        slot_short_ms = (time.perf_counter() - t0) * 1e3
+        long_fut.result(timeout=600)
+    finally:
+        engine.stop()
+    # sequential reference: the short request queued behind the long
+    # generation pays the whole long run first. generate compiles one
+    # program per max_new, so warm with the EXACT max_new values the
+    # timed region runs — warming with any other value would leave
+    # two compilations inside the timer and fabricate the speedup.
+    long_prompt = jnp.ones((1, prompt_len), jnp.int32)
+    short_prompt = jnp.full((1, 8), 2, jnp.int32)
+    _sync(generate(params, long_prompt, cfg, long_new, slot_max_len))
+    _sync(generate(params, short_prompt, cfg, short_new, slot_max_len))
+    t0 = time.perf_counter()
+    _sync(generate(params, long_prompt, cfg, long_new, slot_max_len))
+    _sync(generate(params, short_prompt, cfg, short_new, slot_max_len))
+    seq_short_ms = (time.perf_counter() - t0) * 1e3
+    out["slot_admission"] = {
+        "short_latency_ms_sequential": round(seq_short_ms, 1),
+        "short_latency_ms_slots": round(slot_short_ms, 1),
+        "admission_speedup_x": round(
+            seq_short_ms / max(slot_short_ms, 1e-3), 2
+        ),
+    }
     return out
 
 
