@@ -1,0 +1,430 @@
+"""Multi-host serving: one HTTP frontend, a decode spanning the pod.
+
+Models too large for one host's devices serve across hosts the same
+way they train: every process joins the pod through the supervisor's
+catalog (``parallel.distributed.initialize_from_catalog`` — the exact
+rendezvous the training capstone uses), params shard over a GLOBAL
+mesh with the training partition rules, and XLA's collectives carry
+the decode over ICI within a host and DCN between hosts.
+
+Process 0 is the frontend: it serves ``/health`` and
+``POST /v1/generate`` (token-level, same request shape as the
+single-host server's core knobs) and turns each request into a
+fixed-shape operand bundle broadcast to the pod
+(``multihost_utils.broadcast_one_to_all``). Every process — frontend
+included — then runs the SAME jitted ``generate`` on the same
+operands in the same order, which is all SPMD needs; process 0
+fetches the replicated result and responds. Followers run the
+broadcast-follow loop with no HTTP surface (their supervisor job
+health-checks process liveness, e.g. ``kill -0
+$CONTAINERPILOT_<JOB>_PID``).
+
+Shutdown: SIGTERM on process 0 broadcasts a shutdown op so followers
+exit cleanly; a follower dying mid-request wedges the pod's
+collectives, which the supervisor handles the same way it does for
+training (restart budgets; the frontend exits on the failed
+collective).
+
+    python -m containerpilot_tpu.workload.serve_dist \
+        --process-id 0 --num-processes 2 --catalog 127.0.0.1:8500 \
+        --port 8000 --d-model 1024 ...
+
+Request sampling reproduces the single-host server's key convention
+(fold_in(PRNGKey(seed), 0)), so answers are byte-identical to a
+single-host server of the same config (tested with two real OS
+processes on the CPU backend).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import queue
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger("containerpilot.serve_dist")
+
+OP_SHUTDOWN = 0
+OP_GENERATE = 1
+
+
+def _payload_zeros(max_len: int) -> Dict[str, np.ndarray]:
+    return {
+        "op": np.zeros((), np.int32),
+        "prompt": np.zeros((max_len,), np.int32),
+        "plen": np.zeros((), np.int32),
+        "max_new": np.zeros((), np.int32),
+        "temperature": np.zeros((), np.float32),
+        "top_k": np.zeros((), np.int32),
+        "top_p": np.zeros((), np.float32),
+        "eos_id": np.full((), -1, np.int32),
+        "seed": np.zeros((), np.int32),
+    }
+
+
+def _payload_for(req: Dict[str, Any], max_len: int) -> Dict[str, np.ndarray]:
+    p = _payload_zeros(max_len)
+    tokens = req["tokens"]
+    p["op"] = np.asarray(OP_GENERATE, np.int32)
+    p["prompt"][: len(tokens)] = np.asarray(tokens, np.int32)
+    p["plen"] = np.asarray(len(tokens), np.int32)
+    # bucket the compiled decode length to multiples of 16 (the
+    # single-host server's convention) — per-request max_new variation
+    # must not recompile generate on EVERY host in the pod; the
+    # frontend trims the response to the requested length
+    bucketed = min(-(-req["max_new"] // 16) * 16, max_len - len(tokens))
+    p["max_new"] = np.asarray(bucketed, np.int32)
+    p["temperature"] = np.asarray(req.get("temperature", 0.0), np.float32)
+    p["top_k"] = np.asarray(req.get("top_k", 0), np.int32)
+    p["top_p"] = np.asarray(req.get("top_p", 0.0), np.float32)
+    p["eos_id"] = np.asarray(req.get("eos_id", -1), np.int32)
+    p["seed"] = np.asarray(req.get("seed", 0), np.int32)
+    return p
+
+
+def shard_params_global(params: Any, mesh, cfg) -> Any:
+    """Place identically-initialized host params onto a multi-host
+    mesh: each process contributes exactly the shards it addresses
+    (``make_array_from_callback`` slices the host copy), so no data
+    moves over DCN at load time."""
+    from jax.sharding import NamedSharding
+
+    from ..parallel.sharding import param_sharding_rules
+
+    rules = param_sharding_rules(cfg, mesh)
+
+    def put(leaf, spec):
+        host = np.asarray(leaf)
+        return jax.make_array_from_callback(
+            host.shape, NamedSharding(mesh, spec),
+            lambda idx: host[idx],
+        )
+
+    return jax.tree_util.tree_map(put, params, rules)
+
+
+def _decode_pod(params, cfg, payload, max_len: int):
+    """The SPMD part every process runs identically: one generate call
+    shaped purely by broadcast scalars (so every host traces and
+    executes the same program in the same order)."""
+    from ..models.decode import generate
+
+    plen = int(payload["plen"])
+    max_new = int(payload["max_new"])
+    prompt = jnp.asarray(payload["prompt"][None, :plen], jnp.int32)
+    row_key = jax.random.fold_in(
+        jax.random.PRNGKey(int(payload["seed"])), 0
+    )
+    return generate(
+        params, prompt, cfg, max_new_tokens=max_new, max_len=max_len,
+        temperature=float(payload["temperature"]),
+        rng=jnp.stack([row_key]),
+        top_k=int(payload["top_k"]),
+        top_p=float(payload["top_p"]),
+        eos_id=int(payload["eos_id"]),
+    )
+
+
+class _Frontend:
+    """Process 0's HTTP surface: requests land in a queue the pod
+    loop drains; the loop owns all device work."""
+
+    def __init__(self, host: str, port: int, max_len: int,
+                 vocab: int) -> None:
+        from ..utils.http import HTTPServer, Response
+
+        self.max_len = max_len
+        self.vocab = vocab
+        self.ready = False
+        self.requests: "queue.Queue[Tuple[dict, queue.Queue]]" = (
+            queue.Queue()
+        )
+        self._server = HTTPServer()
+        self._server.route("GET", "/health", self._health)
+        self._server.route("POST", "/v1/generate", self._generate)
+        self._host, self._port = host, port
+        self._Response = Response
+        self._loop = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.bound_port or self._port
+
+    async def _health(self, _req):
+        if not self.ready:
+            return self._Response(503, b"warming\n")
+        return self._Response(200, b"ok\n")
+
+    async def _generate(self, req):
+        import asyncio
+
+        try:
+            body = json.loads(req.body.decode() or "{}")
+            rows = body.get("tokens")
+            if (
+                not isinstance(rows, list) or len(rows) != 1
+                or not isinstance(rows[0], list) or not rows[0]
+            ):
+                raise ValueError(
+                    "'tokens' must be one non-empty row (the pod "
+                    "frontend serves single-row requests)"
+                )
+            tokens = rows[0]
+            if any(
+                not isinstance(t, int) or isinstance(t, bool)
+                or t < 0 or t >= self.vocab
+                for t in tokens
+            ):
+                raise ValueError(
+                    f"token ids must be integers in [0, {self.vocab})"
+                )
+            max_new = int(body.get("max_new_tokens", 16))
+            if max_new < 1:
+                raise ValueError("max_new_tokens must be >= 1")
+            if len(tokens) + max_new > self.max_len:
+                raise ValueError(
+                    f"prompt + max_new_tokens exceeds max_len "
+                    f"{self.max_len}"
+                )
+            # full knob validation HERE: a malformed value that only
+            # failed inside _decode_pod would be pod-fatal (the loop
+            # deliberately re-raises collective-path errors), and an
+            # out-of-int32 value would crash payload packing
+            top_k = int(body.get("top_k", 0))
+            top_p = float(body.get("top_p", 0.0))
+            eos_id = int(body.get("eos_id", -1))
+            seed = int(body.get("seed", 0))
+            if not 0 <= top_k <= self.vocab:
+                raise ValueError(f"top_k must be in [0, {self.vocab}]")
+            if not 0.0 <= top_p <= 1.0:
+                raise ValueError("top_p must be in [0, 1]")
+            if eos_id >= self.vocab:
+                raise ValueError(f"eos_id must be < {self.vocab}")
+            if not -(2**31) <= seed < 2**31:
+                raise ValueError("seed must fit in int32")
+            work = {
+                "tokens": tokens, "max_new": max_new,
+                "temperature": float(body.get("temperature", 0.0)),
+                "top_k": top_k,
+                "top_p": top_p,
+                "eos_id": max(eos_id, -1),
+                "seed": seed,
+            }
+        except (ValueError, KeyError, TypeError, OverflowError) as exc:
+            return self._Response(422, f"{exc}\n".encode())
+        done: "queue.Queue" = queue.Queue()
+        self.requests.put((work, done))
+        result = await asyncio.get_event_loop().run_in_executor(
+            None, done.get
+        )
+        if isinstance(result, Exception):
+            return self._Response(500, f"{result}\n".encode())
+        return self._Response(
+            200, json.dumps({"tokens": [result]}).encode(),
+            content_type="application/json",
+        )
+
+    def start(self) -> None:
+        import asyncio
+
+        started = threading.Event()
+
+        def run() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            loop.run_until_complete(
+                self._server.start_tcp(self._host, self._port)
+            )
+            started.set()
+            loop.run_forever()
+
+        self._thread = threading.Thread(
+            target=run, name="serve-dist-http", daemon=True
+        )
+        self._thread.start()
+        if not started.wait(timeout=30):
+            raise RuntimeError("frontend never bound")
+
+    def stop(self) -> None:
+        import asyncio
+
+        if self._loop is not None:
+            async def shutdown() -> None:
+                await self._server.stop()
+                asyncio.get_event_loop().stop()
+
+            self._loop.call_soon_threadsafe(
+                lambda: asyncio.ensure_future(shutdown())
+            )
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+def main() -> int:
+    from jax.experimental import multihost_utils
+
+    from ..discovery.consul import ConsulBackend
+    from ..models.transformer import TransformerConfig, init_params
+    from ..parallel import MeshPlan, initialize_from_catalog, make_mesh
+    from .modelcfg import derive_d_ff
+
+    parser = argparse.ArgumentParser(
+        description="multi-host pod inference server"
+    )
+    parser.add_argument("--process-id", type=int, required=True)
+    parser.add_argument("--num-processes", type=int, required=True)
+    parser.add_argument("--catalog", required=True)
+    parser.add_argument("--coordinator-port", type=int, default=0)
+    parser.add_argument("--advertise-address", default="")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--max-len", type=int, default=512)
+    parser.add_argument("--d-model", type=int, default=256)
+    parser.add_argument("--n-layers", type=int, default=2)
+    parser.add_argument("--n-heads", type=int, default=4)
+    parser.add_argument("--n-kv-heads", type=int, default=0)
+    parser.add_argument("--vocab", type=int, default=1024)
+    parser.add_argument("--checkpoint-dir", default="",
+                        help="shared-storage checkpoint the WHOLE pod "
+                        "restores in lockstep (orbax is a global "
+                        "checkpointer)")
+    parser.add_argument("--use-ema", action="store_true")
+    args = parser.parse_args()
+
+    kw = {}
+    if args.coordinator_port:
+        kw["coordinator_port"] = args.coordinator_port
+    initialize_from_catalog(
+        ConsulBackend(address=args.catalog),
+        args.process_id,
+        args.num_processes,
+        advertise_address=args.advertise_address,
+        **kw,
+    )
+    cfg = TransformerConfig(
+        vocab_size=args.vocab,
+        d_model=args.d_model,
+        n_heads=args.n_heads,
+        n_kv_heads=args.n_kv_heads,
+        n_layers=args.n_layers,
+        d_ff=derive_d_ff(args.d_model),
+        max_seq_len=args.max_len,
+    )
+    n_global = jax.device_count()
+    if cfg.n_heads % n_global:
+        raise SystemExit(
+            f"{n_global} global devices must divide n_heads "
+            f"{cfg.n_heads}"
+        )
+    mesh = make_mesh(jax.devices(), plan=MeshPlan(data=1, model=n_global))
+    if args.checkpoint_dir:
+        from .modelcfg import restore_params_only
+
+        restored = restore_params_only(
+            cfg, mesh, args.checkpoint_dir, use_ema=args.use_ema
+        )
+        if restored is None:
+            raise SystemExit(f"no checkpoint in {args.checkpoint_dir}")
+        params, step = restored
+        if args.process_id == 0:
+            print(f"pod serving checkpoint step {step}", flush=True)
+    else:
+        host_params = jax.tree.map(
+            np.asarray, init_params(jax.random.PRNGKey(0), cfg)
+        )
+        params = shard_params_global(host_params, mesh, cfg)
+
+    frontend = None
+    if args.process_id == 0:
+        frontend = _Frontend(
+            args.host, args.port, args.max_len, cfg.vocab_size
+        )
+        frontend.start()
+        print(f"pod frontend on {args.host}:{frontend.port} "
+              f"({n_global} global devices, model={n_global})",
+              flush=True)
+
+    # warmup in lockstep before /health goes 200: same dummy payload
+    # everywhere, so the pod's first live request doesn't compile
+    warm = _payload_for(
+        {"tokens": [0, 0, 0, 0], "max_new": 8}, args.max_len
+    )
+    np.asarray(_decode_pod(params, cfg, warm, args.max_len))
+    if frontend is not None:
+        frontend.ready = True
+        print("pod warm; accepting traffic", flush=True)
+
+    # graceful pod shutdown: TERM on the FRONTEND broadcasts
+    # OP_SHUTDOWN so followers exit cleanly. Followers keep the
+    # default TERM disposition — a follower can't exit mid-collective
+    # anyway, so its supervisor's TERM-then-KILL handles it.
+    stopping = threading.Event()
+    if frontend is not None:
+        import signal as signal_mod
+
+        signal_mod.signal(
+            signal_mod.SIGTERM, lambda s, f: stopping.set()
+        )
+
+    from .serve import InferenceServer
+
+    while True:
+        work = done_q = None
+        if frontend is not None:
+            while work is None and not stopping.is_set():
+                try:
+                    work, done_q = frontend.requests.get(timeout=0.25)
+                except queue.Empty:
+                    continue
+            payload = (
+                _payload_zeros(args.max_len) if stopping.is_set()
+                else _payload_for(work, args.max_len)
+            )
+        else:
+            payload = _payload_zeros(args.max_len)
+        payload = multihost_utils.broadcast_one_to_all(payload)
+        if int(payload["op"]) == OP_SHUTDOWN:
+            # SIGTERM may have raced an in-flight dequeue (and more
+            # requests may still be queued): every waiting handler
+            # must get an answer or its executor thread blocks
+            # forever and the interpreter can't exit
+            if frontend is not None:
+                leftovers = [done_q] if done_q is not None else []
+                while True:
+                    try:
+                        _w, dq = frontend.requests.get_nowait()
+                        leftovers.append(dq)
+                    except queue.Empty:
+                        break
+                for dq in leftovers:
+                    dq.put(RuntimeError("pod is shutting down"))
+            break
+        try:
+            out = _decode_pod(params, cfg, payload, args.max_len)
+            if done_q is not None:
+                # one trim convention pod-wide: the single-host
+                # server's (slice to the REQUESTED length, then cut
+                # at eos inclusive)
+                row = [int(t) for t in np.asarray(out)[0]]
+                done_q.put(InferenceServer._trim(
+                    [row], work["max_new"], int(payload["eos_id"])
+                )[0])
+        except Exception as exc:  # noqa: BLE001 — pod-fatal
+            if done_q is not None:
+                done_q.put(exc)
+            raise
+    if frontend is not None:
+        frontend.stop()
+        print("pod frontend stopped", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

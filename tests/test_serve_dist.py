@@ -1,0 +1,171 @@
+"""Multi-host pod serving (workload/serve_dist.py): two real OS
+processes rendezvous through a live catalog server, shard the model
+over a 2-process global mesh, and answer HTTP byte-identically to a
+single-host server of the same config."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MODEL_FLAGS = [
+    "--max-len", "48", "--d-model", "64", "--n-layers", "1",
+    "--n-heads", "2", "--vocab", "128",
+]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _sub_env() -> dict:
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # exactly 1 CPU device per process
+    return env
+
+
+def _reference(tokens, max_new, **kw):
+    """Single-device generate with the server key convention."""
+    from containerpilot_tpu.models.decode import generate
+    from containerpilot_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=2, n_layers=1,
+        d_ff=64 * 3 // 128 * 128 or 128, max_seq_len=48,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    seed = kw.pop("seed", 0)
+    eos = kw.pop("eos_id", -1)
+    out = generate(
+        params, jnp.asarray([tokens], jnp.int32), cfg, max_new, 48,
+        rng=jnp.stack(
+            [jax.random.fold_in(jax.random.PRNGKey(seed), 0)]
+        ),
+        eos_id=eos, **kw,
+    )
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    row = [int(t) for t in np.asarray(out)[0]]
+    return InferenceServer._trim([row], max_new, eos)[0]
+
+
+def test_two_process_pod_serves_http(tmp_path):
+    catalog_port, coord_port, http_port = (
+        _free_port(), _free_port(), _free_port()
+    )
+    env = _sub_env()
+    catalog = subprocess.Popen(
+        [sys.executable, "-m", "containerpilot_tpu",
+         "-catalog-server", f"127.0.0.1:{catalog_port}"],
+        cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    procs = []
+    logs = []
+    try:
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{catalog_port}/v1/health/service/x",
+                    timeout=1,
+                )
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    pytest.fail("catalog never became ready")
+                time.sleep(0.2)
+        # the image's sitecustomize pins jax to the tunneled TPU in
+        # every interpreter; the pod processes must pin CPU first
+        wrapper = tmp_path / "serve_dist_cpu.py"
+        wrapper.write_text(
+            "import sys\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "from containerpilot_tpu.workload.serve_dist import main\n"
+            "sys.exit(main())\n"
+        )
+        for pid in (0, 1):
+            fh = open(tmp_path / f"pod{pid}.log", "w")
+            logs.append(fh)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-u", str(wrapper),
+                 "--process-id", str(pid), "--num-processes", "2",
+                 "--catalog", f"127.0.0.1:{catalog_port}",
+                 "--coordinator-port", str(coord_port),
+                 "--advertise-address", "127.0.0.1",
+                 "--host", "127.0.0.1", "--port", str(http_port)]
+                + MODEL_FLAGS,
+                cwd=REPO, env=env, stdout=fh, stderr=subprocess.STDOUT,
+            ))
+
+        base = f"http://127.0.0.1:{http_port}"
+        deadline = time.monotonic() + 240
+        while True:
+            try:
+                urllib.request.urlopen(f"{base}/health", timeout=2)
+                break
+            except Exception:
+                for i, proc in enumerate(procs):
+                    assert proc.poll() is None, (
+                        tmp_path / f"pod{i}.log"
+                    ).read_text()[-3000:]
+                if time.monotonic() > deadline:
+                    pytest.fail(
+                        "pod never became healthy:\n" + "\n".join(
+                            (tmp_path / f"pod{i}.log").read_text()[-2000:]
+                            for i in (0, 1)
+                        )
+                    )
+                time.sleep(0.5)
+
+        def post(body):
+            req = urllib.request.Request(
+                f"{base}/v1/generate",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=240) as resp:
+                return json.loads(resp.read().decode())
+
+        greedy = post({"tokens": [[1, 2, 3]], "max_new_tokens": 6})
+        assert greedy["tokens"][0] == _reference([1, 2, 3], 6)
+
+        sampled = post({
+            "tokens": [[5, 6]], "max_new_tokens": 5,
+            "temperature": 0.8, "top_k": 20, "seed": 9,
+        })
+        assert sampled["tokens"][0] == _reference(
+            [5, 6], 5, temperature=0.8, top_k=20, seed=9
+        )
+
+        # graceful pod shutdown: TERM on the frontend broadcasts the
+        # stop; BOTH processes exit 0
+        procs[0].send_signal(15)
+        for i, proc in enumerate(procs):
+            assert proc.wait(timeout=60) == 0, (
+                tmp_path / f"pod{i}.log"
+            ).read_text()[-3000:]
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        catalog.terminate()
+        catalog.wait(timeout=10)
+        for fh in logs:
+            fh.close()
