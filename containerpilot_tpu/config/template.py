@@ -92,6 +92,38 @@ def _fn_loop(*params: Any) -> List[int]:
     return list(range(start, stop, step))
 
 
+def _check_comparable(a: Any, b: Any) -> None:
+    """Go's eq/ne raise on incompatible types; env values are always
+    strings and number literals are int/float, so a silent False on
+    `eq .COUNT 2` would take the wrong branch with no diagnostic."""
+    str_vs_num = isinstance(a, str) != isinstance(b, str) and (
+        isinstance(a, (str, int, float))
+        and isinstance(b, (str, int, float))
+        and not isinstance(a, bool) and not isinstance(b, bool)
+    )
+    if str_vs_num:
+        raise TemplateError(
+            f"incompatible types for comparison: {a!r} vs {b!r} "
+            "(env values are strings; quote the literal)"
+        )
+
+
+def _fn_eq(first: Any, *rest: Any) -> bool:
+    """Go text/template's builtin ``eq``: true when arg1 equals ANY of
+    the remaining args (reference configs use it inside if blocks)."""
+    if not rest:
+        raise TemplateError("eq needs at least two arguments")
+    for other in rest:
+        _check_comparable(first, other)
+    return any(first == other for other in rest)
+
+
+def _fn_ne(a: Any, b: Any) -> bool:
+    """Go text/template's builtin ``ne``."""
+    _check_comparable(a, b)
+    return a != b
+
+
 FUNCS: Dict[str, Callable[..., Any]] = {
     "default": _fn_default,
     "env": _fn_env,
@@ -100,6 +132,8 @@ FUNCS: Dict[str, Callable[..., Any]] = {
     "replaceAll": _fn_replace_all,
     "regexReplaceAll": _fn_regex_replace_all,
     "loop": _fn_loop,
+    "eq": _fn_eq,
+    "ne": _fn_ne,
 }
 
 

@@ -89,3 +89,29 @@ def test_unknown_function_raises():
 def test_unclosed_block_raises():
     with pytest.raises(TemplateError):
         render("{{ if .NAME }}never closed")
+
+
+def test_eq_ne_builtins():
+    """Go text/template's eq/ne builtins (variadic eq: true when the
+    first arg equals ANY other), usable inside if blocks — what the
+    multihost example uses to pick frontend vs follower health."""
+    assert render(
+        '{{ if eq (.ROLE | default "0") "0" }}front{{ else }}'
+        "follow{{ end }}", {"ROLE": ""}
+    ) == "front"
+    assert render(
+        '{{ if eq .ROLE "0" "1" }}low{{ else }}high{{ end }}',
+        {"ROLE": "3"},
+    ) == "high"
+    assert render(
+        '{{ if ne .ROLE "0" }}yes{{ end }}', {"ROLE": "3"}
+    ) == "yes"
+    with pytest.raises(TemplateError):
+        render("{{ eq .ROLE }}", {"ROLE": "x"})
+
+
+def test_eq_cross_type_raises():
+    """Go's eq errors on incompatible types; env values are strings,
+    so `eq .COUNT 2` must fail loudly, not silently pick a branch."""
+    with pytest.raises(TemplateError, match="incompatible"):
+        render("{{ if eq .COUNT 3 }}x{{ end }}")
