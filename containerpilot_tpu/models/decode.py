@@ -452,6 +452,26 @@ def sample_logits(
     return jnp.where(t[:, 0] <= 0.0, jnp.argmax(raw, axis=-1), sampled)
 
 
+def mask_eos_before_min(
+    logits: jax.Array, step_idx, min_new, eos_id
+) -> jax.Array:
+    """NEG_INF the eos logit for rows still under their min_new
+    floor — sample i honors `min_new_tokens` by construction on every
+    decode path (sampled AND greedy draw from the same masked logits).
+    eos_id < 0 (disabled) indexes nothing thanks to the suppress
+    gate."""
+    b, vocab = logits.shape
+    eos_row = jnp.broadcast_to(jnp.asarray(eos_id, jnp.int32), (b,))
+    min_row = jnp.broadcast_to(jnp.asarray(min_new, jnp.int32), (b,))
+    suppress = (step_idx < min_row) & (eos_row >= 0)
+    eos_onehot = (
+        jnp.arange(vocab)[None, :] == jnp.clip(eos_row, 0)[:, None]
+    )
+    return jnp.where(
+        suppress[:, None] & eos_onehot, NEG_INF, logits
+    )
+
+
 def _sampling_scan(cfg, max_new_tokens: int, greedy: bool,
                    filtered: bool):
     """The shared decode loop: from (cache, next-token logits) sample
@@ -459,8 +479,11 @@ def _sampling_scan(cfg, max_new_tokens: int, greedy: bool,
     generate program and the prefix-cache extend path."""
 
     def scan(params, cache, logits, row_keys, temperature, top_k,
-             top_p, eos_id, pad_id):
+             top_p, eos_id, pad_id, min_new):
         def sample(logits, step_idx):
+            logits = mask_eos_before_min(
+                logits, step_idx, min_new, eos_id
+            )
             if greedy:
                 return jnp.argmax(logits, axis=-1)
             keys = jax.vmap(
@@ -509,10 +532,10 @@ def _jitted_generate(cfg: TransformerConfig, max_new_tokens: int,
     scan = _sampling_scan(cfg, max_new_tokens, greedy, filtered)
 
     def fn(params, prompt, row_keys, temperature, top_k, top_p, eos_id,
-           pad_id):
+           pad_id, min_new):
         logits, cache = prefill(params, prompt, cfg, max_len)
         return scan(params, cache, logits, row_keys, temperature,
-                    top_k, top_p, eos_id, pad_id)
+                    top_k, top_p, eos_id, pad_id, min_new)
 
     return jax.jit(fn)
 
@@ -557,6 +580,7 @@ def generate(
     top_p=0.0,
     eos_id=-1,
     pad_id=0,
+    min_new_tokens=0,
 ) -> jax.Array:
     """Autoregressive generation. prompt: [batch, prompt_len] int32;
     returns [batch, max_new_tokens] int32.
@@ -566,14 +590,15 @@ def generate(
     settings). ``top_k``/``top_p`` filter the sampling distribution
     (0 disables either; both compose). A row with temperature <= 0
     decodes greedily. ``eos_id >= 0`` enables early stop: once a row
-    samples eos, the rest of that row is ``pad_id``. ``rng`` is one
-    key (split per row internally) or [batch] stacked per-row keys —
-    per-row keys keep each row's output independent of co-batched
-    rows.
+    samples eos, the rest of that row is ``pad_id``;
+    ``min_new_tokens`` suppresses eos for a row's first N samples so
+    short answers can be floored. ``rng`` is one key (split per row
+    internally) or [batch] stacked per-row keys — per-row keys keep
+    each row's output independent of co-batched rows.
     """
     operands = _normalize_sampling(
         cfg, prompt.shape[0], max_new_tokens, temperature, rng, top_k,
-        top_p, eos_id, pad_id,
+        top_p, eos_id, pad_id, min_new_tokens,
     )
     if prompt.shape[1] + max_new_tokens > max_len:
         # an overflowing decode would silently clamp cache writes onto
@@ -588,7 +613,8 @@ def generate(
 
 
 def _normalize_sampling(cfg, b, max_new_tokens, temperature, rng,
-                        top_k, top_p, eos_id, pad_id):
+                        top_k, top_p, eos_id, pad_id,
+                        min_new_tokens=0):
     """Validate/broadcast the per-row sampling knobs exactly as
     ``generate`` documents; returns (greedy, filtered, operand arrays
     in _sampling_scan order after the cache/logits)."""
@@ -629,6 +655,12 @@ def _normalize_sampling(cfg, b, max_new_tokens, temperature, rng,
     row_keys = rng if rng.ndim > 1 else jax.random.split(rng, b)
     if row_keys.shape[0] != b:
         raise ValueError(f"rng must be one key or {b} stacked keys")
+    min_arr = row(min_new_tokens, np.int64, "min_new_tokens")
+    if (min_arr < 0).any() or (min_arr > max_new_tokens).any():
+        raise ValueError(
+            f"min_new_tokens must be in [0, max_new_tokens "
+            f"{max_new_tokens}]"
+        )
     greedy = bool((t <= 0.0).all())
     if greedy:
         # dead under argmax; normalize so the compile key can't churn
@@ -643,6 +675,7 @@ def _normalize_sampling(cfg, b, max_new_tokens, temperature, rng,
         jnp.asarray(p_arr, jnp.float32),
         jnp.asarray(np.maximum(eos_arr, -1), jnp.int32),
         jnp.asarray(pad_arr, jnp.int32),
+        jnp.asarray(min_arr, jnp.int32),
     )
 
 
@@ -659,6 +692,7 @@ def generate_from_cache(
     eos_id=-1,
     pad_id=0,
     pos: int = None,
+    min_new_tokens=0,
 ) -> jax.Array:
     """``generate`` starting from an existing (cache, next-token
     logits) pair — the prefix-cache serving path: the caller restored
@@ -691,7 +725,7 @@ def generate_from_cache(
             )
     greedy, filtered, op_arrays = _normalize_sampling(
         cfg, logits.shape[0], max_new_tokens, temperature, rng, top_k,
-        top_p, eos_id, pad_id,
+        top_p, eos_id, pad_id, min_new_tokens,
     )
     fn = _jitted_decode_from_cache(cfg, max_new_tokens, greedy, filtered)
     return fn(params, cache, logits, *op_arrays)

@@ -33,7 +33,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .decode import Cache, decode_step, init_cache, sample_logits
+from .decode import (
+    Cache,
+    decode_step,
+    init_cache,
+    mask_eos_before_min,
+    sample_logits,
+)
 from .transformer import Params, TransformerConfig
 
 
@@ -95,13 +101,16 @@ def _jitted_chunk(cfg: TransformerConfig, slots: int, chunk: int):
     )
 
     def run(params, pool, last, row_keys, step_idx, temperature,
-            top_k, top_p, eos_id, pad_id, done):
+            top_k, top_p, eos_id, pad_id, min_new, done):
         def body(carry, _):
             pool, tok, done, idx = carry
             logits, pool = vstep(params, pool, tok[:, None])  # [S,1,V]
             keys = jax.vmap(jax.random.fold_in)(row_keys, idx)
+            masked = mask_eos_before_min(
+                logits[:, 0, :], idx, min_new, eos_id
+            )
             nxt = sample_logits(
-                logits[:, 0, :], keys, temperature, top_k, top_p
+                masked, keys, temperature, top_k, top_p
             ).astype(jnp.int32)
             nxt = jnp.where(done, pad_id, nxt)
             done = done | (nxt == eos_id)
@@ -126,6 +135,7 @@ def decode_slots_chunk(
     top_p: jax.Array,
     eos_id: jax.Array,
     pad_id: jax.Array,
+    min_new: jax.Array,
     done: jax.Array,
     cfg: TransformerConfig,
     chunk: int,
@@ -134,7 +144,7 @@ def decode_slots_chunk(
     slots = int(last.shape[0])
     return _jitted_chunk(cfg, slots, chunk)(
         params, pool, last, row_keys, step_idx, temperature, top_k,
-        top_p, eos_id, pad_id, done,
+        top_p, eos_id, pad_id, min_new, done,
     )
 
 
@@ -143,10 +153,14 @@ def _jitted_first_sample(cfg: TransformerConfig):
     """Sample token 0 from prefill logits with generate's key
     schedule (fold_in(row_key, 0))."""
 
-    def first(logits, row_key, temperature, top_k, top_p):
+    def first(logits, row_key, temperature, top_k, top_p, eos_id,
+              min_new):
         key = jax.random.fold_in(row_key, jnp.int32(0))
+        masked = mask_eos_before_min(
+            logits, jnp.int32(0), min_new[None], eos_id[None]
+        )
         return sample_logits(
-            logits, key[None], temperature[None], top_k[None],
+            masked, key[None], temperature[None], top_k[None],
             top_p[None],
         )[0].astype(jnp.int32)
 
@@ -154,11 +168,14 @@ def _jitted_first_sample(cfg: TransformerConfig):
 
 
 def first_sample(logits, row_key, temperature, top_k, top_p,
-                 cfg: TransformerConfig) -> jax.Array:
+                 cfg: TransformerConfig, eos_id: int = -1,
+                 min_new: int = 0) -> jax.Array:
     """logits: [1, vocab] from prefill -> token 0 (scalar)."""
     return _jitted_first_sample(cfg)(
         logits, row_key,
         jnp.asarray(temperature, jnp.float32),
         jnp.asarray(top_k, jnp.int32),
         jnp.asarray(top_p, jnp.float32),
+        jnp.asarray(eos_id, jnp.int32),
+        jnp.asarray(min_new, jnp.int32),
     )

@@ -268,6 +268,7 @@ class InferenceServer:
             "top_k": int(body.get("top_k", 0)),
             "top_p": float(body.get("top_p", 0.0)),
             "eos_id": int(body.get("eos_id", default_eos)),
+            "min_new": int(body.get("min_new_tokens", 0)),
             "beam_width": int(body.get("beam_width", 0)),
             "length_penalty": float(body.get("length_penalty", 0.0)),
             "stop": self._parse_stops(body.get("stop")),
@@ -296,6 +297,14 @@ class InferenceServer:
             )
         if p["eos_id"] >= self.cfg.vocab_size:
             raise ValueError(f"eos_id must be < vocab {self.cfg.vocab_size}")
+        if not 0 <= p["min_new"] <= max(p["max_new_requested"], 0):
+            raise ValueError(
+                "min_new_tokens must be in [0, max_new_tokens]"
+            )
+        if p["min_new"] and p["beam_width"]:
+            raise ValueError(
+                "min_new_tokens does not apply to beam search"
+            )
         if prompt_len + p["max_new_requested"] > self.max_len:
             raise ValueError(
                 f"prompt_len + max_new_tokens exceeds max_len "
@@ -327,6 +336,7 @@ class InferenceServer:
         if (
             self.draft_params is not None
             and p["temperature"] <= 0.0
+            and p["min_new"] == 0
             and len(tokens) == 1
         ):
             # greedy single-sequence: draft-and-verify, identical
@@ -344,6 +354,7 @@ class InferenceServer:
                 tokens[0], p["max_new_requested"],
                 temperature=p["temperature"], top_k=p["top_k"],
                 top_p=p["top_p"], eos_id=p["eos_id"], seed=p["seed"],
+                min_new=p["min_new"],
             )
             return [await asyncio.wrap_future(fut)]
         if (
@@ -361,7 +372,7 @@ class InferenceServer:
             return await in_exec(
                 self._executor, generate_with_prefix, self, tokens[0],
                 p["max_new"], p["temperature"], p["top_k"], p["top_p"],
-                p["eos_id"], p["seed"],
+                p["eos_id"], p["seed"], p["min_new"],
             )
         if (
             self.prefill_chunk > 0
@@ -372,11 +383,13 @@ class InferenceServer:
                 self._executor, serve_strategies.run_chunked, self,
                 tokens, prompt_len, p["max_new"], p["temperature"],
                 p["top_k"], p["top_p"], p["eos_id"], p["seed"],
+                p["min_new"],
             )
         job = GenJob(
             rows=tokens, prompt_len=prompt_len, max_new=p["max_new"],
             temperature=p["temperature"], top_k=p["top_k"],
             top_p=p["top_p"], eos_id=p["eos_id"], seed=p["seed"],
+            min_new=p["min_new"],
             future=loop.create_future(),
         )
         return await self._batcher.submit(job)

@@ -31,6 +31,7 @@ class GenJob:
     top_p: float
     eos_id: int
     seed: int
+    min_new: int = 0
     future: "asyncio.Future[List[List[int]]]" = field(repr=False, default=None)
 
 
@@ -113,6 +114,7 @@ class Batcher:
             ks: List[int] = []
             ps: List[float] = []
             eoss: List[int] = []
+            mins: List[int] = []
             keys = []
             for job in jobs:
                 base = jax.random.PRNGKey(job.seed)
@@ -122,6 +124,7 @@ class Batcher:
                     ks.append(job.top_k)
                     ps.append(job.top_p)
                     eoss.append(job.eos_id)
+                    mins.append(job.min_new)
                     keys.append(jax.random.fold_in(base, i))
             # bucket the batch dim to powers of two so concurrency
             # spikes can't compile one program per row count
@@ -135,6 +138,7 @@ class Batcher:
                 ks.append(0)
                 ps.append(0.0)
                 eoss.append(-1)
+                mins.append(0)
                 keys.append(jax.random.PRNGKey(0))
             out = generate(
                 self.params,
@@ -147,6 +151,7 @@ class Batcher:
                 top_k=ks,
                 top_p=ps,
                 eos_id=eoss,
+                min_new_tokens=mins,
             )
             n_real = len(rows) - pad_rows
             return jax.device_get(out[:n_real]).tolist()

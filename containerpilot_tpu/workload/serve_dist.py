@@ -64,6 +64,7 @@ def _payload_zeros(max_len: int) -> Dict[str, np.ndarray]:
         "top_p": np.zeros((), np.float32),
         "eos_id": np.full((), -1, np.int32),
         "seed": np.zeros((), np.int32),
+        "min_new": np.zeros((), np.int32),
     }
 
 
@@ -84,6 +85,7 @@ def _payload_for(req: Dict[str, Any], max_len: int) -> Dict[str, np.ndarray]:
     p["top_p"] = np.asarray(req.get("top_p", 0.0), np.float32)
     p["eos_id"] = np.asarray(req.get("eos_id", -1), np.int32)
     p["seed"] = np.asarray(req.get("seed", 0), np.int32)
+    p["min_new"] = np.asarray(req.get("min_new", 0), np.int32)
     return p
 
 
@@ -127,6 +129,7 @@ def _decode_pod(params, cfg, payload, max_len: int):
         top_k=int(payload["top_k"]),
         top_p=float(payload["top_p"]),
         eos_id=int(payload["eos_id"]),
+        min_new_tokens=int(payload["min_new"]),
     )
 
 
@@ -208,6 +211,11 @@ class _Frontend:
                 raise ValueError(f"eos_id must be < {self.vocab}")
             if not -(2**31) <= seed < 2**31:
                 raise ValueError("seed must fit in int32")
+            min_new = int(body.get("min_new_tokens", 0))
+            if not 0 <= min_new <= max_new:
+                raise ValueError(
+                    "min_new_tokens must be in [0, max_new_tokens]"
+                )
             work = {
                 "tokens": tokens, "max_new": max_new,
                 "temperature": float(body.get("temperature", 0.0)),
@@ -215,6 +223,7 @@ class _Frontend:
                 "top_p": top_p,
                 "eos_id": max(eos_id, -1),
                 "seed": seed,
+                "min_new": min_new,
             }
         except (ValueError, KeyError, TypeError, OverflowError) as exc:
             return self._Response(422, f"{exc}\n".encode())

@@ -71,6 +71,7 @@ class PrefixCache:
 def generate_with_prefix(
     srv: Any, row: List[int], max_new: int, temperature: float,
     top_k: int, top_p: float, eos_id: int, seed: int,
+    min_new: int = 0,
 ) -> List[List[int]]:
     """Single-row generation reusing the longest cached prompt prefix.
 
@@ -134,6 +135,6 @@ def generate_with_prefix(
         max_new_tokens=max_new, temperature=temperature,
         rng=jnp.stack([jax.random.fold_in(jax.random.PRNGKey(seed), 0)]),
         top_k=top_k, top_p=top_p, eos_id=eos_id,
-        pos=plen,
+        pos=plen, min_new_tokens=min_new,
     )
     return jax.device_get(out).tolist()

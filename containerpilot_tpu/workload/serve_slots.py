@@ -53,6 +53,7 @@ class _Request:
     eos_id: int
     pad_id: int
     seed: int
+    min_new: int = 0
     future: Future = field(default_factory=Future)
 
 
@@ -95,6 +96,7 @@ class SlotEngine:
         self._top_p = np.zeros((slots,), np.float32)
         self._eos = np.full((slots,), -1, np.int32)
         self._pad = np.zeros((slots,), np.int32)
+        self._min_new = np.zeros((slots,), np.int32)
         self._done = np.ones((slots,), bool)  # empty slots are "done"
         self._active: List[Optional[_Slot]] = [None] * slots
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
@@ -117,10 +119,13 @@ class SlotEngine:
         eos_id: int = -1,
         pad_id: int = 0,
         seed: int = 0,
+        min_new: int = 0,
     ) -> Future:
         """Queue one sequence; resolves to its generated ids."""
         if max_new < 1:
             raise ValueError("max_new must be >= 1")
+        if not 0 <= min_new <= max_new:
+            raise ValueError("min_new must be in [0, max_new]")
         if not tokens or len(tokens) >= self.max_len:
             raise ValueError(
                 f"prompt must be 1..{self.max_len - 1} tokens"
@@ -134,7 +139,7 @@ class SlotEngine:
             tokens=list(tokens), max_new=int(max_new),
             temperature=float(temperature), top_k=int(top_k),
             top_p=float(top_p), eos_id=int(eos_id), pad_id=int(pad_id),
-            seed=int(seed),
+            seed=int(seed), min_new=int(min_new),
         )
         # atomic with stop()'s drain: either this put lands before the
         # drain (and gets cancelled there) or the stopped check raises
@@ -187,7 +192,8 @@ class SlotEngine:
             jax.random.PRNGKey(req.seed), 0
         )
         first = first_sample(
-            logits, row_key, req.temperature, req.top_k, req.top_p, cfg
+            logits, row_key, req.temperature, req.top_k, req.top_p,
+            cfg, eos_id=req.eos_id, min_new=req.min_new,
         )
         first_host = int(jax.device_get(first))
         self._pool = insert_row(self._pool, row_cache, slot_id, cfg)
@@ -199,6 +205,7 @@ class SlotEngine:
         self._top_p[slot_id] = req.top_p
         self._eos[slot_id] = req.eos_id
         self._pad[slot_id] = req.pad_id
+        self._min_new[slot_id] = req.min_new
         state = _Slot(req=req, emitted=[first_host])
         if first_host == req.eos_id or req.max_new <= 1:
             state.finished = True
@@ -254,6 +261,7 @@ class SlotEngine:
                         jnp.asarray(self._top_p),
                         jnp.asarray(self._eos),
                         jnp.asarray(self._pad),
+                        jnp.asarray(self._min_new),
                         jnp.asarray(self._done),
                         self.cfg, self.chunk,
                     )

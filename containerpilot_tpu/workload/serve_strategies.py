@@ -54,6 +54,7 @@ def run_speculative(
 def run_chunked(
     srv: Any, tokens: List[List[int]], prompt_len: int, max_new: int,
     temperature: float, top_k: int, top_p: float, eos_id: int, seed: int,
+    min_new: int = 0,
 ) -> List[List[int]]:
     """Long single-row prompt: stream the prefill in chunks (peak
     prefill activations O(chunk) instead of O(prompt))."""
@@ -70,6 +71,6 @@ def run_chunked(
         max_new_tokens=max_new, temperature=temperature,
         rng=jnp.stack([jax.random.fold_in(jax.random.PRNGKey(seed), 0)]),
         top_k=top_k, top_p=top_p, eos_id=eos_id,
-        pos=prompt_len,
+        pos=prompt_len, min_new_tokens=min_new,
     )
     return jax.device_get(out).tolist()

@@ -256,3 +256,20 @@ def test_slot_engine_composes_with_tensor_parallel():
         assert b.result(timeout=180) == _solo(params, [5, 6], 4, cfg=cfg)
     finally:
         eng.stop()
+
+
+def test_min_new_matches_generate(params, engine):
+    """min_new through the slot engine equals solo generate with the
+    same floor (the mask applies at the same sample indices)."""
+    tokens = [2, 4, 6]
+    free = _solo(params, tokens, 6)
+    eos = free[1]
+    got = engine.submit(
+        tokens, max_new=6, eos_id=eos, min_new=4
+    ).result(timeout=120)
+    assert got == _solo(
+        params, tokens, 6, eos_id=eos, min_new_tokens=4
+    )
+    assert eos not in got[:4]
+    with pytest.raises(ValueError, match="min_new"):
+        engine.submit(tokens, max_new=4, min_new=5)

@@ -3054,3 +3054,117 @@ def test_completions_stop_strings(run):
     if stop_text is not None:
         assert stopped["tokens"] == free["tokens"][:1]
         assert stop_text not in stopped["text"]
+
+
+def test_min_new_tokens_suppresses_early_eos():
+    """min_new_tokens masks the eos logit for a row's first N samples
+    on the compiled path — greedy AND sampled — so answers can be
+    floored; min_new=0 leaves numerics bitwise-unchanged."""
+    from containerpilot_tpu.models.decode import generate
+    from containerpilot_tpu.models.transformer import init_params
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[3, 5, 7]], jnp.int32)
+
+    baseline = np.asarray(generate(
+        params, prompt, cfg, max_new_tokens=8, max_len=32
+    ))[0]
+    eos = int(baseline[1])  # would stop after 2 tokens
+
+    zero = np.asarray(generate(
+        params, prompt, cfg, max_new_tokens=8, max_len=32,
+        min_new_tokens=0, eos_id=eos,
+    ))[0]
+    floored = np.asarray(generate(
+        params, prompt, cfg, max_new_tokens=8, max_len=32,
+        min_new_tokens=5, eos_id=eos,
+    ))[0]
+    # min_new=0: the early eos stands (token 1), pads follow
+    assert zero[1] == eos
+    # floored: samples 0..4 are eos-free by construction
+    assert not (floored[:5] == eos).any()
+
+    # sampled path too, per-row: row 0 floored, row 1 free
+    two = jnp.asarray([[3, 5, 7], [3, 5, 7]], jnp.int32)
+    out = np.asarray(generate(
+        params, two, cfg, max_new_tokens=8, max_len=32,
+        temperature=0.9, rng=jax.random.PRNGKey(5),
+        eos_id=eos, min_new_tokens=[6, 0],
+    ))
+    assert not (out[0, :6] == eos).any()
+
+    with pytest.raises(ValueError, match="min_new_tokens"):
+        generate(
+            params, prompt, cfg, max_new_tokens=4, max_len=32,
+            min_new_tokens=9,
+        )
+
+
+def test_min_new_tokens_over_http(run):
+    """The serving knob floors answers through the batcher path and
+    422s out-of-range values."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server = InferenceServer(cfg, params, "127.0.0.1", 0, max_len=32)
+
+    def fetch(body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            return exc.code, exc.read().decode()
+
+    async def scenario():
+        import asyncio
+
+        await server.run()
+        loop = asyncio.get_event_loop()
+
+        def go():
+            _s, free = fetch(
+                {"tokens": [[1, 2, 3]], "max_new_tokens": 8}
+            )
+            eos = free["tokens"][0][1]
+            s1, stopped = fetch(
+                {"tokens": [[1, 2, 3]], "max_new_tokens": 8,
+                 "eos_id": eos}
+            )
+            s2, floored = fetch(
+                {"tokens": [[1, 2, 3]], "max_new_tokens": 8,
+                 "eos_id": eos, "min_new_tokens": 5}
+            )
+            s3, bad = fetch(
+                {"tokens": [[1, 2, 3]], "max_new_tokens": 4,
+                 "min_new_tokens": 9}
+            )
+            return eos, (s1, stopped), (s2, floored), s3
+
+        out = await loop.run_in_executor(None, go)
+        await server.stop()
+        return out
+
+    eos, (s1, stopped), (s2, floored), s3 = run(scenario())
+    assert s1 == 200 and len(stopped["tokens"][0]) == 2
+    assert s2 == 200
+    row = floored["tokens"][0]
+    assert len(row) >= 5 and eos not in row[:5]
+    assert s3 == 422
