@@ -17,6 +17,7 @@ API (token-level; tokenization is the caller's concern):
         -> {"text": "...", "tokens": [...]}  (byte-level tokenizer)
     GET /health   -> 200 once the model is compiled and warm
     GET /v1/model -> config summary
+    GET /metrics  -> Prometheus exposition (requests, latency, tokens)
 
 Generation runs on a worker thread so the asyncio loop (health checks
 included) never blocks on TPU execution. The serving concerns live in
@@ -150,18 +151,54 @@ class InferenceServer:
         self._executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="inference"
         )
+        # serving observability: request/latency/token metrics in a
+        # private registry (the supervisor's own /metrics lives on the
+        # telemetry server and must not collide in-process)
+        from prometheus_client import (
+            CollectorRegistry,
+            Counter,
+            Histogram,
+        )
+
+        self._metrics_registry = CollectorRegistry()
+        self._m_requests = Counter(
+            "containerpilot_serve_requests",
+            "requests served, by endpoint and status code",
+            ["endpoint", "code"], registry=self._metrics_registry,
+        )
+        self._m_latency = Histogram(
+            "containerpilot_serve_request_seconds",
+            "request wall time, by endpoint",
+            ["endpoint"], registry=self._metrics_registry,
+            buckets=(.005, .02, .05, .1, .25, .5, 1, 2.5, 5, 10, 30, 60),
+        )
+        self._m_tokens = Counter(
+            "containerpilot_serve_generated_tokens",
+            "tokens returned by generate/completions (post-trim)",
+            registry=self._metrics_registry,
+        )
         self._server = HTTPServer()
         self._server.route("GET", "/health", self._health)
-        self._server.route("GET", "/v1/model", self._model_info)
-        self._server.route("POST", "/v1/generate", self._generate)
-        self._server.route("POST", "/v1/score", self._score)
+        self._server.route("GET", "/metrics", self._metrics)
+        route = self._instrumented
+        self._server.route("GET", "/v1/model", route(
+            "model", self._model_info
+        ))
+        self._server.route("POST", "/v1/generate", route(
+            "generate", self._generate
+        ))
+        self._server.route("POST", "/v1/score", route(
+            "score", self._score
+        ))
         # text surface: byte-level tokenizer, zero external assets
         self.tokenizer = None
         if text:
             from .text import ByteTokenizer
 
             self.tokenizer = ByteTokenizer(cfg.vocab_size)
-            self._server.route("POST", "/v1/completions", self._completions)
+            self._server.route("POST", "/v1/completions", route(
+                "completions", self._completions
+            ))
         self._score_fn = None  # jitted lazily; jit caches per length
         # continuous batching: requests queue here and the batcher
         # coalesces whatever accumulated while the device was busy
@@ -177,6 +214,40 @@ class InferenceServer:
         if not self.ready:
             return Response(503, b"warming up\n")
         return Response(200, b"ok\n")
+
+    async def _metrics(self, _req: Request) -> Response:
+        from prometheus_client import generate_latest
+
+        return Response(
+            200, generate_latest(self._metrics_registry),
+            content_type="text/plain; version=0.0.4",
+        )
+
+    def _instrumented(self, endpoint: str, handler):
+        """Count + time every API request; token accounting happens in
+        the handlers themselves (they know the post-trim lengths)."""
+        import time as time_mod
+
+        async def wrapped(req: Request) -> Response:
+            t0 = time_mod.perf_counter()
+            try:
+                resp = await handler(req)
+            except Exception:
+                # the HTTP layer turns this into a 500; the failing
+                # (often slowest) requests are exactly what the
+                # metrics exist to surface
+                self._m_latency.labels(endpoint).observe(
+                    time_mod.perf_counter() - t0
+                )
+                self._m_requests.labels(endpoint, "500").inc()
+                raise
+            self._m_latency.labels(endpoint).observe(
+                time_mod.perf_counter() - t0
+            )
+            self._m_requests.labels(endpoint, str(resp.status)).inc()
+            return resp
+
+        return wrapped
 
     def _mesh_info(self) -> Optional[Dict[str, int]]:
         """The device mesh the params actually live on (axis -> size),
@@ -443,6 +514,7 @@ class InferenceServer:
         generated = await self._dispatch_generate(tokens, prompt_len, p)
         generated = self._trim(generated, p["max_new_requested"], p["eos_id"])
         generated = self._trim_stops(generated, p["stop"])
+        self._m_tokens.inc(sum(len(r) for r in generated))
         return Response(
             200,
             json.dumps({"tokens": generated}).encode(),
@@ -500,6 +572,7 @@ class InferenceServer:
         generated = await self._dispatch_generate([row], len(row), p)
         generated = self._trim(generated, p["max_new_requested"], p["eos_id"])
         generated = self._trim_stops(generated, p["stop"])
+        self._m_tokens.inc(len(generated[0]))
         return Response(
             200,
             json.dumps(

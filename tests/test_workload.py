@@ -3168,3 +3168,57 @@ def test_min_new_tokens_over_http(run):
     row = floored["tokens"][0]
     assert len(row) >= 5 and eos not in row[:5]
     assert s3 == 422
+
+
+def test_inference_server_metrics_endpoint(run):
+    """GET /metrics: Prometheus exposition with request counts,
+    latency histogram, and post-trim token accounting."""
+    import json
+    import urllib.request
+
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server = InferenceServer(cfg, params, "127.0.0.1", 0, max_len=32)
+
+    def fetch(path, body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{path}",
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"} if body else {},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.read().decode()
+
+    async def scenario():
+        import asyncio
+
+        await server.run()
+        loop = asyncio.get_event_loop()
+
+        def go():
+            fetch("/v1/generate",
+                  {"tokens": [[1, 2, 3]], "max_new_tokens": 6})
+            fetch("/v1/generate",
+                  {"tokens": [[4, 5]], "max_new_tokens": 4})
+            return fetch("/metrics")
+
+        text = await loop.run_in_executor(None, go)
+        await server.stop()
+        return text
+
+    text = run(scenario())
+    assert (
+        'containerpilot_serve_requests_total{'
+        'code="200",endpoint="generate"} 2.0' in text
+    )
+    assert "containerpilot_serve_generated_tokens_total 10.0" in text
+    assert (
+        'containerpilot_serve_request_seconds_count{'
+        'endpoint="generate"} 2.0' in text
+    )
