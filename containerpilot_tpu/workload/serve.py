@@ -10,6 +10,11 @@ API (token-level; tokenization is the caller's concern):
     POST /v1/generate {"tokens": [[1,2,3]], "max_new_tokens": 16,
                        "temperature": 0.0}
         -> {"tokens": [[...generated ids...]]}
+        ("logprobs": true echoes per-token logprobs of the trimmed
+         output via one teacher-forced pass — decode is bit-equal to
+         the forward, so these are exactly the sampler's numbers;
+         approximate only under --kv-int8, whose decode reads a
+         quantized KV cache)
     POST /v1/score    {"tokens": [[1,2,3,4]]}
         -> {"logprobs": [[lp(t1|t0), lp(t2|t0..1), ...]],
             "sums": [total lp per row]}   (teacher-forced scoring)
@@ -340,6 +345,7 @@ class InferenceServer:
             "top_p": float(body.get("top_p", 0.0)),
             "eos_id": int(body.get("eos_id", default_eos)),
             "min_new": int(body.get("min_new_tokens", 0)),
+            "logprobs": bool(body.get("logprobs", False)),
             "beam_width": int(body.get("beam_width", 0)),
             "length_penalty": float(body.get("length_penalty", 0.0)),
             "stop": self._parse_stops(body.get("stop")),
@@ -515,9 +521,15 @@ class InferenceServer:
         generated = self._trim(generated, p["max_new_requested"], p["eos_id"])
         generated = self._trim_stops(generated, p["stop"])
         self._m_tokens.inc(sum(len(r) for r in generated))
+        payload: Dict[str, Any] = {"tokens": generated}
+        if p["logprobs"]:
+            loop = asyncio.get_event_loop()
+            payload["logprobs"] = await loop.run_in_executor(
+                self._executor, self._echo_logprobs, tokens, generated
+            )
         return Response(
             200,
-            json.dumps({"tokens": generated}).encode(),
+            json.dumps(payload).encode(),
             content_type="application/json",
         )
 
@@ -584,6 +596,57 @@ class InferenceServer:
             content_type="application/json",
         )
 
+    def _ensure_score_fn(self) -> None:
+        if self._score_fn is not None:
+            return
+        from ..models.transformer import forward
+
+        def score(params, toks):
+            logits = forward(params, toks[:, :-1], self.cfg)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            picked = jnp.take_along_axis(
+                logp, toks[:, 1:, None], axis=-1
+            )[..., 0]
+            return picked  # [batch, len-1]
+
+        self._score_fn = jax.jit(score)
+
+    def _echo_logprobs(
+        self,
+        prompts: List[List[int]],
+        generated: List[List[int]],
+    ) -> List[List[float]]:
+        """Per-token logprobs of the TRIMMED generated ids, via one
+        teacher-forced pass over prompt+generated. Decode is bit-equal
+        to the forward (tested invariant), so these are exactly the
+        probabilities the sampler saw — and the approach works
+        uniformly across every decode path (batcher, slots, prefix,
+        speculative, beam) with no decode changes. With --kv-int8 the
+        echo is approximate (the scorer runs full-precision while
+        decode read a quantized KV cache; parity there is ~5e-2, not
+        bitwise). Rows pad to a 16-multiple width (capped at max_len)
+        so arbitrary trimmed lengths cannot compile a fresh scoring
+        program per request — causal attention makes the extra pad
+        positions free."""
+        self._ensure_score_fn()
+        rows = [p + g for p, g in zip(prompts, generated)]
+        width = min(-(-max(len(r) for r in rows) // 16) * 16,
+                    self.max_len)
+        padded = [r + [0] * (width - len(r)) for r in rows]
+        picked = jax.device_get(
+            self._score_fn(self.params, jnp.asarray(padded, jnp.int32))
+        ).astype(float)
+        out: List[List[float]] = []
+        for row_lp, prompt, gen in zip(picked, prompts, generated):
+            # lp[i] scores token i+1 of the padded row; generated
+            # token j sits at padded index len(prompt)+j
+            start = len(prompt) - 1
+            out.append([
+                round(float(x), 6)
+                for x in row_lp[start:start + len(gen)]
+            ])
+        return out
+
     async def _score(self, req: Request) -> Response:
         """Teacher-forced per-token logprobs of the given sequences —
         the standard scoring/perplexity endpoint (no sampling)."""
@@ -597,18 +660,7 @@ class InferenceServer:
         except (ValueError, KeyError, TypeError) as exc:
             return Response(422, f"{exc}\n".encode())
 
-        if self._score_fn is None:
-            from ..models.transformer import forward
-
-            def score(params, toks):
-                logits = forward(params, toks[:, :-1], self.cfg)
-                logp = jax.nn.log_softmax(logits, axis=-1)
-                picked = jnp.take_along_axis(
-                    logp, toks[:, 1:, None], axis=-1
-                )[..., 0]
-                return picked  # [batch, len-1]
-
-            self._score_fn = jax.jit(score)
+        self._ensure_score_fn()
 
         def run() -> Any:
             toks = jnp.asarray(tokens, jnp.int32)

@@ -3222,3 +3222,65 @@ def test_inference_server_metrics_endpoint(run):
         'containerpilot_serve_request_seconds_count{'
         'endpoint="generate"} 2.0' in text
     )
+
+
+def test_generate_logprobs_echo(run):
+    """{"logprobs": true} echoes per-token logprobs of the trimmed
+    generated ids via one teacher-forced pass — must match /v1/score
+    on prompt+generated at the generated positions (decode == forward
+    is the tested invariant that makes this exact)."""
+    import json
+    import urllib.request
+
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server = InferenceServer(cfg, params, "127.0.0.1", 0, max_len=32)
+
+    def fetch(path, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return json.loads(resp.read().decode())
+
+    async def scenario():
+        import asyncio
+
+        await server.run()
+        loop = asyncio.get_event_loop()
+
+        def go():
+            prompt = [1, 2, 3]
+            gen = fetch("/v1/generate", {
+                "tokens": [prompt], "max_new_tokens": 6,
+                "logprobs": True,
+            })
+            row = gen["tokens"][0]
+            score = fetch("/v1/score", {"tokens": [prompt + row]})
+            # rows of different trimmed lengths share one echo batch
+            eos = row[1]
+            two = fetch("/v1/generate", {
+                "tokens": [prompt, [4, 5, 6]], "max_new_tokens": 6,
+                "eos_id": eos, "logprobs": True,
+            })
+            return gen, row, score, two
+
+        out = await loop.run_in_executor(None, go)
+        await server.stop()
+        return out
+
+    gen, row, score, two = run(scenario())
+    lps = gen["logprobs"][0]
+    assert len(lps) == len(row) and all(x <= 0.0 for x in lps)
+    # the echo is exactly the score endpoint's tail slice
+    assert lps == score["logprobs"][0][-len(row):]
+    for toks, lp_row in zip(two["tokens"], two["logprobs"]):
+        assert len(toks) == len(lp_row)
