@@ -472,15 +472,58 @@ def mask_eos_before_min(
     )
 
 
+def apply_token_penalties(
+    logits: jax.Array,
+    counts: jax.Array,
+    presence_penalty,
+    frequency_penalty,
+) -> jax.Array:
+    """OpenAI-style repetition control over the GENERATED tokens so
+    far (counts: [batch, vocab]): logit -= presence * (count > 0)
+    + frequency * count. Generated-only (not the prompt) keeps ONE
+    semantic on every decode path — the slot engine and the
+    prefix-cache path have no prompt in scope at sampling time. Both
+    penalties 0 leave logits bitwise-unchanged."""
+    b = logits.shape[0]
+    pres = jnp.broadcast_to(
+        jnp.asarray(presence_penalty, jnp.float32), (b,)
+    )[:, None]
+    freq = jnp.broadcast_to(
+        jnp.asarray(frequency_penalty, jnp.float32), (b,)
+    )[:, None]
+    return logits - pres * (counts > 0) - freq * counts
+
+
+def count_token(
+    counts: jax.Array, token: jax.Array, alive
+) -> jax.Array:
+    """counts[b, token[b]] += 1 for rows still alive (a done row's
+    pad filler must not be penalized)."""
+    b, vocab = counts.shape
+    onehot = (
+        jnp.arange(vocab)[None, :] == token[:, None]
+    ).astype(counts.dtype)
+    return counts + onehot * jnp.asarray(alive, counts.dtype)[:, None]
+
+
 def _sampling_scan(cfg, max_new_tokens: int, greedy: bool,
-                   filtered: bool):
+                   filtered: bool, penalized: bool = False):
     """The shared decode loop: from (cache, next-token logits) sample
     max_new_tokens with eos/pad handling. Used by the prefill-fused
-    generate program and the prefix-cache extend path."""
+    generate program and the prefix-cache extend path.
+
+    ``penalized`` is a static compile-key flag (like greedy/filtered):
+    only requests that actually set presence/frequency penalties pay
+    the [batch, vocab] counts carry and per-step bookkeeping — the
+    common zero-penalty program is unchanged."""
 
     def scan(params, cache, logits, row_keys, temperature, top_k,
-             top_p, eos_id, pad_id, min_new):
-        def sample(logits, step_idx):
+             top_p, eos_id, pad_id, min_new, presence, frequency):
+        def sample(logits, step_idx, counts):
+            if penalized:
+                logits = apply_token_penalties(
+                    logits, counts, presence, frequency
+                )
             logits = mask_eos_before_min(
                 logits, step_idx, min_new, eos_id
             )
@@ -495,23 +538,40 @@ def _sampling_scan(cfg, max_new_tokens: int, greedy: bool,
                 top_p if filtered else None,
             )
 
-        first = sample(logits, jnp.int32(0)).astype(jnp.int32)
+        counts = (
+            jnp.zeros(logits.shape, jnp.float32) if penalized else None
+        )
+        first = sample(logits, jnp.int32(0), counts).astype(jnp.int32)
         # rows that have emitted eos keep decoding (static shapes) but
         # emit pad from then on; eos_id == -1 disables the early stop
         # dynamically (token ids are non-negative, so it never matches)
         done = first == eos_id
+        if penalized:
+            counts = count_token(counts, first, ~done)
 
         def step(carry, step_idx):
-            cache, token, done = carry
+            if penalized:
+                cache, token, done, counts = carry
+            else:
+                cache, token, done = carry
+                counts = None
             logits, cache = decode_step(params, cache, token, cfg)
-            next_token = sample(logits, step_idx).astype(jnp.int32)
+            next_token = sample(
+                logits, step_idx, counts
+            ).astype(jnp.int32)
             next_token = jnp.where(done, pad_id, next_token)
             done = done | (next_token == eos_id)
+            if penalized:
+                counts = count_token(counts, next_token, ~done)
+                return (cache, next_token, done, counts), next_token
             return (cache, next_token, done), next_token
 
-        (_cache, _last, _done), rest = lax.scan(
-            step, (cache, first, done),
-            jnp.arange(1, max_new_tokens, dtype=jnp.int32),
+        init = (
+            (cache, first, done, counts) if penalized
+            else (cache, first, done)
+        )
+        _final, rest = lax.scan(
+            step, init, jnp.arange(1, max_new_tokens, dtype=jnp.int32),
         )
         return jnp.concatenate([first[:, None], rest.T], axis=1)
 
@@ -520,7 +580,8 @@ def _sampling_scan(cfg, max_new_tokens: int, greedy: bool,
 
 @functools.lru_cache(maxsize=32)
 def _jitted_generate(cfg: TransformerConfig, max_new_tokens: int,
-                     max_len: int, greedy: bool, filtered: bool):
+                     max_len: int, greedy: bool, filtered: bool,
+                     penalized: bool = False):
     """One compiled program per (config, lengths, sampling mode); jit's
     own cache covers distinct prompt lengths and batch sizes.
     Everything request-controlled that doesn't change shapes
@@ -529,13 +590,15 @@ def _jitted_generate(cfg: TransformerConfig, max_new_tokens: int,
     cache, and co-batched requests keep independent settings. Each row
     samples from its own key (fold_in per step), so a row's output
     never depends on what it was batched with."""
-    scan = _sampling_scan(cfg, max_new_tokens, greedy, filtered)
+    scan = _sampling_scan(cfg, max_new_tokens, greedy, filtered,
+                          penalized)
 
     def fn(params, prompt, row_keys, temperature, top_k, top_p, eos_id,
-           pad_id, min_new):
+           pad_id, min_new, presence, frequency):
         logits, cache = prefill(params, prompt, cfg, max_len)
         return scan(params, cache, logits, row_keys, temperature,
-                    top_k, top_p, eos_id, pad_id, min_new)
+                    top_k, top_p, eos_id, pad_id, min_new, presence,
+                    frequency)
 
     return jax.jit(fn)
 
@@ -564,8 +627,10 @@ def _jitted_extend(cfg: TransformerConfig):
 @functools.lru_cache(maxsize=32)
 def _jitted_decode_from_cache(cfg: TransformerConfig,
                               max_new_tokens: int, greedy: bool,
-                              filtered: bool):
-    return jax.jit(_sampling_scan(cfg, max_new_tokens, greedy, filtered))
+                              filtered: bool, penalized: bool = False):
+    return jax.jit(
+        _sampling_scan(cfg, max_new_tokens, greedy, filtered, penalized)
+    )
 
 
 def generate(
@@ -581,6 +646,8 @@ def generate(
     eos_id=-1,
     pad_id=0,
     min_new_tokens=0,
+    presence_penalty=0.0,
+    frequency_penalty=0.0,
 ) -> jax.Array:
     """Autoregressive generation. prompt: [batch, prompt_len] int32;
     returns [batch, max_new_tokens] int32.
@@ -592,13 +659,18 @@ def generate(
     decodes greedily. ``eos_id >= 0`` enables early stop: once a row
     samples eos, the rest of that row is ``pad_id``;
     ``min_new_tokens`` suppresses eos for a row's first N samples so
-    short answers can be floored. ``rng`` is one key (split per row
-    internally) or [batch] stacked per-row keys — per-row keys keep
-    each row's output independent of co-batched rows.
+    short answers can be floored. ``presence_penalty`` /
+    ``frequency_penalty`` subtract from the logits of tokens already
+    GENERATED this call (OpenAI semantics over the output, prompt
+    excluded — one semantic across every decode path). ``rng`` is one
+    key (split per row internally) or [batch] stacked per-row keys —
+    per-row keys keep each row's output independent of co-batched
+    rows.
     """
     operands = _normalize_sampling(
         cfg, prompt.shape[0], max_new_tokens, temperature, rng, top_k,
-        top_p, eos_id, pad_id, min_new_tokens,
+        top_p, eos_id, pad_id, min_new_tokens, presence_penalty,
+        frequency_penalty,
     )
     if prompt.shape[1] + max_new_tokens > max_len:
         # an overflowing decode would silently clamp cache writes onto
@@ -607,14 +679,17 @@ def generate(
             f"prompt_len {prompt.shape[1]} + max_new_tokens "
             f"{max_new_tokens} exceeds max_len {max_len}"
         )
-    greedy, filtered, op_arrays = operands
-    fn = _jitted_generate(cfg, max_new_tokens, max_len, greedy, filtered)
+    greedy, filtered, penalized, op_arrays = operands
+    fn = _jitted_generate(
+        cfg, max_new_tokens, max_len, greedy, filtered, penalized
+    )
     return fn(params, prompt, *op_arrays)
 
 
 def _normalize_sampling(cfg, b, max_new_tokens, temperature, rng,
                         top_k, top_p, eos_id, pad_id,
-                        min_new_tokens=0):
+                        min_new_tokens=0, presence_penalty=0.0,
+                        frequency_penalty=0.0):
     """Validate/broadcast the per-row sampling knobs exactly as
     ``generate`` documents; returns (greedy, filtered, operand arrays
     in _sampling_scan order after the cache/logits)."""
@@ -661,6 +736,12 @@ def _normalize_sampling(cfg, b, max_new_tokens, temperature, rng,
             f"min_new_tokens must be in [0, max_new_tokens "
             f"{max_new_tokens}]"
         )
+    pres_arr = row(presence_penalty, np.float32, "presence_penalty")
+    freq_arr = row(frequency_penalty, np.float32, "frequency_penalty")
+    if (np.abs(pres_arr) > 100).any() or (np.abs(freq_arr) > 100).any():
+        raise ValueError(
+            "presence/frequency penalties must be in [-100, 100]"
+        )
     greedy = bool((t <= 0.0).all())
     if greedy:
         # dead under argmax; normalize so the compile key can't churn
@@ -669,13 +750,16 @@ def _normalize_sampling(cfg, b, max_new_tokens, temperature, rng,
     filtered = bool(
         ((k_arr > 0) | ((p_arr > 0.0) & (p_arr < 1.0))).any()
     )
-    return greedy, filtered, (
+    penalized = bool(pres_arr.any() or freq_arr.any())
+    return greedy, filtered, penalized, (
         row_keys,
         jnp.asarray(t, jnp.float32), jnp.asarray(k_arr, jnp.int32),
         jnp.asarray(p_arr, jnp.float32),
         jnp.asarray(np.maximum(eos_arr, -1), jnp.int32),
         jnp.asarray(pad_arr, jnp.int32),
         jnp.asarray(min_arr, jnp.int32),
+        jnp.asarray(pres_arr, jnp.float32),
+        jnp.asarray(freq_arr, jnp.float32),
     )
 
 
@@ -693,6 +777,8 @@ def generate_from_cache(
     pad_id=0,
     pos: int = None,
     min_new_tokens=0,
+    presence_penalty=0.0,
+    frequency_penalty=0.0,
 ) -> jax.Array:
     """``generate`` starting from an existing (cache, next-token
     logits) pair — the prefix-cache serving path: the caller restored
@@ -723,9 +809,12 @@ def generate_from_cache(
                 f"cache pos {pos} + max_new_tokens {max_new_tokens} "
                 f"exceeds cache length {length}"
             )
-    greedy, filtered, op_arrays = _normalize_sampling(
+    greedy, filtered, penalized, op_arrays = _normalize_sampling(
         cfg, logits.shape[0], max_new_tokens, temperature, rng, top_k,
-        top_p, eos_id, pad_id, min_new_tokens,
+        top_p, eos_id, pad_id, min_new_tokens, presence_penalty,
+        frequency_penalty,
     )
-    fn = _jitted_decode_from_cache(cfg, max_new_tokens, greedy, filtered)
+    fn = _jitted_decode_from_cache(
+        cfg, max_new_tokens, greedy, filtered, penalized
+    )
     return fn(params, cache, logits, *op_arrays)

@@ -35,6 +35,8 @@ from jax import lax
 
 from .decode import (
     Cache,
+    apply_token_penalties,
+    count_token,
     decode_step,
     init_cache,
     mask_eos_before_min,
@@ -101,27 +103,31 @@ def _jitted_chunk(cfg: TransformerConfig, slots: int, chunk: int):
     )
 
     def run(params, pool, last, row_keys, step_idx, temperature,
-            top_k, top_p, eos_id, pad_id, min_new, done):
+            top_k, top_p, eos_id, pad_id, min_new, presence,
+            frequency, counts, done):
         def body(carry, _):
-            pool, tok, done, idx = carry
+            pool, tok, done, idx, counts = carry
             logits, pool = vstep(params, pool, tok[:, None])  # [S,1,V]
             keys = jax.vmap(jax.random.fold_in)(row_keys, idx)
-            masked = mask_eos_before_min(
-                logits[:, 0, :], idx, min_new, eos_id
+            masked = apply_token_penalties(
+                logits[:, 0, :], counts, presence, frequency
             )
+            masked = mask_eos_before_min(masked, idx, min_new, eos_id)
             nxt = sample_logits(
                 masked, keys, temperature, top_k, top_p
             ).astype(jnp.int32)
             nxt = jnp.where(done, pad_id, nxt)
             done = done | (nxt == eos_id)
-            return (pool, nxt, done, idx + 1), nxt
+            counts = count_token(counts, nxt, ~done)
+            return (pool, nxt, done, idx + 1, counts), nxt
 
-        (pool, last, done, _), toks = lax.scan(
-            body, (pool, last, done, step_idx), None, length=chunk
+        (pool, last, done, _, counts), toks = lax.scan(
+            body, (pool, last, done, step_idx, counts), None,
+            length=chunk,
         )
-        return pool, last, done, toks.T  # [S, chunk]
+        return pool, last, done, counts, toks.T  # [S, chunk]
 
-    return jax.jit(run, donate_argnums=(1,))
+    return jax.jit(run, donate_argnums=(1, 13))
 
 
 def decode_slots_chunk(
@@ -136,15 +142,21 @@ def decode_slots_chunk(
     eos_id: jax.Array,
     pad_id: jax.Array,
     min_new: jax.Array,
+    presence: jax.Array,
+    frequency: jax.Array,
+    counts: jax.Array,
     done: jax.Array,
     cfg: TransformerConfig,
     chunk: int,
-) -> Tuple[Cache, jax.Array, jax.Array, jax.Array]:
-    """Advance the whole pool ``chunk`` tokens; see _jitted_chunk."""
+):
+    """Advance the whole pool ``chunk`` tokens; see _jitted_chunk.
+    Returns (pool, last, done, counts, tokens [S, chunk]); the pool
+    AND the counts buffer are donated."""
     slots = int(last.shape[0])
     return _jitted_chunk(cfg, slots, chunk)(
         params, pool, last, row_keys, step_idx, temperature, top_k,
-        top_p, eos_id, pad_id, min_new, done,
+        top_p, eos_id, pad_id, min_new, presence, frequency, counts,
+        done,
     )
 
 
@@ -155,6 +167,8 @@ def _jitted_first_sample(cfg: TransformerConfig):
 
     def first(logits, row_key, temperature, top_k, top_p, eos_id,
               min_new):
+        # counts are empty at sample 0, so penalties are a no-op here
+        # by construction — identical to generate's first sample
         key = jax.random.fold_in(row_key, jnp.int32(0))
         masked = mask_eos_before_min(
             logits, jnp.int32(0), min_new[None], eos_id[None]

@@ -345,6 +345,8 @@ class InferenceServer:
             "top_p": float(body.get("top_p", 0.0)),
             "eos_id": int(body.get("eos_id", default_eos)),
             "min_new": int(body.get("min_new_tokens", 0)),
+            "presence": float(body.get("presence_penalty", 0.0)),
+            "frequency": float(body.get("frequency_penalty", 0.0)),
             "logprobs": bool(body.get("logprobs", False)),
             "beam_width": int(body.get("beam_width", 0)),
             "length_penalty": float(body.get("length_penalty", 0.0)),
@@ -382,6 +384,14 @@ class InferenceServer:
             raise ValueError(
                 "min_new_tokens does not apply to beam search"
             )
+        if not (abs(p["presence"]) <= 100 and abs(p["frequency"]) <= 100):
+            raise ValueError(
+                "presence/frequency penalties must be in [-100, 100]"
+            )
+        if (p["presence"] or p["frequency"]) and p["beam_width"]:
+            raise ValueError(
+                "penalties do not apply to beam search"
+            )
         if prompt_len + p["max_new_requested"] > self.max_len:
             raise ValueError(
                 f"prompt_len + max_new_tokens exceeds max_len "
@@ -414,6 +424,7 @@ class InferenceServer:
             self.draft_params is not None
             and p["temperature"] <= 0.0
             and p["min_new"] == 0
+            and not p["presence"] and not p["frequency"]
             and len(tokens) == 1
         ):
             # greedy single-sequence: draft-and-verify, identical
@@ -432,6 +443,8 @@ class InferenceServer:
                 temperature=p["temperature"], top_k=p["top_k"],
                 top_p=p["top_p"], eos_id=p["eos_id"], seed=p["seed"],
                 min_new=p["min_new"],
+                presence_penalty=p["presence"],
+                frequency_penalty=p["frequency"],
             )
             return [await asyncio.wrap_future(fut)]
         if (
@@ -449,7 +462,8 @@ class InferenceServer:
             return await in_exec(
                 self._executor, generate_with_prefix, self, tokens[0],
                 p["max_new"], p["temperature"], p["top_k"], p["top_p"],
-                p["eos_id"], p["seed"], p["min_new"],
+                p["eos_id"], p["seed"], p["min_new"], p["presence"],
+                p["frequency"],
             )
         if (
             self.prefill_chunk > 0
@@ -460,13 +474,14 @@ class InferenceServer:
                 self._executor, serve_strategies.run_chunked, self,
                 tokens, prompt_len, p["max_new"], p["temperature"],
                 p["top_k"], p["top_p"], p["eos_id"], p["seed"],
-                p["min_new"],
+                p["min_new"], p["presence"], p["frequency"],
             )
         job = GenJob(
             rows=tokens, prompt_len=prompt_len, max_new=p["max_new"],
             temperature=p["temperature"], top_k=p["top_k"],
             top_p=p["top_p"], eos_id=p["eos_id"], seed=p["seed"],
-            min_new=p["min_new"],
+            min_new=p["min_new"], presence=p["presence"],
+            frequency=p["frequency"],
             future=loop.create_future(),
         )
         return await self._batcher.submit(job)

@@ -32,6 +32,8 @@ class GenJob:
     eos_id: int
     seed: int
     min_new: int = 0
+    presence: float = 0.0
+    frequency: float = 0.0
     future: "asyncio.Future[List[List[int]]]" = field(repr=False, default=None)
 
 
@@ -115,6 +117,8 @@ class Batcher:
             ps: List[float] = []
             eoss: List[int] = []
             mins: List[int] = []
+            press: List[float] = []
+            freqs: List[float] = []
             keys = []
             for job in jobs:
                 base = jax.random.PRNGKey(job.seed)
@@ -125,6 +129,8 @@ class Batcher:
                     ps.append(job.top_p)
                     eoss.append(job.eos_id)
                     mins.append(job.min_new)
+                    press.append(job.presence)
+                    freqs.append(job.frequency)
                     keys.append(jax.random.fold_in(base, i))
             # bucket the batch dim to powers of two so concurrency
             # spikes can't compile one program per row count
@@ -139,6 +145,8 @@ class Batcher:
                 ps.append(0.0)
                 eoss.append(-1)
                 mins.append(0)
+                press.append(0.0)
+                freqs.append(0.0)
                 keys.append(jax.random.PRNGKey(0))
             out = generate(
                 self.params,
@@ -152,6 +160,8 @@ class Batcher:
                 top_p=ps,
                 eos_id=eoss,
                 min_new_tokens=mins,
+                presence_penalty=press,
+                frequency_penalty=freqs,
             )
             n_real = len(rows) - pad_rows
             return jax.device_get(out[:n_real]).tolist()

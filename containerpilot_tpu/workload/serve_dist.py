@@ -65,6 +65,8 @@ def _payload_zeros(max_len: int) -> Dict[str, np.ndarray]:
         "eos_id": np.full((), -1, np.int32),
         "seed": np.zeros((), np.int32),
         "min_new": np.zeros((), np.int32),
+        "presence": np.zeros((), np.float32),
+        "frequency": np.zeros((), np.float32),
     }
 
 
@@ -86,6 +88,8 @@ def _payload_for(req: Dict[str, Any], max_len: int) -> Dict[str, np.ndarray]:
     p["eos_id"] = np.asarray(req.get("eos_id", -1), np.int32)
     p["seed"] = np.asarray(req.get("seed", 0), np.int32)
     p["min_new"] = np.asarray(req.get("min_new", 0), np.int32)
+    p["presence"] = np.asarray(req.get("presence", 0.0), np.float32)
+    p["frequency"] = np.asarray(req.get("frequency", 0.0), np.float32)
     return p
 
 
@@ -130,6 +134,8 @@ def _decode_pod(params, cfg, payload, max_len: int):
         top_p=float(payload["top_p"]),
         eos_id=int(payload["eos_id"]),
         min_new_tokens=int(payload["min_new"]),
+        presence_penalty=float(payload["presence"]),
+        frequency_penalty=float(payload["frequency"]),
     )
 
 
@@ -216,6 +222,13 @@ class _Frontend:
                 raise ValueError(
                     "min_new_tokens must be in [0, max_new_tokens]"
                 )
+            presence = float(body.get("presence_penalty", 0.0))
+            frequency = float(body.get("frequency_penalty", 0.0))
+            if not (abs(presence) <= 100 and abs(frequency) <= 100):
+                raise ValueError(
+                    "presence/frequency penalties must be in "
+                    "[-100, 100]"
+                )
             work = {
                 "tokens": tokens, "max_new": max_new,
                 "temperature": float(body.get("temperature", 0.0)),
@@ -224,6 +237,8 @@ class _Frontend:
                 "eos_id": max(eos_id, -1),
                 "seed": seed,
                 "min_new": min_new,
+                "presence": presence,
+                "frequency": frequency,
             }
         except (ValueError, KeyError, TypeError, OverflowError) as exc:
             return self._Response(422, f"{exc}\n".encode())

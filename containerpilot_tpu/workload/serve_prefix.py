@@ -72,6 +72,8 @@ def generate_with_prefix(
     srv: Any, row: List[int], max_new: int, temperature: float,
     top_k: int, top_p: float, eos_id: int, seed: int,
     min_new: int = 0,
+    presence: float = 0.0,
+    frequency: float = 0.0,
 ) -> List[List[int]]:
     """Single-row generation reusing the longest cached prompt prefix.
 
@@ -136,5 +138,6 @@ def generate_with_prefix(
         rng=jnp.stack([jax.random.fold_in(jax.random.PRNGKey(seed), 0)]),
         top_k=top_k, top_p=top_p, eos_id=eos_id,
         pos=plen, min_new_tokens=min_new,
+        presence_penalty=presence, frequency_penalty=frequency,
     )
     return jax.device_get(out).tolist()

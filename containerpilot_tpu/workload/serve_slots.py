@@ -54,6 +54,8 @@ class _Request:
     pad_id: int
     seed: int
     min_new: int = 0
+    presence: float = 0.0
+    frequency: float = 0.0
     future: Future = field(default_factory=Future)
 
 
@@ -97,6 +99,11 @@ class SlotEngine:
         self._eos = np.full((slots,), -1, np.int32)
         self._pad = np.zeros((slots,), np.int32)
         self._min_new = np.zeros((slots,), np.int32)
+        self._presence = np.zeros((slots,), np.float32)
+        self._frequency = np.zeros((slots,), np.float32)
+        # generated-token counts per slot, device-resident (the chunk
+        # program reads and donates it like the pool)
+        self._counts = jnp.zeros((slots, cfg.vocab_size), jnp.float32)
         self._done = np.ones((slots,), bool)  # empty slots are "done"
         self._active: List[Optional[_Slot]] = [None] * slots
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
@@ -120,6 +127,8 @@ class SlotEngine:
         pad_id: int = 0,
         seed: int = 0,
         min_new: int = 0,
+        presence_penalty: float = 0.0,
+        frequency_penalty: float = 0.0,
     ) -> Future:
         """Queue one sequence; resolves to its generated ids."""
         if max_new < 1:
@@ -140,6 +149,8 @@ class SlotEngine:
             temperature=float(temperature), top_k=int(top_k),
             top_p=float(top_p), eos_id=int(eos_id), pad_id=int(pad_id),
             seed=int(seed), min_new=int(min_new),
+            presence=float(presence_penalty),
+            frequency=float(frequency_penalty),
         )
         # atomic with stop()'s drain: either this put lands before the
         # drain (and gets cancelled there) or the stopped check raises
@@ -206,6 +217,14 @@ class SlotEngine:
         self._eos[slot_id] = req.eos_id
         self._pad[slot_id] = req.pad_id
         self._min_new[slot_id] = req.min_new
+        self._presence[slot_id] = req.presence
+        self._frequency[slot_id] = req.frequency
+        # fresh generated-token counts; sample 0 (just drawn) counts
+        # unless it ended the row — matching generate's scan exactly
+        row_counts = jnp.zeros((self.cfg.vocab_size,), jnp.float32)
+        if first_host != req.eos_id:
+            row_counts = row_counts.at[first_host].set(1.0)
+        self._counts = self._counts.at[slot_id].set(row_counts)
         state = _Slot(req=req, emitted=[first_host])
         if first_host == req.eos_id or req.max_new <= 1:
             state.finished = True
@@ -252,7 +271,8 @@ class SlotEngine:
             if not any(s is not None for s in self._active):
                 continue
             try:
-                self._pool, self._last, done_dev, toks = (
+                (self._pool, self._last, done_dev, self._counts,
+                 toks) = (
                     decode_slots_chunk(
                         self.params, self._pool, self._last,
                         self._keys, jnp.asarray(self._step_idx),
@@ -262,6 +282,9 @@ class SlotEngine:
                         jnp.asarray(self._eos),
                         jnp.asarray(self._pad),
                         jnp.asarray(self._min_new),
+                        jnp.asarray(self._presence),
+                        jnp.asarray(self._frequency),
+                        self._counts,
                         jnp.asarray(self._done),
                         self.cfg, self.chunk,
                     )
@@ -281,6 +304,9 @@ class SlotEngine:
                 )
                 self._last = jnp.zeros((self.slots,), jnp.int32)
                 self._keys = jnp.zeros((self.slots, 2), jnp.uint32)
+                self._counts = jnp.zeros(
+                    (self.slots, self.cfg.vocab_size), jnp.float32
+                )
                 continue
             toks_host = np.asarray(jax.device_get(toks))
             self._step_idx += self.chunk

@@ -55,6 +55,8 @@ def run_chunked(
     srv: Any, tokens: List[List[int]], prompt_len: int, max_new: int,
     temperature: float, top_k: int, top_p: float, eos_id: int, seed: int,
     min_new: int = 0,
+    presence: float = 0.0,
+    frequency: float = 0.0,
 ) -> List[List[int]]:
     """Long single-row prompt: stream the prefill in chunks (peak
     prefill activations O(chunk) instead of O(prompt))."""
@@ -72,5 +74,6 @@ def run_chunked(
         rng=jnp.stack([jax.random.fold_in(jax.random.PRNGKey(seed), 0)]),
         top_k=top_k, top_p=top_p, eos_id=eos_id,
         pos=prompt_len, min_new_tokens=min_new,
+        presence_penalty=presence, frequency_penalty=frequency,
     )
     return jax.device_get(out).tolist()

@@ -273,3 +273,16 @@ def test_min_new_matches_generate(params, engine):
     assert eos not in got[:4]
     with pytest.raises(ValueError, match="min_new"):
         engine.submit(tokens, max_new=4, min_new=5)
+
+
+def test_penalties_match_generate(params, engine):
+    """Penalties through the slot engine equal solo generate — the
+    counts buffer reproduces the scan's bookkeeping exactly."""
+    tokens = [1, 2, 3]
+    kw = dict(frequency_penalty=50.0, temperature=0.7, seed=8)
+    got = engine.submit(tokens, max_new=8, **kw).result(timeout=120)
+    assert got == _solo(
+        params, tokens, 8, temperature=0.7, seed=8,
+        frequency_penalty=50.0,
+    )
+    assert len(set(got)) == len(got)

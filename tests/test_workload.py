@@ -3284,3 +3284,59 @@ def test_generate_logprobs_echo(run):
     assert lps == score["logprobs"][0][-len(row):]
     for toks, lp_row in zip(two["tokens"], two["logprobs"]):
         assert len(toks) == len(lp_row)
+
+
+def test_penalties_suppress_repetition(run):
+    """presence/frequency penalties subtract from generated-token
+    logits across the compiled paths; zero penalties are bitwise
+    neutral; out-of-range 422s."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server = InferenceServer(cfg, params, "127.0.0.1", 0, max_len=32)
+
+    def fetch(body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            return exc.code, exc.read().decode()
+
+    async def scenario():
+        import asyncio
+
+        await server.run()
+        loop = asyncio.get_event_loop()
+
+        def go():
+            base = {"tokens": [[1, 2, 3]], "max_new_tokens": 8}
+            _s, plain = fetch(base)
+            _s, zero = fetch({**base, "presence_penalty": 0.0,
+                              "frequency_penalty": 0.0})
+            s1, norep = fetch({**base, "frequency_penalty": 50.0})
+            s2, bad = fetch({**base, "presence_penalty": 1000.0})
+            return plain, zero, (s1, norep), s2
+
+        out = await loop.run_in_executor(None, go)
+        await server.stop()
+        return out
+
+    plain, zero, (s1, norep), s2 = run(scenario())
+    assert zero["tokens"] == plain["tokens"]
+    row = norep["tokens"][0]
+    assert s1 == 200 and len(set(row)) == len(row)
+    assert s2 == 422
