@@ -175,3 +175,4 @@ def test_fuzz_sample_logits_invariants(seed, top_k, top_p, temperature):
         for row, tok in zip(l_np, toks):
             kth = np.sort(row)[::-1][min(top_k, vocab) - 1]
             assert row[tok] >= kth
+

@@ -3340,3 +3340,69 @@ def test_penalties_suppress_repetition(run):
     row = norep["tokens"][0]
     assert s1 == 200 and len(set(row)) == len(row)
     assert s2 == 422
+
+
+def test_fuzz_generate_knob_combinations():
+    """Random combinations of every sampling knob against the
+    invariants that must hold regardless: output shape, pads after
+    eos, min_new eos suppression, seed determinism, and in-vocab ids
+    (penalty EFFECTS are asserted by their dedicated tests; here the
+    knobs only widen the combination space). Knob values are drawn so
+    the combos reuse a small
+    set of compiled programs (max_new fixed; greedy/filtered/
+    penalized each toggled)."""
+    import random
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from containerpilot_tpu.models.decode import generate
+    from containerpilot_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = random.Random(7)
+    max_new = 8
+
+    for trial in range(12):
+        greedy = rng.random() < 0.4
+        kw = {
+            "temperature": 0.0 if greedy else rng.uniform(0.3, 1.5),
+            "top_k": rng.choice([0, 0, 5, 40]),
+            "top_p": rng.choice([0.0, 0.0, 0.7, 0.95]),
+            "eos_id": rng.choice([-1, rng.randrange(cfg.vocab_size)]),
+            "min_new_tokens": rng.choice([0, 0, 3]),
+            "presence_penalty": rng.choice([0.0, 0.0, 1.5]),
+            "frequency_penalty": rng.choice([0.0, 0.0, 2.0]),
+        }
+        prompt = jnp.asarray(
+            [[rng.randrange(cfg.vocab_size) for _ in range(4)]],
+            jnp.int32,
+        )
+        key = jax.random.PRNGKey(trial)
+        out1 = np.asarray(generate(
+            params, prompt, cfg, max_new, 32, rng=key, **kw
+        ))[0]
+        out2 = np.asarray(generate(
+            params, prompt, cfg, max_new, 32, rng=key, **kw
+        ))[0]
+        label = f"trial {trial}: {kw}"
+        assert out1.shape == (max_new,), label
+        assert (out1 == out2).all(), f"nondeterministic: {label}"
+        assert ((out1 >= 0) & (out1 < cfg.vocab_size)).all(), label
+        eos = kw["eos_id"]
+        if eos >= 0:
+            hits = np.flatnonzero(out1 == eos)
+            if hits.size:
+                first = int(hits[0])
+                # eos never before the floor...
+                assert first >= kw["min_new_tokens"], label
+                # ...and everything after the first eos is pad (0)
+                assert (out1[first + 1:] == 0).all(), label
