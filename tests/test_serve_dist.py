@@ -154,6 +154,15 @@ def test_two_process_pod_serves_http(tmp_path):
             [5, 6], 5, temperature=0.8, top_k=20, seed=9
         )
 
+        # the newer sampling knobs ride the broadcast payload too
+        knobs = post({
+            "tokens": [[7, 8, 9]], "max_new_tokens": 6,
+            "min_new_tokens": 3, "frequency_penalty": 30.0,
+        })
+        assert knobs["tokens"][0] == _reference(
+            [7, 8, 9], 6, min_new_tokens=3, frequency_penalty=30.0
+        )
+
         # graceful pod shutdown: TERM on the frontend broadcasts the
         # stop; BOTH processes exit 0
         procs[0].send_signal(15)
