@@ -61,7 +61,7 @@ def _wait_http(url: str, deadline_s: float = 30) -> None:
 
 def _supervisor_config(
     tmp_path, idx: int, catalog_port: int, coord_port: int,
-    job_port: int,
+    job_port: int, crash_idx: int = 1,
 ) -> str:
     # ONE shared checkpoint dir for the pod (orbax is a global
     # checkpointer: primary-process writes + cross-process barriers;
@@ -84,7 +84,7 @@ def _supervisor_config(
         "--startup-timeout", "120",
         "--heartbeat-file", str(heartbeat),
     ]
-    if idx == 1:
+    if idx == crash_idx:
         exec_argv += [
             "--crash-step", str(CRASH_STEP),
             "--crash-sentinel", str(tmp_path / "crash-sentinel"),
@@ -128,7 +128,18 @@ def _supervisor_config(
     return str(path)
 
 
-def test_supervised_multiprocess_training_with_crash_and_resume(tmp_path):
+@pytest.mark.parametrize(
+    "crash_idx", [1, 0],
+    ids=["worker-crash", "coordinator-crash"],
+)
+def test_supervised_multiprocess_training_with_crash_and_resume(
+    tmp_path, crash_idx
+):
+    """crash_idx=0 kills the process HOSTING the jax coordinator —
+    the harder failure: the whole rendezvous must rebuild (the
+    reincarnated process 0 clears the stale coordinator registration
+    and re-registers; the survivor's watchdog turns its hang into a
+    restart that discovers the fresh coordinator)."""
     from containerpilot_tpu.discovery.consul import ConsulBackend
 
     catalog_port, coord_port = _free_port(), _free_port()
@@ -143,7 +154,7 @@ def test_supervised_multiprocess_training_with_crash_and_resume(tmp_path):
     )
     supervisors = []
     logs = []
-    timeline = []  # (monotonic_t, trainer1 present in catalog)
+    timeline = []  # (monotonic_t, crashing trainer present in catalog)
     stop_poll = threading.Event()
     try:
         _wait_http(
@@ -151,7 +162,8 @@ def test_supervised_multiprocess_training_with_crash_and_resume(tmp_path):
         )
         for idx in (0, 1):
             cfg_path = _supervisor_config(
-                tmp_path, idx, catalog_port, coord_port, job_ports[idx]
+                tmp_path, idx, catalog_port, coord_port,
+                job_ports[idx], crash_idx,
             )
             log_fh = open(tmp_path / f"sup{idx}.log", "w")
             logs.append(log_fh)
@@ -169,7 +181,9 @@ def test_supervised_multiprocess_training_with_crash_and_resume(tmp_path):
         def poll_catalog() -> None:
             while not stop_poll.is_set():
                 try:
-                    present = bool(backend.instances("trainer1"))
+                    present = bool(
+                        backend.instances(f"trainer{crash_idx}")
+                    )
                     timeline.append((time.monotonic(), present))
                 except Exception:
                     pass
@@ -242,10 +256,10 @@ def test_supervised_multiprocess_training_with_crash_and_resume(tmp_path):
             base["params_digest"], rel=1e-5
         )
 
-        # the crash was catalog-visible: trainer1 was in the passing
-        # set, fell out (stale heartbeat -> failing health exec -> TTL
-        # lapse -> critical), and returned once the reincarnated pod
-        # resumed stepping
+        # the crash was catalog-visible: the crashing trainer was in
+        # the passing set, fell out (stale heartbeat -> failing health
+        # exec -> TTL lapse -> critical), and returned once the
+        # reincarnated pod resumed stepping
         saw_present = saw_gap_after_present = saw_return = False
         for _, present in timeline:
             if present and not saw_present:
