@@ -93,18 +93,37 @@ def _fn_loop(*params: Any) -> List[int]:
 
 
 def _check_comparable(a: Any, b: Any) -> None:
-    """Go's eq/ne raise on incompatible types; env values are always
-    strings and number literals are int/float, so a silent False on
-    `eq .COUNT 2` would take the wrong branch with no diagnostic."""
-    str_vs_num = isinstance(a, str) != isinstance(b, str) and (
-        isinstance(a, (str, int, float))
-        and isinstance(b, (str, int, float))
-        and not isinstance(a, bool) and not isinstance(b, bool)
+    """Go's eq/ne raise on incomparable basic kinds; env values are
+    always strings and number literals are int/float, so a silent
+    False on `eq .COUNT 2` would take the wrong branch with no
+    diagnostic. Mirrors the reference for mixed numeric kinds too:
+    Go treats int vs float as incomparable (``eq 1 1.0`` errors), so
+    we reject it rather than silently returning Python's True."""
+    def kind(v: Any) -> str:
+        if isinstance(v, bool):
+            return "bool"
+        if isinstance(v, int):
+            return "int"
+        if isinstance(v, float):
+            return "float"
+        if isinstance(v, str):
+            return "str"
+        return "other"
+
+    ka, kb = kind(a), kind(b)
+    mismatch = (
+        ka != kb
+        and {ka, kb} <= {"str", "int", "float"}
     )
-    if str_vs_num:
-        raise TemplateError(
-            f"incompatible types for comparison: {a!r} vs {b!r} "
+    if mismatch:
+        hint = (
             "(env values are strings; quote the literal)"
+            if "str" in (ka, kb)
+            else "(int and float literals are incomparable kinds in "
+            "Go templates; use matching literals)"
+        )
+        raise TemplateError(
+            f"incompatible types for comparison: {a!r} vs {b!r} {hint}"
         )
 
 

@@ -98,11 +98,21 @@ def _get_table() -> Optional[dict]:
 
 def _largest_divisor_block(seq: int, block: int) -> int:
     """The largest power-of-two block <= ``block`` dividing seq (the
-    kernels require exact grids); floors at the minimum tile."""
-    b = block
+    kernels require exact grids). Fails loudly on seq not a multiple
+    of DEFAULT_BLOCK: pick_blocks is a public helper (bench/autotune
+    call it), and silently clamping to a non-tile block (e.g. 100, or
+    a degenerate 2) would hand pallas a grid Mosaic rejects — every
+    flash call site gates on seq % 128 == 0 (flash_eligible), so such
+    a seq here is a caller bug, not a tuning decision."""
+    if seq % DEFAULT_BLOCK != 0:
+        raise ValueError(
+            f"flash blocks require seq % {DEFAULT_BLOCK} == 0; got "
+            f"seq={seq} (gate the call on flash_eligible)"
+        )
+    b = min(block, seq)
     while b > DEFAULT_BLOCK and seq % b != 0:
         b //= 2
-    return max(b, min(DEFAULT_BLOCK, seq))
+    return b
 
 
 def pick_blocks(kind: str, seq: int) -> Tuple[int, int]:

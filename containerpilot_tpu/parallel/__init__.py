@@ -8,7 +8,6 @@ annotate shardings, and let XLA insert the collectives over ICI/DCN
 (SURVEY.md §5 distributed-backend mapping).
 """
 from .checkpoint import (
-    checkpoint_has_ema,
     wait_for_checkpoints,
     latest_step,
     restore_checkpoint,
@@ -67,7 +66,6 @@ __all__ = [
     "restore_checkpoint",
     "restore_params",
     "latest_step",
-    "checkpoint_has_ema",
     "initialize_from_catalog",
     "initialize_from_env",
     "StepWatchdog",

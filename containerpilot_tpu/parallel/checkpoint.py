@@ -211,42 +211,39 @@ def _extract_ema(node: Any) -> Optional[Any]:
     return None
 
 
-def checkpoint_has_ema(directory: str) -> bool:
-    """True when the latest checkpoint's optimizer state carries an
-    EMA shadow subtree — i.e. restore_params(prefer_ema=True) would
-    return shadow weights rather than silently falling back to the
-    raw params. Lets CLI consumers report what they actually scored."""
-    step = latest_step(directory)
-    if step is None:
-        return False
-    import orbax.checkpoint as ocp
+class RestoredParams(tuple):
+    """The ``(params, step)`` pair restore_params hands back, which
+    additionally records on ``.ema`` whether the EMA shadow is what
+    was actually restored — consumers report what they scored from
+    the restore itself, not from a separate metadata probe that can
+    disagree with it (e.g. a transient metadata-read failure on a
+    checkpoint that does carry a shadow)."""
 
-    try:
-        meta = ocp.PyTreeCheckpointer().metadata(
-            _step_path(directory, step)
-        ).item_metadata
-        meta_tree = meta.tree if hasattr(meta, "tree") else meta
-        opt_meta = meta_tree[1]
-    except (KeyError, IndexError, TypeError, AttributeError):
-        return False
-    marker = object()
-    _, found = _swap_in_ema(
-        jax.tree.map(lambda _: None, opt_meta), marker
-    )
-    return found
+    ema: bool
+
+    def __new__(cls, params: Any, step: Any, ema: bool):
+        self = super().__new__(cls, (params, step))
+        self.ema = ema
+        return self
+
+    def __getnewargs__(self):
+        # tuple's default supplies one arg; __new__ needs three, so
+        # pickle/deepcopy would otherwise TypeError
+        return (self[0], self[1], self.ema)
 
 
 def restore_params(
     directory: str, state_like: Any, prefer_ema: bool = False
-) -> Optional[Any]:
+) -> Optional["RestoredParams"]:
     """Restore ONLY the params (and step) of the latest train-state
     checkpoint — optimizer moments are orbax PLACEHOLDERs and never
     leave disk. Serving pays params-sized memory instead of the full
     train state (adam's mu/nu alone double it).
 
     ``state_like`` is a TrainState-shaped pytree of arrays or
-    ShapeDtypeStructs (e.g. from abstract_train_state). Returns
-    (params, step) or None when no checkpoint exists.
+    ShapeDtypeStructs (e.g. from abstract_train_state). Returns a
+    RestoredParams (a ``(params, step)`` tuple with ``.ema``) or None
+    when no checkpoint exists.
 
     ``prefer_ema``: when the checkpoint was written by a with_ema
     optimizer (train.with_ema), return the EMA shadow weights instead
@@ -327,7 +324,7 @@ def restore_params(
     if ema_found:
         ema = _extract_ema(restored.opt_state)
         if ema is not None:
-            return ema, restored.step
+            return RestoredParams(ema, restored.step, True)
         # restored.params are placeholders here (swapped out above);
         # re-restore the raw params rather than hand back sentinels
         log.warning(
@@ -335,4 +332,4 @@ def restore_params(
             "raw params"
         )
         return restore_params(directory, state_like, prefer_ema=False)
-    return restored.params, restored.step
+    return RestoredParams(restored.params, restored.step, False)

@@ -67,7 +67,7 @@ def main() -> int:
     args = parser.parse_args()
 
     from ..models.transformer import TransformerConfig
-    from ..parallel import checkpoint_has_ema, make_mesh
+    from ..parallel import make_mesh
     from .data import TokenShardDataset
 
     cfg = TransformerConfig(
@@ -82,9 +82,6 @@ def main() -> int:
         window=args.window,
         loss_chunk=args.loss_chunk,
     )
-    # reported honestly: the restore falls back to raw params (with a
-    # logged warning) when --use-ema finds no shadow in the checkpoint
-    ema_scored = args.use_ema and checkpoint_has_ema(args.checkpoint_dir)
     restored = restore_merged_params(
         cfg, make_mesh(), args.checkpoint_dir, use_ema=args.use_ema,
         lora_dir=args.lora_dir, lora_rank=args.lora_rank,
@@ -92,6 +89,10 @@ def main() -> int:
     if restored is None:
         raise SystemExit(f"no checkpoint in {args.checkpoint_dir}")
     params, step = restored
+    # reported honestly FROM the restore: .ema says whether the shadow
+    # weights are what actually came back (the restore falls back to
+    # raw params, with a logged warning, when the checkpoint has none)
+    ema_scored = restored.ema
 
     dataset = TokenShardDataset(
         args.data_dir, args.seq_len, args.batch,

@@ -21,8 +21,11 @@ def restore_params_only(
 ) -> Optional[Tuple[Any, int]]:
     """Params-only restore (optionally the EMA shadow) landing on
     ``mesh`` — optimizer moments stay PLACEHOLDERs on disk. Returns
-    (params, checkpoint_step) or None when no checkpoint exists."""
+    (params, checkpoint_step) — with ``.ema`` recording whether the
+    shadow is what actually came back — or None when no checkpoint
+    exists."""
     from ..parallel import abstract_train_state, restore_params
+    from ..parallel.checkpoint import RestoredParams
 
     restored = restore_params(
         checkpoint_dir,
@@ -32,7 +35,7 @@ def restore_params_only(
     if restored is None:
         return None
     params, step = restored
-    return params, int(step)
+    return RestoredParams(params, int(step), restored.ema)
 
 
 def validate_lora_flags(lora_dir: str, lora_rank: int) -> None:
@@ -71,8 +74,11 @@ def restore_merged_params(
     lora_rank: int = 0,
 ) -> Optional[Tuple[Any, int]]:
     """restore_params_only + optional merge_lora, the composition the
-    evaluate CLI scores. Returns (params, checkpoint_step) or None
-    when no checkpoint exists."""
+    evaluate CLI scores. Returns (params, checkpoint_step) — with
+    ``.ema`` from the base restore — or None when no checkpoint
+    exists."""
+    from ..parallel.checkpoint import RestoredParams
+
     validate_lora_flags(lora_dir, lora_rank)
     restored = restore_params_only(cfg, mesh, checkpoint_dir, use_ema)
     if restored is None:
@@ -80,7 +86,7 @@ def restore_merged_params(
     params, step = restored
     if lora_dir:
         params, _ = merge_lora(params, cfg, mesh, lora_dir, lora_rank)
-    return params, step
+    return RestoredParams(params, step, restored.ema)
 
 
 def average_eval_loss(params, cfg, n: int, batch_at) -> float:

@@ -50,6 +50,11 @@ from .serve_prefix import MIN_REUSE, PrefixCache, generate_with_prefix
 
 log = logging.getLogger("containerpilot.serve")
 
+# warmup()'s slot-engine dummy request: this many prompt ids +
+# (chunk+1) new tokens. The construction-time max_len guard and the
+# warm request itself must agree or the guard stops protecting.
+WARMUP_PROMPT_LEN = 4
+
 _GenJob = GenJob  # pre-split name, kept for importers
 
 
@@ -138,6 +143,18 @@ class InferenceServer:
                     "--slots does not compose with --prefill-chunk "
                     "(slot admission prefills one-shot; chunked "
                     "admission is future work)"
+                )
+            # warmup() pushes a dummy request of 4 prompt ids +
+            # (chunk+1) new tokens through the engine; a legal but
+            # tiny --max-len must fail HERE with a clean message, not
+            # after the port is bound with a submit() traceback
+            if WARMUP_PROMPT_LEN + slot_chunk + 1 > max_len:
+                raise ValueError(
+                    f"--slots requires max_len >= slot_chunk + "
+                    f"{WARMUP_PROMPT_LEN + 1} (warmup request needs "
+                    f"{WARMUP_PROMPT_LEN} prompt ids + "
+                    f"chunk+1={slot_chunk + 1} new tokens; max_len is "
+                    f"{max_len})"
                 )
             from .serve_slots import SlotEngine
 
@@ -753,7 +770,8 @@ class InferenceServer:
             # and the (S, K) chunk) so the first live request doesn't
             # stall on multi-second compilation behind a 200 /health
             fut = self.slot_engine.submit(
-                [0, 0, 0, 0], max_new=self.slot_engine.chunk + 1
+                [0] * WARMUP_PROMPT_LEN,
+                max_new=self.slot_engine.chunk + 1,
             )
             await asyncio.wrap_future(fut)
         self.ready = True

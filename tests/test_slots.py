@@ -229,6 +229,24 @@ def test_slots_reject_prefix_cache(params):
         )
 
 
+def test_slots_reject_max_len_too_small_for_warmup(params):
+    """A legal but tiny --max-len must fail at construction with a
+    clean message — not after the port is bound, when warmup()'s
+    dummy request (4 prompt ids + chunk+1 new tokens) would hit
+    submit()'s ValueError and kill the server mid-startup."""
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    with pytest.raises(ValueError, match="max_len >= slot_chunk"):
+        InferenceServer(
+            CFG, params, "127.0.0.1", 0, max_len=8, slots=2,
+            slot_chunk=8,
+        )
+    # the boundary itself is fine: 4 + chunk + 1 == max_len
+    InferenceServer(
+        CFG, params, "127.0.0.1", 0, max_len=9, slots=1, slot_chunk=4,
+    )
+
+
 def test_slot_engine_composes_with_tensor_parallel():
     """The slot pool rides TP-sharded params: the vmapped decode and
     the insert/chunk programs partition under GSPMD, and output stays

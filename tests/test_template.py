@@ -115,3 +115,15 @@ def test_eq_cross_type_raises():
     so `eq .COUNT 2` must fail loudly, not silently pick a branch."""
     with pytest.raises(TemplateError, match="incompatible"):
         render("{{ if eq .COUNT 3 }}x{{ end }}")
+
+
+def test_eq_int_vs_float_raises():
+    """Go treats int vs float literals as incomparable basic kinds
+    (``eq 1 1.0`` errors); Python's 1 == 1.0 must not silently
+    diverge from the reference's wire behavior."""
+    with pytest.raises(TemplateError, match="incompatible"):
+        render("{{ if eq 1 1.0 }}x{{ end }}")
+    with pytest.raises(TemplateError, match="incompatible"):
+        render("{{ if ne 2.0 2 }}x{{ end }}")
+    # matching kinds still compare fine
+    assert render("{{ if eq 1.5 1.5 }}y{{ end }}") == "y"

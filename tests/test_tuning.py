@@ -123,6 +123,46 @@ def test_build_table_picks_fastest_blocks_per_kind():
     assert table["blocks"]["train"]["2048"] == [256, 128]
 
 
+def test_build_table_honesty_guard_rejects_noise_wins():
+    # 256x128 "wins" fwd by nothing (ties) and loses train: neither may
+    # displace the 128/128 default; speedups are recorded per entry
+    results = {
+        "2048": {
+            "xla_fwd_ms": 100, "xla_train_ms": 100,
+            "flash": {
+                "128x128": {"fwd_ms": 5.0, "train_ms": 9.0},
+                "256x128": {"fwd_ms": 5.0, "train_ms": 10.0},
+            },
+        },
+        "4096": {
+            "xla_fwd_ms": 100, "xla_train_ms": 100,
+            "flash": {
+                "128x128": {"fwd_ms": 20.0, "train_ms": 40.0},
+                "256x256": {"fwd_ms": 10.0, "train_ms": 30.0},
+            },
+        },
+    }
+    table = build_table(results, "test")
+    assert table["blocks"]["fwd"]["2048"] == [128, 128]
+    assert table["blocks"]["train"]["2048"] == [128, 128]
+    # a real win still ships, with its measured margin
+    assert table["blocks"]["fwd"]["4096"] == [256, 256]
+    assert table["speedup_vs_default"]["fwd"]["4096"] == 2.0
+    assert table["speedup_vs_default"]["train"]["2048"] == 1.0
+
+
+def test_pick_blocks_rejects_non_tile_seq_loudly():
+    # a seq that isn't a 128-multiple can't be clamped to any honest
+    # block (100 isn't tileable, halving to 2 is degenerate): the
+    # public helper must fail loudly, not feed pallas a bad grid
+    for seq in (64, 100, 192, 2050):
+        with pytest.raises(ValueError, match="flash blocks require"):
+            tuning.pick_blocks("train", seq)
+    # 128-multiples keep clamping to true divisors
+    bq, bk = tuning.pick_blocks("train", 2176)
+    assert 2176 % bq == 0 and 2176 % bk == 0
+
+
 def test_autotune_measure_smoke():
     """End-to-end measure() on the CPU backend (interpret-mode pallas):
     tiny shapes, one candidate — asserts structure and positivity."""

@@ -588,6 +588,21 @@ def test_checkpoint_save_restore_roundtrip(tmp_path):
     assert sorted(os.listdir(ckdir)) == ["step_3"]
 
 
+def test_restored_params_pickles_and_deepcopies():
+    """RestoredParams crosses process boundaries (serving restores in
+    executors); tuple.__getnewargs__ must supply all three ctor args."""
+    import copy
+    import pickle
+
+    from containerpilot_tpu.parallel.checkpoint import RestoredParams
+
+    r = RestoredParams({"w": 1}, 5, True)
+    params, step = r  # stays a 2-tuple for existing unpack sites
+    assert (params, step) == ({"w": 1}, 5)
+    for clone in (pickle.loads(pickle.dumps(r)), copy.deepcopy(r)):
+        assert tuple(clone) == tuple(r) and clone.ema is True
+
+
 def test_restore_params_only(tmp_path):
     """Serving restore: params (and step) come back; optimizer moments
     stay orbax PLACEHOLDERs and are never materialized."""
@@ -2134,9 +2149,14 @@ def test_ema_tracks_params_and_checkpoints(tmp_path):
     from containerpilot_tpu.parallel import restore_params
 
     got_params, got_step = restore_params(str(tmp_path), abstract)
-    got_ema, ema_step = restore_params(
+    ema_restored = restore_params(
         str(tmp_path), abstract, prefer_ema=True
     )
+    got_ema, ema_step = ema_restored
+    # .ema reports what the restore ACTUALLY returned (evaluate's
+    # "ema" report field comes from here, not a metadata re-probe)
+    assert ema_restored.ema is True
+    assert restore_params(str(tmp_path), abstract).ema is False
     assert int(got_step) == int(ema_step) == int(state.step)
     np.testing.assert_allclose(
         np.asarray(got_ema["layers"]["wq"]), got, rtol=0, atol=0
@@ -2153,9 +2173,11 @@ def test_ema_tracks_params_and_checkpoints(tmp_path):
     plain, _ = plain_step(plain, tokens)
     save_checkpoint(str(tmp_path / "plain"), 1, plain)
     plain_abstract = abstract_train_state(jax.random.PRNGKey(0), cfg, mesh)
-    fallback, _ = restore_params(
+    fallback_restored = restore_params(
         str(tmp_path / "plain"), plain_abstract, prefer_ema=True
     )
+    fallback, _ = fallback_restored
+    assert fallback_restored.ema is False  # honest: raw params came back
     np.testing.assert_allclose(
         np.asarray(fallback["layers"]["wq"]),
         np.asarray(plain.params["layers"]["wq"]),
