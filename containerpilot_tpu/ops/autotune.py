@@ -28,9 +28,12 @@ from typing import Dict, Iterable, List, Tuple
 
 log = logging.getLogger("containerpilot.autotune")
 
+from .tuning import DEFAULT_BLOCK
+
 CANDIDATE_BLOCKS = (128, 256, 512)
-DEFAULT_PAIR = (128, 128)  # tuning.DEFAULT_BLOCK squared: the
-# untuned baseline every accepted pair must measurably beat
+# the untuned baseline every accepted pair must measurably beat —
+# derived, so the guard can't drift from pick_blocks' actual fallback
+DEFAULT_PAIR = (DEFAULT_BLOCK, DEFAULT_BLOCK)
 
 
 def _sync(x) -> None:

@@ -97,8 +97,9 @@ def _get_table() -> Optional[dict]:
 
 
 def _largest_divisor_block(seq: int, block: int) -> int:
-    """The largest power-of-two block <= ``block`` dividing seq (the
-    kernels require exact grids). Fails loudly on seq not a multiple
+    """The largest block <= ``block`` dividing seq (halving from
+    ``block``, floored at DEFAULT_BLOCK — the kernels require exact
+    grids). Fails loudly on seq not a multiple
     of DEFAULT_BLOCK: pick_blocks is a public helper (bench/autotune
     call it), and silently clamping to a non-tile block (e.g. 100, or
     a degenerate 2) would hand pallas a grid Mosaic rejects — every
@@ -109,10 +110,12 @@ def _largest_divisor_block(seq: int, block: int) -> int:
             f"flash blocks require seq % {DEFAULT_BLOCK} == 0; got "
             f"seq={seq} (gate the call on flash_eligible)"
         )
-    b = min(block, seq)
+    b = block
     while b > DEFAULT_BLOCK and seq % b != 0:
         b //= 2
-    return b
+    # halving an odd-multiple block can undershoot DEFAULT_BLOCK with
+    # a non-divisor; the floor is always a divisor thanks to the gate
+    return max(b, DEFAULT_BLOCK)
 
 
 def pick_blocks(kind: str, seq: int) -> Tuple[int, int]:

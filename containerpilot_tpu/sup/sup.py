@@ -33,10 +33,30 @@ PASS_THROUGH_SIGNALS = (
 )
 
 
+PR_SET_CHILD_SUBREAPER = 36  # linux/prctl.h
+
+
+def claim_subreaper() -> bool:
+    """Mark this process a child subreaper (ctypes twin of
+    native/sup.cpp's prctl call): orphans of our descendants reparent
+    to US, not to PID 1, so the waitpid(-1) loop actually collects
+    them even when we are not literal PID 1 (systemd on a TPU VM, a
+    test harness, a PID namespace with a shim at 1). Best-effort:
+    returns False on kernels/platforms without the prctl."""
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        return libc.prctl(PR_SET_CHILD_SUBREAPER, 1, 0, 0, 0) == 0
+    except (OSError, AttributeError):
+        return False
+
+
 def run(argv: Optional[List[str]] = None) -> int:
     """Fork the worker and babysit it as PID 1; returns the worker's
     exit code (reference: sup/sup.go:15-30)."""
     argv = argv if argv is not None else sys.argv
+    claim_subreaper()
     worker_pid = os.fork()
     if worker_pid == 0:
         # child: become the real supervisor process

@@ -20,6 +20,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <sys/prctl.h>
 #include <sys/types.h>
 #include <sys/wait.h>
 #include <unistd.h>
@@ -55,6 +56,18 @@ int main(int argc, char **argv) {
   if (argc < 2) {
     fprintf(stderr, "usage: %s <command> [args...]\n", argv[0]);
     return 2;
+  }
+
+  // Orphans only reparent onto us automatically when we are literal
+  // PID 1. Everywhere else — under systemd on a TPU VM, under a test
+  // harness, in a PID namespace where some shim is 1 — we must claim
+  // subreaper status or the waitpid(-1) loop below never sees a
+  // single orphan and "reaping" silently does nothing (reference
+  // proves this arrangement end-to-end:
+  // integration_tests/tests/test_reap_zombies/run.sh:24-30).
+  // Harmless as real PID 1; best-effort on kernels without it.
+  if (prctl(PR_SET_CHILD_SUBREAPER, 1, 0, 0, 0) != 0) {
+    perror("cpsup: prctl(PR_SET_CHILD_SUBREAPER)");
   }
 
   pid_t worker = fork();

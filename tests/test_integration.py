@@ -92,37 +92,201 @@ def test_coprocess_restart_budget_resets_on_reload(run, tmp_path):
     assert run(scenario(), timeout=60)
 
 
-@pytest.mark.skipif(not os.path.exists(CPSUP), reason="cpsup not built")
-def test_cpsup_reaps_zombies():
-    """integration test_reap_zombies: orphans reparented onto cpsup get
-    reaped (reference asserts <=1 transient zombie)."""
-    # worker double-forks: the intermediate parent exits so the
-    # grandchild (which exits fast) reparents to cpsup as a zombie
-    script = (
-        "for i in 1 2 3; do (sh -c 'sleep 0.2' &) ; done; sleep 2"
-    )
-    proc = subprocess.Popen(
-        [CPSUP, "/bin/sh", "-c", script],
-        stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE,
-    )
+def _proc_state_ppid(pid):
+    """(state, ppid) from /proc/<pid>/stat, or None if the process is
+    gone. Split after the last ')' — comm may contain spaces."""
     try:
-        time.sleep(1.2)  # grandchildren exited; cpsup should have reaped
-        zombies = 0
-        for pid_dir in os.listdir("/proc"):
-            if not pid_dir.isdigit():
-                continue
+        with open(f"/proc/{pid}/stat") as f:
+            rest = f.read().rsplit(")", 1)[1].split()
+        return rest[0], int(rest[1])
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+def _drive_orphan_reaper(spawn, tmp_path):
+    """Shared honest-reaping scenario (reference:
+    integration_tests/tests/test_reap_zombies/run.sh:24-30): the
+    worker double-forks an orphan that lingers, so we can assert it
+    actually REPARENTED onto the init process (subreaper) — the old
+    vacuous test counted zombies whose parent was cpsup, of which
+    there were zero by construction because orphans went to the real
+    init — and then that the init's waitpid(-1) loop collected it."""
+    pidfile = tmp_path / "orphan.pid"
+    # ( cmd & ) double-forks: the subshell parent exits at once; the
+    # orphan sleeps until WE kill it, so no assertion races a fixed
+    # lifetime on a loaded single-core box
+    # exec keeps the orphan a single process; >/dev/null detaches it
+    # from the worker's stdio pipes so nothing outlives it holding them
+    script = (
+        f"( sh -c 'echo $$ > {pidfile}; exec sleep 120' "
+        "> /dev/null 2>&1 & ) ; sleep 120"
+    )
+    proc = spawn(script)
+    orphan = None
+    try:
+        deadline = time.monotonic() + 10
+        while True:
+            assert time.monotonic() < deadline, "orphan never spawned"
             try:
-                with open(f"/proc/{pid_dir}/stat") as f:
-                    fields = f.read().split()
-                if fields[2] == "Z" and int(fields[3]) == proc.pid:
-                    zombies += 1
-            except OSError:
-                continue
-        assert zombies <= 1, f"cpsup left {zombies} zombies"
+                orphan = int(pidfile.read_text())
+                break
+            except (OSError, ValueError):
+                time.sleep(0.02)
+        # 1) the orphan must reparent onto the init-under-test while
+        # it is still alive (subreaper status; fails on a cpsup
+        # without PR_SET_CHILD_SUBREAPER: PPID lands on the real init)
+        deadline = time.monotonic() + 10
+        last = None
+        while True:
+            last = _proc_state_ppid(orphan)
+            if last is not None and last[1] == proc.pid:
+                break
+            assert time.monotonic() < deadline, (
+                f"orphan {orphan} never reparented onto the "
+                f"supervisor {proc.pid} (last stat {last}; "
+                "PR_SET_CHILD_SUBREAPER missing?)"
+            )
+            time.sleep(0.02)
+        # 2) kill it: it must be REAPED, not left a zombie child
+        os.kill(orphan, signal.SIGKILL)
+        deadline = time.monotonic() + 10
+        while _proc_state_ppid(orphan) is not None:
+            state, _ = _proc_state_ppid(orphan) or ("", 0)
+            assert time.monotonic() < deadline, (
+                f"orphan {orphan} still present (state {state!r}) — "
+                "the waitpid(-1) loop never collected it"
+            )
+            time.sleep(0.05)
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+@pytest.mark.skipif(not os.path.exists(CPSUP), reason="cpsup not built")
+def test_cpsup_reaps_zombies(tmp_path):
+    """integration test_reap_zombies: orphans reparent onto cpsup
+    (child-subreaper) and its waitpid(-1) loop collects them."""
+    def spawn(script):
+        return subprocess.Popen(
+            [CPSUP, "/bin/sh", "-c", script],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+
+    _drive_orphan_reaper(spawn, tmp_path)
+
+
+def test_sup_py_reaps_zombies(tmp_path):
+    """The Python sup fallback claims subreaper status too (ctypes
+    prctl) and reaps orphans exactly like the native binary."""
+    code = (
+        "import sys; from containerpilot_tpu.sup import run_sup; "
+        "sys.exit(run_sup(['containerpilot', '-config', sys.argv[1]]))"
+    )
+
+    def spawn(script):
+        cfg = write_config(
+            tmp_path,
+            """
+            {
+              stopTimeout: "1ms",
+              jobs: [ { name: "main", exec: ["/bin/sh", "-c", %s] } ],
+            }
+            """ % repr(script),
+        )
+        return subprocess.Popen(
+            [sys.executable, "-c", code, cfg], cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+
+    _drive_orphan_reaper(spawn, tmp_path)
+
+
+def _unshare_available():
+    try:
+        return subprocess.run(
+            ["unshare", "--pid", "--fork", "--mount-proc", "true"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=15,
+        ).returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+@pytest.mark.skipif(not os.path.exists(CPSUP), reason="cpsup not built")
+@pytest.mark.skipif(
+    not _unshare_available(), reason="unshare --pid not permitted"
+)
+def test_container_entrypoint_arrangement_ns_pid1(tmp_path):
+    """The Dockerfile's ENTRYPOINT arrangement — cpsup as literal
+    PID 1 running `python -m containerpilot_tpu -config ...` — driven
+    in a PID namespace (`unshare --pid --fork --mount-proc`), docker
+    not required (reference: integration_tests/tests/
+    test_reap_zombies/run.sh:14-36 runs the same shape in-container).
+
+    Asserts, from inside the namespace: the orphan reparents to PID 1
+    (cpsup), and after it exits no zombie remains in the ns /proc;
+    from outside: all jobs complete -> supervisor exit 0 propagates
+    through cpsup and unshare."""
+    report = tmp_path / "report.txt"
+    probe = tmp_path / "probe.sh"
+    probe.write_text(
+        """#!/bin/sh
+# runs as the supervisor's job INSIDE the pid ns (mount-proc'd).
+# Poll, never fixed-sleep: the box has one core and fixed lifetimes
+# race under load. The orphan sleeps until we kill it.
+# exec -> the orphan is one process; /dev/null -> it does not hold
+# the job's stdout pipe open past the probe (the supervisor waits on
+# pipe EOF after the job exits)
+( sh -c 'echo $$ > {tmp}/orphan.pid; exec sleep 120' \
+  > /dev/null 2>&1 & )
+i=0
+while [ ! -s {tmp}/orphan.pid ] && [ $i -lt 200 ]; do
+  i=$((i + 1)); sleep 0.05
+done
+read OP < {tmp}/orphan.pid
+# after the intermediate subshell exits the orphan's parent must
+# become the namespace's PID 1 = cpsup
+i=0; P=unset
+while [ $i -lt 200 ]; do
+  P=$(awk '{{print $4}}' /proc/$OP/stat 2>/dev/null || echo gone)
+  [ "$P" = 1 ] && break
+  i=$((i + 1)); sleep 0.05
+done
+echo "orphan_ppid=$P" >> {report}
+# kill it: PID 1's waitpid(-1) loop must collect the zombie
+kill -9 $OP
+i=0
+while [ -e /proc/$OP ] && [ $i -lt 200 ]; do
+  i=$((i + 1)); sleep 0.05
+done
+if [ -e /proc/$OP ]; then R=no; else R=yes; fi
+echo "reaped=$R" >> {report}
+echo "init_comm=$(awk '{{print $2}}' /proc/1/stat)" >> {report}
+""".format(tmp=tmp_path, report=report)
+    )
+    probe.chmod(0o755)
+    cfg = write_config(
+        tmp_path,
+        """
+        { stopTimeout: "1ms",
+          jobs: [ { name: "probe", exec: "%s" } ] }
+        """ % probe,
+    )
+    proc = subprocess.run(
+        ["unshare", "--pid", "--fork", "--mount-proc",
+         CPSUP, sys.executable, "-m", "containerpilot_tpu",
+         "-config", cfg],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout.decode()
+    got = dict(
+        line.split("=", 1)
+        for line in report.read_text().splitlines() if "=" in line
+    )
+    assert got["orphan_ppid"] == "1", got   # reparented onto cpsup
+    assert got["reaped"] == "yes", got      # and actually collected
+    assert got["init_comm"] == "(cpsup)", got
 
 
 @pytest.mark.skipif(not os.path.exists(CPSUP), reason="cpsup not built")
