@@ -41,6 +41,9 @@ from containerpilot_tpu.events import EventBus, GLOBAL_STARTUP
 from containerpilot_tpu.jobs import Job, JobConfig
 
 BASELINE_MS = 35.0  # midpoint of the reference's documented 20-50ms
+MFU_TARGET = 0.35   # the docs/50-workload.md "MFU target" contract
+# (v5e, seq 2048 / batch 8 bench config); training_bench stamps its
+# measurement with meets_target so BENCH_r{N}.json self-reports
 CYCLES = 60
 WARMUP = 5
 
@@ -253,6 +256,11 @@ def training_bench() -> dict:
         "remat_variants": variants,
         "best_remat": best_name,
         **best,
+        # the stated perf contract (docs/50-workload.md "MFU target"):
+        # the measurement carries its own verdict so the artifact is
+        # self-evidencing
+        "target_mfu": MFU_TARGET,
+        "meets_target": best["mfu"] >= MFU_TARGET,
         "device": device_kind,
     }
 

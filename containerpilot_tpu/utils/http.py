@@ -49,6 +49,40 @@ class Response:
         self.headers = headers or {}
 
 
+class StreamingResponse:
+    """A response whose body arrives incrementally from an async
+    iterator of byte chunks (SSE events, chunk-boundary token
+    deltas). Sent with ``Connection: close`` and no Content-Length:
+    the closing connection delimits the stream, which every HTTP/1.1
+    client understands and which keeps this server's one-request-per-
+    connection model intact.
+
+    Client disconnects are detected promptly (the reader hits EOF)
+    and the iterator is ``aclose()``d, so a handler generator's
+    ``finally`` can release what the request holds (e.g. free a slot
+    mid-generation)."""
+
+    def __init__(
+        self,
+        chunks,  # AsyncIterator[bytes]
+        status: int = 200,
+        content_type: str = "text/event-stream",
+        headers: Optional[Dict[str, str]] = None,
+        close: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self.status = status
+        self.chunks = chunks
+        self.content_type = content_type
+        self.headers = headers or {}
+        # aclose() on a NEVER-STARTED async generator skips its body
+        # entirely (an immediate disconnect aborts before the first
+        # __anext__), so generator-finally cleanup alone is not
+        # enough: ``close`` is invoked unconditionally when the
+        # stream ends, however it ends. Make it idempotent — the
+        # generator's own finally may run too.
+        self.close = close
+
+
 _REASONS = {
     200: "OK",
     400: "Bad Request",
@@ -128,6 +162,9 @@ class HTTPServer:
             except Exception:
                 log.exception("request handling failed")
                 response = Response(500, b"internal server error\n")
+        if isinstance(response, StreamingResponse):
+            await self._write_stream(reader, writer, response)
+            return
         try:
             reason = _REASONS.get(response.status, "Unknown")
             headers = {
@@ -144,6 +181,76 @@ class HTTPServer:
         except (ConnectionError, BrokenPipeError):
             pass
         finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _write_stream(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        response: StreamingResponse,
+    ) -> None:
+        """Send head, then relay chunks as they arrive; abort the
+        moment the client goes away. Each chunk wait races a read on
+        the request side of the socket — EOF there is the earliest
+        reliable disconnect signal (drain() only fails on a later
+        write)."""
+        agen = response.chunks
+        eof_task = asyncio.ensure_future(reader.read())
+        try:
+            reason = _REASONS.get(response.status, "Unknown")
+            headers = {
+                "Content-Type": response.content_type,
+                "Cache-Control": "no-store",
+                "Connection": "close",
+                **response.headers,
+            }
+            head = f"HTTP/1.1 {response.status} {reason}\r\n" + "".join(
+                f"{k}: {v}\r\n" for k, v in headers.items()
+            )
+            writer.write(head.encode() + b"\r\n")
+            await writer.drain()
+            while True:
+                get_task = asyncio.ensure_future(agen.__anext__())
+                await asyncio.wait(
+                    {get_task, eof_task},
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if eof_task.done():
+                    get_task.cancel()
+                    try:
+                        await get_task
+                    except (StopAsyncIteration, asyncio.CancelledError,
+                            Exception):
+                        pass
+                    break
+                chunk = get_task.result()  # raises StopAsyncIteration
+                writer.write(chunk)
+                await writer.drain()
+        except StopAsyncIteration:
+            pass
+        except (ConnectionError, BrokenPipeError):
+            pass
+        except Exception:
+            log.exception("stream write failed")
+        finally:
+            eof_task.cancel()
+            try:
+                await eof_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            try:
+                await agen.aclose()  # run the generator's cleanup
+            except Exception:
+                log.exception("stream close failed")
+            if response.close is not None:
+                try:
+                    response.close()
+                except Exception:
+                    log.exception("stream close callback failed")
             try:
                 writer.close()
                 await writer.wait_closed()

@@ -33,6 +33,9 @@ from .modelcfg import average_eval_loss, derive_d_ff, restore_merged_params
 
 
 def main() -> int:
+    from .modelcfg import enable_compile_cache
+
+    enable_compile_cache()
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--checkpoint-dir", required=True)
     parser.add_argument("--data-dir", required=True)

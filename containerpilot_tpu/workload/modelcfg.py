@@ -10,6 +10,30 @@ from typing import Any, Optional, Tuple
 import jax
 
 
+def enable_compile_cache() -> Optional[str]:
+    """Opt-in persistent XLA compilation cache, shared by every
+    workload CLI (env: ``CONTAINERPILOT_COMPILE_CACHE=<dir>``).
+
+    The supervisor's whole failure story is crash→restart→resume; the
+    dominant cost of a reincarnation is recompiling the exact
+    programs the dead process already compiled. With the cache on
+    shared storage a restarted trainer or pod member re-warms from
+    cached executables, directly shrinking the restart window the
+    supervisor's budgets (and a serving pod's downtime) pay for.
+    Returns the cache dir when enabled, else None."""
+    import os
+
+    path = os.environ.get("CONTAINERPILOT_COMPILE_CACHE", "")
+    if not path:
+        return None
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # default min-compile-time gate (1s) would skip most of a tiny
+    # model's programs; anything over half a second is worth a disk hit
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return path
+
+
 def derive_d_ff(d_model: int) -> int:
     """The triad's shared SwiGLU width rule: ~3x d_model, floored to
     a 128 multiple (MXU tile), never 0."""

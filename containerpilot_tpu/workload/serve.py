@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.transformer import TransformerConfig
-from ..utils.http import HTTPServer, Request, Response
+from ..utils.http import HTTPServer, Request, Response, StreamingResponse
 from . import serve_strategies
 from .serve_batcher import Batcher, GenJob
 from .serve_cli import main  # noqa: F401  (one import path for the CLI)
@@ -321,6 +321,8 @@ class InferenceServer:
                     self.slot_engine.stats
                     if self.slot_engine is not None else None
                 ),
+                # SSE streaming rides the slot engine's chunks
+                "stream": self.slot_engine is not None,
             }
         ).encode()
         return Response(200, body, content_type="application/json")
@@ -546,6 +548,8 @@ class InferenceServer:
                 body, self.cfg.vocab_size, min_row_len=1
             )
             p = self._parse_sampling(body, tokens, prompt_len)
+            if bool(body.get("stream", False)):
+                return self._generate_stream(tokens, p)
         except (ValueError, KeyError, TypeError) as exc:
             return Response(422, f"{exc}\n".encode())
 
@@ -565,6 +569,93 @@ class InferenceServer:
             content_type="application/json",
         )
 
+    def _generate_stream(
+        self, tokens: List[List[int]], p: Dict[str, Any]
+    ) -> "StreamingResponse":
+        """SSE token streaming over the slot engine's chunk
+        boundaries: each emitted delta becomes a ``data:`` event, the
+        terminal event carries ``done``; concatenating the deltas
+        byte-matches the non-streamed response's row (the engine's
+        emission IS the post-trim output). A client disconnect sets
+        the cancel event — the engine frees the slot at the next
+        chunk boundary instead of decoding to the end."""
+        if self.slot_engine is None:
+            raise ValueError(
+                "stream requires --slots (token streaming rides the "
+                "slot engine's chunk boundaries)"
+            )
+        if len(tokens) != 1:
+            raise ValueError("stream serves a single row per request")
+        for knob, why in (
+            ("logprobs", "echo logprobs need the full row"),
+            ("beam_width", "beams have no incremental prefix"),
+            ("stop", "stop sequences need whole-row trimming"),
+        ):
+            if p[knob]:
+                raise ValueError(f"stream does not compose with "
+                                 f"{knob} ({why})")
+
+        import threading as threading_mod
+
+        loop = asyncio.get_event_loop()
+        deltas: "asyncio.Queue" = asyncio.Queue()
+        _DONE = object()
+        cancel = threading_mod.Event()
+
+        def on_tokens(delta: List[int]) -> None:  # worker thread
+            loop.call_soon_threadsafe(deltas.put_nowait, delta)
+
+        fut = self.slot_engine.submit(
+            tokens[0], p["max_new_requested"],
+            temperature=p["temperature"], top_k=p["top_k"],
+            top_p=p["top_p"], eos_id=p["eos_id"], seed=p["seed"],
+            min_new=p["min_new"],
+            presence_penalty=p["presence"],
+            frequency_penalty=p["frequency"],
+            on_tokens=on_tokens, cancel=cancel,
+        )
+        fut.add_done_callback(
+            lambda _f: loop.call_soon_threadsafe(deltas.put_nowait, _DONE)
+        )
+
+        sent = [0]
+        finished = [False]
+
+        def finish() -> None:
+            # runs on ANY stream end — completion, mid-stream
+            # disconnect (generator finally), or a disconnect so
+            # early the generator never started (StreamingResponse
+            # close callback). Idempotent: both paths may fire.
+            if finished[0]:
+                return
+            finished[0] = True
+            cancel.set()  # the engine stops decoding this row
+            self._m_tokens.inc(sent[0])
+
+        async def events():
+            try:
+                while True:
+                    delta = await deltas.get()
+                    if delta is _DONE:
+                        break
+                    sent[0] += len(delta)
+                    yield (
+                        b"data: "
+                        + json.dumps({"tokens": delta}).encode()
+                        + b"\n\n"
+                    )
+                yield (
+                    b"data: "
+                    + json.dumps(
+                        {"done": True, "count": sent[0]}
+                    ).encode()
+                    + b"\n\n"
+                )
+            finally:
+                finish()
+
+        return StreamingResponse(events(), close=finish)
+
     async def _completions(self, req: Request) -> Response:
         """Text in/out over the built-in byte-level tokenizer: encode
         the prompt, run the exact same decode dispatch as
@@ -575,6 +666,16 @@ class InferenceServer:
         as token-level stop sequences, excluded from the output."""
         try:
             body = json.loads(req.body.decode() or "{}")
+            if bool(body.get("stream", False)):
+                # honest 422 instead of a silently-plain 200 an SSE
+                # client would hang on: text deltas would need UTF-8
+                # partial-byte holdback (the byte tokenizer can split
+                # a multibyte char across chunks) — token-level
+                # streaming lives on /v1/generate
+                raise ValueError(
+                    "streaming is token-level; use /v1/generate with "
+                    "\"stream\": true"
+                )
             prompt = body.get("prompt")
             if not isinstance(prompt, str) or not prompt:
                 raise ValueError("'prompt' must be a non-empty string")

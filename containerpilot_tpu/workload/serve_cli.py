@@ -217,8 +217,10 @@ def load_model(args: argparse.Namespace):
 
 
 def main() -> int:
+    from .modelcfg import enable_compile_cache
     from .serve import InferenceServer
 
+    enable_compile_cache()
     args = build_arg_parser().parse_args()
     cfg, params = load_model(args)
     server = InferenceServer(

@@ -20,10 +20,25 @@ health-checks process liveness, e.g. ``kill -0
 $CONTAINERPILOT_<JOB>_PID``).
 
 Shutdown: SIGTERM on process 0 broadcasts a shutdown op so followers
-exit cleanly; a follower dying mid-request wedges the pod's
-collectives, which the supervisor handles the same way it does for
-training (restart budgets; the frontend exits on the failed
-collective).
+exit cleanly.
+
+Failure detection (``--watchdog``): serving gets the same
+decode-progress deadline training has (parallel/watchdog.py). The
+frontend broadcasts OP_HEARTBEAT whenever the pod is idle, so every
+process — frontend and followers alike — completes a broadcast(+
+decode) cycle at least every watchdog/4 seconds and beat()s its
+StepWatchdog. A follower that wedges mid-decode (or dies) stalls the
+NEXT cycle pod-wide: every peer's watchdog turns its silent
+collective hang into a hard exit (code 86) the supervisor's restart
+budgets absorb, and the reincarnated pod re-rendezvouses through the
+catalog — a wedged-but-alive follower can no longer hang the
+frontend indefinitely.
+
+Parallelism: ``--dp`` splits the global device count into a
+(data, model) mesh — ``--dp 2`` over 4 processes serves on a 2x2
+dp x tp mesh (params sharded over model, replicated over data), so
+tensor parallelism crosses process boundaries exactly as a real pod's
+does.
 
     python -m containerpilot_tpu.workload.serve_dist \
         --process-id 0 --num-processes 2 --catalog 127.0.0.1:8500 \
@@ -39,8 +54,10 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import queue
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -51,6 +68,9 @@ log = logging.getLogger("containerpilot.serve_dist")
 
 OP_SHUTDOWN = 0
 OP_GENERATE = 1
+OP_HEARTBEAT = 2  # idle liveness tick: bounds every broadcast wait
+
+WATCHDOG_EXIT = 86  # parallel.watchdog.EXIT_CODE — same semantics
 
 
 def _payload_zeros(max_len: int) -> Dict[str, np.ndarray]:
@@ -297,7 +317,9 @@ def main() -> int:
     from ..discovery.consul import ConsulBackend
     from ..models.transformer import TransformerConfig, init_params
     from ..parallel import MeshPlan, initialize_from_catalog, make_mesh
-    from .modelcfg import derive_d_ff
+    from .modelcfg import derive_d_ff, enable_compile_cache
+
+    enable_compile_cache()
 
     parser = argparse.ArgumentParser(
         description="multi-host pod inference server"
@@ -320,7 +342,38 @@ def main() -> int:
                         "restores in lockstep (orbax is a global "
                         "checkpointer)")
     parser.add_argument("--use-ema", action="store_true")
+    parser.add_argument("--dp", type=int, default=1,
+                        help="data-parallel axis size: the global "
+                        "device count factors as (dp, devices/dp) — "
+                        "model shards over the inner axis")
+    parser.add_argument("--watchdog", type=float, default=0.0,
+                        help="decode-progress deadline in seconds "
+                        "(0 = off): every process hard-exits %d when "
+                        "a broadcast+decode cycle stalls past it, so "
+                        "a wedged peer becomes a supervisor restart "
+                        "instead of a silent pod hang"
+                        % WATCHDOG_EXIT)
+    parser.add_argument("--startup-grace", type=float, default=300.0,
+                        help="first-beat grace covering rendezvous + "
+                        "restore + warmup compile")
+    parser.add_argument("--wedge-file", default="",
+                        help="fault injection (tests): when this file "
+                        "exists, a follower consumes it and wedges — "
+                        "stops making progress without exiting — to "
+                        "prove the watchdog path")
     args = parser.parse_args()
+
+    # armed BEFORE rendezvous (the trainer's pattern): a peer that
+    # died between catalog registration and its first collective
+    # wedges our rendezvous/warmup just as silently as a mid-serve
+    # death, and the grace window covers the startup compile
+    dog = None
+    if args.watchdog > 0:
+        from ..parallel import StepWatchdog
+
+        dog = StepWatchdog(
+            args.watchdog, exit_code=WATCHDOG_EXIT
+        ).start(grace_s=max(args.startup_grace, args.watchdog))
 
     kw = {}
     if args.coordinator_port:
@@ -342,12 +395,18 @@ def main() -> int:
         max_seq_len=args.max_len,
     )
     n_global = jax.device_count()
-    if cfg.n_heads % n_global:
+    if args.dp < 1 or n_global % args.dp:
         raise SystemExit(
-            f"{n_global} global devices must divide n_heads "
-            f"{cfg.n_heads}"
+            f"--dp {args.dp} must divide the {n_global} global devices"
         )
-    mesh = make_mesh(jax.devices(), plan=MeshPlan(data=1, model=n_global))
+    n_model = n_global // args.dp
+    if cfg.n_heads % n_model:
+        raise SystemExit(
+            f"model axis {n_model} must divide n_heads {cfg.n_heads}"
+        )
+    mesh = make_mesh(
+        jax.devices(), plan=MeshPlan(data=args.dp, model=n_model)
+    )
     if args.checkpoint_dir:
         from .modelcfg import restore_params_only
 
@@ -372,7 +431,8 @@ def main() -> int:
         )
         frontend.start()
         print(f"pod frontend on {args.host}:{frontend.port} "
-              f"({n_global} global devices, model={n_global})",
+              f"({n_global} global devices, data={args.dp} "
+              f"model={n_model})",
               flush=True)
 
     # warmup in lockstep before /health goes 200: same dummy payload
@@ -381,6 +441,8 @@ def main() -> int:
         {"tokens": [0, 0, 0, 0], "max_new": 8}, args.max_len
     )
     np.asarray(_decode_pod(params, cfg, warm, args.max_len))
+    if dog is not None:
+        dog.beat()  # startup done: tighten to the serve deadline
     if frontend is not None:
         frontend.ready = True
         print("pod warm; accepting traffic", flush=True)
@@ -399,22 +461,53 @@ def main() -> int:
 
     from .serve import InferenceServer
 
+    # the pod must tick at least this often for followers' broadcast
+    # waits to be bounded (the watchdog can only see completed cycles)
+    heartbeat_every = args.watchdog / 4 if args.watchdog > 0 else None
+
     while True:
         work = done_q = None
         if frontend is not None:
+            idle_since = time.monotonic()
             while work is None and not stopping.is_set():
                 try:
                     work, done_q = frontend.requests.get(timeout=0.25)
                 except queue.Empty:
+                    if (
+                        heartbeat_every is not None
+                        and time.monotonic() - idle_since
+                        >= heartbeat_every
+                    ):
+                        break  # tick the pod, then resume waiting
                     continue
-            payload = (
-                _payload_zeros(args.max_len) if stopping.is_set()
-                else _payload_for(work, args.max_len)
-            )
+            if stopping.is_set():
+                payload = _payload_zeros(args.max_len)
+            elif work is None:
+                payload = _payload_zeros(args.max_len)
+                payload["op"] = np.asarray(OP_HEARTBEAT, np.int32)
+            else:
+                payload = _payload_for(work, args.max_len)
         else:
             payload = _payload_zeros(args.max_len)
+            if args.wedge_file and os.path.exists(args.wedge_file):
+                # fault injection: consume the trigger (wedge ONCE, so
+                # the reincarnation comes back healthy) and stop
+                # making progress without exiting — exactly what a
+                # stuck decode looks like to the rest of the pod
+                try:
+                    os.remove(args.wedge_file)
+                except OSError:
+                    pass
+                print("follower: injected wedge", flush=True)
+                while True:
+                    time.sleep(3600)
         payload = multihost_utils.broadcast_one_to_all(payload)
-        if int(payload["op"]) == OP_SHUTDOWN:
+        op = int(payload["op"])
+        if op == OP_HEARTBEAT:
+            if dog is not None:
+                dog.beat()
+            continue
+        if op == OP_SHUTDOWN:
             # SIGTERM may have raced an in-flight dequeue (and more
             # requests may still be queued): every waiting handler
             # must get an answer or its executor thread blocks
@@ -432,6 +525,8 @@ def main() -> int:
             break
         try:
             out = _decode_pod(params, cfg, payload, args.max_len)
+            if dog is not None:
+                dog.beat()
             if done_q is not None:
                 # one trim convention pod-wide: the single-host
                 # server's (slice to the REQUESTED length, then cut
@@ -444,6 +539,8 @@ def main() -> int:
             if done_q is not None:
                 done_q.put(exc)
             raise
+    if dog is not None:
+        dog.stop()
     if frontend is not None:
         frontend.stop()
         print("pod frontend stopped", flush=True)

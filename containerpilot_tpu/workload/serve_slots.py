@@ -56,6 +56,13 @@ class _Request:
     min_new: int = 0
     presence: float = 0.0
     frequency: float = 0.0
+    # streaming: called from the worker thread with each newly emitted
+    # token delta (already eos/max_new-capped — concatenation equals
+    # the future's final result exactly)
+    on_tokens: Optional[callable] = None
+    # cooperative cancel (client disconnect): the worker frees the
+    # slot at the next chunk boundary instead of decoding to the end
+    cancel: Optional[threading.Event] = None
     future: Future = field(default_factory=Future)
 
 
@@ -129,8 +136,15 @@ class SlotEngine:
         min_new: int = 0,
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0,
+        on_tokens: Optional[callable] = None,
+        cancel: Optional[threading.Event] = None,
     ) -> Future:
-        """Queue one sequence; resolves to its generated ids."""
+        """Queue one sequence; resolves to its generated ids.
+
+        ``on_tokens`` (worker-thread callback) streams each emitted
+        delta; ``cancel`` (a threading.Event the caller sets, e.g. on
+        client disconnect) frees the slot at the next chunk boundary —
+        the future then resolves with whatever was emitted."""
         if max_new < 1:
             raise ValueError("max_new must be >= 1")
         if not 0 <= min_new <= max_new:
@@ -151,6 +165,7 @@ class SlotEngine:
             seed=int(seed), min_new=int(min_new),
             presence=float(presence_penalty),
             frequency=float(frequency_penalty),
+            on_tokens=on_tokens, cancel=cancel,
         )
         # atomic with stop()'s drain: either this put lands before the
         # drain (and gets cancelled there) or the stopped check raises
@@ -230,6 +245,7 @@ class SlotEngine:
             state.finished = True
         self._done[slot_id] = state.finished
         self._active[slot_id] = state
+        self._notify(req, [first_host])
 
     def _harvest(self, slot_id: int) -> None:
         state = self._active[slot_id]
@@ -244,8 +260,42 @@ class SlotEngine:
         if not req.future.done():
             req.future.set_result(out)
 
+    @staticmethod
+    def _notify(req: _Request, delta: List[int]) -> None:
+        """Deliver a streamed delta; a raising callback (e.g. the
+        consumer's event loop already closed in a shutdown race) must
+        never escape into _run — it would kill the worker thread and
+        strand every in-flight future while /health stays 200."""
+        if req.on_tokens is None:
+            return
+        try:
+            req.on_tokens(list(delta))
+        except Exception:  # noqa: BLE001
+            log.exception("on_tokens callback failed; dropping delta")
+
+    def _sweep_cancelled(self) -> None:
+        """Free slots whose requests were cancelled (client gone):
+        the slot returns to the pool at this chunk boundary and the
+        future resolves with the partial emission (nobody is usually
+        waiting — the disconnect is why we're here)."""
+        for i, s in enumerate(self._active):
+            if (
+                s is not None
+                and s.req.cancel is not None
+                and s.req.cancel.is_set()
+            ):
+                self._active[i] = None
+                self._done[i] = True
+                if not s.req.future.done():
+                    s.req.future.set_result(list(s.emitted))
+                log.info(
+                    "slot %d freed mid-generation (%d/%d tokens): "
+                    "request cancelled", i, len(s.emitted), s.req.max_new,
+                )
+
     def _run(self) -> None:
         while not self._stopped.is_set():
+            self._sweep_cancelled()
             free = [i for i, s in enumerate(self._active) if s is None]
             any_active = any(s is not None for s in self._active)
             # block for work only when fully idle; otherwise drain
@@ -257,6 +307,9 @@ class SlotEngine:
                     if req is None:  # stop sentinel
                         return
                     block = False
+                    if req.cancel is not None and req.cancel.is_set():
+                        req.future.cancel()  # left before admission
+                        continue
                     try:
                         self._admit(free.pop(0), req)
                     except Exception as exc:  # noqa: BLE001
@@ -314,12 +367,15 @@ class SlotEngine:
                 if state is None:
                     continue
                 req = state.req
+                before = len(state.emitted)
                 for t in toks_host[i]:
                     if len(state.emitted) >= req.max_new:
                         break
                     state.emitted.append(int(t))
                     if int(t) == req.eos_id:
                         break
+                if len(state.emitted) > before:
+                    self._notify(req, state.emitted[before:])
                 ended = (
                     len(state.emitted) >= req.max_new
                     or (req.eos_id >= 0 and req.eos_id in state.emitted)

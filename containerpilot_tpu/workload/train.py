@@ -36,6 +36,9 @@ import jax.numpy as jnp
 
 
 def main() -> int:
+    from .modelcfg import enable_compile_cache
+
+    enable_compile_cache()
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--steps", type=int, default=100)
     parser.add_argument("--batch", type=int, default=8)

@@ -29,6 +29,119 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def run_mesh(args, dog) -> int:
+    """The production-path variant: a (data, model) mesh via
+    parallel.train — init_train_state / make_train_step / sharded
+    save+restore — so the capstone's crash/restart/resume story runs
+    over cross-process TENSOR parallelism, not just pmap dp. The
+    global batch is a pure function of the step on every process
+    (make_array_from_callback slices it), so loss parity with a
+    1-process --tp 1 baseline holds by construction."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from containerpilot_tpu.models.transformer import TransformerConfig
+    from containerpilot_tpu.parallel import (
+        MeshPlan,
+        abstract_train_state,
+        init_train_state,
+        latest_step,
+        make_mesh,
+        make_train_step,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+    from containerpilot_tpu.parallel.sharding import batch_spec
+
+    n_global = jax.device_count()
+    assert n_global % args.tp == 0, (n_global, args.tp)
+    plan = MeshPlan(data=n_global // args.tp, model=args.tp)
+    mesh = make_mesh(jax.devices(), plan=plan)
+    assert args.global_batch % plan.data == 0
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=128,
+        max_seq_len=16, dtype=jnp.float32, flash_min_seq=0,
+    )
+    seq = cfg.max_seq_len
+    lr = 1e-2
+
+    rng = jax.random.PRNGKey(0)
+    state = init_train_state(rng, cfg, mesh, learning_rate=lr)
+    start = 0
+    restored = restore_checkpoint(
+        args.checkpoint_dir,
+        abstract_train_state(rng, cfg, mesh, lr),
+    )
+    if restored is not None:
+        state = restored
+        start = latest_step(args.checkpoint_dir)
+        print(f"worker {args.process_id}: resumed at step {start} "
+              f"(mesh {plan.data}x{plan.model})", flush=True)
+
+    step_fn = make_train_step(cfg, mesh, learning_rate=lr)
+    batch_sharding = NamedSharding(mesh, batch_spec())
+
+    def global_batch_for(step: int):
+        rows = jax.device_get(
+            jax.random.randint(
+                jax.random.PRNGKey(10_000 + step),
+                (args.global_batch, seq + 1), 0, cfg.vocab_size,
+                jnp.int32,
+            )
+        )
+        return jax.make_array_from_callback(
+            rows.shape, batch_sharding, lambda idx: rows[idx]
+        )
+
+    digest_fn = jax.jit(
+        lambda p: sum(
+            jnp.sum(jnp.abs(x.astype(jnp.float32)))
+            for x in jax.tree.leaves(p)
+        )
+    )
+
+    final_loss = None
+    for step in range(start, args.steps):
+        state, loss = step_fn(state, global_batch_for(step))
+        final_loss = float(jax.device_get(loss))
+        dog.beat()
+        if args.heartbeat_file:
+            with open(args.heartbeat_file, "w") as fh:
+                fh.write(str(step))
+        # sharded save in lockstep on the pod's ONE shared directory
+        save_checkpoint(args.checkpoint_dir, step + 1, state)
+        dog.beat()
+        print(f"worker {args.process_id}: step {step} loss "
+              f"{final_loss:.5f}", flush=True)
+        if step == args.crash_step and args.crash_sentinel:
+            if not os.path.exists(args.crash_sentinel):
+                with open(args.crash_sentinel, "w") as fh:
+                    fh.write(str(step))
+                print(f"worker {args.process_id}: injected crash after "
+                      f"step {step}", flush=True)
+                sys.stdout.flush()
+                os._exit(1)
+    digest = float(jax.device_get(digest_fn(state.params)))
+    dog.stop()
+
+    with open(args.out, "w") as fh:
+        json.dump(
+            {
+                "process_id": args.process_id,
+                "final_loss": final_loss,
+                "params_digest": digest,
+                "resumed_from": start,
+            },
+            fh,
+        )
+    print(f"worker {args.process_id}: done (loss {final_loss:.5f})",
+          flush=True)
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--process-id", type=int, required=True)
@@ -44,6 +157,14 @@ def main() -> int:
     parser.add_argument("--step-timeout", type=float, default=30.0)
     parser.add_argument("--startup-timeout", type=float, default=150.0)
     parser.add_argument("--heartbeat-file", default="")
+    parser.add_argument("--tp", type=int, default=0,
+                        help="tensor-parallel axis size: > 0 switches "
+                        "from the pmap data-parallel path to the "
+                        "production mesh path (parallel.train: "
+                        "make_mesh + init_train_state + "
+                        "make_train_step + sharded checkpointing) on "
+                        "a (devices/tp, tp) dp x tp mesh — tensor "
+                        "parallelism then crosses process boundaries")
     args = parser.parse_args()
 
     import jax
@@ -94,6 +215,9 @@ def main() -> int:
     dog = StepWatchdog(args.step_timeout).start(
         grace_s=max(args.startup_timeout, args.step_timeout)
     )
+
+    if args.tp > 0:
+        return run_mesh(args, dog)
 
     n_global = jax.device_count()
     n_local = jax.local_device_count()

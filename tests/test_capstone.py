@@ -1,10 +1,11 @@
 """The SURVEY §7 capstone: supervised multi-process training e2e.
 
-Two supervisor instances (the real CLI, real configs) each run a
+N supervisor instances (the real CLI, real configs) each run a
 training worker job. The workers rendezvous through a live catalog
 server (``-catalog-server``, the supervisor's own daemon), complete a
-data-parallel run over a 2-process CPU mesh, and checkpoint every
-step. A fault is injected: one worker crashes mid-run; its peer's
+pod run over an N-process CPU mesh — pmap data-parallel at N=2, the
+production 2x2 dp x tp mesh path (parallel.train + sharded
+checkpointing) at N=4 — and checkpoint every step. A fault is injected: one worker crashes mid-run; its peer's
 step watchdog turns the resulting collective hang into an exit; BOTH
 supervisors apply their restart budgets; the reincarnated pod
 re-rendezvouses and resumes from the latest checkpoint.
@@ -61,7 +62,7 @@ def _wait_http(url: str, deadline_s: float = 30) -> None:
 
 def _supervisor_config(
     tmp_path, idx: int, catalog_port: int, coord_port: int,
-    job_port: int, crash_idx: int = 1,
+    job_port: int, crash_idx: int = 1, n_procs: int = 2, tp: int = 0,
 ) -> str:
     # ONE shared checkpoint dir for the pod (orbax is a global
     # checkpointer: primary-process writes + cross-process barriers;
@@ -73,17 +74,21 @@ def _supervisor_config(
     exec_argv = [
         sys.executable, WORKER,
         "--process-id", str(idx),
-        "--num-processes", "2",
+        "--num-processes", str(n_procs),
         "--catalog", f"127.0.0.1:{catalog_port}",
         "--coordinator-port", str(coord_port),
         "--steps", str(STEPS),
         "--global-batch", str(GLOBAL_BATCH),
         "--checkpoint-dir", str(ckpt),
         "--out", str(out),
-        "--step-timeout", "30",
-        "--startup-timeout", "120",
+        # the single-core box serializes n_procs compiles: scale the
+        # deadlines with the pod size
+        "--step-timeout", str(30 * max(1, n_procs // 2)),
+        "--startup-timeout", str(120 * max(1, n_procs // 2)),
         "--heartbeat-file", str(heartbeat),
     ]
+    if tp:
+        exec_argv += ["--tp", str(tp)]
     if idx == crash_idx:
         exec_argv += [
             "--crash-step", str(CRASH_STEP),
@@ -100,9 +105,9 @@ def _supervisor_config(
                 "name": f"trainer{idx}",
                 "exec": exec_argv,
                 # budget absorbs: the injected crash / watchdog exit,
-                # one rendezvous-race failure, the successful rerun,
-                # and cheap already-complete no-ops
-                "restarts": 4,
+                # rendezvous-race failures (more peers, more races),
+                # the successful rerun, and already-complete no-ops
+                "restarts": 4 + max(0, n_procs - 2),
                 "port": job_port,
                 "interfaces": ["static:127.0.0.1"],
                 # progress-based health: passes only while the worker
@@ -129,21 +134,26 @@ def _supervisor_config(
 
 
 @pytest.mark.parametrize(
-    "crash_idx", [1, 0],
-    ids=["worker-crash", "coordinator-crash"],
+    "n_procs,tp,crash_idx", [(2, 0, 1), (2, 0, 0), (4, 2, 1)],
+    ids=["worker-crash", "coordinator-crash", "dp2xtp2-worker-crash"],
 )
 def test_supervised_multiprocess_training_with_crash_and_resume(
-    tmp_path, crash_idx
+    tmp_path, n_procs, tp, crash_idx
 ):
     """crash_idx=0 kills the process HOSTING the jax coordinator —
     the harder failure: the whole rendezvous must rebuild (the
     reincarnated process 0 clears the stale coordinator registration
     and re-registers; the survivor's watchdog turns its hang into a
-    restart that discovers the fresh coordinator)."""
+    restart that discovers the fresh coordinator).
+
+    The dp2xtp2 variant runs FOUR supervised processes on a 2x2
+    dp x tp mesh through the production path (parallel.train +
+    sharded checkpointing), so the crash/restart/resume story covers
+    cross-process tensor parallelism, not just pmap dp."""
     from containerpilot_tpu.discovery.consul import ConsulBackend
 
     catalog_port, coord_port = _free_port(), _free_port()
-    job_ports = (_free_port(), _free_port())
+    job_ports = tuple(_free_port() for _ in range(n_procs))
     env = _sub_env()
 
     catalog = subprocess.Popen(
@@ -160,10 +170,10 @@ def test_supervised_multiprocess_training_with_crash_and_resume(
         _wait_http(
             f"http://127.0.0.1:{catalog_port}/v1/health/service/none"
         )
-        for idx in (0, 1):
+        for idx in range(n_procs):
             cfg_path = _supervisor_config(
                 tmp_path, idx, catalog_port, coord_port,
-                job_ports[idx], crash_idx,
+                job_ports[idx], crash_idx, n_procs=n_procs, tp=tp,
             )
             log_fh = open(tmp_path / f"sup{idx}.log", "w")
             logs.append(log_fh)
@@ -192,17 +202,17 @@ def test_supervised_multiprocess_training_with_crash_and_resume(
         poller = threading.Thread(target=poll_catalog, daemon=True)
         poller.start()
 
-        deadline = time.monotonic() + 480
+        deadline = time.monotonic() + 480 * max(1, n_procs // 2)
         for proc in supervisors:
             remaining = max(5.0, deadline - time.monotonic())
             try:
                 proc.wait(timeout=remaining)
             except subprocess.TimeoutExpired:
                 pytest.fail(
-                    "supervisor did not exit; sup0/sup1 logs:\n"
+                    "supervisor did not exit; logs:\n"
                     + "\n".join(
                         (tmp_path / f"sup{i}.log").read_text()[-3000:]
-                        for i in (0, 1)
+                        for i in range(n_procs)
                     )
                 )
         stop_poll.set()
@@ -218,7 +228,7 @@ def test_supervised_multiprocess_training_with_crash_and_resume(
         assert (tmp_path / "crash-sentinel").exists()
 
         outs = []
-        for idx in (0, 1):
+        for idx in range(n_procs):
             out_path = tmp_path / f"out{idx}.json"
             assert out_path.exists(), (
                 f"worker {idx} never finished:\n"
@@ -226,13 +236,13 @@ def test_supervised_multiprocess_training_with_crash_and_resume(
             )
             outs.append(json.loads(out_path.read_text()))
 
-        # both workers completed the SAME run and resumed mid-stream
+        # every worker completed the SAME run and resumed mid-stream
         # (a from-scratch restart would report resumed_from == 0)
         for out in outs:
             assert out["resumed_from"] > 0, out
-        assert outs[0]["final_loss"] == pytest.approx(
-            outs[1]["final_loss"], abs=1e-5
-        )
+            assert out["final_loss"] == pytest.approx(
+                outs[0]["final_loss"], abs=1e-5
+            )
 
         # loss parity with a single-process run over the identical
         # global batch schedule
@@ -243,7 +253,8 @@ def test_supervised_multiprocess_training_with_crash_and_resume(
              "--steps", str(STEPS),
              "--global-batch", str(GLOBAL_BATCH),
              "--checkpoint-dir", str(tmp_path / "ckpt-base"),
-             "--out", str(base_out)],
+             "--out", str(base_out)]
+            + (["--tp", "1"] if tp else []),  # same code path as pod
             cwd=REPO, env=env, capture_output=True, text=True,
             timeout=240,
         )

@@ -219,6 +219,275 @@ def test_inference_server_slot_engine(run, params):
     )
 
 
+def test_stream_deltas_concatenate_to_result(params, engine):
+    """on_tokens deltas, concatenated, ARE the final result — the
+    streaming surface can't drift from the non-streamed one."""
+    deltas = []
+    got = engine.submit(
+        [1, 2, 3], max_new=8, temperature=0.7, seed=11,
+        on_tokens=deltas.append,
+    ).result(timeout=120)
+    assert sum(deltas, []) == got
+    assert got == _solo(params, [1, 2, 3], 8, temperature=0.7, seed=11)
+    # the first delta is the admission sample: streaming starts
+    # before the row's decode finishes, not after
+    assert len(deltas) >= 2 and len(deltas[0]) == 1
+
+
+def test_cancel_frees_slot_mid_generation(params, engine):
+    """A cancelled request releases its slot at the next chunk
+    boundary with a partial emission; the pool keeps serving."""
+    import threading
+
+    cancel = threading.Event()
+    first = threading.Event()
+    partial = []
+
+    def on_tokens(delta):
+        partial.extend(delta)
+        first.set()
+
+    max_new = MAX_LEN - 3
+    fut = engine.submit(
+        [5, 6, 7], max_new=max_new, on_tokens=on_tokens, cancel=cancel,
+    )
+    assert first.wait(timeout=120), "no first token"
+    cancel.set()
+    got = fut.result(timeout=120)
+    assert 0 < len(got) < max_new, (
+        f"cancel did not stop decode early ({len(got)}/{max_new})"
+    )
+    # the slot is back in the pool and byte-parity still holds
+    deadline = __import__("time").monotonic() + 30
+    while engine.stats["active"]:
+        assert __import__("time").monotonic() < deadline
+        __import__("time").sleep(0.05)
+    after = engine.submit([1, 2, 3, 4], max_new=7).result(timeout=120)
+    assert after == _solo(params, [1, 2, 3, 4], 7)
+
+
+def _read_sse(port, body, abort_after=None):
+    """POST /v1/generate with stream:true and read SSE events as they
+    arrive; abort_after closes the socket after that many events (a
+    client disconnect mid-stream)."""
+    import http.client
+    import json as json_mod
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request(
+        "POST", "/v1/generate", json_mod.dumps(body),
+        {"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200, resp.read()
+    assert resp.getheader("Content-Type") == "text/event-stream"
+    events = []
+    buf = b""
+    while True:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            raw, buf = buf.split(b"\n\n", 1)
+            assert raw.startswith(b"data: "), raw
+            events.append(json_mod.loads(raw[len(b"data: "):]))
+            if abort_after is not None and len(events) >= abort_after:
+                # hard disconnect: closing the response closes the
+                # underlying socket (Connection: close responses own
+                # it), which is the server's EOF signal
+                resp.close()
+                conn.close()
+                return events
+    conn.close()
+    return events
+
+
+def test_server_stream_matches_non_streamed(run, params):
+    """Streamed tokens byte-match the non-streamed response, greedy
+    and sampled; the terminal event reports the count."""
+    import asyncio
+    import json as json_mod
+    import urllib.request
+
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    server = InferenceServer(
+        CFG, params, "127.0.0.1", 0, max_len=MAX_LEN, slots=2,
+        slot_chunk=3,
+    )
+
+    def fetch(path, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{path}",
+            data=json_mod.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return json_mod.loads(resp.read().decode())
+
+    async def scenario():
+        await server.run()
+        loop = asyncio.get_event_loop()
+        reqs = [
+            {"tokens": [[1, 2, 3]], "max_new_tokens": 7},
+            {"tokens": [[4, 5]], "max_new_tokens": 6,
+             "temperature": 0.9, "top_k": 12, "seed": 3},
+        ]
+        results = []
+        for body in reqs:
+            plain = await loop.run_in_executor(
+                None, lambda b=body: fetch("/v1/generate", b)
+            )
+            events = await loop.run_in_executor(
+                None, lambda b=body: _read_sse(
+                    server.port, dict(b, stream=True)
+                )
+            )
+            results.append((plain, events))
+        await server.stop()
+        return results
+
+    for plain, events in run(scenario()):
+        assert events[-1]["done"] is True
+        streamed = sum(
+            (e["tokens"] for e in events if "tokens" in e), []
+        )
+        assert streamed == plain["tokens"][0]
+        assert events[-1]["count"] == len(streamed)
+
+
+def test_server_stream_disconnect_frees_slot(run, params):
+    """Closing the connection mid-stream cancels the request: the
+    slot returns to the pool well before the requested length could
+    have decoded, and the server keeps serving."""
+    import asyncio
+    import json as json_mod
+    import urllib.request
+
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    server = InferenceServer(
+        CFG, params, "127.0.0.1", 0, max_len=MAX_LEN, slots=2,
+        slot_chunk=2,
+    )
+
+    def fetch(path, body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{path}",
+            data=json_mod.dumps(body).encode() if body else None,
+            headers={"Content-Type": "application/json"} if body else {},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return json_mod.loads(resp.read().decode())
+
+    async def scenario():
+        import time as time_mod
+
+        await server.run()
+        loop = asyncio.get_event_loop()
+        max_new = MAX_LEN - 3
+        events = await loop.run_in_executor(
+            None, lambda: _read_sse(
+                server.port,
+                {"tokens": [[7, 8, 9]], "max_new_tokens": max_new,
+                 "stream": True},
+                abort_after=1,
+            )
+        )
+        assert len(events) == 1  # we left after the first token
+        # the slot must come back without the row decoding to the end
+        deadline = time_mod.monotonic() + 60
+        while True:
+            info = await loop.run_in_executor(
+                None, lambda: fetch("/v1/model")
+            )
+            if info["slot_engine"]["active"] == 0:
+                break
+            assert time_mod.monotonic() < deadline, info
+            await asyncio.sleep(0.1)
+        # cancellation kept the token counter well under the request
+        metrics = await loop.run_in_executor(
+            None,
+            lambda: urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=30
+            ).read().decode(),
+        )
+        token_lines = [
+            line for line in metrics.splitlines()
+            if line.startswith("containerpilot_serve_generated_tokens_total")
+        ]
+        assert token_lines, "token counter missing from /metrics"
+        for line in token_lines:
+            assert float(line.split()[-1]) < max_new, line
+        # and the pool still answers correctly
+        after = await loop.run_in_executor(
+            None, lambda: fetch(
+                "/v1/generate",
+                {"tokens": [[1, 2, 3]], "max_new_tokens": 5},
+            )
+        )
+        await server.stop()
+        return after
+
+    after = run(scenario())
+    assert after["tokens"][0] == _solo(params, [1, 2, 3], 5)
+
+
+def test_server_stream_rejects_bad_compositions(run, params):
+    """stream without --slots, and stream+stop, fail with clean 422s
+    before any decode starts."""
+    import asyncio
+    import json as json_mod
+    import urllib.error
+    import urllib.request
+
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    vanilla = InferenceServer(CFG, params, "127.0.0.1", 0,
+                              max_len=MAX_LEN)
+    slotted = InferenceServer(CFG, params, "127.0.0.1", 0,
+                              max_len=MAX_LEN, slots=1)
+
+    def post_status(port, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate",
+            data=json_mod.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as exc:
+            return exc.code, exc.read().decode()
+
+    async def scenario():
+        await vanilla.run()
+        await slotted.run()
+        loop = asyncio.get_event_loop()
+        no_slots = await loop.run_in_executor(
+            None, lambda: post_status(
+                vanilla.port,
+                {"tokens": [[1, 2]], "max_new_tokens": 4,
+                 "stream": True},
+            )
+        )
+        with_stop = await loop.run_in_executor(
+            None, lambda: post_status(
+                slotted.port,
+                {"tokens": [[1, 2]], "max_new_tokens": 4,
+                 "stream": True, "stop": [[3]]},
+            )
+        )
+        await vanilla.stop()
+        await slotted.stop()
+        return no_slots, with_stop
+
+    no_slots, with_stop = run(scenario())
+    assert no_slots[0] == 422 and "--slots" in no_slots[1]
+    assert with_stop[0] == 422 and "stop" in with_stop[1]
+
+
 def test_slots_reject_prefix_cache(params):
     from containerpilot_tpu.workload.serve import InferenceServer
 

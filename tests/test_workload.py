@@ -2609,18 +2609,26 @@ def test_inference_server_text_completions(run):
             lambda: fetch("/v1/completions",
                           {"prompt": "x", "max_new_tokens": 999}),
         )
+        # stream is token-level: the text surface must 422, not hand
+        # an SSE client a plain 200 body it would hang parsing
+        streamed = await loop.run_in_executor(
+            None,
+            lambda: fetch("/v1/completions",
+                          {"prompt": "x", "stream": True}),
+        )
         await server.stop()
-        return comp, gen, bad, too_long
+        return comp, gen, bad, too_long, streamed
 
     import json
 
-    comp, gen, bad, too_long = run(scenario(), timeout=120)
+    comp, gen, bad, too_long, streamed = run(scenario(), timeout=120)
     assert comp[0] == 200, comp
     assert gen[0] == 200, gen
     assert comp[1]["tokens"] == gen[1]["tokens"][0]
     assert comp[1]["text"] == tok.decode(comp[1]["tokens"])
     assert bad[0] == 422
     assert too_long[0] == 422
+    assert streamed[0] == 422 and "/v1/generate" in streamed[1]
 
 
 def test_serve_text_requires_byte_vocab():
@@ -2796,6 +2804,51 @@ def test_inference_server_reports_mesh(run):
     info, gen = run(scenario())
     assert info["mesh"] == {"data": 1, "model": 8}
     assert len(gen["tokens"][0]) == 4
+
+
+def test_compile_cache_env_populates_and_reuses(tmp_path):
+    """CONTAINERPILOT_COMPILE_CACHE: a workload CLI run persists its
+    compiled programs, and a fresh process reads them back (cache-hit
+    logging on) — the reincarnation-warmup lever the supervisor's
+    restart story leans on."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    wrapper = tmp_path / "train_cpu.py"
+    wrapper.write_text(
+        "import sys\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from containerpilot_tpu.workload.train import main\n"
+        "sys.exit(main())\n"
+    )
+    cache = tmp_path / "xla-cache"
+    argv = [
+        sys.executable, "-u", str(wrapper),
+        "--steps", "2", "--batch", "2", "--seq-len", "16",
+        "--d-model", "32", "--n-layers", "1", "--n-heads", "2",
+        "--vocab", "64",
+    ]
+    env = dict(os.environ, CONTAINERPILOT_COMPILE_CACHE=str(cache))
+    env.pop("XLA_FLAGS", None)
+    first = subprocess.run(
+        argv, env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert first.returncode == 0, first.stdout[-2000:] + first.stderr[-2000:]
+    entries = list(cache.iterdir())
+    assert entries, "compile cache never populated"
+    # second process must HIT the persisted entries, not just write new
+    env["JAX_EXPLAIN_CACHE_MISSES"] = "true"
+    before = {e.name for e in entries}
+    second = subprocess.run(
+        argv, env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert second.returncode == 0, second.stderr[-2000:]
+    after = {e.name for e in cache.iterdir()}
+    assert before <= after  # nothing evicted; hits don't rewrite
 
 
 def test_trainer_graceful_preemption(tmp_path):
