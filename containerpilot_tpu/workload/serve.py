@@ -579,13 +579,26 @@ class InferenceServer:
         emission IS the post-trim output). A client disconnect sets
         the cancel event — the engine frees the slot at the next
         chunk boundary instead of decoding to the end."""
+        if len(tokens) != 1:
+            raise ValueError("stream serves a single row per request")
+        return self._stream_response(tokens[0], p)
+
+    def _stream_response(
+        self,
+        row: List[int],
+        p: Dict[str, Any],
+        delta_event=None,
+        tail_events=None,
+    ) -> "StreamingResponse":
+        """Shared slot-engine SSE plumbing for the token and text
+        streaming surfaces. ``delta_event(delta) -> dict`` shapes each
+        event; ``tail_events() -> [dict]`` may append events before
+        the terminal ``done`` (e.g. a UTF-8 decoder flush)."""
         if self.slot_engine is None:
             raise ValueError(
                 "stream requires --slots (token streaming rides the "
                 "slot engine's chunk boundaries)"
             )
-        if len(tokens) != 1:
-            raise ValueError("stream serves a single row per request")
         for knob, why in (
             ("logprobs", "echo logprobs need the full row"),
             ("beam_width", "beams have no incremental prefix"),
@@ -594,6 +607,10 @@ class InferenceServer:
             if p[knob]:
                 raise ValueError(f"stream does not compose with "
                                  f"{knob} ({why})")
+        if delta_event is None:
+            delta_event = lambda d: {"tokens": d}  # noqa: E731
+        if tail_events is None:
+            tail_events = list  # noqa: E731 — no tail
 
         import threading as threading_mod
 
@@ -606,7 +623,7 @@ class InferenceServer:
             loop.call_soon_threadsafe(deltas.put_nowait, delta)
 
         fut = self.slot_engine.submit(
-            tokens[0], p["max_new_requested"],
+            row, p["max_new_requested"],
             temperature=p["temperature"], top_k=p["top_k"],
             top_p=p["top_p"], eos_id=p["eos_id"], seed=p["seed"],
             min_new=p["min_new"],
@@ -632,6 +649,9 @@ class InferenceServer:
             cancel.set()  # the engine stops decoding this row
             self._m_tokens.inc(sent[0])
 
+        def sse(payload: Dict[str, Any]) -> bytes:
+            return b"data: " + json.dumps(payload).encode() + b"\n\n"
+
         async def events():
             try:
                 while True:
@@ -639,18 +659,10 @@ class InferenceServer:
                     if delta is _DONE:
                         break
                     sent[0] += len(delta)
-                    yield (
-                        b"data: "
-                        + json.dumps({"tokens": delta}).encode()
-                        + b"\n\n"
-                    )
-                yield (
-                    b"data: "
-                    + json.dumps(
-                        {"done": True, "count": sent[0]}
-                    ).encode()
-                    + b"\n\n"
-                )
+                    yield sse(delta_event(delta))
+                for extra in tail_events():
+                    yield sse(extra)
+                yield sse({"done": True, "count": sent[0]})
             finally:
                 finish()
 
@@ -666,16 +678,6 @@ class InferenceServer:
         as token-level stop sequences, excluded from the output."""
         try:
             body = json.loads(req.body.decode() or "{}")
-            if bool(body.get("stream", False)):
-                # honest 422 instead of a silently-plain 200 an SSE
-                # client would hang on: text deltas would need UTF-8
-                # partial-byte holdback (the byte tokenizer can split
-                # a multibyte char across chunks) — token-level
-                # streaming lives on /v1/generate
-                raise ValueError(
-                    "streaming is token-level; use /v1/generate with "
-                    "\"stream\": true"
-                )
             prompt = body.get("prompt")
             if not isinstance(prompt, str) or not prompt:
                 raise ValueError("'prompt' must be a non-empty string")
@@ -711,6 +713,8 @@ class InferenceServer:
             p = self._parse_sampling(
                 body, [row], len(row), default_eos=self.tokenizer.EOS
             )
+            if bool(body.get("stream", False)):
+                return self._completions_stream(row, p)
         except (ValueError, KeyError, TypeError) as exc:
             return Response(422, f"{exc}\n".encode())
 
@@ -727,6 +731,21 @@ class InferenceServer:
                 }
             ).encode(),
             content_type="application/json",
+        )
+
+    def _completions_stream(
+        self, row: List[int], p: Dict[str, Any]
+    ) -> "StreamingResponse":
+        """Text SSE over the same slot-chunk plumbing: each event
+        carries the delta's ids AND the text they decode to, with
+        UTF-8 partial-byte holdback (text.stream_decoder).
+        Concatenated event text equals the non-streamed ``text``;
+        concatenated ids equal its ``tokens``."""
+        from .text import stream_decoder
+
+        delta_event, tail_events = stream_decoder(self.tokenizer)
+        return self._stream_response(
+            row, p, delta_event=delta_event, tail_events=tail_events
         )
 
     def _ensure_score_fn(self) -> None:

@@ -32,13 +32,44 @@ class ByteTokenizer:
         ids = [b + self.OFFSET for b in text.encode("utf-8")]
         return [self.BOS] + ids if bos else ids
 
-    def decode(self, ids: List[int]) -> str:
-        """Ids back to text; specials and out-of-byte-range ids (a
-        model may emit any id < vocab_size) are dropped, invalid UTF-8
-        sequences become replacement characters."""
-        raw = bytes(
+    def to_bytes(self, ids: List[int]) -> bytes:
+        """The raw bytes behind a run of ids: specials and
+        out-of-byte-range ids (a model may emit any id < vocab_size)
+        are dropped. The ONE id filter — decode() and the streaming
+        surface both read through it, so their outputs can't drift."""
+        return bytes(
             i - self.OFFSET
             for i in ids
             if self.OFFSET <= i < self.OFFSET + 256
         )
-        return raw.decode("utf-8", errors="replace")
+
+    def decode(self, ids: List[int]) -> str:
+        """Ids back to text; invalid UTF-8 sequences become
+        replacement characters."""
+        return self.to_bytes(ids).decode("utf-8", errors="replace")
+
+
+def stream_decoder(tokenizer: ByteTokenizer):
+    """(delta_event, tail_events) for SSE text streaming with UTF-8
+    partial-byte holdback: the byte tokenizer can split a multibyte
+    character across chunk boundaries, so an incremental decoder
+    buffers dangling bytes between events and the tail flush emits
+    whatever remains (replacement chars — exactly what decode() does
+    to the same ids). Incremental UTF-8 decoding is split-invariant,
+    so concatenated event text equals decode() of the concatenated
+    ids for EVERY possible chunking."""
+    import codecs
+
+    dec = codecs.getincrementaldecoder("utf-8")("replace")
+
+    def delta_event(delta: List[int]) -> dict:
+        return {
+            "tokens": delta,
+            "text": dec.decode(tokenizer.to_bytes(delta)),
+        }
+
+    def tail_events() -> List[dict]:
+        flush = dec.decode(b"", True)
+        return [{"tokens": [], "text": flush}] if flush else []
+
+    return delta_event, tail_events

@@ -266,16 +266,16 @@ def test_cancel_frees_slot_mid_generation(params, engine):
     assert after == _solo(params, [1, 2, 3, 4], 7)
 
 
-def _read_sse(port, body, abort_after=None):
-    """POST /v1/generate with stream:true and read SSE events as they
-    arrive; abort_after closes the socket after that many events (a
-    client disconnect mid-stream)."""
+def _read_sse(port, body, abort_after=None, path="/v1/generate"):
+    """POST with stream:true and read SSE events as they arrive;
+    abort_after closes the socket after that many events (a client
+    disconnect mid-stream)."""
     import http.client
     import json as json_mod
 
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
     conn.request(
-        "POST", "/v1/generate", json_mod.dumps(body),
+        "POST", path, json_mod.dumps(body),
         {"Content-Type": "application/json"},
     )
     resp = conn.getresponse()
@@ -432,6 +432,109 @@ def test_server_stream_disconnect_frees_slot(run, params):
 
     after = run(scenario())
     assert after["tokens"][0] == _solo(params, [1, 2, 3], 5)
+
+
+def test_stream_decoder_holds_back_split_multibyte():
+    """Deterministic coverage of the holdback path the server test
+    can't force (it depends on what the model happens to emit): a
+    multibyte char split across deltas is buffered until complete,
+    and a dangling prefix at stream end flushes as the SAME
+    replacement char the one-shot decode produces."""
+    from containerpilot_tpu.workload.text import (
+        ByteTokenizer,
+        stream_decoder,
+    )
+
+    tok = ByteTokenizer(512)
+    e_acute = tok.encode("é", bos=False)  # 2 ids: 0xC3 0xA9
+    assert len(e_acute) == 2
+
+    # split across two deltas: nothing until the char completes
+    delta_event, tail_events = stream_decoder(tok)
+    first = delta_event([e_acute[0]])
+    second = delta_event([e_acute[1]])
+    assert first["text"] == "" and second["text"] == "é"
+    assert tail_events() == []  # nothing dangling
+
+    # dangling prefix at stream end: the flush event carries exactly
+    # what decode() makes of the same ids
+    delta_event, tail_events = stream_decoder(tok)
+    assert delta_event([e_acute[0]])["text"] == ""
+    (flush,) = tail_events()
+    assert flush["tokens"] == []
+    assert flush["text"] == tok.decode([e_acute[0]]) == "�"
+    assert tail_events() == []  # flush is one-shot
+
+    # specials interleaved: filtered identically to decode()
+    delta_event, tail_events = stream_decoder(tok)
+    parts = [
+        delta_event([tok.EOS, e_acute[0]])["text"],
+        delta_event([e_acute[1], tok.PAD])["text"],
+    ]
+    assert "".join(parts) == tok.decode(
+        [tok.EOS, e_acute[0], e_acute[1], tok.PAD]
+    ) == "é"
+
+
+def test_server_completions_stream_matches_non_streamed(run):
+    """Text SSE on /v1/completions: per-event text rides UTF-8
+    partial-byte holdback, so concatenated event text equals the
+    non-streamed 'text' and concatenated ids equal its 'tokens' —
+    whatever byte sequences the model emits."""
+    import asyncio
+    import json as json_mod
+    import urllib.request
+
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=512, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server = InferenceServer(
+        cfg, params, "127.0.0.1", 0, max_len=48, text=True, slots=2,
+        slot_chunk=3,
+    )
+
+    def fetch(body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/completions",
+            data=json_mod.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return json_mod.loads(resp.read().decode())
+
+    async def scenario():
+        await server.run()
+        loop = asyncio.get_event_loop()
+        results = []
+        for body in (
+            {"prompt": "hé", "max_new_tokens": 9},  # multibyte prompt
+            {"prompt": "ab", "max_new_tokens": 7,
+             "temperature": 0.9, "seed": 4},
+        ):
+            plain = await loop.run_in_executor(
+                None, lambda b=body: fetch(b)
+            )
+            events = await loop.run_in_executor(
+                None, lambda b=body: _read_sse(
+                    server.port, dict(b, stream=True),
+                    path="/v1/completions",
+                )
+            )
+            results.append((plain, events))
+        await server.stop()
+        return results
+
+    for plain, events in run(scenario()):
+        assert events[-1]["done"] is True
+        toks = sum((e["tokens"] for e in events if "tokens" in e), [])
+        text = "".join(e.get("text", "") for e in events[:-1])
+        assert toks == plain["tokens"]
+        assert text == plain["text"]
+        assert events[-1]["count"] == len(toks)
 
 
 def test_server_stream_rejects_bad_compositions(run, params):

@@ -2609,8 +2609,8 @@ def test_inference_server_text_completions(run):
             lambda: fetch("/v1/completions",
                           {"prompt": "x", "max_new_tokens": 999}),
         )
-        # stream is token-level: the text surface must 422, not hand
-        # an SSE client a plain 200 body it would hang parsing
+        # this server has no --slots: stream must 422 cleanly, not
+        # hand an SSE client a plain 200 body it would hang parsing
         streamed = await loop.run_in_executor(
             None,
             lambda: fetch("/v1/completions",
@@ -2628,7 +2628,7 @@ def test_inference_server_text_completions(run):
     assert comp[1]["text"] == tok.decode(comp[1]["tokens"])
     assert bad[0] == 422
     assert too_long[0] == 422
-    assert streamed[0] == 422 and "/v1/generate" in streamed[1]
+    assert streamed[0] == 422 and "--slots" in streamed[1]
 
 
 def test_serve_text_requires_byte_vocab():
