@@ -494,6 +494,28 @@ def apply_token_penalties(
     return logits - pres * (counts > 0) - freq * counts
 
 
+BIAS_SLOTS = 16  # static per-row logit_bias capacity (OpenAI allows
+# 300; serving caps requests well below — static K keeps ONE program)
+
+
+def apply_logit_bias(
+    logits: jax.Array, bias_idx: jax.Array, bias_val: jax.Array
+) -> jax.Array:
+    """OpenAI-style logit_bias: add ``bias_val[b, j]`` to token
+    ``bias_idx[b, j]``'s logit before temperature/filters. Sparse and
+    static-shape: idx/val are [batch, K] with -1 marking unused slots,
+    so arbitrary per-request bias sets run in one compiled program.
+    Applied BEFORE the min_new eos mask, so a positive eos bias can
+    never break the min_new_tokens floor."""
+    b, vocab = logits.shape
+    valid = bias_idx >= 0
+    idx = jnp.where(valid, bias_idx, 0)
+    add = jnp.zeros_like(logits, shape=(b, vocab)).at[
+        jnp.arange(b)[:, None], idx
+    ].add(jnp.where(valid, bias_val, 0.0).astype(logits.dtype))
+    return logits + add
+
+
 def count_token(
     counts: jax.Array, token: jax.Array, alive
 ) -> jax.Array:
@@ -507,23 +529,28 @@ def count_token(
 
 
 def _sampling_scan(cfg, max_new_tokens: int, greedy: bool,
-                   filtered: bool, penalized: bool = False):
+                   filtered: bool, penalized: bool = False,
+                   biased: bool = False):
     """The shared decode loop: from (cache, next-token logits) sample
     max_new_tokens with eos/pad handling. Used by the prefill-fused
     generate program and the prefix-cache extend path.
 
-    ``penalized`` is a static compile-key flag (like greedy/filtered):
-    only requests that actually set presence/frequency penalties pay
-    the [batch, vocab] counts carry and per-step bookkeeping — the
-    common zero-penalty program is unchanged."""
+    ``penalized``/``biased`` are static compile-key flags (like
+    greedy/filtered): only requests that actually set
+    presence/frequency penalties pay the [batch, vocab] counts carry,
+    and only requests carrying a logit_bias pay the per-step
+    scatter-add — the common plain program is unchanged."""
 
     def scan(params, cache, logits, row_keys, temperature, top_k,
-             top_p, eos_id, pad_id, min_new, presence, frequency):
+             top_p, eos_id, pad_id, min_new, presence, frequency,
+             bias_idx, bias_val):
         def sample(logits, step_idx, counts):
             if penalized:
                 logits = apply_token_penalties(
                     logits, counts, presence, frequency
                 )
+            if biased:
+                logits = apply_logit_bias(logits, bias_idx, bias_val)
             logits = mask_eos_before_min(
                 logits, step_idx, min_new, eos_id
             )
@@ -581,7 +608,7 @@ def _sampling_scan(cfg, max_new_tokens: int, greedy: bool,
 @functools.lru_cache(maxsize=32)
 def _jitted_generate(cfg: TransformerConfig, max_new_tokens: int,
                      max_len: int, greedy: bool, filtered: bool,
-                     penalized: bool = False):
+                     penalized: bool = False, biased: bool = False):
     """One compiled program per (config, lengths, sampling mode); jit's
     own cache covers distinct prompt lengths and batch sizes.
     Everything request-controlled that doesn't change shapes
@@ -591,14 +618,14 @@ def _jitted_generate(cfg: TransformerConfig, max_new_tokens: int,
     samples from its own key (fold_in per step), so a row's output
     never depends on what it was batched with."""
     scan = _sampling_scan(cfg, max_new_tokens, greedy, filtered,
-                          penalized)
+                          penalized, biased)
 
     def fn(params, prompt, row_keys, temperature, top_k, top_p, eos_id,
-           pad_id, min_new, presence, frequency):
+           pad_id, min_new, presence, frequency, bias_idx, bias_val):
         logits, cache = prefill(params, prompt, cfg, max_len)
         return scan(params, cache, logits, row_keys, temperature,
                     top_k, top_p, eos_id, pad_id, min_new, presence,
-                    frequency)
+                    frequency, bias_idx, bias_val)
 
     return jax.jit(fn)
 
@@ -627,9 +654,11 @@ def _jitted_extend(cfg: TransformerConfig):
 @functools.lru_cache(maxsize=32)
 def _jitted_decode_from_cache(cfg: TransformerConfig,
                               max_new_tokens: int, greedy: bool,
-                              filtered: bool, penalized: bool = False):
+                              filtered: bool, penalized: bool = False,
+                              biased: bool = False):
     return jax.jit(
-        _sampling_scan(cfg, max_new_tokens, greedy, filtered, penalized)
+        _sampling_scan(cfg, max_new_tokens, greedy, filtered,
+                       penalized, biased)
     )
 
 
@@ -648,6 +677,7 @@ def generate(
     min_new_tokens=0,
     presence_penalty=0.0,
     frequency_penalty=0.0,
+    logit_bias=None,
 ) -> jax.Array:
     """Autoregressive generation. prompt: [batch, prompt_len] int32;
     returns [batch, max_new_tokens] int32.
@@ -662,7 +692,13 @@ def generate(
     short answers can be floored. ``presence_penalty`` /
     ``frequency_penalty`` subtract from the logits of tokens already
     GENERATED this call (OpenAI semantics over the output, prompt
-    excluded — one semantic across every decode path). ``rng`` is one
+    excluded — one semantic across every decode path).
+    ``logit_bias`` adds per-token offsets to the logits before
+    temperature/filters (OpenAI semantics: -100 effectively bans a
+    token, +100 effectively forces it) — one ``{token_id: bias}``
+    dict for the whole batch or a per-row list of dicts, at most
+    BIAS_SLOTS entries per row; applied before the min_new eos mask
+    so a positive eos bias cannot break the floor. ``rng`` is one
     key (split per row internally) or [batch] stacked per-row keys —
     per-row keys keep each row's output independent of co-batched
     rows.
@@ -670,7 +706,7 @@ def generate(
     operands = _normalize_sampling(
         cfg, prompt.shape[0], max_new_tokens, temperature, rng, top_k,
         top_p, eos_id, pad_id, min_new_tokens, presence_penalty,
-        frequency_penalty,
+        frequency_penalty, logit_bias,
     )
     if prompt.shape[1] + max_new_tokens > max_len:
         # an overflowing decode would silently clamp cache writes onto
@@ -679,9 +715,10 @@ def generate(
             f"prompt_len {prompt.shape[1]} + max_new_tokens "
             f"{max_new_tokens} exceeds max_len {max_len}"
         )
-    greedy, filtered, penalized, op_arrays = operands
+    greedy, filtered, penalized, biased, op_arrays = operands
     fn = _jitted_generate(
-        cfg, max_new_tokens, max_len, greedy, filtered, penalized
+        cfg, max_new_tokens, max_len, greedy, filtered, penalized,
+        biased,
     )
     return fn(params, prompt, *op_arrays)
 
@@ -689,10 +726,11 @@ def generate(
 def _normalize_sampling(cfg, b, max_new_tokens, temperature, rng,
                         top_k, top_p, eos_id, pad_id,
                         min_new_tokens=0, presence_penalty=0.0,
-                        frequency_penalty=0.0):
+                        frequency_penalty=0.0, logit_bias=None):
     """Validate/broadcast the per-row sampling knobs exactly as
-    ``generate`` documents; returns (greedy, filtered, operand arrays
-    in _sampling_scan order after the cache/logits)."""
+    ``generate`` documents; returns (greedy, filtered, penalized,
+    biased, operand arrays in _sampling_scan order after the
+    cache/logits)."""
     import numpy as np
 
     def row(v, dtype, name):
@@ -742,6 +780,7 @@ def _normalize_sampling(cfg, b, max_new_tokens, temperature, rng,
         raise ValueError(
             "presence/frequency penalties must be in [-100, 100]"
         )
+    bias_idx, bias_val = normalize_logit_bias(cfg, b, logit_bias)
     greedy = bool((t <= 0.0).all())
     if greedy:
         # dead under argmax; normalize so the compile key can't churn
@@ -751,7 +790,8 @@ def _normalize_sampling(cfg, b, max_new_tokens, temperature, rng,
         ((k_arr > 0) | ((p_arr > 0.0) & (p_arr < 1.0))).any()
     )
     penalized = bool(pres_arr.any() or freq_arr.any())
-    return greedy, filtered, penalized, (
+    biased = bool((bias_idx >= 0).any())
+    return greedy, filtered, penalized, biased, (
         row_keys,
         jnp.asarray(t, jnp.float32), jnp.asarray(k_arr, jnp.int32),
         jnp.asarray(p_arr, jnp.float32),
@@ -760,7 +800,52 @@ def _normalize_sampling(cfg, b, max_new_tokens, temperature, rng,
         jnp.asarray(min_arr, jnp.int32),
         jnp.asarray(pres_arr, jnp.float32),
         jnp.asarray(freq_arr, jnp.float32),
+        jnp.asarray(bias_idx, jnp.int32),
+        jnp.asarray(bias_val, jnp.float32),
     )
+
+
+def normalize_logit_bias(cfg, b: int, logit_bias):
+    """[b, BIAS_SLOTS] (idx, val) arrays from None, one {token: bias}
+    dict applied to every row, or a per-row list of such dicts (None
+    entries allowed). Unused slots carry idx -1. Validates ids, |bias|
+    <= 100 (OpenAI's range), and the per-row entry cap."""
+    import numpy as np
+
+    idx = np.full((b, BIAS_SLOTS), -1, np.int32)
+    val = np.zeros((b, BIAS_SLOTS), np.float32)
+    if logit_bias is None:
+        return idx, val
+    rows = (
+        logit_bias if isinstance(logit_bias, (list, tuple))
+        else [logit_bias] * b
+    )
+    if len(rows) != b:
+        raise ValueError(f"logit_bias must be one dict or {b} rows")
+    for r, entry in enumerate(rows):
+        if entry is None:
+            continue
+        if not isinstance(entry, dict):
+            raise ValueError("logit_bias rows must be dicts or None")
+        if len(entry) > BIAS_SLOTS:
+            raise ValueError(
+                f"logit_bias is capped at {BIAS_SLOTS} tokens per row"
+            )
+        for j, (tok, bias) in enumerate(sorted(entry.items())):
+            tok = int(tok)
+            bias = float(bias)
+            if not 0 <= tok < cfg.vocab_size:
+                raise ValueError(
+                    f"logit_bias token ids must be in "
+                    f"[0, {cfg.vocab_size})"
+                )
+            if not abs(bias) <= 100:
+                raise ValueError(
+                    "logit_bias values must be in [-100, 100]"
+                )
+            idx[r, j] = tok
+            val[r, j] = bias
+    return idx, val
 
 
 def generate_from_cache(
@@ -779,6 +864,7 @@ def generate_from_cache(
     min_new_tokens=0,
     presence_penalty=0.0,
     frequency_penalty=0.0,
+    logit_bias=None,
 ) -> jax.Array:
     """``generate`` starting from an existing (cache, next-token
     logits) pair — the prefix-cache serving path: the caller restored
@@ -809,12 +895,14 @@ def generate_from_cache(
                 f"cache pos {pos} + max_new_tokens {max_new_tokens} "
                 f"exceeds cache length {length}"
             )
-    greedy, filtered, penalized, op_arrays = _normalize_sampling(
-        cfg, logits.shape[0], max_new_tokens, temperature, rng, top_k,
-        top_p, eos_id, pad_id, min_new_tokens, presence_penalty,
-        frequency_penalty,
+    greedy, filtered, penalized, biased, op_arrays = (
+        _normalize_sampling(
+            cfg, logits.shape[0], max_new_tokens, temperature, rng,
+            top_k, top_p, eos_id, pad_id, min_new_tokens,
+            presence_penalty, frequency_penalty, logit_bias,
+        )
     )
     fn = _jitted_decode_from_cache(
-        cfg, max_new_tokens, greedy, filtered, penalized
+        cfg, max_new_tokens, greedy, filtered, penalized, biased
     )
     return fn(params, cache, logits, *op_arrays)

@@ -34,7 +34,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from .decode import (
+    BIAS_SLOTS,
     Cache,
+    apply_logit_bias,
     apply_token_penalties,
     count_token,
     decode_step,
@@ -104,7 +106,7 @@ def _jitted_chunk(cfg: TransformerConfig, slots: int, chunk: int):
 
     def run(params, pool, last, row_keys, step_idx, temperature,
             top_k, top_p, eos_id, pad_id, min_new, presence,
-            frequency, counts, done):
+            frequency, bias_idx, bias_val, counts, done):
         def body(carry, _):
             pool, tok, done, idx, counts = carry
             logits, pool = vstep(params, pool, tok[:, None])  # [S,1,V]
@@ -112,6 +114,9 @@ def _jitted_chunk(cfg: TransformerConfig, slots: int, chunk: int):
             masked = apply_token_penalties(
                 logits[:, 0, :], counts, presence, frequency
             )
+            # always-on operand (the pool program is ONE compile):
+            # idx -1 rows add exactly zero, bitwise-neutral
+            masked = apply_logit_bias(masked, bias_idx, bias_val)
             masked = mask_eos_before_min(masked, idx, min_new, eos_id)
             nxt = sample_logits(
                 masked, keys, temperature, top_k, top_p
@@ -127,7 +132,7 @@ def _jitted_chunk(cfg: TransformerConfig, slots: int, chunk: int):
         )
         return pool, last, done, counts, toks.T  # [S, chunk]
 
-    return jax.jit(run, donate_argnums=(1, 13))
+    return jax.jit(run, donate_argnums=(1, 15))
 
 
 def decode_slots_chunk(
@@ -144,19 +149,22 @@ def decode_slots_chunk(
     min_new: jax.Array,
     presence: jax.Array,
     frequency: jax.Array,
+    bias_idx: jax.Array,
+    bias_val: jax.Array,
     counts: jax.Array,
     done: jax.Array,
     cfg: TransformerConfig,
     chunk: int,
 ):
     """Advance the whole pool ``chunk`` tokens; see _jitted_chunk.
-    Returns (pool, last, done, counts, tokens [S, chunk]); the pool
-    AND the counts buffer are donated."""
+    ``bias_idx``/``bias_val`` are [S, BIAS_SLOTS] per-slot logit_bias
+    operands (-1 = unused slot). Returns (pool, last, done, counts,
+    tokens [S, chunk]); the pool AND the counts buffer are donated."""
     slots = int(last.shape[0])
     return _jitted_chunk(cfg, slots, chunk)(
         params, pool, last, row_keys, step_idx, temperature, top_k,
-        top_p, eos_id, pad_id, min_new, presence, frequency, counts,
-        done,
+        top_p, eos_id, pad_id, min_new, presence, frequency,
+        bias_idx, bias_val, counts, done,
     )
 
 
@@ -166,12 +174,17 @@ def _jitted_first_sample(cfg: TransformerConfig):
     schedule (fold_in(row_key, 0))."""
 
     def first(logits, row_key, temperature, top_k, top_p, eos_id,
-              min_new):
+              min_new, bias_idx, bias_val):
         # counts are empty at sample 0, so penalties are a no-op here
-        # by construction — identical to generate's first sample
+        # by construction — identical to generate's first sample.
+        # logit_bias DOES apply at sample 0 (generate biases every
+        # draw), hence the operands here.
         key = jax.random.fold_in(row_key, jnp.int32(0))
+        masked = apply_logit_bias(
+            logits, bias_idx[None], bias_val[None]
+        )
         masked = mask_eos_before_min(
-            logits, jnp.int32(0), min_new[None], eos_id[None]
+            masked, jnp.int32(0), min_new[None], eos_id[None]
         )
         return sample_logits(
             masked, key[None], temperature[None], top_k[None],
@@ -183,8 +196,14 @@ def _jitted_first_sample(cfg: TransformerConfig):
 
 def first_sample(logits, row_key, temperature, top_k, top_p,
                  cfg: TransformerConfig, eos_id: int = -1,
-                 min_new: int = 0) -> jax.Array:
-    """logits: [1, vocab] from prefill -> token 0 (scalar)."""
+                 min_new: int = 0, bias_idx=None,
+                 bias_val=None) -> jax.Array:
+    """logits: [1, vocab] from prefill -> token 0 (scalar).
+    ``bias_idx``/``bias_val``: [BIAS_SLOTS] logit_bias row (None =
+    no bias)."""
+    if bias_idx is None:
+        bias_idx = jnp.full((BIAS_SLOTS,), -1, jnp.int32)
+        bias_val = jnp.zeros((BIAS_SLOTS,), jnp.float32)
     return _jitted_first_sample(cfg)(
         logits, row_key,
         jnp.asarray(temperature, jnp.float32),
@@ -192,4 +211,6 @@ def first_sample(logits, row_key, temperature, top_k, top_p,
         jnp.asarray(top_p, jnp.float32),
         jnp.asarray(eos_id, jnp.int32),
         jnp.asarray(min_new, jnp.int32),
+        jnp.asarray(bias_idx, jnp.int32),
+        jnp.asarray(bias_val, jnp.float32),
     )

@@ -62,6 +62,49 @@ def restore_params_only(
     return RestoredParams(params, int(step), restored.ema)
 
 
+def parse_logit_bias(raw: Any, vocab_size: int):
+    """The ONE HTTP-facing ``logit_bias`` parser (single-host server
+    and pod frontend both call it — the bounds must not diverge):
+    OpenAI's {token_id: bias} with string or int keys; ``{}`` and
+    None are a no-op (OpenAI accepts an empty map). Raises ValueError
+    for the 422 path; the model-side normalize_logit_bias re-checks
+    the same bounds."""
+    if raw is None:
+        return None
+    from ..models.decode import BIAS_SLOTS
+
+    if not isinstance(raw, dict):
+        raise ValueError(
+            "'logit_bias' must be a {token_id: bias} object"
+        )
+    if not raw:
+        return None  # OpenAI semantics: an empty map is a no-op
+    if len(raw) > BIAS_SLOTS:
+        raise ValueError(
+            f"'logit_bias' is capped at {BIAS_SLOTS} tokens"
+        )
+    out = {}
+    for k, v in raw.items():
+        try:
+            tok = int(k)
+            bias = float(v)
+        except (TypeError, ValueError):
+            raise ValueError(
+                "'logit_bias' keys must be token ids and values "
+                "numbers"
+            ) from None
+        if not 0 <= tok < vocab_size:
+            raise ValueError(
+                f"'logit_bias' token ids must be in [0, {vocab_size})"
+            )
+        if not abs(bias) <= 100:
+            raise ValueError(
+                "'logit_bias' values must be in [-100, 100]"
+            )
+        out[tok] = bias
+    return out
+
+
 def validate_lora_flags(lora_dir: str, lora_rank: int) -> None:
     """Clean SystemExit for the flag-misuse cases every CLI shares."""
     if lora_rank > 0 and not lora_dir:

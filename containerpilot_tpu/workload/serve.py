@@ -327,6 +327,14 @@ class InferenceServer:
         ).encode()
         return Response(200, body, content_type="application/json")
 
+    def _parse_logit_bias(self, raw: Any) -> Optional[Dict[int, float]]:
+        """Delegates to the shared parser (modelcfg.parse_logit_bias)
+        so the single-host server and the pod frontend accept exactly
+        the same requests."""
+        from .modelcfg import parse_logit_bias
+
+        return parse_logit_bias(raw, self.cfg.vocab_size)
+
     def _parse_stops(self, raw: Any) -> List[List[int]]:
         """Token-level stop sequences: a list of non-empty id rows
         (the text surface converts strings before calling). Bounded so
@@ -370,7 +378,12 @@ class InferenceServer:
             "beam_width": int(body.get("beam_width", 0)),
             "length_penalty": float(body.get("length_penalty", 0.0)),
             "stop": self._parse_stops(body.get("stop")),
+            "logit_bias": self._parse_logit_bias(
+                body.get("logit_bias")
+            ),
         }
+        if p["logit_bias"] and p["beam_width"]:
+            raise ValueError("logit_bias does not apply to beam search")
         if p["beam_width"]:
             from ..models.beam import validate_beam_args
 
@@ -444,6 +457,7 @@ class InferenceServer:
             and p["temperature"] <= 0.0
             and p["min_new"] == 0
             and not p["presence"] and not p["frequency"]
+            and not p["logit_bias"]
             and len(tokens) == 1
         ):
             # greedy single-sequence: draft-and-verify, identical
@@ -464,6 +478,7 @@ class InferenceServer:
                 min_new=p["min_new"],
                 presence_penalty=p["presence"],
                 frequency_penalty=p["frequency"],
+                logit_bias=p["logit_bias"],
             )
             return [await asyncio.wrap_future(fut)]
         if (
@@ -482,7 +497,7 @@ class InferenceServer:
                 self._executor, generate_with_prefix, self, tokens[0],
                 p["max_new"], p["temperature"], p["top_k"], p["top_p"],
                 p["eos_id"], p["seed"], p["min_new"], p["presence"],
-                p["frequency"],
+                p["frequency"], p["logit_bias"],
             )
         if (
             self.prefill_chunk > 0
@@ -494,13 +509,14 @@ class InferenceServer:
                 tokens, prompt_len, p["max_new"], p["temperature"],
                 p["top_k"], p["top_p"], p["eos_id"], p["seed"],
                 p["min_new"], p["presence"], p["frequency"],
+                p["logit_bias"],
             )
         job = GenJob(
             rows=tokens, prompt_len=prompt_len, max_new=p["max_new"],
             temperature=p["temperature"], top_k=p["top_k"],
             top_p=p["top_p"], eos_id=p["eos_id"], seed=p["seed"],
             min_new=p["min_new"], presence=p["presence"],
-            frequency=p["frequency"],
+            frequency=p["frequency"], logit_bias=p["logit_bias"],
             future=loop.create_future(),
         )
         return await self._batcher.submit(job)
@@ -629,6 +645,7 @@ class InferenceServer:
             min_new=p["min_new"],
             presence_penalty=p["presence"],
             frequency_penalty=p["frequency"],
+            logit_bias=p["logit_bias"],
             on_tokens=on_tokens, cancel=cancel,
         )
         fut.add_done_callback(

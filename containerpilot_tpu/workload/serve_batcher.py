@@ -34,6 +34,9 @@ class GenJob:
     min_new: int = 0
     presence: float = 0.0
     frequency: float = 0.0
+    # one {token_id: bias} dict applied to every row of this job
+    # (requests are single-job; rows share the request's bias)
+    logit_bias: Optional[dict] = None
     future: "asyncio.Future[List[List[int]]]" = field(repr=False, default=None)
 
 
@@ -119,6 +122,7 @@ class Batcher:
             mins: List[int] = []
             press: List[float] = []
             freqs: List[float] = []
+            biases: List[Optional[dict]] = []
             keys = []
             for job in jobs:
                 base = jax.random.PRNGKey(job.seed)
@@ -131,6 +135,7 @@ class Batcher:
                     mins.append(job.min_new)
                     press.append(job.presence)
                     freqs.append(job.frequency)
+                    biases.append(job.logit_bias)
                     keys.append(jax.random.fold_in(base, i))
             # bucket the batch dim to powers of two so concurrency
             # spikes can't compile one program per row count
@@ -147,6 +152,7 @@ class Batcher:
                 mins.append(0)
                 press.append(0.0)
                 freqs.append(0.0)
+                biases.append(None)
                 keys.append(jax.random.PRNGKey(0))
             out = generate(
                 self.params,
@@ -162,6 +168,9 @@ class Batcher:
                 min_new_tokens=mins,
                 presence_penalty=press,
                 frequency_penalty=freqs,
+                logit_bias=(
+                    biases if any(b for b in biases) else None
+                ),
             )
             n_real = len(rows) - pad_rows
             return jax.device_get(out[:n_real]).tolist()

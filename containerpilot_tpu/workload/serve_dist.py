@@ -66,6 +66,8 @@ import numpy as np
 
 log = logging.getLogger("containerpilot.serve_dist")
 
+from ..models.decode import BIAS_SLOTS
+
 OP_SHUTDOWN = 0
 OP_GENERATE = 1
 OP_HEARTBEAT = 2  # idle liveness tick: bounds every broadcast wait
@@ -87,6 +89,8 @@ def _payload_zeros(max_len: int) -> Dict[str, np.ndarray]:
         "min_new": np.zeros((), np.int32),
         "presence": np.zeros((), np.float32),
         "frequency": np.zeros((), np.float32),
+        "bias_idx": np.full((BIAS_SLOTS,), -1, np.int32),
+        "bias_val": np.zeros((BIAS_SLOTS,), np.float32),
     }
 
 
@@ -110,6 +114,11 @@ def _payload_for(req: Dict[str, Any], max_len: int) -> Dict[str, np.ndarray]:
     p["min_new"] = np.asarray(req.get("min_new", 0), np.int32)
     p["presence"] = np.asarray(req.get("presence", 0.0), np.float32)
     p["frequency"] = np.asarray(req.get("frequency", 0.0), np.float32)
+    for j, (tok_id, bias) in enumerate(
+        sorted((req.get("logit_bias") or {}).items())
+    ):
+        p["bias_idx"][j] = tok_id
+        p["bias_val"][j] = bias
     return p
 
 
@@ -146,6 +155,13 @@ def _decode_pod(params, cfg, payload, max_len: int):
     row_key = jax.random.fold_in(
         jax.random.PRNGKey(int(payload["seed"])), 0
     )
+    # rebuild the dict form generate expects; every host derives the
+    # identical dict from the identical broadcast arrays
+    bias = {
+        int(i): float(v)
+        for i, v in zip(payload["bias_idx"], payload["bias_val"])
+        if int(i) >= 0
+    }
     return generate(
         params, prompt, cfg, max_new_tokens=max_new, max_len=max_len,
         temperature=float(payload["temperature"]),
@@ -156,6 +172,7 @@ def _decode_pod(params, cfg, payload, max_len: int):
         min_new_tokens=int(payload["min_new"]),
         presence_penalty=float(payload["presence"]),
         frequency_penalty=float(payload["frequency"]),
+        logit_bias=bias or None,
     )
 
 
@@ -249,6 +266,11 @@ class _Frontend:
                     "presence/frequency penalties must be in "
                     "[-100, 100]"
                 )
+            from .modelcfg import parse_logit_bias
+
+            bias = parse_logit_bias(
+                body.get("logit_bias"), self.vocab
+            ) or {}
             work = {
                 "tokens": tokens, "max_new": max_new,
                 "temperature": float(body.get("temperature", 0.0)),
@@ -259,6 +281,7 @@ class _Frontend:
                 "min_new": min_new,
                 "presence": presence,
                 "frequency": frequency,
+                "logit_bias": bias,
             }
         except (ValueError, KeyError, TypeError, OverflowError) as exc:
             return self._Response(422, f"{exc}\n".encode())

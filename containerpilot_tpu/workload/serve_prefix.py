@@ -74,6 +74,7 @@ def generate_with_prefix(
     min_new: int = 0,
     presence: float = 0.0,
     frequency: float = 0.0,
+    logit_bias: Any = None,
 ) -> List[List[int]]:
     """Single-row generation reusing the longest cached prompt prefix.
 
@@ -139,5 +140,6 @@ def generate_with_prefix(
         top_k=top_k, top_p=top_p, eos_id=eos_id,
         pos=plen, min_new_tokens=min_new,
         presence_penalty=presence, frequency_penalty=frequency,
+        logit_bias=logit_bias,
     )
     return jax.device_get(out).tolist()

@@ -31,7 +31,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.decode import _jitted_prefill
+from ..models.decode import (
+    BIAS_SLOTS,
+    _jitted_prefill,
+    normalize_logit_bias,
+)
 from ..models.slots import (
     decode_slots_chunk,
     first_sample,
@@ -56,6 +60,9 @@ class _Request:
     min_new: int = 0
     presence: float = 0.0
     frequency: float = 0.0
+    # [BIAS_SLOTS] logit_bias row (idx -1 = unused); None = no bias
+    bias_idx: Optional[object] = None
+    bias_val: Optional[object] = None
     # streaming: called from the worker thread with each newly emitted
     # token delta (already eos/max_new-capped — concatenation equals
     # the future's final result exactly)
@@ -108,6 +115,8 @@ class SlotEngine:
         self._min_new = np.zeros((slots,), np.int32)
         self._presence = np.zeros((slots,), np.float32)
         self._frequency = np.zeros((slots,), np.float32)
+        self._bias_idx = np.full((slots, BIAS_SLOTS), -1, np.int32)
+        self._bias_val = np.zeros((slots, BIAS_SLOTS), np.float32)
         # generated-token counts per slot, device-resident (the chunk
         # program reads and donates it like the pool)
         self._counts = jnp.zeros((slots, cfg.vocab_size), jnp.float32)
@@ -136,15 +145,19 @@ class SlotEngine:
         min_new: int = 0,
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0,
+        logit_bias=None,
         on_tokens: Optional[callable] = None,
         cancel: Optional[threading.Event] = None,
     ) -> Future:
         """Queue one sequence; resolves to its generated ids.
 
-        ``on_tokens`` (worker-thread callback) streams each emitted
-        delta; ``cancel`` (a threading.Event the caller sets, e.g. on
-        client disconnect) frees the slot at the next chunk boundary —
-        the future then resolves with whatever was emitted."""
+        ``logit_bias``: a {token_id: bias} dict (generate's contract,
+        validated here so a bad request fails the submit, not the
+        pool). ``on_tokens`` (worker-thread callback) streams each
+        emitted delta; ``cancel`` (a threading.Event the caller sets,
+        e.g. on client disconnect) frees the slot at the next chunk
+        boundary — the future then resolves with whatever was
+        emitted."""
         if max_new < 1:
             raise ValueError("max_new must be >= 1")
         if not 0 <= min_new <= max_new:
@@ -158,6 +171,12 @@ class SlotEngine:
                 f"prompt {len(tokens)} + max_new {max_new} exceeds "
                 f"max_len {self.max_len}"
             )
+        bias_idx = bias_val = None
+        if logit_bias:
+            rows_idx, rows_val = normalize_logit_bias(
+                self.cfg, 1, logit_bias
+            )
+            bias_idx, bias_val = rows_idx[0], rows_val[0]
         req = _Request(
             tokens=list(tokens), max_new=int(max_new),
             temperature=float(temperature), top_k=int(top_k),
@@ -165,6 +184,7 @@ class SlotEngine:
             seed=int(seed), min_new=int(min_new),
             presence=float(presence_penalty),
             frequency=float(frequency_penalty),
+            bias_idx=bias_idx, bias_val=bias_val,
             on_tokens=on_tokens, cancel=cancel,
         )
         # atomic with stop()'s drain: either this put lands before the
@@ -220,6 +240,7 @@ class SlotEngine:
         first = first_sample(
             logits, row_key, req.temperature, req.top_k, req.top_p,
             cfg, eos_id=req.eos_id, min_new=req.min_new,
+            bias_idx=req.bias_idx, bias_val=req.bias_val,
         )
         first_host = int(jax.device_get(first))
         self._pool = insert_row(self._pool, row_cache, slot_id, cfg)
@@ -234,6 +255,12 @@ class SlotEngine:
         self._min_new[slot_id] = req.min_new
         self._presence[slot_id] = req.presence
         self._frequency[slot_id] = req.frequency
+        if req.bias_idx is not None:
+            self._bias_idx[slot_id] = req.bias_idx
+            self._bias_val[slot_id] = req.bias_val
+        else:
+            self._bias_idx[slot_id] = -1
+            self._bias_val[slot_id] = 0.0
         # fresh generated-token counts; sample 0 (just drawn) counts
         # unless it ended the row — matching generate's scan exactly
         row_counts = jnp.zeros((self.cfg.vocab_size,), jnp.float32)
@@ -337,6 +364,8 @@ class SlotEngine:
                         jnp.asarray(self._min_new),
                         jnp.asarray(self._presence),
                         jnp.asarray(self._frequency),
+                        jnp.asarray(self._bias_idx),
+                        jnp.asarray(self._bias_val),
                         self._counts,
                         jnp.asarray(self._done),
                         self.cfg, self.chunk,

@@ -57,6 +57,7 @@ def run_chunked(
     min_new: int = 0,
     presence: float = 0.0,
     frequency: float = 0.0,
+    logit_bias: Any = None,
 ) -> List[List[int]]:
     """Long single-row prompt: stream the prefill in chunks (peak
     prefill activations O(chunk) instead of O(prompt))."""
@@ -75,5 +76,6 @@ def run_chunked(
         top_k=top_k, top_p=top_p, eos_id=eos_id,
         pos=prompt_len, min_new_tokens=min_new,
         presence_penalty=presence, frequency_penalty=frequency,
+        logit_bias=logit_bias,
     )
     return jax.device_get(out).tolist()

@@ -184,10 +184,13 @@ def test_pod_serves_http(tmp_path, n_procs, dp):
         knobs = post({
             "tokens": [[7, 8, 9]], "max_new_tokens": 6,
             "min_new_tokens": 3, "frequency_penalty": 30.0,
+            "logit_bias": {"11": -100},
         })
         assert knobs["tokens"][0] == _reference(
-            [7, 8, 9], 6, min_new_tokens=3, frequency_penalty=30.0
+            [7, 8, 9], 6, min_new_tokens=3, frequency_penalty=30.0,
+            logit_bias={11: -100.0},
         )
+        assert 11 not in knobs["tokens"][0]
 
         # graceful pod shutdown: TERM on the frontend broadcasts the
         # stop; ALL processes exit 0

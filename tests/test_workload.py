@@ -3417,6 +3417,172 @@ def test_penalties_suppress_repetition(run):
     assert s2 == 422
 
 
+def test_logit_bias_math_and_validation():
+    """apply_logit_bias: -1 slots are bitwise-neutral, entries add
+    exactly; normalize_logit_bias rejects the same bounds the HTTP
+    layer documents."""
+    import numpy as np
+
+    from containerpilot_tpu.models.decode import (
+        BIAS_SLOTS,
+        apply_logit_bias,
+        normalize_logit_bias,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=16, dtype=jnp.float32,
+    )
+    logits = jnp.arange(2 * 32, dtype=jnp.float32).reshape(2, 32)
+    idx, val = normalize_logit_bias(
+        cfg, 2, [{5: 3.0, 7: -2.0}, None]
+    )
+    out = apply_logit_bias(logits, jnp.asarray(idx), jnp.asarray(val))
+    expect = np.array(logits)  # writable copy
+    expect[0, 5] += 3.0
+    expect[0, 7] += -2.0
+    np.testing.assert_array_equal(np.asarray(out), expect)
+    # all-empty bias is bitwise-neutral
+    idx0, val0 = normalize_logit_bias(cfg, 2, None)
+    np.testing.assert_array_equal(
+        np.asarray(
+            apply_logit_bias(logits, jnp.asarray(idx0),
+                             jnp.asarray(val0))
+        ),
+        np.asarray(logits),
+    )
+    for bad in (
+        {99: 1.0},             # out of vocab
+        {3: 500.0},            # out of range
+        {i: 1.0 for i in range(BIAS_SLOTS + 1)},  # over cap
+    ):
+        with pytest.raises(ValueError):
+            normalize_logit_bias(cfg, 1, bad)
+
+
+def test_logit_bias_forces_and_bans_across_paths():
+    """OpenAI semantics end-to-end: +100 effectively forces a token
+    every step, -100 bans one, greedy and sampled — and the slot
+    engine's emission matches generate's with the same bias."""
+    from containerpilot_tpu.models.decode import generate
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload.serve_slots import SlotEngine
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+
+    forced = generate(
+        params, prompt, cfg, 6, 32, logit_bias={9: 100.0}
+    )
+    assert [int(t) for t in forced[0]] == [9] * 6
+
+    plain = [int(t) for t in generate(params, prompt, cfg, 6, 32)[0]]
+    banned_id = plain[0]
+    banned = generate(
+        params, prompt, cfg, 6, 32, logit_bias={banned_id: -100.0}
+    )
+    assert banned_id not in [int(t) for t in banned[0]]
+
+    # sampled path: the ban holds under temperature too
+    rng = jnp.stack([jax.random.fold_in(jax.random.PRNGKey(7), 0)])
+    sampled = generate(
+        params, prompt, cfg, 8, 32, temperature=1.2, rng=rng,
+        logit_bias={banned_id: -100.0},
+    )
+    assert banned_id not in [int(t) for t in sampled[0]]
+
+    # slot engine parity with the same bias (server key convention)
+    eng = SlotEngine(cfg, params, 32, slots=2, chunk=3)
+    try:
+        got = eng.submit(
+            [1, 2, 3], max_new=6, logit_bias={9: 100.0}
+        ).result(timeout=120)
+        assert got == [9] * 6
+        ref = generate(
+            params, prompt, cfg, 6, 32,
+            rng=jnp.stack(
+                [jax.random.fold_in(jax.random.PRNGKey(0), 0)]
+            ),
+            logit_bias={banned_id: -100.0},
+        )
+        got2 = eng.submit(
+            [1, 2, 3], max_new=6, logit_bias={banned_id: -100.0}
+        ).result(timeout=120)
+        assert got2 == [int(t) for t in ref[0]]
+    finally:
+        eng.stop()
+
+
+def test_logit_bias_over_http(run):
+    """/v1/generate accepts OpenAI's string-keyed logit_bias through
+    the batcher path; bad requests 422; beam rejects it."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server = InferenceServer(cfg, params, "127.0.0.1", 0, max_len=32)
+
+    def fetch(body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            return exc.code, exc.read().decode()
+
+    async def scenario():
+        import asyncio
+
+        await server.run()
+        loop = asyncio.get_event_loop()
+
+        def go():
+            base = {"tokens": [[1, 2, 3]], "max_new_tokens": 5}
+            s_plain, plain = fetch(base)
+            s_force, forced = fetch(
+                {**base, "logit_bias": {"9": 100}}
+            )
+            # OpenAI semantics: an empty map is a no-op, not an error
+            s_empty, empty = fetch({**base, "logit_bias": {}})
+            s_bad1, _ = fetch({**base, "logit_bias": {"999": 1}})
+            s_bad2, _ = fetch({**base, "logit_bias": {"3": 1000}})
+            s_bad3, _ = fetch({**base, "logit_bias": []})
+            s_beam, beam_err = fetch(
+                {**base, "logit_bias": {"9": 1}, "beam_width": 2}
+            )
+            return (s_plain, plain), (s_force, forced), \
+                (s_empty, empty), s_bad1, s_bad2, s_bad3, \
+                (s_beam, beam_err)
+
+        out = await loop.run_in_executor(None, go)
+        await server.stop()
+        return out
+
+    ((s_plain, plain), (s_force, forced), (s_empty, empty), s_bad1,
+     s_bad2, s_bad3, (s_beam, beam_err)) = run(scenario())
+    assert s_force == 200 and forced["tokens"][0] == [9] * 5
+    assert s_plain == s_empty == 200
+    assert empty["tokens"] == plain["tokens"]
+    assert s_bad1 == s_bad2 == s_bad3 == 422
+    assert s_beam == 422 and "beam" in beam_err
+
+
 def test_fuzz_generate_knob_combinations():
     """Random combinations of every sampling knob against the
     invariants that must hold regardless: output shape, pads after
@@ -3425,7 +3591,7 @@ def test_fuzz_generate_knob_combinations():
     knobs only widen the combination space). Knob values are drawn so
     the combos reuse a small
     set of compiled programs (max_new fixed; greedy/filtered/
-    penalized each toggled)."""
+    penalized/biased each toggled)."""
     import random
 
     import jax
@@ -3456,6 +3622,10 @@ def test_fuzz_generate_knob_combinations():
             "min_new_tokens": rng.choice([0, 0, 3]),
             "presence_penalty": rng.choice([0.0, 0.0, 1.5]),
             "frequency_penalty": rng.choice([0.0, 0.0, 2.0]),
+            "logit_bias": rng.choice([
+                None, None,
+                {rng.randrange(cfg.vocab_size): rng.choice([-100.0, -5.0, 5.0])},
+            ]),
         }
         prompt = jnp.asarray(
             [[rng.randrange(cfg.vocab_size) for _ in range(4)]],
@@ -3481,3 +3651,10 @@ def test_fuzz_generate_knob_combinations():
                 assert first >= kw["min_new_tokens"], label
                 # ...and everything after the first eos is pad (0)
                 assert (out1[first + 1:] == 0).all(), label
+        bias = kw["logit_bias"]
+        if bias:
+            ((tok, val),) = bias.items()
+            if val <= -100.0 and tok != 0 and tok != eos:
+                # a full ban keeps the token out (pad 0 and eos fill
+                # rows for other reasons, so those ids are exempt)
+                assert tok not in out1, label
