@@ -149,6 +149,12 @@ def prefill(
             attn = flash_attention_forward(
                 q, k, v, block_q=fq, block_k=fk, window=cfg.window
             )
+        elif getattr(attn_fn, "gqa_native", False):
+            # ring attention (context-parallel prefill): the ring
+            # rotates the SMALL grouped K/V over ICI — repeating
+            # first would ship n_heads/kv_heads x more bytes per hop
+            # (transformer.py honors the same flag)
+            attn = attn_fn(q, k, v)
         else:
             attn = attn_fn(
                 q, repeat_kv(k, cfg.n_heads), repeat_kv(v, cfg.n_heads)

@@ -14,7 +14,11 @@ from .checkpoint import (
     restore_params,
     save_checkpoint,
 )
-from .context import context_parallel_config, flash_parallel_config
+from .context import (
+    context_parallel_config,
+    cp_generate,
+    flash_parallel_config,
+)
 from .distributed import initialize_from_catalog, initialize_from_env
 from .watchdog import StepWatchdog
 from .mesh import MeshPlan, make_mesh
@@ -45,6 +49,7 @@ from .train import (
 __all__ = [
     "MeshPlan",
     "context_parallel_config",
+    "cp_generate",
     "flash_parallel_config",
     "make_pipeline_train_step",
     "make_mesh",

@@ -6,12 +6,21 @@ XLA partitions it from the shard_map boundary's in/out specs. The rest
 of the stack — sharding rules, optimizer, train step — is unchanged:
 context parallelism composes with tensor and data parallelism by
 construction.
+
+Serving gets the same long-context story through ``cp_generate``: the
+PREFILL — the quadratic, activation-heavy part of a long-prompt
+request — runs ring attention over the seq axis, then the KV cache
+gathers off the ring once and the decode scan runs on the existing
+(unsharded) path with the full sampling contract.
 """
 from __future__ import annotations
 
 import dataclasses
+import functools
 
-from jax.sharding import Mesh, PartitionSpec as P
+import jax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.transformer import TransformerConfig, flash_eligible
 from ..ops.ring_attention import ring_attention, shard_map
@@ -80,3 +89,84 @@ def context_parallel_config(
     # ICI); the layer passes unrepeated heads through
     attn.gqa_native = True
     return dataclasses.replace(cfg, attention_fn=attn)
+
+
+@functools.lru_cache(maxsize=8)
+def _cp_prefill_fn(cfg: TransformerConfig, mesh: Mesh, max_len: int,
+                   axis_name: str):
+    """One compiled context-parallel prefill per (config, mesh,
+    max_len): ring attention over the seq axis while every other op
+    stays seq-local under XLA's partitioner, then ONE gather point —
+    the decode scan reads the whole cache every step, so the cache
+    leaves the ring replicated here rather than re-gathering per
+    step. Cached at this level because context_parallel_config builds
+    a fresh attention closure per call (a fresh closure would defeat
+    jit's own cache)."""
+    cfg_cp = context_parallel_config(cfg, mesh, axis_name)
+    from ..models.decode import prefill
+
+    replicated = NamedSharding(mesh, P())
+
+    def fn(params, prompt):
+        logits, cache = prefill(params, prompt, cfg_cp, max_len)
+        cache = jax.tree.map(
+            lambda x: lax.with_sharding_constraint(x, replicated),
+            cache,
+        )
+        return lax.with_sharding_constraint(logits, replicated), cache
+
+    return jax.jit(fn)
+
+
+def cp_generate(
+    params,
+    prompt: jax.Array,
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    max_new_tokens: int,
+    max_len: int,
+    axis_name: str = "seq",
+    **sampling,
+):
+    """Long-prompt generation with context-parallel prefill: the
+    prompt shards over ``axis_name`` (each device holds seq/P tokens;
+    the quadratic attention runs as a ring, activations stay
+    seq-local), the cache gathers once, and the decode runs
+    ``generate_from_cache`` with the full sampling contract
+    (temperature/top_k/top_p/eos/min_new/penalties/logit_bias).
+
+    The prompt length must divide by the seq axis (ring_attention's
+    contract); callers bucket long prompts to multiples of the axis.
+    Numerics: ring attention's online softmax is the same math as
+    single-device attention up to float reassociation — greedy output
+    matches the unsharded path away from argmax ties.
+    """
+    plen = int(prompt.shape[1])
+    if axis_name not in mesh.axis_names:
+        raise ValueError(
+            f"mesh has no {axis_name!r} axis: {mesh.axis_names} "
+            "(build it with MeshPlan(seq=...))"
+        )
+    axis = mesh.shape[axis_name]
+    if plen % axis:
+        raise ValueError(
+            f"prompt len {plen} must divide by {axis_name}={axis} "
+            "(bucket long prompts to multiples of the seq axis)"
+        )
+    if plen + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt_len {plen} + max_new_tokens {max_new_tokens} "
+            f"exceeds max_len {max_len}"
+        )
+    from ..models.decode import generate_from_cache
+
+    prompt = jax.device_put(
+        prompt, NamedSharding(mesh, P(None, axis_name))
+    )
+    logits, cache = _cp_prefill_fn(cfg, mesh, max_len, axis_name)(
+        params, prompt
+    )
+    return generate_from_cache(
+        params, cache, logits, cfg, max_new_tokens, pos=plen,
+        **sampling,
+    )
