@@ -135,8 +135,11 @@ def cp_generate(
     ``generate_from_cache`` with the full sampling contract
     (temperature/top_k/top_p/eos/min_new/penalties/logit_bias).
 
-    The prompt length must divide by the seq axis (ring_attention's
-    contract); callers bucket long prompts to multiples of the axis.
+    Ring attention needs the sharded length to divide by the seq
+    axis, so the largest axis-divisible HEAD of the prompt rings
+    through prefill and any remainder (< axis tokens) extends the
+    gathered cache with one short decode_chunk — arbitrary prompt
+    lengths, exact semantics, at most axis-1 tiny extend programs.
     Numerics: ring attention's online softmax is the same math as
     single-device attention up to float reassociation — greedy output
     matches the unsharded path away from argmax ties.
@@ -148,24 +151,29 @@ def cp_generate(
             "(build it with MeshPlan(seq=...))"
         )
     axis = mesh.shape[axis_name]
-    if plen % axis:
+    head = plen - plen % axis
+    if head == 0:
         raise ValueError(
-            f"prompt len {plen} must divide by {axis_name}={axis} "
-            "(bucket long prompts to multiples of the seq axis)"
+            f"prompt len {plen} is shorter than the {axis_name} axis "
+            f"({axis}): nothing to shard — use the plain path"
         )
     if plen + max_new_tokens > max_len:
         raise ValueError(
             f"prompt_len {plen} + max_new_tokens {max_new_tokens} "
             f"exceeds max_len {max_len}"
         )
-    from ..models.decode import generate_from_cache
+    from ..models.decode import _jitted_extend, generate_from_cache
 
-    prompt = jax.device_put(
-        prompt, NamedSharding(mesh, P(None, axis_name))
+    sharded_head = jax.device_put(
+        prompt[:, :head], NamedSharding(mesh, P(None, axis_name))
     )
     logits, cache = _cp_prefill_fn(cfg, mesh, max_len, axis_name)(
-        params, prompt
+        params, sharded_head
     )
+    if head < plen:
+        logits, cache = _jitted_extend(cfg)(
+            params, cache, prompt[:, head:]
+        )
     return generate_from_cache(
         params, cache, logits, cfg, max_new_tokens, pos=plen,
         **sampling,

@@ -98,6 +98,8 @@ class InferenceServer:
         text: bool = False,
         slots: int = 0,
         slot_chunk: int = 8,
+        cp_mesh: Any = None,
+        cp_min_len: int = 0,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -105,6 +107,41 @@ class InferenceServer:
         self.port = port
         self.max_len = max_len
         self.ready = False
+        # context-parallel prefill: single-row prompts at least
+        # cp_min_len long ring over the mesh's seq axis
+        # (parallel.cp_generate); everything else takes the usual
+        # paths. Composition is validated at startup below.
+        self.cp_mesh = cp_mesh
+        self.cp_min_len = cp_min_len
+        if cp_mesh is not None:
+            seq_axis = cp_mesh.shape.get("seq", 1)
+            if seq_axis <= 1:
+                raise ValueError(
+                    "--cp mesh needs a seq axis > 1 "
+                    "(MeshPlan(seq=...))"
+                )
+            if cp_min_len == 0:
+                # unset: default to something that amortizes a ring
+                self.cp_min_len = 8 * seq_axis
+            elif cp_min_len < seq_axis:
+                # an explicit value below the axis is unusable (the
+                # prompt's head must cover the axis) — honor the
+                # user's intent by clamping to the floor, not by
+                # silently overriding with the default
+                self.cp_min_len = seq_axis
+            for flag, why in (
+                (slots > 0, "--slots (the pool prefills per slot)"),
+                (draft_layers > 0, "--draft-layers (speculative "
+                 "prefill is chunk-driven)"),
+                (prefix_cache_entries > 0, "--prefix-cache (cached "
+                 "prefixes bypass the ring)"),
+                (cfg.window > 0, "--window (ring attention rejects "
+                 "sliding windows)"),
+            ):
+                if flag:
+                    raise ValueError(
+                        f"--cp does not compose with {why}"
+                    )
         # self-speculative decoding: a layer-prefix draft accelerates
         # greedy single-sequence generation, output unchanged
         self.draft_params = self.draft_cfg = None
@@ -323,6 +360,13 @@ class InferenceServer:
                 ),
                 # SSE streaming rides the slot engine's chunks
                 "stream": self.slot_engine is not None,
+                "cp": (
+                    {
+                        "seq": int(self.cp_mesh.shape["seq"]),
+                        "min_len": self.cp_min_len,
+                    }
+                    if self.cp_mesh is not None else None
+                ),
             }
         ).encode()
         return Response(200, body, content_type="application/json")
@@ -481,6 +525,17 @@ class InferenceServer:
                 logit_bias=p["logit_bias"],
             )
             return [await asyncio.wrap_future(fut)]
+        if (
+            self.cp_mesh is not None
+            and len(tokens) == 1
+            and prompt_len >= self.cp_min_len
+        ):
+            # long prompt: the prefill — the quadratic part — rings
+            # over the seq axis; decode runs the normal scan
+            return await in_exec(
+                self._executor, serve_strategies.run_cp, self,
+                tokens, p,
+            )
         if (
             self.prefix_cache is not None
             and len(tokens) == 1

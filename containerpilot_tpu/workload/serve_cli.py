@@ -120,6 +120,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "local devices (heads/ffn/vocab partitioned, XLA inserts the "
         "collectives); 1 = single device",
     )
+    parser.add_argument(
+        "--cp", type=int, default=1,
+        help="context-parallel prefill ways: long single-row prompts "
+        "ring their prefill over a seq axis of N local devices "
+        "(parallel.cp_generate); 1 = off. Does not compose with "
+        "--tp/--slots/--draft-layers/--prefix-cache/--window",
+    )
+    parser.add_argument(
+        "--cp-min-len", type=int, default=0,
+        help="prompts at least this long take the --cp ring "
+        "(default 8x the seq axis)",
+    )
     return parser
 
 
@@ -223,6 +235,26 @@ def main() -> int:
     enable_compile_cache()
     args = build_arg_parser().parse_args()
     cfg, params = load_model(args)
+    cp = getattr(args, "cp", 1) or 1
+    cp_mesh = None
+    if cp > 1:
+        import jax as _jax
+
+        from ..parallel import MeshPlan, make_mesh
+
+        if getattr(args, "tp", 1) > 1:
+            raise SystemExit(
+                "--cp does not compose with --tp (one mesh per "
+                "server; a seq x model serving mesh is future work)"
+            )
+        devices = _jax.devices()
+        if cp > len(devices):
+            raise SystemExit(
+                f"--cp {cp} exceeds the {len(devices)} local devices"
+            )
+        cp_mesh = make_mesh(
+            devices[:cp], plan=MeshPlan(data=1, model=1, seq=cp)
+        )
     server = InferenceServer(
         cfg, params, args.host, args.port, args.max_len,
         draft_layers=args.draft_layers, speculate=args.speculate,
@@ -231,6 +263,7 @@ def main() -> int:
         prefill_chunk=args.prefill_chunk,
         slots=args.slots, slot_chunk=args.slot_chunk,
         text=args.text,
+        cp_mesh=cp_mesh, cp_min_len=getattr(args, "cp_min_len", 0),
     )
 
     async def serve() -> None:

@@ -51,6 +51,28 @@ def run_speculative(
     return jax.device_get(out).tolist()
 
 
+def run_cp(srv: Any, tokens: List[List[int]], p: dict) -> List[List[int]]:
+    """Context-parallel prefill for one long row: ring attention over
+    the server's seq mesh, cache gathered once, normal decode
+    (parallel.cp_generate) with the server's key convention."""
+    from ..parallel import cp_generate
+
+    srv.batch_stats["calls"] += 1
+    srv.batch_stats["rows"] += 1
+    out = cp_generate(
+        srv.params, jnp.asarray(tokens, jnp.int32), srv.cfg,
+        srv.cp_mesh, p["max_new"], srv.max_len,
+        temperature=p["temperature"],
+        rng=jnp.stack(
+            [jax.random.fold_in(jax.random.PRNGKey(p["seed"]), 0)]
+        ),
+        top_k=p["top_k"], top_p=p["top_p"], eos_id=p["eos_id"],
+        min_new_tokens=p["min_new"], presence_penalty=p["presence"],
+        frequency_penalty=p["frequency"], logit_bias=p["logit_bias"],
+    )
+    return jax.device_get(out).tolist()
+
+
 def run_chunked(
     srv: Any, tokens: List[List[int]], prompt_len: int, max_new: int,
     temperature: float, top_k: int, top_p: float, eos_id: int, seed: int,

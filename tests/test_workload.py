@@ -422,15 +422,106 @@ def test_cp_generate_matches_unsharded(run):
     assert [int(t) for t in cp_s[0]] == [int(t) for t in plain_s[0]]
     assert 7 not in [int(t) for t in cp_s[0]]
 
+    # a non-axis-divisible prompt: the divisible head rings, the
+    # remainder extends the gathered cache — still byte-equal
+    odd = jax.random.randint(
+        jax.random.PRNGKey(9), (1, 30), 0, cfg.vocab_size, jnp.int32
+    )
+    plain_odd = generate(params, odd, cfg, 6, 128)
+    cp_odd = cp_generate(params, odd, cfg, mesh, 6, 128)
+    assert [int(t) for t in cp_odd[0]] == [int(t) for t in plain_odd[0]]
+
     # contract checks fail loudly
-    bad = jnp.ones((1, 30), jnp.int32)  # 30 % 8 != 0
-    with pytest.raises(ValueError, match="divide"):
-        cp_generate(params, bad, cfg, mesh, 4, 128)
+    with pytest.raises(ValueError, match="shorter than"):
+        cp_generate(params, jnp.ones((1, 6), jnp.int32), cfg, mesh,
+                    4, 128)
     with pytest.raises(ValueError, match="exceeds max_len"):
         cp_generate(params, prompt, cfg, mesh, 128, 128)
     no_seq = make_mesh(jax.devices()[:8], plan=MeshPlan(data=1, model=8))
     with pytest.raises(ValueError, match="no 'seq' axis"):
         cp_generate(params, prompt, cfg, no_seq, 4, 128)
+
+
+def test_serve_cp_long_prompt_matches_vanilla(run):
+    """--cp end-to-end: a server with a seq-axis mesh answers long
+    prompts byte-identically to a vanilla server (the cp ring prefill
+    feeds the same decode), short prompts take the normal path, and
+    /v1/model reports the cp config; bad compositions fail at
+    construction."""
+    import json
+    import urllib.request
+
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.parallel import MeshPlan, make_mesh
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_kv_heads=2,
+        n_layers=2, d_ff=64, max_seq_len=128, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh(
+        jax.devices()[:8], plan=MeshPlan(data=1, model=1, seq=8)
+    )
+    cp_srv = InferenceServer(
+        cfg, params, "127.0.0.1", 0, max_len=128, cp_mesh=mesh,
+        cp_min_len=32,
+    )
+    vanilla = InferenceServer(cfg, params, "127.0.0.1", 0, max_len=128)
+
+    with pytest.raises(ValueError, match="--cp does not compose"):
+        InferenceServer(
+            cfg, params, "127.0.0.1", 0, max_len=128, cp_mesh=mesh,
+            slots=2,
+        )
+
+    import numpy as _np
+
+    long_prompt = _np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=45
+    ).tolist()
+
+    def fetch(port, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return json.loads(resp.read().decode())
+
+    async def scenario():
+        import asyncio
+
+        await cp_srv.run()
+        await vanilla.run()
+        loop = asyncio.get_event_loop()
+
+        def go():
+            reqs = [
+                {"tokens": [long_prompt], "max_new_tokens": 6},
+                {"tokens": [long_prompt], "max_new_tokens": 5,
+                 "temperature": 0.8, "top_k": 10, "seed": 4},
+                {"tokens": [[1, 2, 3]], "max_new_tokens": 4},  # short
+            ]
+            pairs = [
+                (fetch(cp_srv.port, r), fetch(vanilla.port, r))
+                for r in reqs
+            ]
+            info = urllib.request.urlopen(
+                f"http://127.0.0.1:{cp_srv.port}/v1/model", timeout=30
+            ).read().decode()
+            return pairs, json.loads(info)
+
+        out = await loop.run_in_executor(None, go)
+        await cp_srv.stop()
+        await vanilla.stop()
+        return out
+
+    pairs, info = run(scenario(), timeout=300)
+    for got, want in pairs:
+        assert got["tokens"] == want["tokens"]
+    assert info["cp"] == {"seq": 8, "min_len": 32}
 
 
 def test_ring_attention_gqa_native():
