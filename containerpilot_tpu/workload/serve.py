@@ -129,6 +129,15 @@ class InferenceServer:
                 # user's intent by clamping to the floor, not by
                 # silently overriding with the default
                 self.cp_min_len = seq_axis
+            if self.cp_min_len >= max_len:
+                # fail at startup, not as a feature that silently
+                # never engages: every admissible prompt satisfies
+                # prompt_len + max_new <= max_len < cp_min_len
+                raise ValueError(
+                    f"--cp never engages: cp_min_len "
+                    f"{self.cp_min_len} >= max_len {max_len} "
+                    "(lower --cp-min-len or raise --max-len)"
+                )
             for flag, why in (
                 (slots > 0, "--slots (the pool prefills per slot)"),
                 (draft_layers > 0, "--draft-layers (speculative "
@@ -428,6 +437,23 @@ class InferenceServer:
         }
         if p["logit_bias"] and p["beam_width"]:
             raise ValueError("logit_bias does not apply to beam search")
+        p["n"] = int(body.get("n", 1))
+        if not 1 <= p["n"] <= self.max_batch_rows:
+            raise ValueError(
+                f"n must be in [1, --max-batch-rows "
+                f"{self.max_batch_rows}]"
+            )
+        if p["n"] > 1:
+            if len(tokens) != 1:
+                raise ValueError(
+                    "n > 1 takes a single prompt row (it IS the "
+                    "row multiplier)"
+                )
+            if p["beam_width"]:
+                raise ValueError(
+                    "n does not compose with beam search (beams "
+                    "already return one best row)"
+                )
         if p["beam_width"]:
             from ..models.beam import validate_beam_args
 
@@ -619,7 +645,23 @@ class InferenceServer:
                 body, self.cfg.vocab_size, min_row_len=1
             )
             p = self._parse_sampling(body, tokens, prompt_len)
-            if bool(body.get("stream", False)):
+            stream = bool(body.get("stream", False))
+            if p["n"] > 1:
+                if stream:
+                    # the client sent ONE row; blame the actual
+                    # conflict, not the post-duplication row count
+                    raise ValueError(
+                        "n does not compose with stream (one SSE "
+                        "stream carries one row)"
+                    )
+                # OpenAI's n: one prompt, n independent samples. Each
+                # duplicated row draws from fold_in(seed, i) — the
+                # server's existing per-row key convention — so the
+                # samples differ under temperature (greedy duplicates
+                # are identical by definition) and ride the batcher
+                # as ONE device call.
+                tokens = [list(tokens[0]) for _ in range(p["n"])]
+            if stream:
                 return self._generate_stream(tokens, p)
         except (ValueError, KeyError, TypeError) as exc:
             return Response(422, f"{exc}\n".encode())
@@ -785,6 +827,10 @@ class InferenceServer:
             p = self._parse_sampling(
                 body, [row], len(row), default_eos=self.tokenizer.EOS
             )
+            if p["n"] > 1:
+                raise ValueError(
+                    "n returns token rows; use /v1/generate"
+                )
             if bool(body.get("stream", False)):
                 return self._completions_stream(row, p)
         except (ValueError, KeyError, TypeError) as exc:

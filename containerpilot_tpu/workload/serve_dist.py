@@ -230,6 +230,13 @@ class _Frontend:
                 raise ValueError(
                     f"token ids must be integers in [0, {self.vocab})"
                 )
+            if int(body.get("n", 1)) != 1:
+                # loud 422, not a silent one-sample 200 the client
+                # would mis-index (the single-host server supports n)
+                raise ValueError(
+                    "the pod frontend serves single-sample requests; "
+                    "n > 1 is a single-host server feature"
+                )
             max_new = int(body.get("max_new_tokens", 16))
             if max_new < 1:
                 raise ValueError("max_new_tokens must be >= 1")

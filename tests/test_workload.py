@@ -3657,6 +3657,86 @@ def test_logit_bias_forces_and_bans_across_paths():
         eng.stop()
 
 
+def test_n_samples_over_http(run):
+    """OpenAI's n: one prompt, n independent samples as one batched
+    device call — row i draws from fold_in(seed, i), so each row
+    byte-matches the model-level generate with that key; greedy rows
+    are identical by definition; bad compositions 422."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from containerpilot_tpu.models.decode import generate
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server = InferenceServer(cfg, params, "127.0.0.1", 0, max_len=32)
+
+    def fetch(body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            return exc.code, exc.read().decode()
+
+    async def scenario():
+        import asyncio
+
+        await server.run()
+        loop = asyncio.get_event_loop()
+
+        def go():
+            base = {"tokens": [[1, 2, 3]], "max_new_tokens": 6}
+            s1, sampled = fetch({
+                **base, "n": 3, "temperature": 0.9, "seed": 11,
+            })
+            s2, greedy = fetch({**base, "n": 2})
+            s3, _ = fetch({**base, "n": 99})
+            s4, _ = fetch({
+                "tokens": [[1, 2], [3, 4]], "max_new_tokens": 4,
+                "n": 2,
+            })
+            s5, _ = fetch({**base, "n": 2, "beam_width": 2})
+            s6, stream_err = fetch({**base, "n": 2, "stream": True})
+            return (s1, sampled), (s2, greedy), s3, s4, s5, \
+                (s6, stream_err)
+
+        out = await loop.run_in_executor(None, go)
+        await server.stop()
+        return out
+
+    ((s1, sampled), (s2, greedy), s3, s4, s5,
+     (s6, stream_err)) = run(scenario())
+    assert s1 == 200 and len(sampled["tokens"]) == 3
+    # row i == model-level generate with the per-row key convention
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    for i, row in enumerate(sampled["tokens"]):
+        ref = generate(
+            params, prompt, cfg, 6, 32, temperature=0.9,
+            rng=jnp.stack(
+                [jax.random.fold_in(jax.random.PRNGKey(11), i)]
+            ),
+        )
+        assert row == [int(t) for t in ref[0]], i
+    # independent keys actually diversify (not a fixed guarantee in
+    # general, but deterministic for this seed/model)
+    assert len({tuple(r) for r in sampled["tokens"]}) > 1
+    assert s2 == 200 and greedy["tokens"][0] == greedy["tokens"][1]
+    assert s3 == s4 == s5 == 422
+    # the n+stream 422 names the actual conflict, not the row count
+    assert s6 == 422 and "n does not compose with stream" in stream_err
+
+
 def test_logit_bias_over_http(run):
     """/v1/generate accepts OpenAI's string-keyed logit_bias through
     the batcher path; bad requests 422; beam rejects it."""
