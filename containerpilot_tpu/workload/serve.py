@@ -120,23 +120,32 @@ class InferenceServer:
                     "--cp mesh needs a seq axis > 1 "
                     "(MeshPlan(seq=...))"
                 )
+            if seq_axis >= max_len:
+                # no admissible prompt can cover the axis: cp could
+                # never engage no matter the threshold
+                raise ValueError(
+                    f"--cp never engages: the seq axis ({seq_axis}) "
+                    f"is not below max_len ({max_len})"
+                )
             if cp_min_len == 0:
-                # unset: default to something that amortizes a ring
-                self.cp_min_len = 8 * seq_axis
+                # unset: default to something that amortizes a ring,
+                # self-clamped so the derived default always CAN
+                # engage under this max_len
+                self.cp_min_len = min(8 * seq_axis, max_len - 1)
             elif cp_min_len < seq_axis:
                 # an explicit value below the axis is unusable (the
                 # prompt's head must cover the axis) — honor the
                 # user's intent by clamping to the floor, not by
                 # silently overriding with the default
                 self.cp_min_len = seq_axis
-            if self.cp_min_len >= max_len:
-                # fail at startup, not as a feature that silently
-                # never engages: every admissible prompt satisfies
-                # prompt_len + max_new <= max_len < cp_min_len
+            elif cp_min_len >= max_len:
+                # the user's own threshold excludes every admissible
+                # prompt (prompt_len + max_new <= max_len): fail at
+                # startup, not as a feature that silently never runs
                 raise ValueError(
-                    f"--cp never engages: cp_min_len "
-                    f"{self.cp_min_len} >= max_len {max_len} "
-                    "(lower --cp-min-len or raise --max-len)"
+                    f"--cp never engages: cp_min_len {cp_min_len} "
+                    f">= max_len {max_len} (lower --cp-min-len or "
+                    "raise --max-len)"
                 )
             for flag, why in (
                 (slots > 0, "--slots (the pool prefills per slot)"),

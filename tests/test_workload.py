@@ -431,6 +431,15 @@ def test_cp_generate_matches_unsharded(run):
     cp_odd = cp_generate(params, odd, cfg, mesh, 6, 128)
     assert [int(t) for t in cp_odd[0]] == [int(t) for t in plain_odd[0]]
 
+    # int8 KV cache composes: the ring reads the dequant roundtrip in
+    # prefill and the gathered cache carries the scales
+    import dataclasses as _dc
+
+    cfg_q = _dc.replace(cfg, kv_int8=True)
+    plain_q = generate(params, prompt, cfg_q, 6, 128)
+    cp_q = cp_generate(params, prompt, cfg_q, mesh, 6, 128)
+    assert [int(t) for t in cp_q[0]] == [int(t) for t in plain_q[0]]
+
     # contract checks fail loudly
     with pytest.raises(ValueError, match="shorter than"):
         cp_generate(params, jnp.ones((1, 6), jnp.int32), cfg, mesh,
@@ -474,6 +483,17 @@ def test_serve_cp_long_prompt_matches_vanilla(run):
             cfg, params, "127.0.0.1", 0, max_len=128, cp_mesh=mesh,
             slots=2,
         )
+    # an explicit threshold no admissible prompt can reach fails at
+    # startup; the DERIVED default instead self-clamps below max_len
+    with pytest.raises(ValueError, match="never engages"):
+        InferenceServer(
+            cfg, params, "127.0.0.1", 0, max_len=128, cp_mesh=mesh,
+            cp_min_len=128,
+        )
+    defaulted = InferenceServer(
+        cfg, params, "127.0.0.1", 0, max_len=32, cp_mesh=mesh,
+    )
+    assert defaulted.cp_min_len == 31  # min(8*8, max_len-1)
 
     import numpy as _np
 
