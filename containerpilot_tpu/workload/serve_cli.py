@@ -229,9 +229,18 @@ def load_model(args: argparse.Namespace):
 
 
 def main() -> int:
+    import logging
+
     from .modelcfg import enable_compile_cache
     from .serve import InferenceServer
 
+    # the server's operational lines (listening, warm/accepting
+    # traffic, slot frees) exist for the SUPERVISOR's log collection;
+    # without a handler they vanish
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(message)s",
+    )
     enable_compile_cache()
     args = build_arg_parser().parse_args()
     cfg, params = load_model(args)
