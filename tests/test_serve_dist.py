@@ -38,23 +38,28 @@ def _sub_env() -> dict:
     return env
 
 
-def _reference(tokens, max_new, **kw):
-    """Single-device generate with the server key convention."""
+def _reference(tokens, max_new, cfg=None, params=None, **kw):
+    """Single-device generate with the server key convention — the
+    ONE copy of the fold_in(PRNGKey(seed), 0) + _trim parity recipe
+    every pod test compares against."""
     from containerpilot_tpu.models.decode import generate
     from containerpilot_tpu.models.transformer import (
         TransformerConfig,
         init_params,
     )
 
-    cfg = TransformerConfig(
-        vocab_size=128, d_model=64, n_heads=2, n_layers=1,
-        d_ff=64 * 3 // 128 * 128 or 128, max_seq_len=48,
-    )
-    params = init_params(jax.random.PRNGKey(0), cfg)
+    if cfg is None:
+        cfg = TransformerConfig(
+            vocab_size=128, d_model=64, n_heads=2, n_layers=1,
+            d_ff=64 * 3 // 128 * 128 or 128, max_seq_len=48,
+        )
+    if params is None:
+        params = init_params(jax.random.PRNGKey(0), cfg)
     seed = kw.pop("seed", 0)
     eos = kw.pop("eos_id", -1)
     out = generate(
-        params, jnp.asarray([tokens], jnp.int32), cfg, max_new, 48,
+        params, jnp.asarray([tokens], jnp.int32), cfg, max_new,
+        cfg.max_seq_len,
         rng=jnp.stack(
             [jax.random.fold_in(jax.random.PRNGKey(seed), 0)]
         ),
@@ -199,6 +204,107 @@ def test_pod_serves_http(tmp_path, n_procs, dp):
             assert proc.wait(timeout=60 * max(1, n_procs // 2)) == 0, (
                 tmp_path / f"pod{i}.log"
             ).read_text()[-3000:]
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        catalog.terminate()
+        catalog.wait(timeout=10)
+        for fh in logs:
+            fh.close()
+
+
+def test_pod_restores_checkpoint_in_lockstep(tmp_path):
+    """--checkpoint-dir on the pod: every process restores the SAME
+    trained weights through orbax's global barriers onto the pod
+    mesh (saved on a DIFFERENT, single-process topology — the
+    restore re-shards), and answers change accordingly: byte-parity
+    with a single-device restore of the same checkpoint."""
+    import numpy as np
+
+    # train a couple of steps single-process to produce the artifact
+    ck = tmp_path / "ck"
+    worker = os.path.join(REPO, "tests", "capstone_worker.py")
+    env = _sub_env()
+    trained = subprocess.run(
+        [sys.executable, worker, "--process-id", "0",
+         "--num-processes", "1", "--tp", "1", "--steps", "2",
+         "--global-batch", "4", "--checkpoint-dir", str(ck),
+         "--out", str(tmp_path / "t.json")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert trained.returncode == 0, trained.stderr[-2000:]
+
+    # the capstone worker's model config, serving-shaped
+    model_flags = [
+        "--max-len", "48", "--d-model", "32", "--n-layers", "1",
+        "--n-heads", "2", "--vocab", "64",
+    ]
+    catalog_port, coord_port, http_port = (
+        _free_port(), _free_port(), _free_port()
+    )
+    catalog = subprocess.Popen(
+        [sys.executable, "-m", "containerpilot_tpu",
+         "-catalog-server", f"127.0.0.1:{catalog_port}"],
+        cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    procs = []
+    logs = []
+    try:
+        _wait_catalog(catalog_port)
+        wrapper = _write_cpu_wrapper(tmp_path)
+        for pid in (0, 1):
+            fh = open(tmp_path / f"pod{pid}.log", "w")
+            logs.append(fh)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-u", str(wrapper),
+                 "--process-id", str(pid), "--num-processes", "2",
+                 "--catalog", f"127.0.0.1:{catalog_port}",
+                 "--coordinator-port", str(coord_port),
+                 "--advertise-address", "127.0.0.1",
+                 "--host", "127.0.0.1", "--port", str(http_port),
+                 "--checkpoint-dir", str(ck)]
+                + model_flags,
+                cwd=REPO, env=env, stdout=fh, stderr=subprocess.STDOUT,
+            ))
+        base = f"http://127.0.0.1:{http_port}"
+        _wait_pod_healthy(base, procs, tmp_path, 2, 240)
+
+        req = urllib.request.Request(
+            f"{base}/v1/generate",
+            data=json.dumps(
+                {"tokens": [[1, 2, 3]], "max_new_tokens": 6}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=240) as resp:
+            got = json.loads(resp.read().decode())["tokens"][0]
+        assert "pod serving checkpoint step 2" in (
+            tmp_path / "pod0.log"
+        ).read_text()
+
+        # reference: single-device restore of the same checkpoint,
+        # through the module's ONE parity recipe
+        from containerpilot_tpu.models.transformer import (
+            TransformerConfig,
+        )
+        from containerpilot_tpu.parallel import MeshPlan, make_mesh
+        from containerpilot_tpu.workload.modelcfg import (
+            derive_d_ff,
+            restore_params_only,
+        )
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=2, n_layers=1,
+            d_ff=derive_d_ff(32), max_seq_len=48,
+        )
+        one_dev = make_mesh(
+            jax.devices()[:1], plan=MeshPlan(data=1, model=1)
+        )
+        params, step = restore_params_only(cfg, one_dev, str(ck))
+        assert int(step) == 2
+        assert got == _reference([1, 2, 3], 6, cfg=cfg, params=params)
     finally:
         for proc in procs:
             if proc.poll() is None:

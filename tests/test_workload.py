@@ -2910,6 +2910,35 @@ def test_tensor_parallel_generate_parity():
         )
 
 
+def test_tensor_parallel_moe_generate_parity():
+    """Expert-parallel serving: an MoE model's experts shard over the
+    model axis with the rest of the TP rules, and sharded decode
+    byte-matches single-device — the ep x tp serving composition."""
+    import numpy as np
+
+    from containerpilot_tpu.models.decode import generate
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.parallel import (
+        MeshPlan,
+        make_mesh,
+        shard_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq_len=32, dtype=jnp.float32, moe_experts=4,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh(jax.devices()[:4], plan=MeshPlan(data=1, model=4))
+    sharded = shard_params(params, mesh, cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(11), (2, 5), 0, cfg.vocab_size, jnp.int32
+    )
+    single = generate(params, prompt, cfg, max_new_tokens=6, max_len=32)
+    ep = generate(sharded, prompt, cfg, max_new_tokens=6, max_len=32)
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(ep))
+
+
 def test_inference_server_reports_mesh(run):
     """/v1/model surfaces the device mesh TP-sharded params live on,
     and serving works end-to-end on sharded params."""
@@ -3062,8 +3091,12 @@ def test_continuous_deployment_reload_serves_new_checkpoint(tmp_path):
                 # pre-training weights — deterministic ordering on a
                 # box where job startup times race
                 "exec": ["/bin/sh", "-c",
-                         f"while [ ! -f {tmp_path}/train-gate ]; do "
-                         "sleep 0.2; done; exec " + " ".join(
+                         "while [ ! -f "
+                         + __import__("shlex").quote(
+                             str(tmp_path / "train-gate")
+                         )
+                         + " ]; do sleep 0.2; done; exec "
+                         + __import__("shlex").join(
                              [sys.executable, "-u",
                               wrapper("train_cpu.py", "train"),
                               "--steps", "4", "--batch", "2",
