@@ -1,0 +1,20 @@
+"""Shared Prometheus exposition plumbing for the serving surfaces.
+
+The single-host server (workload/serve.py) and the pod frontend
+(workload/serve_dist.py) each keep their own metrics in a PRIVATE
+CollectorRegistry (an in-process supervisor's metrics must never
+collide with a workload's), but the /metrics response format is ONE
+convention — exposed here so the two surfaces cannot drift.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
+def exposition(registry) -> Tuple[bytes, str]:
+    """(body, content_type) for a /metrics response over ``registry``."""
+    from prometheus_client import generate_latest
+
+    return generate_latest(registry), PROM_CONTENT_TYPE

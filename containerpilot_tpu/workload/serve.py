@@ -293,12 +293,10 @@ class InferenceServer:
         return Response(200, b"ok\n")
 
     async def _metrics(self, _req: Request) -> Response:
-        from prometheus_client import generate_latest
+        from ..utils.prom import exposition
 
-        return Response(
-            200, generate_latest(self._metrics_registry),
-            content_type="text/plain; version=0.0.4",
-        )
+        body, content_type = exposition(self._metrics_registry)
+        return Response(200, body, content_type=content_type)
 
     def _instrumented(self, endpoint: str, handler):
         """Count + time every API request; token accounting happens in

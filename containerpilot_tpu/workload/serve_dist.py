@@ -181,17 +181,47 @@ class _Frontend:
     loop drains; the loop owns all device work."""
 
     def __init__(self, host: str, port: int, max_len: int,
-                 vocab: int) -> None:
+                 vocab: int, pod_info: Optional[Dict[str, Any]] = None,
+                 ) -> None:
+        from prometheus_client import (
+            CollectorRegistry,
+            Counter,
+            Histogram,
+        )
+
         from ..utils.http import HTTPServer, Response
 
         self.max_len = max_len
         self.vocab = vocab
         self.ready = False
+        # /v1/model payload: model config + pod topology, set by main()
+        self.pod_info = pod_info or {}
         self.requests: "queue.Queue[Tuple[dict, queue.Queue]]" = (
             queue.Queue()
         )
+        # observability parity with the single-host server: a private
+        # registry (an in-process supervisor's metrics never collide)
+        self._registry = CollectorRegistry()
+        self._m_requests = Counter(
+            "containerpilot_pod_requests",
+            "pod frontend requests by endpoint and status",
+            ["endpoint", "status"], registry=self._registry,
+        )
+        self._m_latency = Histogram(
+            "containerpilot_pod_request_seconds",
+            "pod request latency (broadcast + lockstep decode)",
+            registry=self._registry,
+            buckets=(.05, .1, .25, .5, 1, 2.5, 5, 10, 30, 60, 120),
+        )
+        self._m_tokens = Counter(
+            "containerpilot_pod_generated_tokens",
+            "tokens returned by the pod frontend (post-trim)",
+            registry=self._registry,
+        )
         self._server = HTTPServer()
         self._server.route("GET", "/health", self._health)
+        self._server.route("GET", "/metrics", self._metrics)
+        self._server.route("GET", "/v1/model", self._model)
         self._server.route("POST", "/v1/generate", self._generate)
         self._host, self._port = host, port
         self._Response = Response
@@ -206,6 +236,19 @@ class _Frontend:
         if not self.ready:
             return self._Response(503, b"warming\n")
         return self._Response(200, b"ok\n")
+
+    async def _metrics(self, _req):
+        from ..utils.prom import exposition
+
+        body, content_type = exposition(self._registry)
+        return self._Response(200, body, content_type=content_type)
+
+    async def _model(self, _req):
+        self._m_requests.labels("model", "200").inc()
+        return self._Response(
+            200, json.dumps(self.pod_info).encode(),
+            content_type="application/json",
+        )
 
     async def _generate(self, req):
         import asyncio
@@ -291,14 +334,20 @@ class _Frontend:
                 "logit_bias": bias,
             }
         except (ValueError, KeyError, TypeError, OverflowError) as exc:
+            self._m_requests.labels("generate", "422").inc()
             return self._Response(422, f"{exc}\n".encode())
+        t0 = time.perf_counter()
         done: "queue.Queue" = queue.Queue()
         self.requests.put((work, done))
         result = await asyncio.get_event_loop().run_in_executor(
             None, done.get
         )
+        self._m_latency.observe(time.perf_counter() - t0)
         if isinstance(result, Exception):
+            self._m_requests.labels("generate", "500").inc()
             return self._Response(500, f"{result}\n".encode())
+        self._m_requests.labels("generate", "200").inc()
+        self._m_tokens.inc(len(result))
         return self._Response(
             200, json.dumps({"tokens": [result]}).encode(),
             content_type="application/json",
@@ -457,7 +506,21 @@ def main() -> int:
     frontend = None
     if args.process_id == 0:
         frontend = _Frontend(
-            args.host, args.port, args.max_len, cfg.vocab_size
+            args.host, args.port, args.max_len, cfg.vocab_size,
+            pod_info={
+                "vocab_size": cfg.vocab_size,
+                "d_model": cfg.d_model,
+                "n_heads": cfg.n_heads,
+                "n_kv_heads": cfg.kv_heads,
+                "n_layers": cfg.n_layers,
+                "max_len": args.max_len,
+                "pod": {
+                    "num_processes": args.num_processes,
+                    "devices": n_global,
+                    "mesh": {"data": args.dp, "model": n_model},
+                    "watchdog_s": args.watchdog or None,
+                },
+            },
         )
         frontend.start()
         print(f"pod frontend on {args.host}:{frontend.port} "

@@ -197,6 +197,28 @@ def test_pod_serves_http(tmp_path, n_procs, dp):
         )
         assert 11 not in knobs["tokens"][0]
 
+        # observability parity: /v1/model reports the pod topology,
+        # /metrics carries the request/token counters
+        info = json.loads(urllib.request.urlopen(
+            f"{base}/v1/model", timeout=30
+        ).read().decode())
+        assert info["pod"]["num_processes"] == n_procs
+        assert info["pod"]["mesh"] == {
+            "data": dp, "model": n_procs // dp,
+        }
+        metrics = urllib.request.urlopen(
+            f"{base}/metrics", timeout=30
+        ).read().decode()
+        assert (
+            'containerpilot_pod_requests_total'
+            '{endpoint="generate",status="200"} 3.0'
+        ) in metrics
+        assert (
+            'containerpilot_pod_requests_total'
+            '{endpoint="model",status="200"} 1.0'
+        ) in metrics
+        assert "containerpilot_pod_generated_tokens_total" in metrics
+
         # graceful pod shutdown: TERM on the frontend broadcasts the
         # stop; ALL processes exit 0
         procs[0].send_signal(15)
