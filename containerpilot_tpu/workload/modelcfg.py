@@ -62,6 +62,25 @@ def restore_params_only(
     return RestoredParams(params, int(step), restored.ema)
 
 
+def score_logprobs_fn(cfg: Any):
+    """The ONE teacher-forced scoring function: per-token logprobs of
+    toks[1:] from a forward over toks[:-1]. The single-host
+    /v1/score and the pod frontend's twin both jit exactly this, so
+    their numbers cannot drift."""
+    import jax.numpy as jnp
+
+    from ..models.transformer import forward
+
+    def score(params, toks):
+        logits = forward(params, toks[:, :-1], cfg)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return jnp.take_along_axis(
+            logp, toks[:, 1:, None], axis=-1
+        )[..., 0]
+
+    return score
+
+
 def parse_logit_bias(raw: Any, vocab_size: int):
     """The ONE HTTP-facing ``logit_bias`` parser (single-host server
     and pod frontend both call it — the bounds must not diverge):

@@ -876,17 +876,9 @@ class InferenceServer:
     def _ensure_score_fn(self) -> None:
         if self._score_fn is not None:
             return
-        from ..models.transformer import forward
+        from .modelcfg import score_logprobs_fn
 
-        def score(params, toks):
-            logits = forward(params, toks[:, :-1], self.cfg)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            picked = jnp.take_along_axis(
-                logp, toks[:, 1:, None], axis=-1
-            )[..., 0]
-            return picked  # [batch, len-1]
-
-        self._score_fn = jax.jit(score)
+        self._score_fn = jax.jit(score_logprobs_fn(self.cfg))
 
     def _echo_logprobs(
         self,

@@ -52,6 +52,7 @@ processes on the CPU backend).
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import logging
 import os
@@ -71,6 +72,7 @@ from ..models.decode import BIAS_SLOTS
 OP_SHUTDOWN = 0
 OP_GENERATE = 1
 OP_HEARTBEAT = 2  # idle liveness tick: bounds every broadcast wait
+OP_SCORE = 3      # teacher-forced logprobs over the broadcast row
 
 WATCHDOG_EXIT = 86  # parallel.watchdog.EXIT_CODE — same semantics
 
@@ -141,6 +143,28 @@ def shard_params_global(params: Any, mesh, cfg) -> Any:
         )
 
     return jax.tree_util.tree_map(put, params, rules)
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_score_fn(cfg):
+    from .modelcfg import score_logprobs_fn
+
+    return jax.jit(score_logprobs_fn(cfg))
+
+
+def _score_pod(params, cfg, payload, max_len: int):
+    """Teacher-forced per-token logprobs of the broadcast row — the
+    pod twin of the single-host /v1/score (the SAME jitted function,
+    modelcfg.score_logprobs_fn); every process runs it in lockstep
+    like a decode. Rows pad to a 16-multiple width (capped at
+    max_len) so per-request length variation can't compile a fresh
+    pod-wide program inside the watchdog deadline — causal attention
+    makes the pad positions free, and the result slices back."""
+    plen = int(payload["plen"])
+    width = min(-(-plen // 16) * 16, max_len)
+    toks = jnp.asarray(payload["prompt"][None, :width], jnp.int32)
+    out = _jitted_score_fn(cfg)(params, toks)
+    return out[:, : plen - 1]
 
 
 def _decode_pod(params, cfg, payload, max_len: int):
@@ -223,6 +247,7 @@ class _Frontend:
         self._server.route("GET", "/metrics", self._metrics)
         self._server.route("GET", "/v1/model", self._model)
         self._server.route("POST", "/v1/generate", self._generate)
+        self._server.route("POST", "/v1/score", self._score)
         self._host, self._port = host, port
         self._Response = Response
         self._loop = None
@@ -231,6 +256,25 @@ class _Frontend:
     @property
     def port(self) -> int:
         return self._server.bound_port or self._port
+
+    async def _dispatch(self, endpoint: str, work: Dict[str, Any]):
+        """queue → pod loop → result, with the latency/500 accounting
+        every endpoint shares. Returns (result, None) on success or
+        (None, 500 Response) on a pod-side failure."""
+        import asyncio
+
+        t0 = time.perf_counter()
+        done: "queue.Queue" = queue.Queue()
+        self.requests.put((work, done))
+        result = await asyncio.get_event_loop().run_in_executor(
+            None, done.get
+        )
+        self._m_latency.observe(time.perf_counter() - t0)
+        if isinstance(result, Exception):
+            self._m_requests.labels(endpoint, "500").inc()
+            return None, self._Response(500, f"{result}\n".encode())
+        self._m_requests.labels(endpoint, "200").inc()
+        return result, None
 
     async def _health(self, _req):
         if not self.ready:
@@ -336,20 +380,58 @@ class _Frontend:
         except (ValueError, KeyError, TypeError, OverflowError) as exc:
             self._m_requests.labels("generate", "422").inc()
             return self._Response(422, f"{exc}\n".encode())
-        t0 = time.perf_counter()
-        done: "queue.Queue" = queue.Queue()
-        self.requests.put((work, done))
-        result = await asyncio.get_event_loop().run_in_executor(
-            None, done.get
-        )
-        self._m_latency.observe(time.perf_counter() - t0)
-        if isinstance(result, Exception):
-            self._m_requests.labels("generate", "500").inc()
-            return self._Response(500, f"{result}\n".encode())
-        self._m_requests.labels("generate", "200").inc()
+        result, err = await self._dispatch("generate", work)
+        if err is not None:
+            return err
         self._m_tokens.inc(len(result))
         return self._Response(
             200, json.dumps({"tokens": [result]}).encode(),
+            content_type="application/json",
+        )
+
+    async def _score(self, req):
+        import asyncio
+
+        try:
+            body = json.loads(req.body.decode() or "{}")
+            rows = body.get("tokens")
+            if (
+                not isinstance(rows, list) or len(rows) != 1
+                or not isinstance(rows[0], list) or len(rows[0]) < 2
+            ):
+                raise ValueError(
+                    "'tokens' must be one row of at least 2 ids (the "
+                    "pod frontend serves single-row requests)"
+                )
+            tokens = rows[0]
+            if any(
+                not isinstance(t, int) or isinstance(t, bool)
+                or t < 0 or t >= self.vocab
+                for t in tokens
+            ):
+                raise ValueError(
+                    f"token ids must be integers in [0, {self.vocab})"
+                )
+            if len(tokens) > self.max_len:
+                raise ValueError(
+                    f"row length exceeds max_len {self.max_len}"
+                )
+        except (ValueError, KeyError, TypeError) as exc:
+            self._m_requests.labels("score", "422").inc()
+            return self._Response(422, f"{exc}\n".encode())
+        result, err = await self._dispatch("score", {"score": tokens})
+        if err is not None:
+            return err
+        return self._Response(
+            200,
+            json.dumps(
+                {
+                    "logprobs": [[round(float(x), 6) for x in row]
+                                 for row in result],
+                    "sums": [round(float(sum(row)), 6)
+                             for row in result],
+                }
+            ).encode(),
             content_type="application/json",
         )
 
@@ -578,6 +660,14 @@ def main() -> int:
             elif work is None:
                 payload = _payload_zeros(args.max_len)
                 payload["op"] = np.asarray(OP_HEARTBEAT, np.int32)
+            elif "score" in work:
+                payload = _payload_zeros(args.max_len)
+                payload["op"] = np.asarray(OP_SCORE, np.int32)
+                row = work["score"]
+                payload["prompt"][: len(row)] = np.asarray(
+                    row, np.int32
+                )
+                payload["plen"] = np.asarray(len(row), np.int32)
             else:
                 payload = _payload_for(work, args.max_len)
         else:
@@ -617,6 +707,13 @@ def main() -> int:
                     dq.put(RuntimeError("pod is shutting down"))
             break
         try:
+            if op == OP_SCORE:
+                out = _score_pod(params, cfg, payload, args.max_len)
+                if dog is not None:
+                    dog.beat()
+                if done_q is not None:
+                    done_q.put(np.asarray(out).tolist())
+                continue
             out = _decode_pod(params, cfg, payload, args.max_len)
             if dog is not None:
                 dog.beat()

@@ -38,21 +38,27 @@ def _sub_env() -> dict:
     return env
 
 
+def _default_cfg():
+    """The config MODEL_FLAGS describes — the ONE copy every parity
+    check derives from."""
+    from containerpilot_tpu.models.transformer import TransformerConfig
+    from containerpilot_tpu.workload.modelcfg import derive_d_ff
+
+    return TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=2, n_layers=1,
+        d_ff=derive_d_ff(64), max_seq_len=48,
+    )
+
+
 def _reference(tokens, max_new, cfg=None, params=None, **kw):
     """Single-device generate with the server key convention — the
     ONE copy of the fold_in(PRNGKey(seed), 0) + _trim parity recipe
     every pod test compares against."""
     from containerpilot_tpu.models.decode import generate
-    from containerpilot_tpu.models.transformer import (
-        TransformerConfig,
-        init_params,
-    )
+    from containerpilot_tpu.models.transformer import init_params
 
     if cfg is None:
-        cfg = TransformerConfig(
-            vocab_size=128, d_model=64, n_heads=2, n_layers=1,
-            d_ff=64 * 3 // 128 * 128 or 128, max_seq_len=48,
-        )
+        cfg = _default_cfg()
     if params is None:
         params = init_params(jax.random.PRNGKey(0), cfg)
     seed = kw.pop("seed", 0)
@@ -196,6 +202,33 @@ def test_pod_serves_http(tmp_path, n_procs, dp):
             logit_bias={11: -100.0},
         )
         assert 11 not in knobs["tokens"][0]
+
+        # /v1/score rides the broadcast too: teacher-forced logprobs
+        # match the single-host formula bit-for-bit
+        req = urllib.request.Request(
+            f"{base}/v1/score",
+            data=json.dumps({"tokens": [[1, 2, 3, 4]]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=240) as resp:
+            scored = json.loads(resp.read().decode())
+        from containerpilot_tpu.models.transformer import init_params
+        from containerpilot_tpu.workload.modelcfg import (
+            score_logprobs_fn,
+        )
+
+        s_cfg = _default_cfg()
+        s_params = init_params(jax.random.PRNGKey(0), s_cfg)
+        # pad to the pod's 16-multiple width convention, slice back —
+        # the same function the endpoint jits
+        toks = jnp.asarray([[1, 2, 3, 4] + [0] * 12], jnp.int32)
+        want = [
+            round(float(x), 6)
+            for x in np.asarray(
+                score_logprobs_fn(s_cfg)(s_params, toks)
+            )[0][:3]
+        ]
+        assert scored["logprobs"][0] == want
 
         # observability parity: /v1/model reports the pod topology,
         # /metrics carries the request/token counters
