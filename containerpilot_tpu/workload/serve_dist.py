@@ -206,6 +206,7 @@ class _Frontend:
 
     def __init__(self, host: str, port: int, max_len: int,
                  vocab: int, pod_info: Optional[Dict[str, Any]] = None,
+                 text: bool = False,
                  ) -> None:
         from prometheus_client import (
             CollectorRegistry,
@@ -248,6 +249,16 @@ class _Frontend:
         self._server.route("GET", "/v1/model", self._model)
         self._server.route("POST", "/v1/generate", self._generate)
         self._server.route("POST", "/v1/score", self._score)
+        # text surface: byte-level tokenizer, zero external assets —
+        # the single-host server's --text, pod-shaped
+        self.tokenizer = None
+        if text:
+            from .text import ByteTokenizer
+
+            self.tokenizer = ByteTokenizer(vocab)
+            self._server.route(
+                "POST", "/v1/completions", self._completions
+            )
         self._host, self._port = host, port
         self._Response = Response
         self._loop = None
@@ -294,89 +305,104 @@ class _Frontend:
             content_type="application/json",
         )
 
-    async def _generate(self, req):
-        import asyncio
+    def _parse_work(self, body, tokens, default_eos: int = -1):
+        """Validate the sampling knobs shared by /v1/generate and the
+        --text surface into a broadcastable work dict. Full knob
+        validation HERE: a malformed value that only failed inside
+        _decode_pod would be pod-fatal (the loop deliberately
+        re-raises collective-path errors), and an out-of-int32 value
+        would crash payload packing. Raises ValueError for a 422."""
+        if int(body.get("n", 1)) != 1:
+            # loud 422, not a silent one-sample 200 the client
+            # would mis-index (the single-host server supports n)
+            raise ValueError(
+                "the pod frontend serves single-sample requests; "
+                "n > 1 is a single-host server feature"
+            )
+        for knob in ("stop", "stream", "logprobs", "beam_width"):
+            # same rule: single-host features the broadcast payload
+            # does not carry must fail loudly, never silently drop
+            if body.get(knob):
+                raise ValueError(
+                    f"the pod frontend does not support {knob!r}; "
+                    "it is a single-host server feature"
+                )
+        max_new = int(body.get("max_new_tokens", 16))
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(tokens) + max_new > self.max_len:
+            raise ValueError(
+                f"prompt + max_new_tokens exceeds max_len "
+                f"{self.max_len}"
+            )
+        top_k = int(body.get("top_k", 0))
+        top_p = float(body.get("top_p", 0.0))
+        eos_id = int(body.get("eos_id", default_eos))
+        seed = int(body.get("seed", 0))
+        if not 0 <= top_k <= self.vocab:
+            raise ValueError(f"top_k must be in [0, {self.vocab}]")
+        if not 0.0 <= top_p <= 1.0:
+            raise ValueError("top_p must be in [0, 1]")
+        if eos_id >= self.vocab:
+            raise ValueError(f"eos_id must be < {self.vocab}")
+        if not -(2**31) <= seed < 2**31:
+            raise ValueError("seed must fit in int32")
+        min_new = int(body.get("min_new_tokens", 0))
+        if not 0 <= min_new <= max_new:
+            raise ValueError(
+                "min_new_tokens must be in [0, max_new_tokens]"
+            )
+        presence = float(body.get("presence_penalty", 0.0))
+        frequency = float(body.get("frequency_penalty", 0.0))
+        if not (abs(presence) <= 100 and abs(frequency) <= 100):
+            raise ValueError(
+                "presence/frequency penalties must be in "
+                "[-100, 100]"
+            )
+        from .modelcfg import parse_logit_bias
 
+        bias = parse_logit_bias(
+            body.get("logit_bias"), self.vocab
+        ) or {}
+        return {
+            "tokens": tokens, "max_new": max_new,
+            "temperature": float(body.get("temperature", 0.0)),
+            "top_k": top_k,
+            "top_p": top_p,
+            "eos_id": max(eos_id, -1),
+            "seed": seed,
+            "min_new": min_new,
+            "presence": presence,
+            "frequency": frequency,
+            "logit_bias": bias,
+        }
+
+    def _parse_single_row(self, body, min_len: int = 1):
+        rows = body.get("tokens")
+        if (
+            not isinstance(rows, list) or len(rows) != 1
+            or not isinstance(rows[0], list)
+            or len(rows[0]) < min_len
+        ):
+            raise ValueError(
+                f"'tokens' must be one row of at least {min_len} "
+                "ids (the pod frontend serves single-row requests)"
+            )
+        tokens = rows[0]
+        if any(
+            not isinstance(t, int) or isinstance(t, bool)
+            or t < 0 or t >= self.vocab
+            for t in tokens
+        ):
+            raise ValueError(
+                f"token ids must be integers in [0, {self.vocab})"
+            )
+        return tokens
+
+    async def _generate(self, req):
         try:
             body = json.loads(req.body.decode() or "{}")
-            rows = body.get("tokens")
-            if (
-                not isinstance(rows, list) or len(rows) != 1
-                or not isinstance(rows[0], list) or not rows[0]
-            ):
-                raise ValueError(
-                    "'tokens' must be one non-empty row (the pod "
-                    "frontend serves single-row requests)"
-                )
-            tokens = rows[0]
-            if any(
-                not isinstance(t, int) or isinstance(t, bool)
-                or t < 0 or t >= self.vocab
-                for t in tokens
-            ):
-                raise ValueError(
-                    f"token ids must be integers in [0, {self.vocab})"
-                )
-            if int(body.get("n", 1)) != 1:
-                # loud 422, not a silent one-sample 200 the client
-                # would mis-index (the single-host server supports n)
-                raise ValueError(
-                    "the pod frontend serves single-sample requests; "
-                    "n > 1 is a single-host server feature"
-                )
-            max_new = int(body.get("max_new_tokens", 16))
-            if max_new < 1:
-                raise ValueError("max_new_tokens must be >= 1")
-            if len(tokens) + max_new > self.max_len:
-                raise ValueError(
-                    f"prompt + max_new_tokens exceeds max_len "
-                    f"{self.max_len}"
-                )
-            # full knob validation HERE: a malformed value that only
-            # failed inside _decode_pod would be pod-fatal (the loop
-            # deliberately re-raises collective-path errors), and an
-            # out-of-int32 value would crash payload packing
-            top_k = int(body.get("top_k", 0))
-            top_p = float(body.get("top_p", 0.0))
-            eos_id = int(body.get("eos_id", -1))
-            seed = int(body.get("seed", 0))
-            if not 0 <= top_k <= self.vocab:
-                raise ValueError(f"top_k must be in [0, {self.vocab}]")
-            if not 0.0 <= top_p <= 1.0:
-                raise ValueError("top_p must be in [0, 1]")
-            if eos_id >= self.vocab:
-                raise ValueError(f"eos_id must be < {self.vocab}")
-            if not -(2**31) <= seed < 2**31:
-                raise ValueError("seed must fit in int32")
-            min_new = int(body.get("min_new_tokens", 0))
-            if not 0 <= min_new <= max_new:
-                raise ValueError(
-                    "min_new_tokens must be in [0, max_new_tokens]"
-                )
-            presence = float(body.get("presence_penalty", 0.0))
-            frequency = float(body.get("frequency_penalty", 0.0))
-            if not (abs(presence) <= 100 and abs(frequency) <= 100):
-                raise ValueError(
-                    "presence/frequency penalties must be in "
-                    "[-100, 100]"
-                )
-            from .modelcfg import parse_logit_bias
-
-            bias = parse_logit_bias(
-                body.get("logit_bias"), self.vocab
-            ) or {}
-            work = {
-                "tokens": tokens, "max_new": max_new,
-                "temperature": float(body.get("temperature", 0.0)),
-                "top_k": top_k,
-                "top_p": top_p,
-                "eos_id": max(eos_id, -1),
-                "seed": seed,
-                "min_new": min_new,
-                "presence": presence,
-                "frequency": frequency,
-                "logit_bias": bias,
-            }
+            work = self._parse_work(body, self._parse_single_row(body))
         except (ValueError, KeyError, TypeError, OverflowError) as exc:
             self._m_requests.labels("generate", "422").inc()
             return self._Response(422, f"{exc}\n".encode())
@@ -389,29 +415,45 @@ class _Frontend:
             content_type="application/json",
         )
 
+    async def _completions(self, req):
+        """Text in/out around the same broadcast decode /v1/generate
+        uses: encode the prompt through the byte tokenizer, default
+        eos to the tokenizer's EOS, decode the generated ids back —
+        the single-host /v1/completions contract, pod-shaped."""
+        tok = self.tokenizer
+        try:
+            body = json.loads(req.body.decode() or "{}")
+            prompt = body.get("prompt")
+            if not isinstance(prompt, str) or not prompt:
+                raise ValueError("'prompt' must be a non-empty string")
+            row = tok.encode(prompt)
+            if len(row) >= self.max_len:
+                raise ValueError(
+                    f"prompt encodes to {len(row)} ids; max_len is "
+                    f"{self.max_len}"
+                )
+            work = self._parse_work(body, row, default_eos=tok.EOS)
+        except (ValueError, KeyError, TypeError, OverflowError) as exc:
+            self._m_requests.labels("completions", "422").inc()
+            return self._Response(422, f"{exc}\n".encode())
+        result, err = await self._dispatch("completions", work)
+        if err is not None:
+            return err
+        self._m_tokens.inc(len(result))
+        return self._Response(
+            200,
+            json.dumps(
+                {"text": tok.decode(result), "tokens": result}
+            ).encode(),
+            content_type="application/json",
+        )
+
     async def _score(self, req):
         import asyncio
 
         try:
             body = json.loads(req.body.decode() or "{}")
-            rows = body.get("tokens")
-            if (
-                not isinstance(rows, list) or len(rows) != 1
-                or not isinstance(rows[0], list) or len(rows[0]) < 2
-            ):
-                raise ValueError(
-                    "'tokens' must be one row of at least 2 ids (the "
-                    "pod frontend serves single-row requests)"
-                )
-            tokens = rows[0]
-            if any(
-                not isinstance(t, int) or isinstance(t, bool)
-                or t < 0 or t >= self.vocab
-                for t in tokens
-            ):
-                raise ValueError(
-                    f"token ids must be integers in [0, {self.vocab})"
-                )
+            tokens = self._parse_single_row(body, min_len=2)
             if len(tokens) > self.max_len:
                 raise ValueError(
                     f"row length exceeds max_len {self.max_len}"
@@ -503,6 +545,9 @@ def main() -> int:
                         "restores in lockstep (orbax is a global "
                         "checkpointer)")
     parser.add_argument("--use-ema", action="store_true")
+    parser.add_argument("--text", action="store_true",
+                        help="byte-tokenizer /v1/completions on the "
+                        "frontend (vocab must be >= 259)")
     parser.add_argument("--dp", type=int, default=1,
                         help="data-parallel axis size: the global "
                         "device count factors as (dp, devices/dp) — "
@@ -555,6 +600,17 @@ def main() -> int:
         d_ff=derive_d_ff(args.d_model),
         max_seq_len=args.max_len,
     )
+    if args.text:
+        from .text import ByteTokenizer
+
+        if args.vocab < ByteTokenizer.N_IDS:
+            # EVERY process must fail here, not just the frontend:
+            # a frontend dying after rendezvous would strand the
+            # followers in their first broadcast
+            raise SystemExit(
+                f"--text needs vocab >= {ByteTokenizer.N_IDS}, got "
+                f"{args.vocab}"
+            )
     n_global = jax.device_count()
     if args.dp < 1 or n_global % args.dp:
         raise SystemExit(
@@ -589,6 +645,7 @@ def main() -> int:
     if args.process_id == 0:
         frontend = _Frontend(
             args.host, args.port, args.max_len, cfg.vocab_size,
+            text=args.text,
             pod_info={
                 "vocab_size": cfg.vocab_size,
                 "d_model": cfg.d_model,
@@ -596,6 +653,7 @@ def main() -> int:
                 "n_kv_heads": cfg.kv_heads,
                 "n_layers": cfg.n_layers,
                 "max_len": args.max_len,
+                "text": args.text,
                 "pod": {
                     "num_processes": args.num_processes,
                     "devices": n_global,

@@ -11,6 +11,7 @@ import socket
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.request
 
 import jax
@@ -257,6 +258,106 @@ def test_pod_serves_http(tmp_path, n_procs, dp):
         procs[0].send_signal(15)
         for i, proc in enumerate(procs):
             assert proc.wait(timeout=60 * max(1, n_procs // 2)) == 0, (
+                tmp_path / f"pod{i}.log"
+            ).read_text()[-3000:]
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        catalog.terminate()
+        catalog.wait(timeout=10)
+        for fh in logs:
+            fh.close()
+
+
+def test_pod_text_completions(tmp_path):
+    """--text on the pod: /v1/completions encodes through the byte
+    tokenizer, rides the same broadcast decode, and byte-matches the
+    single-host text contract; unsupported single-host knobs fail
+    loudly instead of being silently dropped."""
+    catalog_port, coord_port, http_port = (
+        _free_port(), _free_port(), _free_port()
+    )
+    env = _sub_env()
+    catalog = subprocess.Popen(
+        [sys.executable, "-m", "containerpilot_tpu",
+         "-catalog-server", f"127.0.0.1:{catalog_port}"],
+        cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    procs = []
+    logs = []
+    try:
+        _wait_catalog(catalog_port)
+        wrapper = _write_cpu_wrapper(tmp_path)
+        for pid in (0, 1):
+            fh = open(tmp_path / f"pod{pid}.log", "w")
+            logs.append(fh)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-u", str(wrapper),
+                 "--process-id", str(pid), "--num-processes", "2",
+                 "--catalog", f"127.0.0.1:{catalog_port}",
+                 "--coordinator-port", str(coord_port),
+                 "--advertise-address", "127.0.0.1",
+                 "--host", "127.0.0.1", "--port", str(http_port),
+                 "--text", "--vocab", "512", "--max-len", "48",
+                 "--d-model", "64", "--n-layers", "1",
+                 "--n-heads", "2"],
+                cwd=REPO, env=env, stdout=fh, stderr=subprocess.STDOUT,
+            ))
+        base = f"http://127.0.0.1:{http_port}"
+        _wait_pod_healthy(base, procs, tmp_path, 2, 240)
+
+        def post(path, body):
+            req = urllib.request.Request(
+                f"{base}{path}",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=240) as resp:
+                    return resp.status, json.loads(resp.read().decode())
+            except urllib.error.HTTPError as exc:
+                return exc.code, exc.read().decode()
+
+        status, comp = post(
+            "/v1/completions", {"prompt": "hi", "max_new_tokens": 6}
+        )
+        assert status == 200
+
+        # single-host reference: same encode, eos default, decode
+        from containerpilot_tpu.models.transformer import (
+            TransformerConfig,
+        )
+        from containerpilot_tpu.workload.modelcfg import derive_d_ff
+        from containerpilot_tpu.workload.text import ByteTokenizer
+
+        t_cfg = TransformerConfig(
+            vocab_size=512, d_model=64, n_heads=2, n_layers=1,
+            d_ff=derive_d_ff(64), max_seq_len=48,
+        )
+        tok = ByteTokenizer(512)
+        want = _reference(
+            tok.encode("hi"), 6, cfg=t_cfg, eos_id=tok.EOS
+        )
+        assert comp["tokens"] == want
+        assert comp["text"] == tok.decode(comp["tokens"])
+
+        # unsupported knobs fail loudly on both POST endpoints
+        s1, body1 = post(
+            "/v1/completions",
+            {"prompt": "x", "stop": ["y"]},
+        )
+        s2, body2 = post(
+            "/v1/generate",
+            {"tokens": [[1, 2]], "stream": True},
+        )
+        assert s1 == 422 and "does not support 'stop'" in body1
+        assert s2 == 422 and "does not support 'stream'" in body2
+
+        procs[0].send_signal(15)
+        for i, proc in enumerate(procs):
+            assert proc.wait(timeout=60) == 0, (
                 tmp_path / f"pod{i}.log"
             ).read_text()[-3000:]
     finally:
