@@ -124,8 +124,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--cp", type=int, default=1,
         help="context-parallel prefill ways: long single-row prompts "
         "ring their prefill over a seq axis of N local devices "
-        "(parallel.cp_generate); 1 = off. Does not compose with "
-        "--tp/--slots/--draft-layers/--prefix-cache/--window",
+        "(parallel.cp_generate); 1 = off. Composes with --tp (a "
+        "seq x model mesh over cp*tp devices); rejects "
+        "--slots/--draft-layers/--prefix-cache/--window",
     )
     parser.add_argument(
         "--cp-min-len", type=int, default=0,
@@ -135,18 +136,28 @@ def build_arg_parser() -> argparse.ArgumentParser:
     return parser
 
 
-def _serving_mesh(tp: int):
+def _serving_mesh(tp: int, cp: int = 1):
     """The mesh model loading/sharding lands on: an explicit --tp N
-    builds a pure tensor-parallel mesh over the first N local devices;
-    otherwise the default factoring over all local devices."""
+    builds a pure tensor-parallel mesh over the first N local
+    devices; --cp adds a seq axis for context-parallel prefill
+    (params shard over model and replicate over seq, so the SAME
+    mesh serves both the ring prefill and the tp decode); otherwise
+    the default factoring over all local devices."""
     from ..parallel import MeshPlan, make_mesh
 
-    if tp <= 1:
+    tp, cp = max(tp, 1), max(cp, 1)
+    if tp == 1 and cp == 1:
         return make_mesh()
     devices = jax.devices()
-    if tp > len(devices):
+    if tp * cp > len(devices):
         raise SystemExit(
-            f"--tp {tp} exceeds the {len(devices)} local devices"
+            f"--tp {tp} x --cp {cp} exceeds the {len(devices)} "
+            "local devices"
+        )
+    if cp > 1:
+        return make_mesh(
+            devices[: tp * cp],
+            plan=MeshPlan(data=1, model=tp, seq=cp),
         )
     return make_mesh(devices[:tp], plan=MeshPlan(data=1, model=tp))
 
@@ -171,7 +182,10 @@ def _validate_tp(cfg: TransformerConfig, tp: int) -> None:
 
 
 def load_model(args: argparse.Namespace):
-    """Build the config and load/transform params per the flags."""
+    """Build the config and load/transform params per the flags.
+    Returns (cfg, params, mesh) — the ONE mesh everything landed on
+    (checkpoint restore, shard, LoRA merge, and the --cp ring must
+    share a device set or cross-mesh ops are uncompilable)."""
     cfg = TransformerConfig(
         vocab_size=args.vocab,
         d_model=args.d_model,
@@ -185,12 +199,13 @@ def load_model(args: argparse.Namespace):
         kv_int8=args.kv_int8,
     )
     tp = getattr(args, "tp", 1) or 1
+    cp = getattr(args, "cp", 1) or 1
     if tp > 1:
         _validate_tp(cfg, tp)
     # ONE mesh for everything loaded here: checkpoint restore, the
-    # fresh-init shard, and the LoRA adapter must share a device set
-    # or the merge add is uncompilable
-    mesh = _serving_mesh(tp)
+    # fresh-init shard, the LoRA adapter, AND the --cp ring must
+    # share a device set or cross-mesh ops are uncompilable
+    mesh = _serving_mesh(tp, cp)
     params = None
     if args.checkpoint_dir:
         # shared with the evaluate CLI (workload/modelcfg.py):
@@ -225,7 +240,7 @@ def load_model(args: argparse.Namespace):
             f"int8: params {before} -> {param_bytes(params)} bytes "
             f"({before / param_bytes(params):.1f}x smaller)"
         )
-    return cfg, params
+    return cfg, params, mesh
 
 
 def main() -> int:
@@ -243,27 +258,11 @@ def main() -> int:
     )
     enable_compile_cache()
     args = build_arg_parser().parse_args()
-    cfg, params = load_model(args)
+    cfg, params, mesh = load_model(args)
     cp = getattr(args, "cp", 1) or 1
-    cp_mesh = None
-    if cp > 1:
-        import jax as _jax
-
-        from ..parallel import MeshPlan, make_mesh
-
-        if getattr(args, "tp", 1) > 1:
-            raise SystemExit(
-                "--cp does not compose with --tp (one mesh per "
-                "server; a seq x model serving mesh is future work)"
-            )
-        devices = _jax.devices()
-        if cp > len(devices):
-            raise SystemExit(
-                f"--cp {cp} exceeds the {len(devices)} local devices"
-            )
-        cp_mesh = make_mesh(
-            devices[:cp], plan=MeshPlan(data=1, model=1, seq=cp)
-        )
+    # the EXACT mesh the params loaded onto: the ring and the params
+    # must share one device set (and do, structurally)
+    cp_mesh = mesh if cp > 1 else None
     server = InferenceServer(
         cfg, params, args.host, args.port, args.max_len,
         draft_layers=args.draft_layers, speculate=args.speculate,

@@ -440,6 +440,18 @@ def test_cp_generate_matches_unsharded(run):
     cp_q = cp_generate(params, prompt, cfg_q, mesh, 6, 128)
     assert [int(t) for t in cp_q[0]] == [int(t) for t in plain_q[0]]
 
+    # cp x tp: model-sharded params on a (seq, model) mesh — the ring
+    # keeps heads on 'model' inside its shard_map, the gathered cache
+    # decodes tensor-parallel, output still byte-equal
+    from containerpilot_tpu.parallel import shard_params
+
+    mesh_tp = make_mesh(
+        jax.devices()[:8], plan=MeshPlan(data=1, model=2, seq=4)
+    )
+    sharded = shard_params(params, mesh_tp, cfg)
+    cp_tp = cp_generate(sharded, prompt, cfg, mesh_tp, 8, 128)
+    assert [int(t) for t in cp_tp[0]] == [int(t) for t in plain[0]]
+
     # contract checks fail loudly
     with pytest.raises(ValueError, match="shorter than"):
         cp_generate(params, jnp.ones((1, 6), jnp.int32), cfg, mesh,
@@ -451,17 +463,26 @@ def test_cp_generate_matches_unsharded(run):
         cp_generate(params, prompt, cfg, no_seq, 4, 128)
 
 
-def test_serve_cp_long_prompt_matches_vanilla(run):
-    """--cp end-to-end: a server with a seq-axis mesh answers long
-    prompts byte-identically to a vanilla server (the cp ring prefill
-    feeds the same decode), short prompts take the normal path, and
+@pytest.mark.parametrize(
+    "plan_kw", [dict(model=1, seq=8), dict(model=2, seq=4)],
+    ids=["cp8", "cp4xtp2"],
+)
+def test_serve_cp_long_prompt_matches_vanilla(run, plan_kw):
+    """--cp end-to-end: a server with a seq-axis mesh (pure, or
+    composed with tensor parallelism — model-sharded params on a
+    seq x model mesh) answers long prompts byte-identically to a
+    vanilla server, short prompts take the normal path, and
     /v1/model reports the cp config; bad compositions fail at
     construction."""
     import json
     import urllib.request
 
     from containerpilot_tpu.models.transformer import init_params
-    from containerpilot_tpu.parallel import MeshPlan, make_mesh
+    from containerpilot_tpu.parallel import (
+        MeshPlan,
+        make_mesh,
+        shard_params,
+    )
     from containerpilot_tpu.workload.serve import InferenceServer
 
     cfg = TransformerConfig(
@@ -470,10 +491,14 @@ def test_serve_cp_long_prompt_matches_vanilla(run):
     )
     params = init_params(jax.random.PRNGKey(0), cfg)
     mesh = make_mesh(
-        jax.devices()[:8], plan=MeshPlan(data=1, model=1, seq=8)
+        jax.devices()[:8], plan=MeshPlan(data=1, **plan_kw)
+    )
+    srv_params = (
+        shard_params(params, mesh, cfg)
+        if plan_kw["model"] > 1 else params
     )
     cp_srv = InferenceServer(
-        cfg, params, "127.0.0.1", 0, max_len=128, cp_mesh=mesh,
+        cfg, srv_params, "127.0.0.1", 0, max_len=128, cp_mesh=mesh,
         cp_min_len=32,
     )
     vanilla = InferenceServer(cfg, params, "127.0.0.1", 0, max_len=128)
@@ -541,7 +566,7 @@ def test_serve_cp_long_prompt_matches_vanilla(run):
     pairs, info = run(scenario(), timeout=300)
     for got, want in pairs:
         assert got["tokens"] == want["tokens"]
-    assert info["cp"] == {"seq": 8, "min_len": 32}
+    assert info["cp"] == {"seq": plan_kw["seq"], "min_len": 32}
 
 
 def test_ring_attention_gqa_native():
