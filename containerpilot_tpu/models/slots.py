@@ -47,6 +47,34 @@ from .decode import (
 from .transformer import Params, TransformerConfig
 
 
+def append_chunk(emitted, toks, max_new: int, eos_id: int) -> bool:
+    """The ONE chunk-append convention shared by the slot engine and
+    the pod's streamed decode (their outputs are documented as
+    byte-identical to generate, so the rules must live in one place):
+    append ``toks`` into ``emitted`` capped at ``max_new``, stopping
+    at eos inclusive. Returns whether the row ended."""
+    for t in toks:
+        if len(emitted) >= max_new:
+            break
+        emitted.append(int(t))
+        if int(t) == eos_id:
+            break
+    return (
+        len(emitted) >= max_new
+        or (eos_id >= 0 and eos_id in emitted)
+    )
+
+
+def seed_counts(vocab_size: int, first: int, eos_id: int) -> jax.Array:
+    """Fresh generated-token counts after sample 0: the just-drawn
+    token counts unless it ended the row — matching generate's scan
+    exactly (the other half of the shared convention)."""
+    counts = jnp.zeros((vocab_size,), jnp.float32)
+    if first != eos_id:
+        counts = counts.at[first].set(1.0)
+    return counts
+
+
 def slot_cache(cfg: TransformerConfig, slots: int, max_len: int) -> Cache:
     """A pool of ``slots`` single-row caches, stacked on a leading
     slot axis (k/v: [S, layers, 1, length, kv_heads, head_dim];

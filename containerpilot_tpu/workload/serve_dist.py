@@ -93,6 +93,13 @@ def _payload_zeros(max_len: int) -> Dict[str, np.ndarray]:
         "frequency": np.zeros((), np.float32),
         "bias_idx": np.full((BIAS_SLOTS,), -1, np.int32),
         "bias_val": np.zeros((BIAS_SLOTS,), np.float32),
+        # > 0: stream the decode in K-token lockstep chunks (one tiny
+        # per-chunk 'go' broadcast lets the frontend cancel mid-way)
+        "chunk": np.zeros((), np.int32),
+        # the UNbucketed request length: chunked emission caps here,
+        # and it must be broadcast so every process derives the same
+        # done decision (the chunk program's done mask is an operand)
+        "max_new_req": np.zeros((), np.int32),
     }
 
 
@@ -121,6 +128,8 @@ def _payload_for(req: Dict[str, Any], max_len: int) -> Dict[str, np.ndarray]:
     ):
         p["bias_idx"][j] = tok_id
         p["bias_val"][j] = bias
+    p["chunk"] = np.asarray(req.get("chunk", 0), np.int32)
+    p["max_new_req"] = np.asarray(req["max_new"], np.int32)
     return p
 
 
@@ -167,6 +176,103 @@ def _score_pod(params, cfg, payload, max_len: int):
     return out[:, : plen - 1]
 
 
+def _stream_generate_pod(
+    params, cfg, payload, max_len: int, multihost_utils, dog=None,
+    emit=None, cancelled=None,
+):
+    """Chunked lockstep generation for SSE streaming: the slot
+    engine's building blocks (1-slot pool, first_sample, K-token
+    chunk program) run identically on every process, so emissions are
+    byte-identical to the slot engine's — which is byte-identical to
+    generate. Between chunks the frontend broadcasts a tiny ``go``
+    scalar: a client disconnect (``cancelled``) stops the pod
+    mid-generation with ONE more round-trip, and every round beats
+    the watchdog. ``emit`` (frontend only) receives each delta."""
+    from ..models.decode import _jitted_prefill
+    from ..models.slots import (
+        append_chunk,
+        decode_slots_chunk,
+        first_sample,
+        insert_row,
+        seed_counts,
+        slot_cache,
+    )
+
+    plen = int(payload["plen"])
+    max_new = int(payload["max_new_req"])
+    chunk = int(payload["chunk"])
+    eos_id = int(payload["eos_id"])
+    prompt = jnp.asarray(payload["prompt"][None, :plen], jnp.int32)
+    row_key = jax.random.fold_in(
+        jax.random.PRNGKey(int(payload["seed"])), 0
+    )
+    logits, row_cache = _jitted_prefill(cfg, max_len)(params, prompt)
+    first = first_sample(
+        logits, row_key,
+        float(payload["temperature"]), int(payload["top_k"]),
+        float(payload["top_p"]), cfg, eos_id=eos_id,
+        min_new=int(payload["min_new"]),
+        bias_idx=jnp.asarray(payload["bias_idx"], jnp.int32),
+        bias_val=jnp.asarray(payload["bias_val"], jnp.float32),
+    )
+    first_host = int(jax.device_get(first))
+    emitted = [first_host]
+    if emit is not None:
+        emit(list(emitted))
+    if dog is not None:
+        dog.beat()
+
+    pool = insert_row(slot_cache(cfg, 1, max_len), row_cache, 0, cfg)
+    last = jnp.asarray([first_host], jnp.int32)
+    keys = row_key[None]
+    step_idx = np.asarray([1], np.int32)
+    counts = seed_counts(cfg.vocab_size, first_host, eos_id)[None]
+    done = first_host == eos_id or max_new <= 1
+
+    def frontend_go() -> int:
+        if emit is None:
+            return 0  # followers' value is ignored by the broadcast
+        if done or len(emitted) >= max_new:
+            return 0
+        if cancelled is not None and cancelled.is_set():
+            return 0
+        return 1
+
+    while True:
+        go = int(multihost_utils.broadcast_one_to_all(
+            {"go": np.asarray(frontend_go(), np.int32)}
+        )["go"])
+        if not go:
+            break
+        (pool, last, done_dev, counts, toks) = decode_slots_chunk(
+            params, pool, last, keys, jnp.asarray(step_idx),
+            jnp.asarray([float(payload["temperature"])], jnp.float32),
+            jnp.asarray([int(payload["top_k"])], jnp.int32),
+            jnp.asarray([float(payload["top_p"])], jnp.float32),
+            jnp.asarray([eos_id], jnp.int32),
+            jnp.asarray([0], jnp.int32),
+            jnp.asarray([int(payload["min_new"])], jnp.int32),
+            jnp.asarray([float(payload["presence"])], jnp.float32),
+            jnp.asarray([float(payload["frequency"])], jnp.float32),
+            jnp.asarray(payload["bias_idx"][None], jnp.int32),
+            jnp.asarray(payload["bias_val"][None], jnp.float32),
+            counts,
+            jnp.asarray([done], bool),
+            cfg, chunk,
+        )
+        step_idx = step_idx + chunk
+        toks_host = np.asarray(jax.device_get(toks))[0]
+        # the slot engine's SHARED append rules (models/slots.py) —
+        # every process derives the same ``done``
+        before = len(emitted)
+        done = append_chunk(emitted, toks_host, max_new, eos_id)
+        if emit is not None and len(emitted) > before:
+            emit(list(emitted[before:]))
+        if dog is not None:
+            dog.beat()
+    return emitted
+
+
 def _decode_pod(params, cfg, payload, max_len: int):
     """The SPMD part every process runs identically: one generate call
     shaped purely by broadcast scalars (so every host traces and
@@ -206,7 +312,7 @@ class _Frontend:
 
     def __init__(self, host: str, port: int, max_len: int,
                  vocab: int, pod_info: Optional[Dict[str, Any]] = None,
-                 text: bool = False,
+                 text: bool = False, stream_chunk: int = 8,
                  ) -> None:
         from prometheus_client import (
             CollectorRegistry,
@@ -221,6 +327,7 @@ class _Frontend:
         self.ready = False
         # /v1/model payload: model config + pod topology, set by main()
         self.pod_info = pod_info or {}
+        self.stream_chunk = max(int(stream_chunk), 1)
         self.requests: "queue.Queue[Tuple[dict, queue.Queue]]" = (
             queue.Queue()
         )
@@ -319,7 +426,7 @@ class _Frontend:
                 "the pod frontend serves single-sample requests; "
                 "n > 1 is a single-host server feature"
             )
-        for knob in ("stop", "stream", "logprobs", "beam_width"):
+        for knob in ("stop", "logprobs", "beam_width"):
             # same rule: single-host features the broadcast payload
             # does not carry must fail loudly, never silently drop
             if body.get(knob):
@@ -406,6 +513,8 @@ class _Frontend:
         except (ValueError, KeyError, TypeError, OverflowError) as exc:
             self._m_requests.labels("generate", "422").inc()
             return self._Response(422, f"{exc}\n".encode())
+        if bool(body.get("stream", False)):
+            return self._generate_stream(work)
         result, err = await self._dispatch("generate", work)
         if err is not None:
             return err
@@ -432,6 +541,11 @@ class _Frontend:
                     f"prompt encodes to {len(row)} ids; max_len is "
                     f"{self.max_len}"
                 )
+            if bool(body.get("stream", False)):
+                raise ValueError(
+                    "the pod text surface does not stream; use "
+                    "/v1/generate with \"stream\": true"
+                )
             work = self._parse_work(body, row, default_eos=tok.EOS)
         except (ValueError, KeyError, TypeError, OverflowError) as exc:
             self._m_requests.labels("completions", "422").inc()
@@ -447,6 +561,60 @@ class _Frontend:
             ).encode(),
             content_type="application/json",
         )
+
+    def _generate_stream(self, work):
+        """SSE over the pod's chunked lockstep decode: each K-token
+        delta becomes a ``data:`` event as its broadcast round lands;
+        concatenated deltas equal the non-streamed pod answer. A
+        client disconnect sets the cancel event — the frontend stops
+        broadcasting ``go`` and the whole pod abandons the request at
+        the next chunk boundary."""
+        import asyncio
+        import threading as threading_mod
+
+        from ..utils.http import StreamingResponse
+
+        cancel = threading_mod.Event()
+        work = dict(work, chunk=self.stream_chunk, _cancel=cancel)
+        done: "queue.Queue" = queue.Queue()
+        t0 = time.perf_counter()
+        self.requests.put((work, done))
+        sent = [0]
+        status = ["200"]
+        finished = [False]
+
+        def finish() -> None:
+            if finished[0]:
+                return
+            finished[0] = True
+            cancel.set()
+            self._m_latency.observe(time.perf_counter() - t0)
+            self._m_tokens.inc(sent[0])
+            self._m_requests.labels("generate", status[0]).inc()
+
+        def sse(payload) -> bytes:
+            return b"data: " + json.dumps(payload).encode() + b"\n\n"
+
+        async def events():
+            loop = asyncio.get_event_loop()
+            try:
+                while True:
+                    item = await loop.run_in_executor(None, done.get)
+                    if isinstance(item, Exception):
+                        status[0] = "500"
+                        yield sse({"error": str(item)})
+                        break
+                    kind, val = item
+                    if kind == "delta":
+                        sent[0] += len(val)
+                        yield sse({"tokens": val})
+                    else:
+                        yield sse({"done": True, "count": sent[0]})
+                        break
+            finally:
+                finish()
+
+        return StreamingResponse(events(), close=finish)
 
     async def _score(self, req):
         import asyncio
@@ -545,6 +713,10 @@ def main() -> int:
                         "restores in lockstep (orbax is a global "
                         "checkpointer)")
     parser.add_argument("--use-ema", action="store_true")
+    parser.add_argument("--stream-chunk", type=int, default=8,
+                        help="tokens per SSE delta when a request "
+                        "sets \"stream\": true (one lockstep "
+                        "broadcast round per chunk)")
     parser.add_argument("--text", action="store_true",
                         help="byte-tokenizer /v1/completions on the "
                         "frontend (vocab must be >= 259)")
@@ -645,7 +817,7 @@ def main() -> int:
     if args.process_id == 0:
         frontend = _Frontend(
             args.host, args.port, args.max_len, cfg.vocab_size,
-            text=args.text,
+            text=args.text, stream_chunk=args.stream_chunk,
             pod_info={
                 "vocab_size": cfg.vocab_size,
                 "d_model": cfg.d_model,
@@ -654,6 +826,7 @@ def main() -> int:
                 "n_layers": cfg.n_layers,
                 "max_len": args.max_len,
                 "text": args.text,
+                "stream": True,
                 "pod": {
                     "num_processes": args.num_processes,
                     "devices": n_global,
@@ -674,6 +847,19 @@ def main() -> int:
         {"tokens": [0, 0, 0, 0], "max_new": 8}, args.max_len
     )
     np.asarray(_decode_pod(params, cfg, warm, args.max_len))
+    # the stream path's programs (prefill, first-sample, the 1-slot
+    # chunk) must compile inside the SAME startup grace — a cold
+    # first streamed request would otherwise hold a broadcast round
+    # open past the tightened watchdog deadline, pod-wide. Every
+    # process derives the identical warm payload from its own flags.
+    warm_stream = _payload_for(
+        {"tokens": [0, 0, 0, 0], "max_new": args.stream_chunk + 1,
+         "chunk": args.stream_chunk},
+        args.max_len,
+    )
+    _stream_generate_pod(
+        params, cfg, warm_stream, args.max_len, multihost_utils
+    )
     if dog is not None:
         dog.beat()  # startup done: tighten to the serve deadline
     if frontend is not None:
@@ -771,6 +957,19 @@ def main() -> int:
                     dog.beat()
                 if done_q is not None:
                     done_q.put(np.asarray(out).tolist())
+                continue
+            if op == OP_GENERATE and int(payload["chunk"]) > 0:
+                emit = cancelled = None
+                if done_q is not None:
+                    emit = lambda d: done_q.put(("delta", d))  # noqa: E731
+                    cancelled = work.get("_cancel")
+                _stream_generate_pod(
+                    params, cfg, payload, args.max_len,
+                    multihost_utils, dog=dog, emit=emit,
+                    cancelled=cancelled,
+                )
+                if done_q is not None:
+                    done_q.put(("end", None))
                 continue
             out = _decode_pod(params, cfg, payload, args.max_len)
             if dog is not None:

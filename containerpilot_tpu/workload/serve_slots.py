@@ -37,9 +37,11 @@ from ..models.decode import (
     normalize_logit_bias,
 )
 from ..models.slots import (
+    append_chunk,
     decode_slots_chunk,
     first_sample,
     insert_row,
+    seed_counts,
     slot_cache,
 )
 from ..models.transformer import TransformerConfig
@@ -261,12 +263,9 @@ class SlotEngine:
         else:
             self._bias_idx[slot_id] = -1
             self._bias_val[slot_id] = 0.0
-        # fresh generated-token counts; sample 0 (just drawn) counts
-        # unless it ended the row — matching generate's scan exactly
-        row_counts = jnp.zeros((self.cfg.vocab_size,), jnp.float32)
-        if first_host != req.eos_id:
-            row_counts = row_counts.at[first_host].set(1.0)
-        self._counts = self._counts.at[slot_id].set(row_counts)
+        self._counts = self._counts.at[slot_id].set(
+            seed_counts(self.cfg.vocab_size, first_host, req.eos_id)
+        )
         state = _Slot(req=req, emitted=[first_host])
         if first_host == req.eos_id or req.max_new <= 1:
             state.finished = True
@@ -397,17 +396,11 @@ class SlotEngine:
                     continue
                 req = state.req
                 before = len(state.emitted)
-                for t in toks_host[i]:
-                    if len(state.emitted) >= req.max_new:
-                        break
-                    state.emitted.append(int(t))
-                    if int(t) == req.eos_id:
-                        break
+                ended = append_chunk(
+                    state.emitted, toks_host[i], req.max_new,
+                    req.eos_id,
+                )
                 if len(state.emitted) > before:
                     self._notify(req, state.emitted[before:])
-                ended = (
-                    len(state.emitted) >= req.max_new
-                    or (req.eos_id >= 0 and req.eos_id in state.emitted)
-                )
                 if ended:
                     self._harvest(i)

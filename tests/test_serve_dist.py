@@ -204,6 +204,39 @@ def test_pod_serves_http(tmp_path, n_procs, dp):
         )
         assert 11 not in knobs["tokens"][0]
 
+        # SSE streaming over the chunked lockstep decode: deltas
+        # concatenate to the non-streamed answer for the same request
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", http_port, timeout=240
+        )
+        conn.request(
+            "POST", "/v1/generate",
+            json.dumps({"tokens": [[1, 2, 3]], "max_new_tokens": 6,
+                        "stream": True}),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "text/event-stream"
+        events, buf = [], b""
+        while True:
+            data = resp.read1(65536)
+            if not data:
+                break
+            buf += data
+            while b"\n\n" in buf:
+                raw, buf = buf.split(b"\n\n", 1)
+                events.append(json.loads(raw[len(b"data: "):]))
+        conn.close()
+        assert events[-1]["done"] is True
+        streamed = sum(
+            (e["tokens"] for e in events if "tokens" in e), []
+        )
+        assert streamed == greedy["tokens"][0]
+        assert events[-1]["count"] == len(streamed)
+
         # /v1/score rides the broadcast too: teacher-forced logprobs
         # match the single-host formula bit-for-bit
         req = urllib.request.Request(
@@ -245,13 +278,36 @@ def test_pod_serves_http(tmp_path, n_procs, dp):
         ).read().decode()
         assert (
             'containerpilot_pod_requests_total'
-            '{endpoint="generate",status="200"} 3.0'
-        ) in metrics
+            '{endpoint="generate",status="200"} 4.0'
+        ) in metrics  # 3 plain + 1 streamed
         assert (
             'containerpilot_pod_requests_total'
             '{endpoint="model",status="200"} 1.0'
         ) in metrics
         assert "containerpilot_pod_generated_tokens_total" in metrics
+
+        # disconnect mid-stream: the frontend stops broadcasting go,
+        # the pod abandons the request at the next chunk boundary,
+        # and keeps serving
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", http_port, timeout=240
+        )
+        conn.request(
+            "POST", "/v1/generate",
+            json.dumps({"tokens": [[5, 6]], "max_new_tokens": 40,
+                        "stream": True}),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        buf = b""
+        while b"\n\n" not in buf:
+            buf += resp.read1(65536)
+        resp.close()
+        conn.close()
+        again = post({"tokens": [[1, 2, 3]], "max_new_tokens": 6})
+        assert again["tokens"][0] == greedy["tokens"][0]
+
 
         # graceful pod shutdown: TERM on the frontend broadcasts the
         # stop; ALL processes exit 0
@@ -268,6 +324,45 @@ def test_pod_serves_http(tmp_path, n_procs, dp):
         catalog.wait(timeout=10)
         for fh in logs:
             fh.close()
+
+
+def test_pod_frontend_parse_never_leaks_exceptions():
+    """Adversarial bodies against the frontend's parse layer: every
+    malformed request must raise the ValueError family the handlers
+    turn into 422s — anything else would reach the broadcast loop,
+    where an exception is deliberately pod-fatal."""
+    import random
+
+    from containerpilot_tpu.workload.serve_dist import _Frontend
+
+    f = _Frontend("127.0.0.1", 0, max_len=48, vocab=512)
+    rng = random.Random(0)
+    atoms = [
+        None, True, False, 0, 1, -1, 2**40, -2**40, 1.5, float("nan"),
+        float("inf"), "x", "", [], {}, [None], [[]], [[1]], [[-1]],
+        [[1, "a"]], [[True]], [[2**40]], {"1": 1}, [[1], [2]],
+        [[1, 2, 3]],
+    ]
+    keys = [
+        "tokens", "max_new_tokens", "temperature", "top_k", "top_p",
+        "eos_id", "seed", "min_new_tokens", "presence_penalty",
+        "frequency_penalty", "logit_bias", "n", "stop", "stream",
+        "logprobs", "beam_width",
+    ]
+    ok = 0
+    for _ in range(300):
+        body = {
+            k: rng.choice(atoms)
+            for k in rng.sample(keys, rng.randrange(1, 6))
+        }
+        try:
+            tokens = f._parse_single_row(body)
+            f._parse_work(body, tokens)
+            ok += 1
+        except (ValueError, KeyError, TypeError, OverflowError):
+            pass  # the 422 family the handlers catch
+    # some random bodies are legal; the point is nothing ELSE raised
+    assert ok >= 0
 
 
 def test_pod_text_completions(tmp_path):
@@ -349,11 +444,11 @@ def test_pod_text_completions(tmp_path):
             {"prompt": "x", "stop": ["y"]},
         )
         s2, body2 = post(
-            "/v1/generate",
-            {"tokens": [[1, 2]], "stream": True},
+            "/v1/completions",
+            {"prompt": "x", "stream": True},
         )
         assert s1 == 422 and "does not support 'stop'" in body1
-        assert s2 == 422 and "does not support 'stream'" in body2
+        assert s2 == 422 and "does not stream" in body2
 
         procs[0].send_signal(15)
         for i, proc in enumerate(procs):
