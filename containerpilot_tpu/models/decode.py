@@ -500,8 +500,13 @@ def apply_token_penalties(
     return logits - pres * (counts > 0) - freq * counts
 
 
-BIAS_SLOTS = 16  # static per-row logit_bias capacity (OpenAI allows
-# 300; serving caps requests well below — static K keeps ONE program)
+BIAS_SLOTS = 16  # fast-path static per-row logit_bias capacity:
+# almost every real request carries a handful of entries, and a
+# static K keeps ONE compiled program for all of them
+BIAS_SLOTS_MAX = 300  # OpenAI's documented logit_bias cap; a request
+# with more than BIAS_SLOTS entries selects this wider static table
+# at normalize time (one extra program keyed by the operand shape)
+# instead of being rejected
 
 
 def apply_logit_bias(
@@ -703,7 +708,9 @@ def generate(
     temperature/filters (OpenAI semantics: -100 effectively bans a
     token, +100 effectively forces it) — one ``{token_id: bias}``
     dict for the whole batch or a per-row list of dicts, at most
-    BIAS_SLOTS entries per row; applied before the min_new eos mask
+    BIAS_SLOTS_MAX (= OpenAI's 300) entries per row — rows within
+    BIAS_SLOTS ride the fast-path program; applied before the min_new
+    eos mask
     so a positive eos bias cannot break the floor. ``rng`` is one
     key (split per row internally) or [batch] stacked per-row keys —
     per-row keys keep each row's output independent of co-batched
@@ -811,44 +818,74 @@ def _normalize_sampling(cfg, b, max_new_tokens, temperature, rng,
     )
 
 
-def normalize_logit_bias(cfg, b: int, logit_bias):
-    """[b, BIAS_SLOTS] (idx, val) arrays from None, one {token: bias}
-    dict applied to every row, or a per-row list of such dicts (None
+def normalize_logit_bias(cfg, b: int, logit_bias, slots: int = None):
+    """[b, K] (idx, val) arrays from None, one {token: bias} dict
+    applied to every row, or a per-row list of such dicts (None
     entries allowed). Unused slots carry idx -1. Validates ids, |bias|
-    <= 100 (OpenAI's range), and the per-row entry cap."""
+    <= 100 (OpenAI's range), and the per-row entry cap
+    (BIAS_SLOTS_MAX = OpenAI's 300).
+
+    ``slots`` pins the static capacity K (fixed-width callers: the
+    slot engine and the pod payload). When None, K is chosen per
+    request: BIAS_SLOTS while every row fits it (the common fast
+    path keeps its one compiled program), else BIAS_SLOTS_MAX — the
+    operand shape keys the one extra program big requests compile."""
     import numpy as np
 
-    idx = np.full((b, BIAS_SLOTS), -1, np.int32)
-    val = np.zeros((b, BIAS_SLOTS), np.float32)
-    if logit_bias is None:
-        return idx, val
-    rows = (
-        logit_bias if isinstance(logit_bias, (list, tuple))
-        else [logit_bias] * b
-    )
-    if len(rows) != b:
-        raise ValueError(f"logit_bias must be one dict or {b} rows")
-    for r, entry in enumerate(rows):
-        if entry is None:
-            continue
-        if not isinstance(entry, dict):
-            raise ValueError("logit_bias rows must be dicts or None")
-        if len(entry) > BIAS_SLOTS:
-            raise ValueError(
-                f"logit_bias is capped at {BIAS_SLOTS} tokens per row"
-            )
-        for j, (tok, bias) in enumerate(sorted(entry.items())):
-            tok = int(tok)
-            bias = float(bias)
-            if not 0 <= tok < cfg.vocab_size:
-                raise ValueError(
-                    f"logit_bias token ids must be in "
-                    f"[0, {cfg.vocab_size})"
+    # parse/validate FIRST so capacity can be picked from the real
+    # row sizes; int-coerce keys BEFORE sorting (a dict mixing int
+    # and str ids — str is OpenAI's JSON wire form — must fail the
+    # documented ValueError way, not a raw TypeError from sorted)
+    rows = []
+    if logit_bias is not None:
+        raw_rows = (
+            logit_bias if isinstance(logit_bias, (list, tuple))
+            else [logit_bias] * b
+        )
+        if len(raw_rows) != b:
+            raise ValueError(f"logit_bias must be one dict or {b} rows")
+        for entry in raw_rows:
+            if entry is None:
+                rows.append([])
+                continue
+            if not isinstance(entry, dict):
+                raise ValueError("logit_bias rows must be dicts or None")
+            try:
+                # dict-dedup AFTER coercion (last wins, matching
+                # parse_logit_bias): {"5": 100, 5: 100} must not
+                # occupy two slots whose scatter-adds SUM past the
+                # validated per-entry +/-100 bound
+                items = sorted(
+                    {int(t): float(v) for t, v in entry.items()}
+                    .items()
                 )
-            if not abs(bias) <= 100:
+            except (TypeError, ValueError):
                 raise ValueError(
-                    "logit_bias values must be in [-100, 100]"
-                )
+                    "logit_bias keys must be token ids and values "
+                    "numbers"
+                ) from None
+            for tok, bias in items:
+                if not 0 <= tok < cfg.vocab_size:
+                    raise ValueError(
+                        f"logit_bias token ids must be in "
+                        f"[0, {cfg.vocab_size})"
+                    )
+                if not abs(bias) <= 100:
+                    raise ValueError(
+                        "logit_bias values must be in [-100, 100]"
+                    )
+            rows.append(items)
+    need = max((len(r) for r in rows), default=0)
+    if slots is None:
+        slots = BIAS_SLOTS if need <= BIAS_SLOTS else BIAS_SLOTS_MAX
+    if need > slots:
+        raise ValueError(
+            f"logit_bias is capped at {slots} tokens per row"
+        )
+    idx = np.full((b, slots), -1, np.int32)
+    val = np.zeros((b, slots), np.float32)
+    for r, items in enumerate(rows):
+        for j, (tok, bias) in enumerate(items):
             idx[r, j] = tok
             val[r, j] = bias
     return idx, val

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .decode import (
-    BIAS_SLOTS,
+    BIAS_SLOTS_MAX,
     Cache,
     apply_logit_bias,
     apply_token_penalties,
@@ -185,8 +185,9 @@ def decode_slots_chunk(
     chunk: int,
 ):
     """Advance the whole pool ``chunk`` tokens; see _jitted_chunk.
-    ``bias_idx``/``bias_val`` are [S, BIAS_SLOTS] per-slot logit_bias
-    operands (-1 = unused slot). Returns (pool, last, done, counts,
+    ``bias_idx``/``bias_val`` are [S, K] per-slot logit_bias operands
+    (-1 = unused slot; serving uses K = BIAS_SLOTS_MAX so one program
+    covers every legal request). Returns (pool, last, done, counts,
     tokens [S, chunk]); the pool AND the counts buffer are donated."""
     slots = int(last.shape[0])
     return _jitted_chunk(cfg, slots, chunk)(
@@ -227,11 +228,13 @@ def first_sample(logits, row_key, temperature, top_k, top_p,
                  min_new: int = 0, bias_idx=None,
                  bias_val=None) -> jax.Array:
     """logits: [1, vocab] from prefill -> token 0 (scalar).
-    ``bias_idx``/``bias_val``: [BIAS_SLOTS] logit_bias row (None =
-    no bias)."""
+    ``bias_idx``/``bias_val``: a [K] logit_bias row (None = no bias;
+    the default materializes at BIAS_SLOTS_MAX — the width serving
+    always passes — so biased and plain callers share one compiled
+    program)."""
     if bias_idx is None:
-        bias_idx = jnp.full((BIAS_SLOTS,), -1, jnp.int32)
-        bias_val = jnp.zeros((BIAS_SLOTS,), jnp.float32)
+        bias_idx = jnp.full((BIAS_SLOTS_MAX,), -1, jnp.int32)
+        bias_val = jnp.zeros((BIAS_SLOTS_MAX,), jnp.float32)
     return _jitted_first_sample(cfg)(
         logits, row_key,
         jnp.asarray(temperature, jnp.float32),

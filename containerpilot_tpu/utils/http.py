@@ -198,8 +198,15 @@ class HTTPServer:
         the request side of the socket — EOF there is the earliest
         reliable disconnect signal (drain() only fails on a later
         write)."""
+        async def _client_gone() -> None:
+            # only a true EOF means the client left: a pipelined
+            # second request from a keep-alive client puts BYTES on
+            # the read side, which must not abort the stream mid-way
+            while await reader.read(65536):
+                pass
+
         agen = response.chunks
-        eof_task = asyncio.ensure_future(reader.read())
+        eof_task = asyncio.ensure_future(_client_gone())
         try:
             reason = _REASONS.get(response.status, "Unknown")
             headers = {

@@ -90,7 +90,7 @@ def parse_logit_bias(raw: Any, vocab_size: int):
     the same bounds."""
     if raw is None:
         return None
-    from ..models.decode import BIAS_SLOTS
+    from ..models.decode import BIAS_SLOTS_MAX
 
     if not isinstance(raw, dict):
         raise ValueError(
@@ -98,9 +98,9 @@ def parse_logit_bias(raw: Any, vocab_size: int):
         )
     if not raw:
         return None  # OpenAI semantics: an empty map is a no-op
-    if len(raw) > BIAS_SLOTS:
+    if len(raw) > BIAS_SLOTS_MAX:
         raise ValueError(
-            f"'logit_bias' is capped at {BIAS_SLOTS} tokens"
+            f"'logit_bias' is capped at {BIAS_SLOTS_MAX} tokens"
         )
     out = {}
     for k, v in raw.items():

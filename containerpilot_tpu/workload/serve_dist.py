@@ -67,7 +67,7 @@ import numpy as np
 
 log = logging.getLogger("containerpilot.serve_dist")
 
-from ..models.decode import BIAS_SLOTS
+from ..models.decode import BIAS_SLOTS_MAX
 
 OP_SHUTDOWN = 0
 OP_GENERATE = 1
@@ -91,8 +91,8 @@ def _payload_zeros(max_len: int) -> Dict[str, np.ndarray]:
         "min_new": np.zeros((), np.int32),
         "presence": np.zeros((), np.float32),
         "frequency": np.zeros((), np.float32),
-        "bias_idx": np.full((BIAS_SLOTS,), -1, np.int32),
-        "bias_val": np.zeros((BIAS_SLOTS,), np.float32),
+        "bias_idx": np.full((BIAS_SLOTS_MAX,), -1, np.int32),
+        "bias_val": np.zeros((BIAS_SLOTS_MAX,), np.float32),
         # > 0: stream the decode in K-token lockstep chunks (one tiny
         # per-chunk 'go' broadcast lets the frontend cancel mid-way)
         "chunk": np.zeros((), np.int32),
@@ -123,9 +123,16 @@ def _payload_for(req: Dict[str, Any], max_len: int) -> Dict[str, np.ndarray]:
     p["min_new"] = np.asarray(req.get("min_new", 0), np.int32)
     p["presence"] = np.asarray(req.get("presence", 0.0), np.float32)
     p["frequency"] = np.asarray(req.get("frequency", 0.0), np.float32)
-    for j, (tok_id, bias) in enumerate(
-        sorted((req.get("logit_bias") or {}).items())
-    ):
+    # int-coerce before sorting (str keys are OpenAI's wire form) and
+    # bound at the static table size: parse_logit_bias upstream 422s
+    # anything over it, so the slice is a defensive bound that can
+    # never raise inside the pod loop (an IndexError here would be
+    # pod-fatal — the loop deliberately re-raises)
+    items = sorted(
+        (int(t), float(v))
+        for t, v in (req.get("logit_bias") or {}).items()
+    )[:BIAS_SLOTS_MAX]
+    for j, (tok_id, bias) in enumerate(items):
         p["bias_idx"][j] = tok_id
         p["bias_val"][j] = bias
     p["chunk"] = np.asarray(req.get("chunk", 0), np.int32)

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.decode import (
-    BIAS_SLOTS,
+    BIAS_SLOTS_MAX,
     _jitted_prefill,
     normalize_logit_bias,
 )
@@ -62,7 +62,9 @@ class _Request:
     min_new: int = 0
     presence: float = 0.0
     frequency: float = 0.0
-    # [BIAS_SLOTS] logit_bias row (idx -1 = unused); None = no bias
+    # [BIAS_SLOTS_MAX] logit_bias row (idx -1 = unused) — always
+    # materialized at the engine's ONE static width so biased and
+    # plain requests share every compiled program
     bias_idx: Optional[object] = None
     bias_val: Optional[object] = None
     # streaming: called from the worker thread with each newly emitted
@@ -117,8 +119,8 @@ class SlotEngine:
         self._min_new = np.zeros((slots,), np.int32)
         self._presence = np.zeros((slots,), np.float32)
         self._frequency = np.zeros((slots,), np.float32)
-        self._bias_idx = np.full((slots, BIAS_SLOTS), -1, np.int32)
-        self._bias_val = np.zeros((slots, BIAS_SLOTS), np.float32)
+        self._bias_idx = np.full((slots, BIAS_SLOTS_MAX), -1, np.int32)
+        self._bias_val = np.zeros((slots, BIAS_SLOTS_MAX), np.float32)
         # generated-token counts per slot, device-resident (the chunk
         # program reads and donates it like the pool)
         self._counts = jnp.zeros((slots, cfg.vocab_size), jnp.float32)
@@ -173,12 +175,10 @@ class SlotEngine:
                 f"prompt {len(tokens)} + max_new {max_new} exceeds "
                 f"max_len {self.max_len}"
             )
-        bias_idx = bias_val = None
-        if logit_bias:
-            rows_idx, rows_val = normalize_logit_bias(
-                self.cfg, 1, logit_bias
-            )
-            bias_idx, bias_val = rows_idx[0], rows_val[0]
+        rows_idx, rows_val = normalize_logit_bias(
+            self.cfg, 1, logit_bias or None, slots=BIAS_SLOTS_MAX
+        )
+        bias_idx, bias_val = rows_idx[0], rows_val[0]
         req = _Request(
             tokens=list(tokens), max_new=int(max_new),
             temperature=float(temperature), top_k=int(top_k),
@@ -257,12 +257,8 @@ class SlotEngine:
         self._min_new[slot_id] = req.min_new
         self._presence[slot_id] = req.presence
         self._frequency[slot_id] = req.frequency
-        if req.bias_idx is not None:
-            self._bias_idx[slot_id] = req.bias_idx
-            self._bias_val[slot_id] = req.bias_val
-        else:
-            self._bias_idx[slot_id] = -1
-            self._bias_val[slot_id] = 0.0
+        self._bias_idx[slot_id] = req.bias_idx
+        self._bias_val[slot_id] = req.bias_val
         self._counts = self._counts.at[slot_id].set(
             seed_counts(self.cfg.vocab_size, first_host, req.eos_id)
         )

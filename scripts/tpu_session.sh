@@ -3,7 +3,7 @@
 # is healthy. Strict ordering — ONE TPU-touching process at a time
 # (the tunnel serves a single client):
 #   1. flash block autotune  -> containerpilot_tpu/ops/tuned/<platform>.json
-#   2. full bench.py         -> docs/bench-snapshots/round4-<platform>.json
+#   2. full bench.py         -> docs/bench-snapshots/round5-<platform>.json
 # Both artifacts are meant to be committed: the tuned table changes
 # routing (ops/tuning.py), the snapshot is the round's evidence.
 set -euo pipefail
@@ -22,7 +22,7 @@ timeout 3600 python -m containerpilot_tpu.ops.autotune \
   --seqs 1024,2048,4096,8192 --blocks 128,256,512 --write
 
 echo "== bench (full, with tuned routing) =="
-SNAP="docs/bench-snapshots/round4-$(python - <<'EOF'
+SNAP="docs/bench-snapshots/round5-$(python - <<'EOF'
 import sys
 sys.path.insert(0, ".")
 from containerpilot_tpu.ops.tuning import platform_slug

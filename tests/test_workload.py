@@ -3803,6 +3803,7 @@ def test_logit_bias_math_and_validation():
 
     from containerpilot_tpu.models.decode import (
         BIAS_SLOTS,
+        BIAS_SLOTS_MAX,
         apply_logit_bias,
         normalize_logit_bias,
     )
@@ -3832,10 +3833,33 @@ def test_logit_bias_math_and_validation():
     for bad in (
         {99: 1.0},             # out of vocab
         {3: 500.0},            # out of range
-        {i: 1.0 for i in range(BIAS_SLOTS + 1)},  # over cap
+        {3: 1.0, "x": 1.0},    # unparseable key: ValueError, not
+        # a raw TypeError out of sorted() on mixed key types
     ):
         with pytest.raises(ValueError):
             normalize_logit_bias(cfg, 1, bad)
+    # str keys are OpenAI's JSON wire form; mixing them with int
+    # keys must coerce, not blow up sorting
+    idx_m, _val_m = normalize_logit_bias(cfg, 1, {"5": 2.0, 3: 1.0})
+    assert sorted(int(i) for i in idx_m[0] if i >= 0) == [3, 5]
+    # BIAS_SLOTS is a fast path, not the cap: one entry over it
+    # jumps to the wide static table (OpenAI's 300); one entry over
+    # THAT is the real 422
+    big = TransformerConfig(
+        vocab_size=512, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=16, dtype=jnp.float32,
+    )
+    assert normalize_logit_bias(cfg, 1, {3: 1.0})[0].shape == \
+        (1, BIAS_SLOTS)
+    idx_w, val_w = normalize_logit_bias(
+        big, 1, {i: 1.0 for i in range(BIAS_SLOTS + 1)}
+    )
+    assert idx_w.shape == (1, BIAS_SLOTS_MAX)
+    assert int((idx_w[0] >= 0).sum()) == BIAS_SLOTS + 1
+    with pytest.raises(ValueError):
+        normalize_logit_bias(
+            big, 1, {i: 1.0 for i in range(BIAS_SLOTS_MAX + 1)}
+        )
 
 
 def test_logit_bias_forces_and_bans_across_paths():
@@ -3891,6 +3915,22 @@ def test_logit_bias_forces_and_bans_across_paths():
             [1, 2, 3], max_new=6, logit_bias={banned_id: -100.0}
         ).result(timeout=120)
         assert got2 == [int(t) for t in ref[0]]
+        # > BIAS_SLOTS entries ride the wide static table (OpenAI
+        # allows 300): 20 banned ids hold on both paths, outputs
+        # byte-identical
+        wide = {i: -100.0 for i in range(20)}
+        ref_w = generate(
+            params, prompt, cfg, 6, 32,
+            rng=jnp.stack(
+                [jax.random.fold_in(jax.random.PRNGKey(0), 0)]
+            ),
+            logit_bias=wide,
+        )
+        got_w = eng.submit(
+            [1, 2, 3], max_new=6, logit_bias=wide
+        ).result(timeout=120)
+        assert got_w == [int(t) for t in ref_w[0]]
+        assert all(t >= 20 for t in got_w)
     finally:
         eng.stop()
 
