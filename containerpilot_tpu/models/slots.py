@@ -89,9 +89,16 @@ def slot_cache(cfg: TransformerConfig, slots: int, max_len: int) -> Cache:
 
 
 @functools.lru_cache(maxsize=8)
-def _jitted_insert(cfg: TransformerConfig):
+def _jitted_insert(cfg: TransformerConfig, out_sharding=None):
     """(pool, row_cache, slot) -> pool with the row written at slot.
-    donate the pool: insertion must not copy S full cache rows."""
+    donate the pool: insertion must not copy S full cache rows.
+
+    ``out_sharding`` (a NamedSharding, hashable) pins the output
+    placement — multi-process serving passes fully-replicated so the
+    pool NEVER drifts into whatever sharding GSPMD would pick for
+    this program (a drifting pool re-enters the next donating program
+    under a different layout; pinning keeps every process's copy
+    bit-identical by construction)."""
 
     def insert(pool: Cache, row: Cache, slot: jax.Array) -> Cache:
         def put(big, small):
@@ -106,18 +113,23 @@ def _jitted_insert(cfg: TransformerConfig):
 
         return jax.tree.map(put, pool, row)
 
-    return jax.jit(insert, donate_argnums=(0,))
+    return jax.jit(
+        insert, donate_argnums=(0,), out_shardings=out_sharding
+    )
 
 
 def insert_row(pool: Cache, row: Cache, slot: int,
-               cfg: TransformerConfig) -> Cache:
+               cfg: TransformerConfig, out_sharding=None) -> Cache:
     """Write a freshly prefilled single-row cache into the pool.
     The pool buffer is donated (in-place update)."""
-    return _jitted_insert(cfg)(pool, row, jnp.asarray(slot, jnp.int32))
+    return _jitted_insert(cfg, out_sharding)(
+        pool, row, jnp.asarray(slot, jnp.int32)
+    )
 
 
 @functools.lru_cache(maxsize=8)
-def _jitted_chunk(cfg: TransformerConfig, slots: int, chunk: int):
+def _jitted_chunk(cfg: TransformerConfig, slots: int, chunk: int,
+                  out_sharding=None):
     """One compiled program advancing every slot ``chunk`` tokens.
 
     Operands (all [S] unless noted): pool cache (donated), last
@@ -160,7 +172,9 @@ def _jitted_chunk(cfg: TransformerConfig, slots: int, chunk: int):
         )
         return pool, last, done, counts, toks.T  # [S, chunk]
 
-    return jax.jit(run, donate_argnums=(1, 15))
+    return jax.jit(
+        run, donate_argnums=(1, 15), out_shardings=out_sharding
+    )
 
 
 def decode_slots_chunk(
@@ -183,14 +197,17 @@ def decode_slots_chunk(
     done: jax.Array,
     cfg: TransformerConfig,
     chunk: int,
+    out_sharding=None,
 ):
     """Advance the whole pool ``chunk`` tokens; see _jitted_chunk.
     ``bias_idx``/``bias_val`` are [S, K] per-slot logit_bias operands
     (-1 = unused slot; serving uses K = BIAS_SLOTS_MAX so one program
     covers every legal request). Returns (pool, last, done, counts,
-    tokens [S, chunk]); the pool AND the counts buffer are donated."""
+    tokens [S, chunk]); the pool AND the counts buffer are donated.
+    ``out_sharding`` pins every output's placement (see
+    _jitted_insert) — the pod passes fully-replicated."""
     slots = int(last.shape[0])
-    return _jitted_chunk(cfg, slots, chunk)(
+    return _jitted_chunk(cfg, slots, chunk, out_sharding)(
         params, pool, last, row_keys, step_idx, temperature, top_k,
         top_p, eos_id, pad_id, min_new, presence, frequency,
         bias_idx, bias_val, counts, done,

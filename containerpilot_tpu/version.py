@@ -1,5 +1,5 @@
 """Version metadata (reference: version/version.go:5-9, injected by LDFLAGS;
 here set at release time and optionally overridden by the build)."""
 
-VERSION = "0.6.0"
+VERSION = "0.7.0"
 GIT_HASH = "dev"

@@ -124,6 +124,58 @@ def parse_logit_bias(raw: Any, vocab_size: int):
     return out
 
 
+def parse_stop_ids(raw: Any, vocab_size: int):
+    """The ONE token-level ``stop`` parser (single-host server and pod
+    frontend — the bounds must not diverge): a list of non-empty id
+    rows (text surfaces encode strings before calling). Bounded so a
+    request can't smuggle in an O(stops*len) trim bill. Raises
+    ValueError for the 422 path."""
+    if raw is None:
+        return []
+    if not isinstance(raw, list) or len(raw) > 8 or not all(
+        isinstance(s, list)
+        and 1 <= len(s) <= 32
+        and all(
+            isinstance(t, int)
+            and not isinstance(t, bool)
+            and 0 <= t < vocab_size
+            for t in s
+        )
+        for s in raw
+    ):
+        raise ValueError(
+            "'stop' must be a list of at most 8 sequences, each "
+            f"1..32 token ids in [0, {vocab_size})"
+        )
+    return raw
+
+
+def parse_stop_strings(raw: Any):
+    """The string-level half of the ``stop`` contract, shared by both
+    text surfaces (single-host and pod /v1/completions): one string or
+    a list of at most 8, each 1..32 UTF-8 bytes. Validated BEFORE
+    encoding so the 422 speaks the text endpoint's language (the
+    id-level bounds in parse_stop_ids would otherwise leak through).
+    Returns the list of strings (None -> None)."""
+    if raw is None:
+        return None
+    if isinstance(raw, str):
+        raw = [raw]
+    if (
+        not isinstance(raw, list)
+        or len(raw) > 8
+        or not all(
+            isinstance(s, str) and 1 <= len(s.encode()) <= 32
+            for s in raw
+        )
+    ):
+        raise ValueError(
+            "'stop' must be a non-empty string (or a list of at "
+            "most 8), each at most 32 UTF-8 bytes"
+        )
+    return raw
+
+
 def validate_lora_flags(lora_dir: str, lora_rank: int) -> None:
     """Clean SystemExit for the flag-misuse cases every CLI shares."""
     if lora_rank > 0 and not lora_dir:

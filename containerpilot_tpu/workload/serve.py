@@ -396,27 +396,12 @@ class InferenceServer:
         return parse_logit_bias(raw, self.cfg.vocab_size)
 
     def _parse_stops(self, raw: Any) -> List[List[int]]:
-        """Token-level stop sequences: a list of non-empty id rows
-        (the text surface converts strings before calling). Bounded so
-        a request can't smuggle in an O(stops*len) trim bill."""
-        if raw is None:
-            return []
-        if not isinstance(raw, list) or len(raw) > 8 or not all(
-            isinstance(s, list)
-            and 1 <= len(s) <= 32
-            and all(
-                isinstance(t, int)
-                and not isinstance(t, bool)
-                and 0 <= t < self.cfg.vocab_size
-                for t in s
-            )
-            for s in raw
-        ):
-            raise ValueError(
-                "'stop' must be a list of at most 8 sequences, each "
-                f"1..32 token ids in [0, {self.cfg.vocab_size})"
-            )
-        return raw
+        """Delegates to the shared parser (modelcfg.parse_stop_ids)
+        so the single-host server and the pod frontend accept exactly
+        the same stop sequences."""
+        from .modelcfg import parse_stop_ids
+
+        return parse_stop_ids(raw, self.cfg.vocab_size)
 
     def _parse_sampling(
         self, body: Dict[str, Any], tokens: List[List[int]],
@@ -808,25 +793,10 @@ class InferenceServer:
                     f"prompt encodes to {len(row)} ids; max_len is "
                     f"{self.max_len}"
                 )
-            stop_raw = body.pop("stop", None)
-            if isinstance(stop_raw, str):
-                stop_raw = [stop_raw]
+            from .modelcfg import parse_stop_strings
+
+            stop_raw = parse_stop_strings(body.pop("stop", None))
             if stop_raw is not None:
-                # string-flavored validation BEFORE encoding, so the
-                # 422 speaks this endpoint's language (the id-level
-                # bounds in _parse_stops would otherwise leak through)
-                if (
-                    not isinstance(stop_raw, list)
-                    or len(stop_raw) > 8
-                    or not all(
-                        isinstance(s, str) and 1 <= len(s.encode()) <= 32
-                        for s in stop_raw
-                    )
-                ):
-                    raise ValueError(
-                        "'stop' must be a non-empty string (or a list "
-                        "of at most 8), each at most 32 UTF-8 bytes"
-                    )
                 body["stop"] = [
                     self.tokenizer.encode(s, bos=False)
                     for s in stop_raw

@@ -1,4 +1,5 @@
-"""Multi-host serving: one HTTP frontend, a decode spanning the pod.
+"""Multi-host serving: one HTTP frontend, a slot-pool decode spanning
+the pod.
 
 Models too large for one host's devices serve across hosts the same
 way they train: every process joins the pod through the supervisor's
@@ -7,47 +8,66 @@ rendezvous the training capstone uses), params shard over a GLOBAL
 mesh with the training partition rules, and XLA's collectives carry
 the decode over ICI within a host and DCN between hosts.
 
-Process 0 is the frontend: it serves ``/health`` and
-``POST /v1/generate`` (token-level, same request shape as the
-single-host server's core knobs) and turns each request into a
-fixed-shape operand bundle broadcast to the pod
-(``multihost_utils.broadcast_one_to_all``). Every process — frontend
-included — then runs the SAME jitted ``generate`` on the same
-operands in the same order, which is all SPMD needs; process 0
-fetches the replicated result and responds. Followers run the
-broadcast-follow loop with no HTTP surface (their supervisor job
-health-checks process liveness, e.g. ``kill -0
-$CONTAINERPILOT_<JOB>_PID``).
+The pod runs the SAME continuous-batching slot engine the single-host
+server does (``models/slots.py``), made SPMD: a fixed pool of
+``--slots`` per-request cache rows decodes in ``--stream-chunk``-token
+lockstep chunks, and between chunks process 0 broadcasts one
+fixed-shape ROUND payload (``multihost_utils.broadcast_one_to_all``)
+carrying this round's admission (at most one new request row: prompt,
+knobs, key), the per-slot active mask, and whether to run a chunk.
+Every process — frontend included — replays the identical device ops
+(`_SlotMirror`: prefill+insert for the admission, then the one
+compiled chunk program); process 0 alone keeps the HTTP bookkeeping
+(emitted tokens, retirement, SSE deltas). Requests therefore JOIN a
+running decode at the next chunk boundary instead of queueing behind
+another request's whole generation — N concurrent requests, streamed
+and non-streamed, with per-request output byte-identical to a solo
+single-host ``generate`` (the engine's tested invariant).
+
+Frontend surface (process 0): ``/health``, ``/metrics``, ``/v1/model``,
+``POST /v1/generate`` (token-level; the single-host server's knobs
+including ``n``, ``stop``, ``logprobs``, ``beam_width``, ``stream``),
+``POST /v1/score``, and behind ``--text`` ``POST /v1/completions``
+(byte tokenizer, streamed or not, with UTF-8 holdback). ``logprobs``
+echoes ride extra lockstep score rounds after a request retires; beams
+run as a one-shot lockstep round. Followers run the broadcast-follow
+loop with no HTTP surface (their supervisor job health-checks process
+liveness, e.g. ``kill -0 $CONTAINERPILOT_<JOB>_PID``).
 
 Shutdown: SIGTERM on process 0 broadcasts a shutdown op so followers
 exit cleanly.
 
 Failure detection (``--watchdog``): serving gets the same
 decode-progress deadline training has (parallel/watchdog.py). The
-frontend broadcasts OP_HEARTBEAT whenever the pod is idle, so every
-process — frontend and followers alike — completes a broadcast(+
-decode) cycle at least every watchdog/4 seconds and beat()s its
-StepWatchdog. A follower that wedges mid-decode (or dies) stalls the
-NEXT cycle pod-wide: every peer's watchdog turns its silent
+frontend broadcasts OP_HEARTBEAT whenever the pod is idle, and every
+ROUND is bounded by one chunk of decode — so every process completes
+a broadcast(+device) cycle at least every watchdog/4 seconds and
+beat()s its StepWatchdog. A follower that wedges mid-decode (or dies)
+stalls the NEXT cycle pod-wide: every peer's watchdog turns its silent
 collective hang into a hard exit (code 86) the supervisor's restart
 budgets absorb, and the reincarnated pod re-rendezvouses through the
-catalog — a wedged-but-alive follower can no longer hang the
-frontend indefinitely.
+catalog. Because ALL generation (streamed or not) now rides chunked
+rounds, no legitimate long request can outlast the deadline — only
+one-shot ops (a beam round, a score round, an unwarmed-shape compile)
+must individually finish inside it; size ``--watchdog`` above the
+slowest of those.
 
 Parallelism: ``--dp`` splits the global device count into a
 (data, model) mesh — ``--dp 2`` over 4 processes serves on a 2x2
 dp x tp mesh (params sharded over model, replicated over data), so
 tensor parallelism crosses process boundaries exactly as a real pod's
-does.
+does. ``--kv-int8`` serves with the int8 KV cache (half the KV bytes;
+identical quantized numerics on every process).
 
     python -m containerpilot_tpu.workload.serve_dist \
         --process-id 0 --num-processes 2 --catalog 127.0.0.1:8500 \
         --port 8000 --d-model 1024 ...
 
 Request sampling reproduces the single-host server's key convention
-(fold_in(PRNGKey(seed), 0)), so answers are byte-identical to a
-single-host server of the same config (tested with two real OS
-processes on the CPU backend).
+(row i of a request draws from fold_in(PRNGKey(seed), i)), so answers
+are byte-identical to a single-host server of the same config (tested
+with real OS processes on the CPU backend, including co-batched
+traffic).
 """
 from __future__ import annotations
 
@@ -59,7 +79,8 @@ import os
 import queue
 import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -70,19 +91,30 @@ log = logging.getLogger("containerpilot.serve_dist")
 from ..models.decode import BIAS_SLOTS_MAX
 
 OP_SHUTDOWN = 0
-OP_GENERATE = 1
+OP_ROUND = 1      # slot-engine round: optional admission + one chunk
 OP_HEARTBEAT = 2  # idle liveness tick: bounds every broadcast wait
 OP_SCORE = 3      # teacher-forced logprobs over the broadcast row
+OP_BEAM = 4       # one-shot lockstep beam search
 
 WATCHDOG_EXIT = 86  # parallel.watchdog.EXIT_CODE — same semantics
 
 
-def _payload_zeros(max_len: int) -> Dict[str, np.ndarray]:
+def _payload_zeros(max_len: int, slots: int) -> Dict[str, np.ndarray]:
+    """The ONE broadcast structure every round uses (a collective
+    broadcast needs identical pytrees on every process, so heartbeat,
+    score, beam, shutdown, and slot rounds all ship this shape)."""
     return {
         "op": np.zeros((), np.int32),
+        # the single row a round can carry: a score/beam request's
+        # tokens, or this round's admission prompt
         "prompt": np.zeros((max_len,), np.int32),
         "plen": np.zeros((), np.int32),
-        "max_new": np.zeros((), np.int32),
+        # admission (admit_slot -1 = none this round); row_idx is the
+        # row's index within its request — the key schedule
+        # fold_in(PRNGKey(seed), row_idx) is the server convention
+        "admit_slot": np.full((), -1, np.int32),
+        "row_idx": np.zeros((), np.int32),
+        "max_new_req": np.zeros((), np.int32),
         "temperature": np.zeros((), np.float32),
         "top_k": np.zeros((), np.int32),
         "top_p": np.zeros((), np.float32),
@@ -93,51 +125,243 @@ def _payload_zeros(max_len: int) -> Dict[str, np.ndarray]:
         "frequency": np.zeros((), np.float32),
         "bias_idx": np.full((BIAS_SLOTS_MAX,), -1, np.int32),
         "bias_val": np.zeros((BIAS_SLOTS_MAX,), np.float32),
-        # > 0: stream the decode in K-token lockstep chunks (one tiny
-        # per-chunk 'go' broadcast lets the frontend cancel mid-way)
-        "chunk": np.zeros((), np.int32),
-        # the UNbucketed request length: chunked emission caps here,
-        # and it must be broadcast so every process derives the same
-        # done decision (the chunk program's done mask is an operand)
-        "max_new_req": np.zeros((), np.int32),
+        # beam round operands
+        "beam_width": np.zeros((), np.int32),
+        "length_penalty": np.zeros((), np.float32),
+        # chunk control: run the (slots, chunk) program this round,
+        # with this pre-chunk inactive mask (1 = slot is dead; evicted
+        # slots — disconnects, stop matches — flip to 1 here)
+        "run_chunk": np.zeros((), np.int32),
+        "done": np.ones((slots,), np.int32),
     }
 
 
-def _payload_for(req: Dict[str, Any], max_len: int) -> Dict[str, np.ndarray]:
-    p = _payload_zeros(max_len)
-    tokens = req["tokens"]
-    p["op"] = np.asarray(OP_GENERATE, np.int32)
-    p["prompt"][: len(tokens)] = np.asarray(tokens, np.int32)
-    p["plen"] = np.asarray(len(tokens), np.int32)
-    # bucket the compiled decode length to multiples of 16 (the
-    # single-host server's convention) — per-request max_new variation
-    # must not recompile generate on EVERY host in the pod; the
-    # frontend trims the response to the requested length
-    bucketed = min(-(-req["max_new"] // 16) * 16, max_len - len(tokens))
-    p["max_new"] = np.asarray(bucketed, np.int32)
-    p["temperature"] = np.asarray(req.get("temperature", 0.0), np.float32)
-    p["top_k"] = np.asarray(req.get("top_k", 0), np.int32)
-    p["top_p"] = np.asarray(req.get("top_p", 0.0), np.float32)
-    p["eos_id"] = np.asarray(req.get("eos_id", -1), np.int32)
-    p["seed"] = np.asarray(req.get("seed", 0), np.int32)
-    p["min_new"] = np.asarray(req.get("min_new", 0), np.int32)
-    p["presence"] = np.asarray(req.get("presence", 0.0), np.float32)
-    p["frequency"] = np.asarray(req.get("frequency", 0.0), np.float32)
-    # int-coerce before sorting (str keys are OpenAI's wire form) and
-    # bound at the static table size: parse_logit_bias upstream 422s
-    # anything over it, so the slice is a defensive bound that can
-    # never raise inside the pod loop (an IndexError here would be
-    # pod-fatal — the loop deliberately re-raises)
-    items = sorted(
-        (int(t), float(v))
-        for t, v in (req.get("logit_bias") or {}).items()
-    )[:BIAS_SLOTS_MAX]
+def _fill_admission(payload, work: Dict[str, Any], row_idx: int,
+                    slot: int) -> None:
+    """Pack one request row's admission into the round payload."""
+    tokens = work["tokens"]
+    payload["prompt"][: len(tokens)] = np.asarray(tokens, np.int32)
+    payload["plen"] = np.asarray(len(tokens), np.int32)
+    payload["admit_slot"] = np.asarray(slot, np.int32)
+    payload["row_idx"] = np.asarray(row_idx, np.int32)
+    payload["max_new_req"] = np.asarray(work["max_new"], np.int32)
+    payload["temperature"] = np.asarray(work["temperature"], np.float32)
+    payload["top_k"] = np.asarray(work["top_k"], np.int32)
+    payload["top_p"] = np.asarray(work["top_p"], np.float32)
+    payload["eos_id"] = np.asarray(work["eos_id"], np.int32)
+    payload["seed"] = np.asarray(work["seed"], np.int32)
+    payload["min_new"] = np.asarray(work["min_new"], np.int32)
+    payload["presence"] = np.asarray(work["presence"], np.float32)
+    payload["frequency"] = np.asarray(work["frequency"], np.float32)
+    # parse_logit_bias upstream coerces keys and caps at
+    # BIAS_SLOTS_MAX; the slice is a defensive bound that can never
+    # raise inside the pod loop (an error here would be pod-fatal)
+    items = sorted((work.get("logit_bias") or {}).items())[
+        :BIAS_SLOTS_MAX
+    ]
     for j, (tok_id, bias) in enumerate(items):
-        p["bias_idx"][j] = tok_id
-        p["bias_val"][j] = bias
-    p["chunk"] = np.asarray(req.get("chunk", 0), np.int32)
-    p["max_new_req"] = np.asarray(req["max_new"], np.int32)
-    return p
+        payload["bias_idx"][j] = tok_id
+        payload["bias_val"][j] = bias
+
+
+class _SlotMirror:
+    """The device half of the slot engine, replayed identically on
+    every process: a fixed pool of single-row caches plus the host
+    knob arrays the chunk program reads. All mutations are driven by
+    broadcast ROUND payloads, so frontend and followers hold
+    bit-identical state without ever exchanging it.
+
+    ``mesh`` (the pod's global mesh) pins EVERY device buffer the
+    mirror owns to an explicit fully-replicated sharding: without the
+    pin, each jitted update leaves the pool in whatever output
+    sharding GSPMD picks for that program, and a pool drifting
+    between layouts across donating programs corrupted decodes
+    (observed as deterministic wrong tokens in the 2-process pod).
+    Replication is also the honest layout — every process must hold
+    the whole pool to keep lockstep admission/retirement purely
+    host-side."""
+
+    def __init__(self, cfg, params, max_len: int, slots: int,
+                 chunk: int, mesh=None) -> None:
+        from ..models.slots import slot_cache
+
+        self.cfg = cfg
+        self.params = params
+        self.max_len = max_len
+        self.slots = slots
+        self.chunk = chunk
+        self.rep = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self.rep = NamedSharding(mesh, PartitionSpec())
+
+        def g(x):
+            if self.rep is None:
+                return x
+            host = np.asarray(jax.device_get(x))
+            return jax.make_array_from_callback(
+                host.shape, self.rep, lambda idx: host[idx]
+            )
+
+        # one shape-polymorphic pinned row-setter for the small
+        # per-slot device arrays (last/keys/counts)
+        self._set_row = jax.jit(
+            lambda a, i, v: a.at[i].set(v), out_shardings=self.rep
+        )
+        self.pool = jax.tree.map(g, slot_cache(cfg, slots, max_len))
+        self.last = g(jnp.zeros((slots,), jnp.int32))
+        self.keys = g(jnp.zeros((slots, 2), jnp.uint32))
+        self.counts = g(
+            jnp.zeros((slots, cfg.vocab_size), jnp.float32)
+        )
+        self.step_idx = np.zeros((slots,), np.int32)
+        self.temp = np.zeros((slots,), np.float32)
+        self.top_k = np.zeros((slots,), np.int32)
+        self.top_p = np.zeros((slots,), np.float32)
+        self.eos = np.full((slots,), -1, np.int32)
+        self.pad = np.zeros((slots,), np.int32)  # server pad: 0
+        self.min_new = np.zeros((slots,), np.int32)
+        self.presence = np.zeros((slots,), np.float32)
+        self.frequency = np.zeros((slots,), np.float32)
+        self.bias_idx = np.full(
+            (slots, BIAS_SLOTS_MAX), -1, np.int32
+        )
+        self.bias_val = np.zeros(
+            (slots, BIAS_SLOTS_MAX), np.float32
+        )
+
+    def admit(self, payload) -> int:
+        """Prefill the broadcast prompt into the named slot with the
+        server key convention; returns sample 0 (every process fetches
+        the same value — the computation is SPMD)."""
+        from ..models.decode import _jitted_prefill
+        from ..models.slots import (
+            first_sample,
+            insert_row,
+            seed_counts,
+        )
+
+        slot = int(payload["admit_slot"])
+        plen = int(payload["plen"])
+        prompt = jnp.asarray(payload["prompt"][None, :plen], jnp.int32)
+        logits, row_cache = _jitted_prefill(self.cfg, self.max_len)(
+            self.params, prompt
+        )
+        row_key = jax.random.fold_in(
+            jax.random.PRNGKey(int(payload["seed"])),
+            int(payload["row_idx"]),
+        )
+        eos_id = int(payload["eos_id"])
+        first = first_sample(
+            logits, row_key,
+            float(payload["temperature"]), int(payload["top_k"]),
+            float(payload["top_p"]), self.cfg, eos_id=eos_id,
+            min_new=int(payload["min_new"]),
+            bias_idx=jnp.asarray(payload["bias_idx"], jnp.int32),
+            bias_val=jnp.asarray(payload["bias_val"], jnp.float32),
+        )
+        first_host = int(jax.device_get(first))
+        self.pool = insert_row(
+            self.pool, row_cache, slot, self.cfg,
+            out_sharding=self.rep,
+        )
+        slot_dev = jnp.asarray(slot, jnp.int32)
+        self.last = self._set_row(self.last, slot_dev, first)
+        self.keys = self._set_row(self.keys, slot_dev, row_key)
+        self.counts = self._set_row(
+            self.counts, slot_dev,
+            seed_counts(self.cfg.vocab_size, first_host, eos_id),
+        )
+        self.step_idx[slot] = 1
+        self.temp[slot] = float(payload["temperature"])
+        self.top_k[slot] = int(payload["top_k"])
+        self.top_p[slot] = float(payload["top_p"])
+        self.eos[slot] = eos_id
+        self.min_new[slot] = int(payload["min_new"])
+        self.presence[slot] = float(payload["presence"])
+        self.frequency[slot] = float(payload["frequency"])
+        self.bias_idx[slot] = payload["bias_idx"]
+        self.bias_val[slot] = payload["bias_val"]
+        # materialize the admission's writes before anything else is
+        # dispatched: letting the next (donating) program overlap
+        # these in-flight donated updates intermittently fed the
+        # chunk TORN pool state in the multi-process pod —
+        # deterministic wrong tokens, reproduced and closed by this
+        # barrier (2-process lab, 2026-07). Rounds are host-paced
+        # anyway, so the lost overlap is one dispatch gap.
+        jax.block_until_ready(
+            (self.pool, self.last, self.keys, self.counts)
+        )
+        return first_host
+
+    def run_chunk(self, done_mask) -> np.ndarray:
+        """Advance every slot one chunk under the broadcast inactive
+        mask; returns the [slots, chunk] sampled tokens (fetched on
+        every process — the fetch is what synchronizes device work, so
+        a wedged computation stalls THIS cycle, not some later one)."""
+        from ..models.slots import decode_slots_chunk
+
+        (self.pool, self.last, _done_dev, self.counts, toks) = (
+            decode_slots_chunk(
+                self.params, self.pool, self.last, self.keys,
+                jnp.asarray(self.step_idx),
+                jnp.asarray(self.temp),
+                jnp.asarray(self.top_k),
+                jnp.asarray(self.top_p),
+                jnp.asarray(self.eos),
+                jnp.asarray(self.pad),
+                jnp.asarray(self.min_new),
+                jnp.asarray(self.presence),
+                jnp.asarray(self.frequency),
+                jnp.asarray(self.bias_idx),
+                jnp.asarray(self.bias_val),
+                self.counts,
+                jnp.asarray(np.asarray(done_mask, bool)),
+                self.cfg, self.chunk,
+                out_sharding=self.rep,
+            )
+        )
+        out = np.asarray(jax.device_get(toks))
+        # same torn-state barrier as admit(): the toks fetch alone
+        # does NOT guarantee the donated pool/counts outputs are
+        # safely materialized before the next round dispatches over
+        # (and donates) them
+        jax.block_until_ready((self.pool, self.last, self.counts))
+        # mutate step_idx only AFTER the execution that read it has
+        # completed: jnp.asarray may zero-copy the numpy buffer, and
+        # an in-place `+=` racing the in-flight chunk fed it TORN
+        # step indices (per-position key flips — caught by the
+        # 2-process co-batch parity test)
+        self.step_idx += self.chunk
+        return out
+
+
+def _apply_round(mirror: _SlotMirror, payload):
+    """The device ops of one ROUND, identical on every process:
+    optional admission, then optionally one chunk. Returns (first
+    token or None, [slots, chunk] tokens or None)."""
+    first = toks = None
+    if int(payload["admit_slot"]) >= 0:
+        first = mirror.admit(payload)
+    if int(payload["run_chunk"]):
+        toks = mirror.run_chunk(payload["done"])
+    if os.environ.get("CONTAINERPILOT_POD_DEBUG"):
+        print(
+            "ROUND admit=%d plen=%d seed=%d row=%d mask=%s first=%s "
+            "toks=%s step=%s last=%s keys=%s"
+            % (
+                int(payload["admit_slot"]), int(payload["plen"]),
+                int(payload["seed"]), int(payload["row_idx"]),
+                np.asarray(payload["done"]).tolist(), first,
+                None if toks is None else toks.tolist(),
+                mirror.step_idx.tolist(),
+                np.asarray(jax.device_get(mirror.last)).tolist(),
+                np.asarray(jax.device_get(mirror.keys)).tolist(),
+            ),
+            flush=True,
+        )
+    return first, toks
 
 
 def shard_params_global(params: Any, mesh, cfg) -> Any:
@@ -175,142 +399,84 @@ def _score_pod(params, cfg, payload, max_len: int):
     like a decode. Rows pad to a 16-multiple width (capped at
     max_len) so per-request length variation can't compile a fresh
     pod-wide program inside the watchdog deadline — causal attention
-    makes the pad positions free, and the result slices back."""
+    makes the pad positions free, and the result slices back.
+    Returns a HOST [1, plen-1] ndarray (the device fetch lives here;
+    see the slice comment below)."""
     plen = int(payload["plen"])
     width = min(-(-plen // 16) * 16, max_len)
     toks = jnp.asarray(payload["prompt"][None, :width], jnp.int32)
     out = _jitted_score_fn(cfg)(params, toks)
-    return out[:, : plen - 1]
+    if os.environ.get("CONTAINERPILOT_POD_DEBUG"):
+        print("SCORE plen=%d" % plen, flush=True)
+    # slice on the HOST: a device-side `out[:, :plen-1]` compiles a
+    # tiny jit(dynamic_slice) per distinct plen — a post-grace
+    # compile the warmup invariant forbids (the fetch is 16 floats
+    # either way)
+    return np.asarray(jax.device_get(out))[:, : plen - 1]
 
 
-def _stream_generate_pod(
-    params, cfg, payload, max_len: int, multihost_utils, dog=None,
-    emit=None, cancelled=None,
-):
-    """Chunked lockstep generation for SSE streaming: the slot
-    engine's building blocks (1-slot pool, first_sample, K-token
-    chunk program) run identically on every process, so emissions are
-    byte-identical to the slot engine's — which is byte-identical to
-    generate. Between chunks the frontend broadcasts a tiny ``go``
-    scalar: a client disconnect (``cancelled``) stops the pod
-    mid-generation with ONE more round-trip, and every round beats
-    the watchdog. ``emit`` (frontend only) receives each delta."""
-    from ..models.decode import _jitted_prefill
-    from ..models.slots import (
-        append_chunk,
-        decode_slots_chunk,
-        first_sample,
-        insert_row,
-        seed_counts,
-        slot_cache,
-    )
+def _beam_pod(params, cfg, payload, max_len: int) -> List[int]:
+    """One-shot lockstep beam search over the broadcast row: the same
+    deterministic ``models.beam.beam_search`` program the single-host
+    server runs, traced from broadcast scalars so every process
+    executes it identically. One-shot by nature — it does not beat the
+    watchdog mid-run, so the deadline must exceed the slowest beam."""
+    from ..models.beam import beam_search
 
     plen = int(payload["plen"])
-    max_new = int(payload["max_new_req"])
-    chunk = int(payload["chunk"])
-    eos_id = int(payload["eos_id"])
     prompt = jnp.asarray(payload["prompt"][None, :plen], jnp.int32)
-    row_key = jax.random.fold_in(
-        jax.random.PRNGKey(int(payload["seed"])), 0
-    )
-    logits, row_cache = _jitted_prefill(cfg, max_len)(params, prompt)
-    first = first_sample(
-        logits, row_key,
-        float(payload["temperature"]), int(payload["top_k"]),
-        float(payload["top_p"]), cfg, eos_id=eos_id,
-        min_new=int(payload["min_new"]),
-        bias_idx=jnp.asarray(payload["bias_idx"], jnp.int32),
-        bias_val=jnp.asarray(payload["bias_val"], jnp.float32),
-    )
-    first_host = int(jax.device_get(first))
-    emitted = [first_host]
-    if emit is not None:
-        emit(list(emitted))
-    if dog is not None:
-        dog.beat()
-
-    pool = insert_row(slot_cache(cfg, 1, max_len), row_cache, 0, cfg)
-    last = jnp.asarray([first_host], jnp.int32)
-    keys = row_key[None]
-    step_idx = np.asarray([1], np.int32)
-    counts = seed_counts(cfg.vocab_size, first_host, eos_id)[None]
-    done = first_host == eos_id or max_new <= 1
-
-    def frontend_go() -> int:
-        if emit is None:
-            return 0  # followers' value is ignored by the broadcast
-        if done or len(emitted) >= max_new:
-            return 0
-        if cancelled is not None and cancelled.is_set():
-            return 0
-        return 1
-
-    while True:
-        go = int(multihost_utils.broadcast_one_to_all(
-            {"go": np.asarray(frontend_go(), np.int32)}
-        )["go"])
-        if not go:
-            break
-        (pool, last, done_dev, counts, toks) = decode_slots_chunk(
-            params, pool, last, keys, jnp.asarray(step_idx),
-            jnp.asarray([float(payload["temperature"])], jnp.float32),
-            jnp.asarray([int(payload["top_k"])], jnp.int32),
-            jnp.asarray([float(payload["top_p"])], jnp.float32),
-            jnp.asarray([eos_id], jnp.int32),
-            jnp.asarray([0], jnp.int32),
-            jnp.asarray([int(payload["min_new"])], jnp.int32),
-            jnp.asarray([float(payload["presence"])], jnp.float32),
-            jnp.asarray([float(payload["frequency"])], jnp.float32),
-            jnp.asarray(payload["bias_idx"][None], jnp.int32),
-            jnp.asarray(payload["bias_val"][None], jnp.float32),
-            counts,
-            jnp.asarray([done], bool),
-            cfg, chunk,
-        )
-        step_idx = step_idx + chunk
-        toks_host = np.asarray(jax.device_get(toks))[0]
-        # the slot engine's SHARED append rules (models/slots.py) —
-        # every process derives the same ``done``
-        before = len(emitted)
-        done = append_chunk(emitted, toks_host, max_new, eos_id)
-        if emit is not None and len(emitted) > before:
-            emit(list(emitted[before:]))
-        if dog is not None:
-            dog.beat()
-    return emitted
-
-
-def _decode_pod(params, cfg, payload, max_len: int):
-    """The SPMD part every process runs identically: one generate call
-    shaped purely by broadcast scalars (so every host traces and
-    executes the same program in the same order)."""
-    from ..models.decode import generate
-
-    plen = int(payload["plen"])
-    max_new = int(payload["max_new"])
-    prompt = jnp.asarray(payload["prompt"][None, :plen], jnp.int32)
-    row_key = jax.random.fold_in(
-        jax.random.PRNGKey(int(payload["seed"])), 0
-    )
-    # rebuild the dict form generate expects; every host derives the
-    # identical dict from the identical broadcast arrays
-    bias = {
-        int(i): float(v)
-        for i, v in zip(payload["bias_idx"], payload["bias_val"])
-        if int(i) >= 0
-    }
-    return generate(
-        params, prompt, cfg, max_new_tokens=max_new, max_len=max_len,
-        temperature=float(payload["temperature"]),
-        rng=jnp.stack([row_key]),
-        top_k=int(payload["top_k"]),
-        top_p=float(payload["top_p"]),
+    out, _score = beam_search(
+        params, prompt, cfg,
+        max_new_tokens=int(payload["max_new_req"]),
+        max_len=max_len,
+        beam_width=int(payload["beam_width"]),
         eos_id=int(payload["eos_id"]),
-        min_new_tokens=int(payload["min_new"]),
-        presence_penalty=float(payload["presence"]),
-        frequency_penalty=float(payload["frequency"]),
-        logit_bias=bias or None,
+        length_penalty=float(payload["length_penalty"]),
     )
+    if os.environ.get("CONTAINERPILOT_POD_DEBUG"):
+        print("BEAM plen=%d width=%d"
+              % (plen, int(payload["beam_width"])), flush=True)
+    return [int(t) for t in np.asarray(jax.device_get(out))]
+
+
+def _hit_stop(emitted: List[int], stops: List[List[int]]) -> bool:
+    """Whether any stop sequence occurs anywhere in the emission —
+    the frontend's early-eviction check (the stop-EXCLUSIVE trim
+    happens at answer time via InferenceServer._trim_stops, so the
+    response is identical to the single-host server's; the eviction
+    just stops paying for tokens the trim would discard)."""
+    for stop in stops:
+        n = len(stop)
+        for i in range(len(emitted) - n + 1):
+            if emitted[i:i + n] == stop:
+                return True
+    return False
+
+
+class _Row:
+    """One decode row of a request (n > 1 fans a request into n)."""
+
+    __slots__ = ("emitted", "finished")
+
+    def __init__(self) -> None:
+        self.emitted: List[int] = []
+        self.finished = False
+
+
+class _GenReq:
+    """Frontend bookkeeping for one /v1/generate|completions request
+    riding the slot pool."""
+
+    def __init__(self, work: Dict[str, Any], done_q) -> None:
+        self.work = work
+        self.done_q = done_q
+        self.rows = [_Row() for _ in range(work["n"])]
+        self.stream = bool(work.get("_stream"))
+        self.cancel = work.get("_cancel")
+        self.answered = False
+
+    def cancelled(self) -> bool:
+        return self.cancel is not None and self.cancel.is_set()
 
 
 class _Frontend:
@@ -320,6 +486,7 @@ class _Frontend:
     def __init__(self, host: str, port: int, max_len: int,
                  vocab: int, pod_info: Optional[Dict[str, Any]] = None,
                  text: bool = False, stream_chunk: int = 8,
+                 slots: int = 4, cfg: Any = None,
                  ) -> None:
         from prometheus_client import (
             CollectorRegistry,
@@ -331,6 +498,8 @@ class _Frontend:
 
         self.max_len = max_len
         self.vocab = vocab
+        self.slots = slots
+        self.cfg = cfg  # model config (beam validation); optional
         self.ready = False
         # /v1/model payload: model config + pod topology, set by main()
         self.pod_info = pod_info or {}
@@ -420,27 +589,14 @@ class _Frontend:
         )
 
     def _parse_work(self, body, tokens, default_eos: int = -1):
-        """Validate the sampling knobs shared by /v1/generate and the
-        --text surface into a broadcastable work dict. Full knob
-        validation HERE: a malformed value that only failed inside
-        _decode_pod would be pod-fatal (the loop deliberately
-        re-raises collective-path errors), and an out-of-int32 value
-        would crash payload packing. Raises ValueError for a 422."""
-        if int(body.get("n", 1)) != 1:
-            # loud 422, not a silent one-sample 200 the client
-            # would mis-index (the single-host server supports n)
-            raise ValueError(
-                "the pod frontend serves single-sample requests; "
-                "n > 1 is a single-host server feature"
-            )
-        for knob in ("stop", "logprobs", "beam_width"):
-            # same rule: single-host features the broadcast payload
-            # does not carry must fail loudly, never silently drop
-            if body.get(knob):
-                raise ValueError(
-                    f"the pod frontend does not support {knob!r}; "
-                    "it is a single-host server feature"
-                )
+        """Validate the decode knobs shared by /v1/generate and the
+        --text surface into a broadcastable work dict — the
+        single-host server's knob set (n, stop, logprobs, beam_width
+        included). Full validation HERE: a malformed value that only
+        failed inside the pod loop would be pod-fatal (the loop
+        deliberately re-raises collective-path errors), and an
+        out-of-int32 value would crash payload packing. Raises
+        ValueError for a 422."""
         max_new = int(body.get("max_new_tokens", 16))
         if max_new < 1:
             raise ValueError("max_new_tokens must be >= 1")
@@ -449,6 +605,7 @@ class _Frontend:
                 f"prompt + max_new_tokens exceeds max_len "
                 f"{self.max_len}"
             )
+        temperature = float(body.get("temperature", 0.0))
         top_k = int(body.get("top_k", 0))
         top_p = float(body.get("top_p", 0.0))
         eos_id = int(body.get("eos_id", default_eos))
@@ -473,14 +630,62 @@ class _Frontend:
                 "presence/frequency penalties must be in "
                 "[-100, 100]"
             )
-        from .modelcfg import parse_logit_bias
+        from .modelcfg import parse_logit_bias, parse_stop_ids
 
         bias = parse_logit_bias(
             body.get("logit_bias"), self.vocab
         ) or {}
+        stop = parse_stop_ids(body.get("stop"), self.vocab)
+        logprobs = bool(body.get("logprobs", False))
+        n = int(body.get("n", 1))
+        if not 1 <= n <= self.slots:
+            raise ValueError(
+                f"n must be in [1, --slots {self.slots}] on the pod "
+                "frontend (each sample occupies one slot)"
+            )
+        beam_width = int(body.get("beam_width", 0))
+        length_penalty = float(body.get("length_penalty", 0.0))
+        if beam_width:
+            if n != 1:
+                raise ValueError(
+                    "n does not compose with beam search (beams "
+                    "already return one best row)"
+                )
+            if temperature > 0.0 or top_k or top_p:
+                raise ValueError(
+                    "beam search is deterministic; drop "
+                    "temperature/top_k/top_p"
+                )
+            if min_new:
+                raise ValueError(
+                    "min_new_tokens does not apply to beam search"
+                )
+            if presence or frequency:
+                raise ValueError("penalties do not apply to beam search")
+            if bias:
+                raise ValueError(
+                    "logit_bias does not apply to beam search"
+                )
+            if self.cfg is not None:
+                from ..models.beam import validate_beam_args
+
+                validate_beam_args(self.cfg, 1, beam_width)
+            elif not 1 <= beam_width <= self.vocab:
+                raise ValueError(
+                    f"beam_width must be in [1, vocab {self.vocab}]"
+                )
+            if beam_width > self.slots:
+                # beams tile the KV cache: one request must not exceed
+                # the pod's configured device-row budget (--slots, the
+                # same sizing the pool uses)
+                raise ValueError(
+                    f"beam_width capped at --slots ({self.slots}) "
+                    "on the pod frontend"
+                )
         return {
+            "kind": "beam" if beam_width else "gen",
             "tokens": tokens, "max_new": max_new,
-            "temperature": float(body.get("temperature", 0.0)),
+            "temperature": temperature,
             "top_k": top_k,
             "top_p": top_p,
             "eos_id": max(eos_id, -1),
@@ -489,7 +694,33 @@ class _Frontend:
             "presence": presence,
             "frequency": frequency,
             "logit_bias": bias,
+            "stop": stop,
+            "logprobs": logprobs,
+            "n": n,
+            "beam_width": beam_width,
+            "length_penalty": length_penalty,
         }
+
+    @staticmethod
+    def _check_stream_composes(work) -> None:
+        if work["kind"] == "beam":
+            raise ValueError(
+                "stream does not compose with beam_width (beams "
+                "have no incremental prefix)"
+            )
+        if work["n"] != 1:
+            raise ValueError(
+                "n does not compose with stream (one SSE stream "
+                "carries one row)"
+            )
+        for knob, why in (
+            ("logprobs", "echo logprobs need the full row"),
+            ("stop", "stop sequences need whole-row trimming"),
+        ):
+            if work[knob]:
+                raise ValueError(
+                    f"stream does not compose with {knob} ({why})"
+                )
 
     def _parse_single_row(self, body, min_len: int = 1):
         rows = body.get("tokens")
@@ -500,7 +731,8 @@ class _Frontend:
         ):
             raise ValueError(
                 f"'tokens' must be one row of at least {min_len} "
-                "ids (the pod frontend serves single-row requests)"
+                "ids (the pod frontend serves single-row requests; "
+                "n is the row multiplier)"
             )
         tokens = rows[0]
         if any(
@@ -517,25 +749,33 @@ class _Frontend:
         try:
             body = json.loads(req.body.decode() or "{}")
             work = self._parse_work(body, self._parse_single_row(body))
+            if bool(body.get("stream", False)):
+                self._check_stream_composes(work)
+                return self._stream_request("generate", work)
         except (ValueError, KeyError, TypeError, OverflowError) as exc:
             self._m_requests.labels("generate", "422").inc()
             return self._Response(422, f"{exc}\n".encode())
-        if bool(body.get("stream", False)):
-            return self._generate_stream(work)
         result, err = await self._dispatch("generate", work)
         if err is not None:
             return err
-        self._m_tokens.inc(len(result))
+        rows = result["tokens"]
+        self._m_tokens.inc(sum(len(r) for r in rows))
+        payload: Dict[str, Any] = {"tokens": rows}
+        if result.get("logprobs") is not None:
+            payload["logprobs"] = result["logprobs"]
         return self._Response(
-            200, json.dumps({"tokens": [result]}).encode(),
+            200, json.dumps(payload).encode(),
             content_type="application/json",
         )
 
     async def _completions(self, req):
-        """Text in/out around the same broadcast decode /v1/generate
+        """Text in/out around the same slot-pool decode /v1/generate
         uses: encode the prompt through the byte tokenizer, default
         eos to the tokenizer's EOS, decode the generated ids back —
-        the single-host /v1/completions contract, pod-shaped."""
+        the single-host /v1/completions contract, pod-shaped.
+        ``stop`` takes strings here (encoded to token rows before the
+        shared parser); ``stream`` emits text deltas with UTF-8
+        partial-byte holdback (text.stream_decoder)."""
         tok = self.tokenizer
         try:
             body = json.loads(req.body.decode() or "{}")
@@ -548,41 +788,69 @@ class _Frontend:
                     f"prompt encodes to {len(row)} ids; max_len is "
                     f"{self.max_len}"
                 )
-            if bool(body.get("stream", False)):
-                raise ValueError(
-                    "the pod text surface does not stream; use "
-                    "/v1/generate with \"stream\": true"
-                )
+            from .modelcfg import parse_stop_strings
+
+            stop_raw = parse_stop_strings(body.pop("stop", None))
+            if stop_raw is not None:
+                body["stop"] = [
+                    tok.encode(s, bos=False) for s in stop_raw
+                ]
             work = self._parse_work(body, row, default_eos=tok.EOS)
+            # the single-host text surface ignores the logprobs knob
+            # (its response carries text+ids only); mirror that
+            # instead of paying echo score rounds nobody reads
+            work["logprobs"] = False
+            if work["n"] > 1:
+                raise ValueError(
+                    "n returns token rows; use /v1/generate"
+                )
+            if bool(body.get("stream", False)):
+                self._check_stream_composes(work)
+                from .text import stream_decoder
+
+                delta_event, tail_events = stream_decoder(tok)
+                return self._stream_request(
+                    "completions", work, delta_event=delta_event,
+                    tail_events=tail_events,
+                )
         except (ValueError, KeyError, TypeError, OverflowError) as exc:
             self._m_requests.labels("completions", "422").inc()
             return self._Response(422, f"{exc}\n".encode())
         result, err = await self._dispatch("completions", work)
         if err is not None:
             return err
-        self._m_tokens.inc(len(result))
+        row_out = result["tokens"][0]
+        self._m_tokens.inc(len(row_out))
         return self._Response(
             200,
             json.dumps(
-                {"text": tok.decode(result), "tokens": result}
+                {"text": tok.decode(row_out), "tokens": row_out}
             ).encode(),
             content_type="application/json",
         )
 
-    def _generate_stream(self, work):
-        """SSE over the pod's chunked lockstep decode: each K-token
-        delta becomes a ``data:`` event as its broadcast round lands;
-        concatenated deltas equal the non-streamed pod answer. A
-        client disconnect sets the cancel event — the frontend stops
-        broadcasting ``go`` and the whole pod abandons the request at
-        the next chunk boundary."""
+    def _stream_request(self, endpoint: str, work,
+                        delta_event=None, tail_events=None):
+        """SSE over the pod's chunked lockstep rounds: each chunk's
+        delta becomes a ``data:`` event as its round lands;
+        concatenated deltas equal the non-streamed answer. A client
+        disconnect sets the cancel event — the frontend evicts the
+        slot at the next round and the pool keeps serving everyone
+        else. ``delta_event``/``tail_events`` shape events for the
+        text surface (UTF-8 holdback), mirroring the single-host
+        server's streaming plumbing."""
         import asyncio
         import threading as threading_mod
 
         from ..utils.http import StreamingResponse
 
+        if delta_event is None:
+            delta_event = lambda d: {"tokens": d}  # noqa: E731
+        if tail_events is None:
+            tail_events = list  # noqa: E731 — no tail
+
         cancel = threading_mod.Event()
-        work = dict(work, chunk=self.stream_chunk, _cancel=cancel)
+        work = dict(work, _cancel=cancel, _stream=True)
         done: "queue.Queue" = queue.Queue()
         t0 = time.perf_counter()
         self.requests.put((work, done))
@@ -597,7 +865,7 @@ class _Frontend:
             cancel.set()
             self._m_latency.observe(time.perf_counter() - t0)
             self._m_tokens.inc(sent[0])
-            self._m_requests.labels("generate", status[0]).inc()
+            self._m_requests.labels(endpoint, status[0]).inc()
 
         def sse(payload) -> bytes:
             return b"data: " + json.dumps(payload).encode() + b"\n\n"
@@ -614,8 +882,10 @@ class _Frontend:
                     kind, val = item
                     if kind == "delta":
                         sent[0] += len(val)
-                        yield sse({"tokens": val})
+                        yield sse(delta_event(val))
                     else:
+                        for extra in tail_events():
+                            yield sse(extra)
                         yield sse({"done": True, "count": sent[0]})
                         break
             finally:
@@ -624,8 +894,6 @@ class _Frontend:
         return StreamingResponse(events(), close=finish)
 
     async def _score(self, req):
-        import asyncio
-
         try:
             body = json.loads(req.body.decode() or "{}")
             tokens = self._parse_single_row(body, min_len=2)
@@ -636,7 +904,9 @@ class _Frontend:
         except (ValueError, KeyError, TypeError) as exc:
             self._m_requests.labels("score", "422").inc()
             return self._Response(422, f"{exc}\n".encode())
-        result, err = await self._dispatch("score", {"score": tokens})
+        result, err = await self._dispatch(
+            "score", {"kind": "score", "score": tokens}
+        )
         if err is not None:
             return err
         return self._Response(
@@ -689,6 +959,345 @@ class _Frontend:
             self._thread.join(timeout=10)
 
 
+def warm_pod(mirror: _SlotMirror) -> None:
+    """Compile the pool's whole serve-path program set before traffic:
+    prefill (plen 4), first-sample, insert, the (slots, chunk) chunk
+    program, and the width-16 scorer. Every process derives the
+    IDENTICAL warm payloads from its own flags (no broadcast needed —
+    broadcasting identical data is identity). Requests at these shapes
+    compile NOTHING afterwards (the invariant
+    tests/test_serve_dist.py::test_pod_warmup_covers_serve_path holds);
+    new prompt lengths, beam shapes, and wider score rows still
+    compile on first use — the watchdog deadline must absorb exactly
+    those."""
+    warm = _payload_zeros(mirror.max_len, mirror.slots)
+    warm["op"] = np.asarray(OP_ROUND, np.int32)
+    _fill_admission(
+        warm,
+        {
+            "tokens": [0, 0, 0, 0],
+            "max_new": mirror.chunk + 1,
+            "temperature": 0.0, "top_k": 0, "top_p": 0.0,
+            "eos_id": -1, "seed": 0, "min_new": 0,
+            "presence": 0.0, "frequency": 0.0, "logit_bias": {},
+        },
+        row_idx=0, slot=0,
+    )
+    warm["run_chunk"] = np.asarray(1, np.int32)
+    warm["done"][0] = 0
+    _apply_round(mirror, warm)
+    warm_score = _payload_zeros(mirror.max_len, mirror.slots)
+    warm_score["plen"] = np.asarray(5, np.int32)
+    _score_pod(mirror.params, mirror.cfg, warm_score, mirror.max_len)
+
+
+def _run_frontend_loop(args, frontend: _Frontend, mirror: _SlotMirror,
+                       dog, multihost_utils, stopping) -> None:
+    """Process 0's round loop: drain HTTP work, drive admissions and
+    chunks via broadcast ROUNDs, keep the per-request emission
+    bookkeeping, answer handlers. Every completed round beat()s the
+    watchdog; idle gaps are bounded by heartbeat rounds."""
+    from .serve import InferenceServer
+
+    S = args.slots
+    heartbeat_every = args.watchdog / 4 if args.watchdog > 0 else None
+    pending: "deque[Tuple[_GenReq, int]]" = deque()
+    owners: List[Optional[Tuple[_GenReq, int]]] = [None] * S
+    open_reqs: List[_GenReq] = []
+
+    def beat() -> None:
+        if dog is not None:
+            dog.beat()
+
+    def bcast(payload):
+        return multihost_utils.broadcast_one_to_all(payload)
+
+    def run_score_round(row: List[int]) -> np.ndarray:
+        """One lockstep score op; returns the [1, plen-1] logprobs."""
+        p = _payload_zeros(args.max_len, S)
+        p["op"] = np.asarray(OP_SCORE, np.int32)
+        p["prompt"][: len(row)] = np.asarray(row, np.int32)
+        p["plen"] = np.asarray(len(row), np.int32)
+        bcast(p)
+        out = _score_pod(mirror.params, mirror.cfg, p, args.max_len)
+        beat()
+        return out
+
+    def echo_logprobs(prompt: List[int],
+                      rows_out: List[List[int]]) -> List[List[float]]:
+        """Per-token logprobs of the TRIMMED generated rows via
+        lockstep score rounds — numerically the single-host
+        _echo_logprobs (same jitted scorer, causal attention makes
+        pad-width differences free)."""
+        lps: List[List[float]] = []
+        start = len(prompt) - 1
+        for gen in rows_out:
+            if not gen:
+                lps.append([])
+                continue
+            picked = run_score_round(prompt + gen)[0]
+            lps.append([
+                round(float(x), 6)
+                for x in picked[start:start + len(gen)]
+            ])
+        return lps
+
+    def finish_req(req: _GenReq) -> None:
+        req.answered = True
+        w = req.work
+        if req.stream:
+            req.done_q.put(("end", None))
+            return
+        rows_out = [
+            InferenceServer._trim(
+                [r.emitted], w["max_new"], w["eos_id"]
+            )[0]
+            for r in req.rows
+        ]
+        rows_out = InferenceServer._trim_stops(rows_out, w["stop"])
+        result: Dict[str, Any] = {"tokens": rows_out}
+        if w["logprobs"]:
+            result["logprobs"] = echo_logprobs(w["tokens"], rows_out)
+        req.done_q.put(result)
+
+    def row_append(req: _GenReq, row: _Row, toks) -> None:
+        from ..models.slots import append_chunk
+
+        w = req.work
+        before = len(row.emitted)
+        ended = append_chunk(
+            row.emitted, toks, w["max_new"], w["eos_id"]
+        )
+        if w["stop"] and not ended and _hit_stop(
+            row.emitted, w["stop"]
+        ):
+            # the whole-row trim at answer time will cut BEFORE the
+            # stop; decoding past it would be paying for discarded
+            # tokens — evict at this boundary
+            ended = True
+        if req.stream and len(row.emitted) > before:
+            req.done_q.put(("delta", list(row.emitted[before:])))
+        if ended:
+            row.finished = True
+
+    def classify(work, done_q) -> None:
+        kind = work.get("kind", "gen")
+        if kind == "score":
+            try:
+                out = run_score_round(work["score"])
+            except Exception as exc:  # noqa: BLE001 — pod-fatal
+                done_q.put(exc)
+                fail_open(exc)
+                raise
+            done_q.put(out.tolist())
+            return
+        if kind == "beam":
+            p = _payload_zeros(args.max_len, S)
+            p["op"] = np.asarray(OP_BEAM, np.int32)
+            tokens = work["tokens"]
+            p["prompt"][: len(tokens)] = np.asarray(tokens, np.int32)
+            p["plen"] = np.asarray(len(tokens), np.int32)
+            p["max_new_req"] = np.asarray(work["max_new"], np.int32)
+            p["beam_width"] = np.asarray(work["beam_width"], np.int32)
+            p["eos_id"] = np.asarray(work["eos_id"], np.int32)
+            p["length_penalty"] = np.asarray(
+                work["length_penalty"], np.float32
+            )
+            bcast(p)
+            try:
+                row = _beam_pod(
+                    mirror.params, mirror.cfg, p, args.max_len
+                )
+                beat()
+                rows_out = InferenceServer._trim(
+                    [row], work["max_new"], work["eos_id"]
+                )
+                rows_out = InferenceServer._trim_stops(
+                    rows_out, work["stop"]
+                )
+                result: Dict[str, Any] = {"tokens": rows_out}
+                if work["logprobs"]:
+                    result["logprobs"] = echo_logprobs(
+                        work["tokens"], rows_out
+                    )
+            except Exception as exc:  # noqa: BLE001 — pod-fatal
+                done_q.put(exc)
+                fail_open(exc)
+                raise
+            done_q.put(result)
+            return
+        req = _GenReq(work, done_q)
+        open_reqs.append(req)
+        for i in range(work["n"]):
+            pending.append((req, i))
+
+    def fail_open(exc: Exception) -> None:
+        """A collective-path failure is pod-fatal: every waiting
+        handler must get an answer before the raise, or its executor
+        thread blocks forever."""
+        for req in open_reqs:
+            if not req.answered:
+                req.answered = True
+                req.done_q.put(exc)
+        while True:
+            try:
+                _w, dq = frontend.requests.get_nowait()
+            except queue.Empty:
+                break
+            dq.put(exc)
+
+    def do_shutdown(leftover=None) -> None:
+        """``leftover``: a (work, done_q) item already dequeued when
+        SIGTERM landed — it is in neither open_reqs nor the queue, so
+        it must be answered explicitly or its handler thread blocks
+        forever and the interpreter can't exit."""
+        p = _payload_zeros(args.max_len, S)
+        p["op"] = np.asarray(OP_SHUTDOWN, np.int32)
+        bcast(p)
+        err = RuntimeError("pod is shutting down")
+        if leftover is not None:
+            leftover[1].put(err)
+        fail_open(err)
+
+    while True:
+        if stopping.is_set():
+            do_shutdown()
+            return
+        if not any(owners) and not pending:
+            # fully idle: block for work, heartbeating on cadence so
+            # followers' broadcast waits stay bounded
+            got = None
+            idle_since = time.monotonic()
+            while got is None and not stopping.is_set():
+                try:
+                    got = frontend.requests.get(timeout=0.25)
+                except queue.Empty:
+                    if (
+                        heartbeat_every is not None
+                        and time.monotonic() - idle_since
+                        >= heartbeat_every
+                    ):
+                        break
+            if stopping.is_set():
+                do_shutdown(leftover=got)
+                return
+            if got is None:
+                p = _payload_zeros(args.max_len, S)
+                p["op"] = np.asarray(OP_HEARTBEAT, np.int32)
+                bcast(p)
+                beat()
+                continue
+            classify(*got)
+            continue
+        # busy: drain whatever queued without blocking (scores and
+        # beams run as their own lockstep ops between chunk rounds)
+        while True:
+            try:
+                classify(*frontend.requests.get_nowait())
+            except queue.Empty:
+                break
+        # sweep cancelled streams: their rows finish NOW, their slots
+        # drop out of the next mask, the pool keeps serving the rest
+        for req in open_reqs:
+            if req.cancelled() and not req.answered:
+                for r in req.rows:
+                    r.finished = True
+                finish_req(req)
+        open_reqs[:] = [r for r in open_reqs if not r.answered]
+        for i, o in enumerate(owners):
+            if o is not None and o[0].rows[o[1]].finished:
+                owners[i] = None
+        # admission: at most one row per round (the payload carries
+        # one prompt) — a fresh request reaches the pool within one
+        # chunk of arriving
+        payload = _payload_zeros(args.max_len, S)
+        payload["op"] = np.asarray(OP_ROUND, np.int32)
+        admit: Optional[Tuple[_GenReq, int, int]] = None
+        free = [i for i, o in enumerate(owners) if o is None]
+        while pending and free and admit is None:
+            req, ridx = pending.popleft()
+            if req.answered or req.cancelled():
+                continue
+            slot = free[0]
+            _fill_admission(payload, req.work, ridx, slot)
+            owners[slot] = (req, ridx)
+            admit = (req, ridx, slot)
+        mask = np.ones(S, np.int32)
+        for i, o in enumerate(owners):
+            if o is not None and not o[0].rows[o[1]].finished:
+                mask[i] = 0
+        run_chunk = int((mask == 0).any())
+        if admit is None and not run_chunk:
+            continue  # e.g. everything was just cancelled
+        payload["run_chunk"] = np.asarray(run_chunk, np.int32)
+        payload["done"] = mask
+        bcast(payload)
+        try:
+            first, toks = _apply_round(mirror, payload)
+        except Exception as exc:  # noqa: BLE001 — pod-fatal
+            fail_open(exc)
+            raise
+        if admit is not None:
+            req, ridx, _slot = admit
+            row_append(req, req.rows[ridx], [first])
+        if toks is not None:
+            for i, o in enumerate(owners):
+                if o is None or mask[i]:
+                    continue
+                req, ridx = o
+                row = req.rows[ridx]
+                if not row.finished:
+                    row_append(req, row, toks[i])
+        for i, o in enumerate(owners):
+            if o is not None and o[0].rows[o[1]].finished:
+                owners[i] = None
+        for req in open_reqs:
+            if not req.answered and all(
+                r.finished for r in req.rows
+            ):
+                finish_req(req)
+        open_reqs[:] = [r for r in open_reqs if not r.answered]
+        beat()
+
+
+def _run_follower_loop(args, mirror: _SlotMirror, dog,
+                       multihost_utils) -> None:
+    """Followers replay whatever op the frontend broadcast; their
+    device state stays bit-identical to process 0's because both run
+    exactly `_apply_round` on exactly the broadcast operands."""
+    while True:
+        if args.wedge_file and os.path.exists(args.wedge_file):
+            # fault injection: consume the trigger (wedge ONCE, so
+            # the reincarnation comes back healthy) and stop making
+            # progress without exiting — exactly what a stuck decode
+            # looks like to the rest of the pod
+            try:
+                os.remove(args.wedge_file)
+            except OSError:
+                pass
+            print("follower: injected wedge", flush=True)
+            while True:
+                time.sleep(3600)
+        payload = multihost_utils.broadcast_one_to_all(
+            _payload_zeros(args.max_len, args.slots)
+        )
+        op = int(payload["op"])
+        if op == OP_SHUTDOWN:
+            return
+        if op == OP_HEARTBEAT:
+            pass
+        elif op == OP_SCORE:
+            _score_pod(
+                mirror.params, mirror.cfg, payload, args.max_len
+            )
+        elif op == OP_BEAM:
+            _beam_pod(mirror.params, mirror.cfg, payload, args.max_len)
+        elif op == OP_ROUND:
+            _apply_round(mirror, payload)
+        if dog is not None:
+            dog.beat()
+
+
 def main() -> int:
     from jax.experimental import multihost_utils
 
@@ -720,10 +1329,20 @@ def main() -> int:
                         "restores in lockstep (orbax is a global "
                         "checkpointer)")
     parser.add_argument("--use-ema", action="store_true")
+    parser.add_argument("--slots", type=int, default=4,
+                        help="slot-pool size: how many requests decode "
+                        "concurrently in lockstep (also the n / "
+                        "beam_width budget); KV memory scales with it")
     parser.add_argument("--stream-chunk", type=int, default=8,
-                        help="tokens per SSE delta when a request "
-                        "sets \"stream\": true (one lockstep "
-                        "broadcast round per chunk)")
+                        help="tokens per lockstep chunk round — the "
+                        "admission latency, the SSE delta "
+                        "granularity, and the watchdog's progress "
+                        "quantum")
+    parser.add_argument("--kv-int8", action="store_true",
+                        help="serve with the int8 KV cache (half the "
+                        "KV bytes; every process quantizes "
+                        "identically, so lockstep answers are still "
+                        "deterministic)")
     parser.add_argument("--text", action="store_true",
                         help="byte-tokenizer /v1/completions on the "
                         "frontend (vocab must be >= 259)")
@@ -734,9 +1353,11 @@ def main() -> int:
     parser.add_argument("--watchdog", type=float, default=0.0,
                         help="decode-progress deadline in seconds "
                         "(0 = off): every process hard-exits %d when "
-                        "a broadcast+decode cycle stalls past it, so "
-                        "a wedged peer becomes a supervisor restart "
-                        "instead of a silent pod hang"
+                        "a broadcast+decode cycle stalls past it. "
+                        "Generation is chunked, so size it above one "
+                        "chunk round plus the slowest ONE-SHOT op "
+                        "(a beam round, a score round, or an "
+                        "unwarmed-shape compile)"
                         % WATCHDOG_EXIT)
     parser.add_argument("--startup-grace", type=float, default=300.0,
                         help="first-beat grace covering rendezvous + "
@@ -760,6 +1381,15 @@ def main() -> int:
             args.watchdog, exit_code=WATCHDOG_EXIT
         ).start(grace_s=max(args.startup_grace, args.watchdog))
 
+    if args.slots < 1 or args.stream_chunk < 1:
+        raise SystemExit("--slots and --stream-chunk must be >= 1")
+    if 4 + args.stream_chunk + 1 > args.max_len:
+        # warmup pushes a 4-id prompt + chunk+1 tokens through the
+        # pool; a legal but tiny --max-len must fail loudly HERE
+        raise SystemExit(
+            f"--max-len {args.max_len} too small for the warmup "
+            f"request (needs >= {4 + args.stream_chunk + 1})"
+        )
     kw = {}
     if args.coordinator_port:
         kw["coordinator_port"] = args.coordinator_port
@@ -778,6 +1408,7 @@ def main() -> int:
         n_layers=args.n_layers,
         d_ff=derive_d_ff(args.d_model),
         max_seq_len=args.max_len,
+        kv_int8=args.kv_int8,
     )
     if args.text:
         from .text import ByteTokenizer
@@ -825,6 +1456,7 @@ def main() -> int:
         frontend = _Frontend(
             args.host, args.port, args.max_len, cfg.vocab_size,
             text=args.text, stream_chunk=args.stream_chunk,
+            slots=args.slots, cfg=cfg,
             pod_info={
                 "vocab_size": cfg.vocab_size,
                 "d_model": cfg.d_model,
@@ -834,6 +1466,11 @@ def main() -> int:
                 "max_len": args.max_len,
                 "text": args.text,
                 "stream": True,
+                "kv_int8": args.kv_int8,
+                "slot_engine": {
+                    "slots": args.slots,
+                    "chunk": args.stream_chunk,
+                },
                 "pod": {
                     "num_processes": args.num_processes,
                     "devices": n_global,
@@ -845,28 +1482,17 @@ def main() -> int:
         frontend.start()
         print(f"pod frontend on {args.host}:{frontend.port} "
               f"({n_global} global devices, data={args.dp} "
-              f"model={n_model})",
+              f"model={n_model}, slots={args.slots})",
               flush=True)
 
-    # warmup in lockstep before /health goes 200: same dummy payload
-    # everywhere, so the pod's first live request doesn't compile
-    warm = _payload_for(
-        {"tokens": [0, 0, 0, 0], "max_new": 8}, args.max_len
+    # warmup in lockstep before /health goes 200 (warm_pod compiles
+    # the pool's whole serve-path program set; see its docstring for
+    # the no-post-grace-compiles invariant)
+    mirror = _SlotMirror(
+        cfg, params, args.max_len, args.slots, args.stream_chunk,
+        mesh=mesh,
     )
-    np.asarray(_decode_pod(params, cfg, warm, args.max_len))
-    # the stream path's programs (prefill, first-sample, the 1-slot
-    # chunk) must compile inside the SAME startup grace — a cold
-    # first streamed request would otherwise hold a broadcast round
-    # open past the tightened watchdog deadline, pod-wide. Every
-    # process derives the identical warm payload from its own flags.
-    warm_stream = _payload_for(
-        {"tokens": [0, 0, 0, 0], "max_new": args.stream_chunk + 1,
-         "chunk": args.stream_chunk},
-        args.max_len,
-    )
-    _stream_generate_pod(
-        params, cfg, warm_stream, args.max_len, multihost_utils
-    )
+    warm_pod(mirror)
     if dog is not None:
         dog.beat()  # startup done: tighten to the serve deadline
     if frontend is not None:
@@ -884,115 +1510,11 @@ def main() -> int:
         signal_mod.signal(
             signal_mod.SIGTERM, lambda s, f: stopping.set()
         )
-
-    from .serve import InferenceServer
-
-    # the pod must tick at least this often for followers' broadcast
-    # waits to be bounded (the watchdog can only see completed cycles)
-    heartbeat_every = args.watchdog / 4 if args.watchdog > 0 else None
-
-    while True:
-        work = done_q = None
-        if frontend is not None:
-            idle_since = time.monotonic()
-            while work is None and not stopping.is_set():
-                try:
-                    work, done_q = frontend.requests.get(timeout=0.25)
-                except queue.Empty:
-                    if (
-                        heartbeat_every is not None
-                        and time.monotonic() - idle_since
-                        >= heartbeat_every
-                    ):
-                        break  # tick the pod, then resume waiting
-                    continue
-            if stopping.is_set():
-                payload = _payload_zeros(args.max_len)
-            elif work is None:
-                payload = _payload_zeros(args.max_len)
-                payload["op"] = np.asarray(OP_HEARTBEAT, np.int32)
-            elif "score" in work:
-                payload = _payload_zeros(args.max_len)
-                payload["op"] = np.asarray(OP_SCORE, np.int32)
-                row = work["score"]
-                payload["prompt"][: len(row)] = np.asarray(
-                    row, np.int32
-                )
-                payload["plen"] = np.asarray(len(row), np.int32)
-            else:
-                payload = _payload_for(work, args.max_len)
-        else:
-            payload = _payload_zeros(args.max_len)
-            if args.wedge_file and os.path.exists(args.wedge_file):
-                # fault injection: consume the trigger (wedge ONCE, so
-                # the reincarnation comes back healthy) and stop
-                # making progress without exiting — exactly what a
-                # stuck decode looks like to the rest of the pod
-                try:
-                    os.remove(args.wedge_file)
-                except OSError:
-                    pass
-                print("follower: injected wedge", flush=True)
-                while True:
-                    time.sleep(3600)
-        payload = multihost_utils.broadcast_one_to_all(payload)
-        op = int(payload["op"])
-        if op == OP_HEARTBEAT:
-            if dog is not None:
-                dog.beat()
-            continue
-        if op == OP_SHUTDOWN:
-            # SIGTERM may have raced an in-flight dequeue (and more
-            # requests may still be queued): every waiting handler
-            # must get an answer or its executor thread blocks
-            # forever and the interpreter can't exit
-            if frontend is not None:
-                leftovers = [done_q] if done_q is not None else []
-                while True:
-                    try:
-                        _w, dq = frontend.requests.get_nowait()
-                        leftovers.append(dq)
-                    except queue.Empty:
-                        break
-                for dq in leftovers:
-                    dq.put(RuntimeError("pod is shutting down"))
-            break
-        try:
-            if op == OP_SCORE:
-                out = _score_pod(params, cfg, payload, args.max_len)
-                if dog is not None:
-                    dog.beat()
-                if done_q is not None:
-                    done_q.put(np.asarray(out).tolist())
-                continue
-            if op == OP_GENERATE and int(payload["chunk"]) > 0:
-                emit = cancelled = None
-                if done_q is not None:
-                    emit = lambda d: done_q.put(("delta", d))  # noqa: E731
-                    cancelled = work.get("_cancel")
-                _stream_generate_pod(
-                    params, cfg, payload, args.max_len,
-                    multihost_utils, dog=dog, emit=emit,
-                    cancelled=cancelled,
-                )
-                if done_q is not None:
-                    done_q.put(("end", None))
-                continue
-            out = _decode_pod(params, cfg, payload, args.max_len)
-            if dog is not None:
-                dog.beat()
-            if done_q is not None:
-                # one trim convention pod-wide: the single-host
-                # server's (slice to the REQUESTED length, then cut
-                # at eos inclusive)
-                row = [int(t) for t in np.asarray(out)[0]]
-                done_q.put(InferenceServer._trim(
-                    [row], work["max_new"], int(payload["eos_id"])
-                )[0])
-        except Exception as exc:  # noqa: BLE001 — pod-fatal
-            if done_q is not None:
-                done_q.put(exc)
-            raise
+        _run_frontend_loop(
+            args, frontend, mirror, dog, multihost_utils, stopping
+        )
+    else:
+        _run_follower_loop(args, mirror, dog, multihost_utils)
     if dog is not None:
         dog.stop()
     if frontend is not None:

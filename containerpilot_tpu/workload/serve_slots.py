@@ -385,6 +385,10 @@ class SlotEngine:
                     (self.slots, self.cfg.vocab_size), jnp.float32
                 )
                 continue
+            # fetch BEFORE mutating step_idx: jnp.asarray may have
+            # zero-copied the numpy buffer into the in-flight chunk,
+            # and an in-place += racing the execution feeds it torn
+            # step indices (the pod mirror learned this the hard way)
             toks_host = np.asarray(jax.device_get(toks))
             self._step_idx += self.chunk
             for i, state in enumerate(self._active):

@@ -51,10 +51,11 @@ def _default_cfg():
     )
 
 
-def _reference(tokens, max_new, cfg=None, params=None, **kw):
+def _reference(tokens, max_new, cfg=None, params=None, row=0, **kw):
     """Single-device generate with the server key convention — the
-    ONE copy of the fold_in(PRNGKey(seed), 0) + _trim parity recipe
-    every pod test compares against."""
+    ONE copy of the fold_in(PRNGKey(seed), row) + _trim parity recipe
+    every pod test compares against (row i of an n-sample request
+    draws from fold_in(seed, i))."""
     from containerpilot_tpu.models.decode import generate
     from containerpilot_tpu.models.transformer import init_params
 
@@ -68,14 +69,14 @@ def _reference(tokens, max_new, cfg=None, params=None, **kw):
         params, jnp.asarray([tokens], jnp.int32), cfg, max_new,
         cfg.max_seq_len,
         rng=jnp.stack(
-            [jax.random.fold_in(jax.random.PRNGKey(seed), 0)]
+            [jax.random.fold_in(jax.random.PRNGKey(seed), row)]
         ),
         eos_id=eos, **kw,
     )
     from containerpilot_tpu.workload.serve import InferenceServer
 
-    row = [int(t) for t in np.asarray(out)[0]]
-    return InferenceServer._trim([row], max_new, eos)[0]
+    out_row = [int(t) for t in np.asarray(out)[0]]
+    return InferenceServer._trim([out_row], max_new, eos)[0]
 
 
 def _write_cpu_wrapper(tmp_path):
@@ -204,7 +205,102 @@ def test_pod_serves_http(tmp_path, n_procs, dp):
         )
         assert 11 not in knobs["tokens"][0]
 
-        # SSE streaming over the chunked lockstep decode: deltas
+        # n > 1: one prompt, n samples as n pool slots — row i draws
+        # from fold_in(seed, i), the single-host batcher's convention
+        two = post({"tokens": [[1, 2, 3]], "max_new_tokens": 6,
+                    "n": 2})
+        assert two["tokens"][0] == _reference([1, 2, 3], 6)
+        assert two["tokens"][1] == two["tokens"][0]  # greedy twins
+        sampled2 = post({
+            "tokens": [[5, 6]], "max_new_tokens": 5,
+            "temperature": 0.8, "top_k": 20, "seed": 9, "n": 2,
+        })
+        assert sampled2["tokens"][0] == sampled["tokens"][0]
+        assert sampled2["tokens"][1] == _reference(
+            [5, 6], 5, temperature=0.8, top_k=20, seed=9, row=1,
+        )
+
+        # stop sequences: OpenAI exclusive trim, identical to the
+        # single-host server's whole-row trim of the same output
+        from containerpilot_tpu.workload.serve import InferenceServer
+
+        ref = _reference([1, 2, 3], 6)
+        stop_seq = ref[2:4]
+        stopped = post({"tokens": [[1, 2, 3]], "max_new_tokens": 6,
+                        "stop": [stop_seq]})
+        assert stopped["tokens"][0] == InferenceServer._trim_stops(
+            [list(ref)], [stop_seq]
+        )[0]
+        assert len(stopped["tokens"][0]) < len(ref)
+
+        # logit_bias beyond the 16-slot fast path (the OpenAI-300
+        # wide table): 20 bans hold, byte-parity with generate
+        wb = post({
+            "tokens": [[1, 2, 3]], "max_new_tokens": 6,
+            "logit_bias": {str(i): -100.0 for i in range(20)},
+        })
+        assert wb["tokens"][0] == _reference(
+            [1, 2, 3], 6, logit_bias={i: -100.0 for i in range(20)}
+        )
+        assert all(t >= 20 for t in wb["tokens"][0])
+
+        # /v1/score rides the broadcast too: teacher-forced logprobs
+        # match the single-host formula bit-for-bit
+        req = urllib.request.Request(
+            f"{base}/v1/score",
+            data=json.dumps({"tokens": [[1, 2, 3, 4]]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=240) as resp:
+            scored = json.loads(resp.read().decode())
+        from containerpilot_tpu.models.transformer import init_params
+        from containerpilot_tpu.workload.modelcfg import (
+            score_logprobs_fn,
+        )
+
+        s_cfg = _default_cfg()
+        s_params = init_params(jax.random.PRNGKey(0), s_cfg)
+        # pad to the pod's 16-multiple width convention, slice back —
+        # the same function the endpoint jits
+        toks = jnp.asarray([[1, 2, 3, 4] + [0] * 12], jnp.int32)
+        want = [
+            round(float(x), 6)
+            for x in np.asarray(
+                score_logprobs_fn(s_cfg)(s_params, toks)
+            )[0][:3]
+        ]
+        assert scored["logprobs"][0] == want
+
+        # logprobs echo: per-token logprobs of the trimmed output via
+        # lockstep score rounds — the single-host echo numbers
+        lp = post({"tokens": [[1, 2, 3]], "max_new_tokens": 6,
+                   "logprobs": True})
+        assert lp["tokens"][0] == ref
+        echo_row = [1, 2, 3] + ref
+        width = -(-len(echo_row) // 16) * 16
+        picked = np.asarray(score_logprobs_fn(s_cfg)(
+            s_params,
+            jnp.asarray(
+                [echo_row + [0] * (width - len(echo_row))], jnp.int32
+            ),
+        ))[0]
+        assert lp["logprobs"][0] == [
+            round(float(x), 6) for x in picked[2:2 + len(ref)]
+        ]
+
+        # beam search: a one-shot lockstep round, byte-identical to
+        # the single-host deterministic beam program
+        from containerpilot_tpu.models.beam import beam_search
+
+        beam = post({"tokens": [[1, 2, 3]], "max_new_tokens": 6,
+                     "beam_width": 2})
+        bt, _sc = beam_search(
+            s_params, jnp.asarray([[1, 2, 3]], jnp.int32), s_cfg,
+            max_new_tokens=6, max_len=48, beam_width=2,
+        )
+        assert beam["tokens"][0] == [int(t) for t in np.asarray(bt)]
+
+        # SSE streaming over the chunked lockstep rounds: deltas
         # concatenate to the non-streamed answer for the same request
         import http.client
 
@@ -237,58 +333,49 @@ def test_pod_serves_http(tmp_path, n_procs, dp):
         assert streamed == greedy["tokens"][0]
         assert events[-1]["count"] == len(streamed)
 
-        # /v1/score rides the broadcast too: teacher-forced logprobs
-        # match the single-host formula bit-for-bit
-        req = urllib.request.Request(
-            f"{base}/v1/score",
-            data=json.dumps({"tokens": [[1, 2, 3, 4]]}).encode(),
-            headers={"Content-Type": "application/json"},
+        # CONTINUOUS BATCHING across the pod: a non-streamed request
+        # lands mid-flight next to a running stream (it joins the
+        # pool at a chunk boundary instead of queueing behind the
+        # whole generation), and BOTH outputs stay byte-identical to
+        # their solo references
+        conn2 = http.client.HTTPConnection(
+            "127.0.0.1", http_port, timeout=240
         )
-        with urllib.request.urlopen(req, timeout=240) as resp:
-            scored = json.loads(resp.read().decode())
-        from containerpilot_tpu.models.transformer import init_params
-        from containerpilot_tpu.workload.modelcfg import (
-            score_logprobs_fn,
+        conn2.request(
+            "POST", "/v1/generate",
+            json.dumps({"tokens": [[5, 6]], "max_new_tokens": 40,
+                        "temperature": 0.8, "top_k": 20, "seed": 9,
+                        "stream": True}),
+            {"Content-Type": "application/json"},
         )
-
-        s_cfg = _default_cfg()
-        s_params = init_params(jax.random.PRNGKey(0), s_cfg)
-        # pad to the pod's 16-multiple width convention, slice back —
-        # the same function the endpoint jits
-        toks = jnp.asarray([[1, 2, 3, 4] + [0] * 12], jnp.int32)
-        want = [
-            round(float(x), 6)
-            for x in np.asarray(
-                score_logprobs_fn(s_cfg)(s_params, toks)
-            )[0][:3]
+        resp2 = conn2.getresponse()
+        assert resp2.status == 200
+        buf2 = b""
+        while b"\n\n" not in buf2:  # the stream is live
+            buf2 += resp2.read1(65536)
+        mid = post({"tokens": [[1, 2, 3]], "max_new_tokens": 6})
+        assert mid["tokens"][0] == greedy["tokens"][0]
+        while True:  # drain the co-batched stream to its end
+            data = resp2.read1(65536)
+            if not data:
+                break
+            buf2 += data
+        conn2.close()
+        events2 = [
+            json.loads(raw[len(b"data: "):])
+            for raw in buf2.split(b"\n\n")
+            if raw.startswith(b"data: ")
         ]
-        assert scored["logprobs"][0] == want
+        assert events2[-1]["done"] is True
+        streamed2 = sum(
+            (e["tokens"] for e in events2 if "tokens" in e), []
+        )
+        assert streamed2 == _reference(
+            [5, 6], 40, temperature=0.8, top_k=20, seed=9
+        )
 
-        # observability parity: /v1/model reports the pod topology,
-        # /metrics carries the request/token counters
-        info = json.loads(urllib.request.urlopen(
-            f"{base}/v1/model", timeout=30
-        ).read().decode())
-        assert info["pod"]["num_processes"] == n_procs
-        assert info["pod"]["mesh"] == {
-            "data": dp, "model": n_procs // dp,
-        }
-        metrics = urllib.request.urlopen(
-            f"{base}/metrics", timeout=30
-        ).read().decode()
-        assert (
-            'containerpilot_pod_requests_total'
-            '{endpoint="generate",status="200"} 4.0'
-        ) in metrics  # 3 plain + 1 streamed
-        assert (
-            'containerpilot_pod_requests_total'
-            '{endpoint="model",status="200"} 1.0'
-        ) in metrics
-        assert "containerpilot_pod_generated_tokens_total" in metrics
-
-        # disconnect mid-stream: the frontend stops broadcasting go,
-        # the pod abandons the request at the next chunk boundary,
-        # and keeps serving
+        # disconnect mid-stream: the frontend evicts the slot at the
+        # next round, the pool keeps serving everyone else
         conn = http.client.HTTPConnection(
             "127.0.0.1", http_port, timeout=240
         )
@@ -307,6 +394,32 @@ def test_pod_serves_http(tmp_path, n_procs, dp):
         conn.close()
         again = post({"tokens": [[1, 2, 3]], "max_new_tokens": 6})
         assert again["tokens"][0] == greedy["tokens"][0]
+
+        # observability parity: /v1/model reports the pod topology
+        # and pool shape, /metrics carries the request/token counters
+        info = json.loads(urllib.request.urlopen(
+            f"{base}/v1/model", timeout=30
+        ).read().decode())
+        assert info["pod"]["num_processes"] == n_procs
+        assert info["pod"]["mesh"] == {
+            "data": dp, "model": n_procs // dp,
+        }
+        assert info["slot_engine"]["slots"] == 4
+        time.sleep(1)  # let the disconnected stream's close land
+        metrics = urllib.request.urlopen(
+            f"{base}/metrics", timeout=30
+        ).read().decode()
+        # 11 plain 200s + 3 streamed 200s (the disconnected stream
+        # still counts its 200)
+        assert (
+            'containerpilot_pod_requests_total'
+            '{endpoint="generate",status="200"} 14.0'
+        ) in metrics
+        assert (
+            'containerpilot_pod_requests_total'
+            '{endpoint="model",status="200"} 1.0'
+        ) in metrics
+        assert "containerpilot_pod_generated_tokens_total" in metrics
 
 
         # graceful pod shutdown: TERM on the frontend broadcasts the
@@ -363,6 +476,81 @@ def test_pod_frontend_parse_never_leaks_exceptions():
             pass  # the 422 family the handlers catch
     # some random bodies are legal; the point is nothing ELSE raised
     assert ok >= 0
+
+
+def test_pod_warmup_covers_serve_path():
+    """The pod's no-post-grace-compiles invariant, in-process: after
+    ``warm_pod``, serving a request at the warmed shapes — a plen-4
+    admission with ARBITRARY sampling knobs (they are operands, not
+    compile keys), the (slots, chunk) chunk program, the width-16
+    scorer — compiles NOTHING. Post-grace compiles are what eat a
+    production pod's watchdog deadline, so a regression that adds an
+    un-warmed shape to the serve path must fail a test instead of
+    wedging a pod. The detector is proven non-vacuous by an unwarmed
+    prompt length compiling."""
+    import logging
+
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload import serve_dist as sd
+
+    cfg = _default_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mirror = sd._SlotMirror(cfg, params, 48, 4, 8)
+    sd.warm_pod(mirror)
+
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    jax_logger = logging.getLogger("jax")
+    old_level = jax_logger.level
+    jax.config.update("jax_log_compiles", True)
+    jax_logger.addHandler(handler)
+    jax_logger.setLevel(logging.DEBUG)
+
+    def compiles():
+        return [
+            r.getMessage() for r in records
+            if "ompil" in r.getMessage()
+        ]
+
+    def admit_round(tokens, slot, **knobs):
+        work = {
+            "tokens": tokens, "max_new": 9, "temperature": 0.0,
+            "top_k": 0, "top_p": 0.0, "eos_id": -1, "seed": 0,
+            "min_new": 0, "presence": 0.0, "frequency": 0.0,
+            "logit_bias": {},
+        }
+        work.update(knobs)
+        p = sd._payload_zeros(48, 4)
+        p["op"] = np.asarray(sd.OP_ROUND, np.int32)
+        sd._fill_admission(p, work, row_idx=0, slot=slot)
+        p["run_chunk"] = np.asarray(1, np.int32)
+        p["done"][slot] = 0
+        sd._apply_round(mirror, p)
+
+    try:
+        # warmed shapes + aggressively different KNOB VALUES: zero
+        # compiles (temperature/top_k/bias/penalties are operands of
+        # the one chunk program, not compile keys)
+        admit_round(
+            [1, 2, 3, 4], slot=1, temperature=0.7, top_k=5, seed=3,
+            min_new=2, presence=0.5, frequency=0.25,
+            logit_bias={7: -5.0},
+        )
+        sc = sd._payload_zeros(48, 4)
+        sc["prompt"][:9] = 1
+        sc["plen"] = np.asarray(9, np.int32)
+        np.asarray(jax.device_get(sd._score_pod(params, cfg, sc, 48)))
+        assert not compiles(), compiles()
+        # non-vacuous: an UNwarmed prompt length (11 — no other
+        # in-process test prefills it) does compile and IS caught
+        records.clear()
+        admit_round(list(range(1, 12)), slot=2)
+        assert compiles()
+    finally:
+        jax.config.update("jax_log_compiles", False)
+        jax_logger.removeHandler(handler)
+        jax_logger.setLevel(old_level)
 
 
 def test_pod_text_completions(tmp_path):
@@ -438,17 +626,53 @@ def test_pod_text_completions(tmp_path):
         assert comp["tokens"] == want
         assert comp["text"] == tok.decode(comp["tokens"])
 
-        # unsupported knobs fail loudly on both POST endpoints
-        s1, body1 = post(
+        # stop strings plumb through the shared parser: a never-
+        # matching stop leaves the completion untouched (200, not the
+        # round-4 422 carve-out)
+        s1, with_stop = post(
             "/v1/completions",
-            {"prompt": "x", "stop": ["y"]},
+            {"prompt": "hi", "max_new_tokens": 6, "stop": ["\x00zz"]},
         )
-        s2, body2 = post(
-            "/v1/completions",
-            {"prompt": "x", "stream": True},
+        assert s1 == 200 and with_stop["tokens"] == want
+
+        # streamed text: UTF-8-holdback deltas concatenate to the
+        # non-streamed text AND ids (the single-host contract,
+        # pod-shaped)
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", http_port, timeout=240
         )
-        assert s1 == 422 and "does not support 'stop'" in body1
-        assert s2 == 422 and "does not stream" in body2
+        conn.request(
+            "POST", "/v1/completions",
+            json.dumps({"prompt": "hi", "max_new_tokens": 6,
+                        "stream": True}),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "text/event-stream"
+        buf = b""
+        while True:
+            data = resp.read1(65536)
+            if not data:
+                break
+            buf += data
+        conn.close()
+        events = [
+            json.loads(raw[len(b"data: "):])
+            for raw in buf.split(b"\n\n")
+            if raw.startswith(b"data: ")
+        ]
+        assert events[-1]["done"] is True
+        streamed_ids = sum(
+            (e["tokens"] for e in events if "tokens" in e), []
+        )
+        streamed_text = "".join(
+            e["text"] for e in events if "text" in e
+        )
+        assert streamed_ids == comp["tokens"]
+        assert streamed_text == comp["text"]
 
         procs[0].send_signal(15)
         for i, proc in enumerate(procs):
@@ -470,7 +694,11 @@ def test_pod_restores_checkpoint_in_lockstep(tmp_path):
     trained weights through orbax's global barriers onto the pod
     mesh (saved on a DIFFERENT, single-process topology — the
     restore re-shards), and answers change accordingly: byte-parity
-    with a single-device restore of the same checkpoint."""
+    with a single-device restore of the same checkpoint. The pod
+    also serves ``--kv-int8`` here: every process quantizes the KV
+    cache identically, so the lockstep answer byte-matches a
+    single-device kv-int8 decode of the same weights (the int8-KV
+    serving accelerator composed with the pod)."""
     import numpy as np
 
     # train a couple of steps single-process to produce the artifact
@@ -515,7 +743,7 @@ def test_pod_restores_checkpoint_in_lockstep(tmp_path):
                  "--coordinator-port", str(coord_port),
                  "--advertise-address", "127.0.0.1",
                  "--host", "127.0.0.1", "--port", str(http_port),
-                 "--checkpoint-dir", str(ck)]
+                 "--checkpoint-dir", str(ck), "--kv-int8"]
                 + model_flags,
                 cwd=REPO, env=env, stdout=fh, stderr=subprocess.STDOUT,
             ))
@@ -548,7 +776,7 @@ def test_pod_restores_checkpoint_in_lockstep(tmp_path):
 
         cfg = TransformerConfig(
             vocab_size=64, d_model=32, n_heads=2, n_layers=1,
-            d_ff=derive_d_ff(32), max_seq_len=48,
+            d_ff=derive_d_ff(32), max_seq_len=48, kv_int8=True,
         )
         one_dev = make_mesh(
             jax.devices()[:1], plan=MeshPlan(data=1, model=1)
