@@ -95,6 +95,7 @@ OP_ROUND = 1      # slot-engine round: optional admission + one chunk
 OP_HEARTBEAT = 2  # idle liveness tick: bounds every broadcast wait
 OP_SCORE = 3      # teacher-forced logprobs over the broadcast row
 OP_BEAM = 4       # one-shot lockstep beam search
+OP_SPEC = 5       # one-shot lockstep speculative (draft-and-verify)
 
 WATCHDOG_EXIT = 86  # parallel.watchdog.EXIT_CODE — same semantics
 
@@ -437,6 +438,30 @@ def _beam_pod(params, cfg, payload, max_len: int) -> List[int]:
         print("BEAM plen=%d width=%d"
               % (plen, int(payload["beam_width"])), flush=True)
     return [int(t) for t in np.asarray(jax.device_get(out))]
+
+
+def _spec_pod(params, draft, cfg, payload, max_len: int) -> List[int]:
+    """One-shot lockstep speculative generation: the single-host
+    draft-and-verify (models/speculative.py — greedy, output
+    IDENTICAL to plain generate) run identically on every process.
+    The host loop's data-dependent acceptance decisions derive from
+    replicated device values, so every process takes the same
+    branches in the same order — all SPMD needs. Like beams, a spec
+    round beats the watchdog only on completion; the deadline must
+    exceed the slowest full generation."""
+    from ..models.speculative import speculative_generate
+
+    draft_params, draft_cfg, speculate = draft
+    plen = int(payload["plen"])
+    prompt = jnp.asarray(payload["prompt"][None, :plen], jnp.int32)
+    out, stats = speculative_generate(
+        params, draft_params, prompt, cfg, draft_cfg,
+        max_new_tokens=int(payload["max_new_req"]), max_len=max_len,
+        speculate=speculate,
+    )
+    if os.environ.get("CONTAINERPILOT_POD_DEBUG"):
+        print("SPEC plen=%d stats=%s" % (plen, stats), flush=True)
+    return [int(t) for t in np.asarray(jax.device_get(out))[0]]
 
 
 def _hit_stop(emitted: List[int], stops: List[List[int]]) -> bool:
@@ -992,7 +1017,8 @@ def warm_pod(mirror: _SlotMirror) -> None:
 
 
 def _run_frontend_loop(args, frontend: _Frontend, mirror: _SlotMirror,
-                       dog, multihost_utils, stopping) -> None:
+                       dog, multihost_utils, stopping,
+                       draft=None) -> None:
     """Process 0's round loop: drain HTTP work, drive admissions and
     chunks via broadcast ROUNDs, keep the per-request emission
     bookkeeping, answer handlers. Every completed round beat()s the
@@ -1080,6 +1106,39 @@ def _run_frontend_loop(args, frontend: _Frontend, mirror: _SlotMirror,
         if ended:
             row.finished = True
 
+    def run_one_shot(work, done_q, op, fill_extra, run_op) -> None:
+        """The shared answer path for one-shot lockstep ops (beam,
+        spec): fill the row payload, broadcast, run, trim, echo
+        logprobs if asked, answer — failing pod-fatally like every
+        collective path."""
+        p = _payload_zeros(args.max_len, S)
+        p["op"] = np.asarray(op, np.int32)
+        tokens = work["tokens"]
+        p["prompt"][: len(tokens)] = np.asarray(tokens, np.int32)
+        p["plen"] = np.asarray(len(tokens), np.int32)
+        p["max_new_req"] = np.asarray(work["max_new"], np.int32)
+        fill_extra(p)
+        bcast(p)
+        try:
+            row = run_op(p)
+            beat()
+            rows_out = InferenceServer._trim(
+                [row], work["max_new"], work["eos_id"]
+            )
+            rows_out = InferenceServer._trim_stops(
+                rows_out, work["stop"]
+            )
+            result: Dict[str, Any] = {"tokens": rows_out}
+            if work["logprobs"]:
+                result["logprobs"] = echo_logprobs(
+                    work["tokens"], rows_out
+                )
+        except Exception as exc:  # noqa: BLE001 — pod-fatal
+            done_q.put(exc)
+            fail_open(exc)
+            raise
+        done_q.put(result)
+
     def classify(work, done_q) -> None:
         kind = work.get("kind", "gen")
         if kind == "score":
@@ -1092,39 +1151,43 @@ def _run_frontend_loop(args, frontend: _Frontend, mirror: _SlotMirror,
             done_q.put(out.tolist())
             return
         if kind == "beam":
-            p = _payload_zeros(args.max_len, S)
-            p["op"] = np.asarray(OP_BEAM, np.int32)
-            tokens = work["tokens"]
-            p["prompt"][: len(tokens)] = np.asarray(tokens, np.int32)
-            p["plen"] = np.asarray(len(tokens), np.int32)
-            p["max_new_req"] = np.asarray(work["max_new"], np.int32)
-            p["beam_width"] = np.asarray(work["beam_width"], np.int32)
-            p["eos_id"] = np.asarray(work["eos_id"], np.int32)
-            p["length_penalty"] = np.asarray(
-                work["length_penalty"], np.float32
-            )
-            bcast(p)
-            try:
-                row = _beam_pod(
+            def fill_beam(p) -> None:
+                p["beam_width"] = np.asarray(
+                    work["beam_width"], np.int32
+                )
+                p["eos_id"] = np.asarray(work["eos_id"], np.int32)
+                p["length_penalty"] = np.asarray(
+                    work["length_penalty"], np.float32
+                )
+
+            run_one_shot(
+                work, done_q, OP_BEAM, fill_beam,
+                lambda p: _beam_pod(
                     mirror.params, mirror.cfg, p, args.max_len
-                )
-                beat()
-                rows_out = InferenceServer._trim(
-                    [row], work["max_new"], work["eos_id"]
-                )
-                rows_out = InferenceServer._trim_stops(
-                    rows_out, work["stop"]
-                )
-                result: Dict[str, Any] = {"tokens": rows_out}
-                if work["logprobs"]:
-                    result["logprobs"] = echo_logprobs(
-                        work["tokens"], rows_out
-                    )
-            except Exception as exc:  # noqa: BLE001 — pod-fatal
-                done_q.put(exc)
-                fail_open(exc)
-                raise
-            done_q.put(result)
+                ),
+            )
+            return
+        if (
+            draft is not None
+            and not work.get("_stream")
+            and not any(owners) and not pending
+            and work["n"] == 1
+            and work["temperature"] <= 0.0
+            and work["min_new"] == 0
+            and not work["presence"] and not work["frequency"]
+            and not work["logit_bias"]
+        ):
+            # greedy single request against an IDLE pool: draft-and-
+            # verify, identical output, fewer target passes (the
+            # single-host routing rule plus the idle condition —
+            # under concurrency the slot pool already wins, and a
+            # one-shot spec round would stall co-batched streams)
+            run_one_shot(
+                work, done_q, OP_SPEC, lambda p: None,
+                lambda p: _spec_pod(
+                    mirror.params, draft, mirror.cfg, p, args.max_len
+                ),
+            )
             return
         req = _GenReq(work, done_q)
         open_reqs.append(req)
@@ -1261,7 +1324,7 @@ def _run_frontend_loop(args, frontend: _Frontend, mirror: _SlotMirror,
 
 
 def _run_follower_loop(args, mirror: _SlotMirror, dog,
-                       multihost_utils) -> None:
+                       multihost_utils, draft=None) -> None:
     """Followers replay whatever op the frontend broadcast; their
     device state stays bit-identical to process 0's because both run
     exactly `_apply_round` on exactly the broadcast operands."""
@@ -1292,6 +1355,11 @@ def _run_follower_loop(args, mirror: _SlotMirror, dog,
             )
         elif op == OP_BEAM:
             _beam_pod(mirror.params, mirror.cfg, payload, args.max_len)
+        elif op == OP_SPEC:
+            _spec_pod(
+                mirror.params, draft, mirror.cfg, payload,
+                args.max_len,
+            )
         elif op == OP_ROUND:
             _apply_round(mirror, payload)
         if dog is not None:
@@ -1338,6 +1406,14 @@ def main() -> int:
                         "admission latency, the SSE delta "
                         "granularity, and the watchdog's progress "
                         "quantum")
+    parser.add_argument("--draft-layers", type=int, default=0,
+                        help="self-speculative decoding: greedy "
+                        "single requests against an idle pool draft "
+                        "with the model's first N layers and verify "
+                        "in chunks — identical output, fewer target "
+                        "passes (0 = off)")
+    parser.add_argument("--speculate", type=int, default=4,
+                        help="draft tokens per speculative round")
     parser.add_argument("--kv-int8", action="store_true",
                         help="serve with the int8 KV cache (half the "
                         "KV bytes; every process quantizes "
@@ -1451,6 +1527,22 @@ def main() -> int:
         )
         params = shard_params_global(host_params, mesh, cfg)
 
+    draft = None
+    if args.draft_layers > 0:
+        if args.speculate < 1:
+            raise SystemExit("--speculate must be >= 1")
+        if not 0 < args.draft_layers < cfg.n_layers:
+            # every process must fail here, not mid-rendezvous
+            raise SystemExit(
+                f"--draft-layers must be in (0, {cfg.n_layers})"
+            )
+        from ..models.speculative import layer_prefix_draft
+
+        draft_params, draft_cfg = layer_prefix_draft(
+            params, cfg, args.draft_layers
+        )
+        draft = (draft_params, draft_cfg, args.speculate)
+
     frontend = None
     if args.process_id == 0:
         frontend = _Frontend(
@@ -1467,6 +1559,13 @@ def main() -> int:
                 "text": args.text,
                 "stream": True,
                 "kv_int8": args.kv_int8,
+                "speculative": (
+                    {
+                        "draft_layers": args.draft_layers,
+                        "speculate": args.speculate,
+                    }
+                    if draft is not None else None
+                ),
                 "slot_engine": {
                     "slots": args.slots,
                     "chunk": args.stream_chunk,
@@ -1493,6 +1592,39 @@ def main() -> int:
         mesh=mesh,
     )
     warm_pod(mirror)
+    if draft is not None:
+        # compile the spec path's whole program set inside the grace:
+        # one tiny end-to-end generation for the glue, PLUS every
+        # per-k draft/verify variant explicitly — k varies 1..speculate
+        # at request time with data-dependent acceptance, so the tiny
+        # run alone would leave unwarmed k shapes to compile mid-way
+        # through a beat-less one-shot round (the single-host warmup's
+        # exact rule, serve.py)
+        from ..models.decode import prefill
+        from ..models.speculative import (
+            _jit_draft_round,
+            _jit_verify_round,
+            speculative_generate,
+        )
+
+        draft_params, draft_cfg, spec_k = draft
+        speculative_generate(
+            params, draft_params,
+            jnp.zeros((1, 4), jnp.int32), cfg, draft_cfg,
+            max_new_tokens=spec_k + 2, max_len=args.max_len,
+            speculate=spec_k,
+        )
+        warm_prompt = jnp.zeros((1, 4), jnp.int32)
+        _logits, tcache = prefill(params, warm_prompt, cfg,
+                                  args.max_len)
+        _dlogits, dcache = prefill(draft_params, warm_prompt,
+                                   draft_cfg, args.max_len)
+        prev = jnp.zeros((1,), jnp.int32)
+        for k in range(1, spec_k + 1):
+            _jit_draft_round(draft_cfg, k)(draft_params, dcache, prev)
+            _jit_verify_round(cfg, k + 1)(
+                params, tcache, jnp.zeros((1, k + 1), jnp.int32)
+            )
     if dog is not None:
         dog.beat()  # startup done: tighten to the serve deadline
     if frontend is not None:
@@ -1511,10 +1643,13 @@ def main() -> int:
             signal_mod.SIGTERM, lambda s, f: stopping.set()
         )
         _run_frontend_loop(
-            args, frontend, mirror, dog, multihost_utils, stopping
+            args, frontend, mirror, dog, multihost_utils, stopping,
+            draft=draft,
         )
     else:
-        _run_follower_loop(args, mirror, dog, multihost_utils)
+        _run_follower_loop(
+            args, mirror, dog, multihost_utils, draft=draft
+        )
     if dog is not None:
         dog.stop()
     if frontend is not None:

@@ -173,6 +173,16 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
+    from containerpilot_tpu.workload.modelcfg import (
+        enable_compile_cache,
+    )
+
+    # honors CONTAINERPILOT_COMPILE_CACHE exactly like the real
+    # workload CLIs: a reincarnated worker re-warms from cached
+    # executables, which is both the feature's purpose and what keeps
+    # the crash-resume capstones' restart windows short
+    enable_compile_cache()
+
     from containerpilot_tpu.models.transformer import (
         TransformerConfig,
         init_params,

@@ -92,3 +92,21 @@ def run():
         return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
 
     return _run
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled executables when a test module finishes.
+
+    The suite runs ~380 tests in ONE interpreter; by the tail of the
+    session the process holds hundreds of live XLA executables and
+    the CPU compiler starts degrading — observed as multi-minute
+    compile stalls and, twice, a segfault inside
+    backend_compile_and_load ~50 minutes in (the crashing test passes
+    alone). Per-module cache clearing bounds that accumulation; the
+    cross-module recompile cost is small because modules share almost
+    no shapes."""
+    yield
+    import jax
+
+    jax.clear_caches()

@@ -133,9 +133,13 @@ def _supervisor_config(
     return str(path)
 
 
+# the 2-proc worker-crash case is subsumed by dp2xtp2-worker-crash
+# (same crash target, superset topology) — dropped to hold the
+# one-core suite budget; coordinator-crash stays 2-proc because the
+# crash TARGET differs
 @pytest.mark.parametrize(
-    "n_procs,tp,crash_idx", [(2, 0, 1), (2, 0, 0), (4, 2, 1)],
-    ids=["worker-crash", "coordinator-crash", "dp2xtp2-worker-crash"],
+    "n_procs,tp,crash_idx", [(2, 0, 0), (4, 2, 1)],
+    ids=["coordinator-crash", "dp2xtp2-worker-crash"],
 )
 def test_supervised_multiprocess_training_with_crash_and_resume(
     tmp_path, n_procs, tp, crash_idx
@@ -155,6 +159,10 @@ def test_supervised_multiprocess_training_with_crash_and_resume(
     catalog_port, coord_port = _free_port(), _free_port()
     job_ports = tuple(_free_port() for _ in range(n_procs))
     env = _sub_env()
+    # the restart half of the story is exactly what the shared XLA
+    # compile cache exists for: the reincarnated worker re-warms from
+    # cached executables instead of recompiling the train step
+    env["CONTAINERPILOT_COMPILE_CACHE"] = str(tmp_path / "xla-cache")
 
     catalog = subprocess.Popen(
         [sys.executable, "-m", "containerpilot_tpu",
