@@ -40,6 +40,15 @@ import time
 from containerpilot_tpu.events import EventBus, GLOBAL_STARTUP
 from containerpilot_tpu.jobs import Job, JobConfig
 
+# Canonical tunnel-aware timing (sync-fetch, floor subtraction, and
+# the floor-noise escalation guard) lives with the autotuner so bench
+# numbers and autotune block selection share one methodology.
+from containerpilot_tpu.ops.autotune import (  # noqa: E402
+    _floor_ms as _sync_floor_ms,
+    _sync,
+    _time_ms,
+)
+
 BASELINE_MS = 35.0  # midpoint of the reference's documented 20-50ms
 MFU_TARGET = 0.35   # the docs/50-workload.md "MFU target" contract
 # (v5e, seq 2048 / batch 8 bench config); training_bench stamps its
@@ -76,60 +85,6 @@ async def dispatch_bench() -> float:
 # ---------------------------------------------------------------------------
 # TPU workload benches
 # ---------------------------------------------------------------------------
-
-
-def _sync(x) -> None:
-    """Force completion. Plain block_until_ready can return early
-    through the axon device tunnel; a tiny host fetch cannot."""
-    import numpy as np
-    import jax.numpy as jnp
-
-    while hasattr(x, "shape") and len(x.shape) > 3:
-        x = x[0]
-    np.asarray(jnp.ravel(x)[:1].astype(jnp.float32))
-
-
-_SYNC_FLOOR_MS = None
-
-
-def _sync_floor_ms() -> float:
-    """The fixed dispatch+fetch roundtrip through the device tunnel
-    (~tens of ms on axon), measured once with a trivial program. Real
-    kernel timings subtract it so numbers reflect device time, not
-    tunnel latency."""
-    global _SYNC_FLOOR_MS
-    if _SYNC_FLOOR_MS is None:
-        import jax
-        import jax.numpy as jnp
-
-        trivial = jax.jit(lambda x: x + 1.0)
-        x = jnp.zeros((8,), jnp.float32)
-        _sync(trivial(x))
-        best = float("inf")
-        for _ in range(5):
-            t0 = time.perf_counter()
-            _sync(trivial(x))
-            best = min(best, (time.perf_counter() - t0) * 1e3)
-        _SYNC_FLOOR_MS = best
-    return _SYNC_FLOOR_MS
-
-
-def _time_ms(fn, *args, n: int = 5, reps: int = 3) -> float:
-    """Amortized timing: n back-to-back dispatches, one sync
-    (in-order execution makes the final fetch wait for all), the
-    tunnel's fixed roundtrip subtracted once; min over ``reps``
-    repetitions discards tunnel latency spikes."""
-    floor = _sync_floor_ms()
-    _sync(fn(*args))  # warm / compile
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        r = None
-        for _ in range(n):
-            r = fn(*args)
-        _sync(r)
-        best = min(best, (time.perf_counter() - t0) * 1e3)
-    return max(best - floor, 1e-3) / n
 
 
 def _peak_flops(device_kind: str) -> float:

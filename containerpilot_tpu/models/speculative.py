@@ -102,12 +102,19 @@ def speculative_generate(
     max_new_tokens: int,
     max_len: int,
     speculate: int = 4,
+    eos_id: int = -1,
 ) -> Tuple[jax.Array, dict]:
     """Greedy generation via draft-and-verify; batch 1.
 
-    Returns ``(tokens [1, max_new_tokens], stats)`` where stats counts
-    rounds and accepted drafts. Output is identical to
-    ``generate(params, ..., temperature=0)``.
+    Returns ``(tokens [1, <=max_new_tokens], stats)`` where stats
+    counts rounds and accepted drafts. Output is identical to
+    ``generate(params, ..., temperature=0)`` up to and including the
+    first ``eos_id`` token: with ``eos_id >= 0`` the round loop stops
+    early once a round emits it (the per-round host check is free —
+    acceptance already fetches the round's tokens), so the row may be
+    shorter than ``max_new_tokens``; every token from the first eos on
+    is exactly what the servers' eos trim discards. ``eos_id < 0``
+    keeps the fixed-length contract.
     """
     if prompt.shape[0] != 1:
         raise ValueError("speculative decoding serves batch 1")
@@ -142,7 +149,9 @@ def speculative_generate(
     rounds = 0
     accepted_total = 0
 
-    while len(out) < max_new_tokens:
+    while len(out) < max_new_tokens and not (
+        eos_id >= 0 and out[0] == eos_id  # prefill's token can be eos
+    ):
         # the verify chunk [prev, d_1..d_k] writes k+1 cache rows at
         # pos..pos+k (the draft's k+1 steps write the same rows), so
         # the round needs pos + k + 1 <= max_len
@@ -177,6 +186,13 @@ def speculative_generate(
         cache = {**cache, "pos": jnp.asarray(pos, jnp.int32)}
         dcache = {**dcache, "pos": jnp.asarray(pos, jnp.int32)}
         prev = jnp.asarray([emitted[-1]], jnp.int32)
+        if eos_id >= 0 and eos_id in emitted:
+            # done: everything past the first eos is trim fodder —
+            # stop paying target passes for it (on the pod this also
+            # frees the lockstep frontend sooner). SPMD-safe: the
+            # check reads the same replicated values every process
+            # fetched for acceptance.
+            break
 
     tokens = jnp.asarray([out[:max_new_tokens]], jnp.int32)
     stats = {
@@ -186,3 +202,49 @@ def speculative_generate(
         "mean_accepted": accepted_total / rounds if rounds else 0.0,
     }
     return tokens, stats
+
+
+def warm_speculative(
+    params: Params,
+    draft_params: Params,
+    cfg: TransformerConfig,
+    draft_cfg: TransformerConfig,
+    speculate: int,
+    max_len: int,
+) -> None:
+    """Compile the speculative path's whole program set.
+
+    Greedy spec traffic dispatches, data-dependently per request, the
+    draft and target prefills plus a per-k draft/verify round for every
+    k in 1..``speculate`` (acceptance decides each round's k at run
+    time) — any variant left uncompiled stalls a live request mid-way
+    through a beat-less round. Both servers call this inside their
+    startup grace so the no-post-grace-compiles invariant holds for
+    ``--draft-layers`` too; one tiny end-to-end generation covers the
+    glue programs around the rounds.
+    """
+    plen = 4
+    prompt = jnp.zeros((1, plen), jnp.int32)
+    # clamp to what the config can actually serve: a small max_len
+    # relative to speculate is a valid configuration (requests clamp k
+    # per round), so warmup must not crash on the e2e call's
+    # plen + max_new <= max_len contract
+    max_new = min(speculate + 2, max_len - plen)
+    if max_new >= 1:
+        speculative_generate(
+            params, draft_params, prompt, cfg, draft_cfg,
+            max_new_tokens=max_new, max_len=max_len,
+            speculate=speculate,
+        )
+    _logits, tcache = prefill(params, prompt, cfg, max_len)
+    _dlogits, dcache = prefill(draft_params, prompt, draft_cfg, max_len)
+    prev = jnp.zeros((1,), jnp.int32)
+    # requests clamp k to max_len - pos - 1 with pos >= 1, so no round
+    # can ever dispatch k beyond max_len - 2 — warm exactly the
+    # dispatchable variants
+    for k in range(1, min(speculate, max_len - 2) + 1):
+        _jit_draft_round(draft_cfg, k)(draft_params, dcache, prev)
+        # verify chunks are k+1 tokens ([prev, drafts])
+        _jit_verify_round(cfg, k + 1)(
+            params, tcache, jnp.zeros((1, k + 1), jnp.int32)
+        )

@@ -37,6 +37,8 @@ DEFAULT_PAIR = (DEFAULT_BLOCK, DEFAULT_BLOCK)
 
 
 def _sync(x) -> None:
+    """Force completion. Plain block_until_ready can return early
+    through the axon device tunnel; a tiny host fetch cannot."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -49,6 +51,10 @@ _FLOOR_MS = None
 
 
 def _floor_ms() -> float:
+    """The fixed dispatch+fetch roundtrip through the device tunnel
+    (~tens of ms on axon), measured once with a trivial program. Real
+    kernel timings subtract it so numbers reflect device time, not
+    tunnel latency."""
     global _FLOOR_MS
     if _FLOOR_MS is None:
         import jax
@@ -67,16 +73,34 @@ def _floor_ms() -> float:
 
 
 def _time_ms(fn, *args, n: int = 5, reps: int = 3) -> float:
+    """Amortized timing: n back-to-back dispatches, one sync
+    (in-order execution makes the final fetch wait for all), the
+    tunnel's fixed roundtrip subtracted once; min over ``reps``
+    repetitions discards tunnel latency spikes."""
     floor = _floor_ms()
     _sync(fn(*args))  # warm / compile
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        r = None
-        for _ in range(n):
-            r = fn(*args)
-        _sync(r)
-        best = min(best, (time.perf_counter() - t0) * 1e3)
+
+    def run(nn: int) -> float:
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            r = None
+            for _ in range(nn):
+                r = fn(*args)
+            _sync(r)
+            best = min(best, (time.perf_counter() - t0) * 1e3)
+        return best
+
+    best = run(n)
+    # Tunnel noise guard: when the whole batch of dispatches finishes
+    # indistinguishably from the bare roundtrip floor, the compute is
+    # hidden under the roundtrip and `best - floor` is noise — a
+    # noise-level "0.0002 ms" must never win block selection or ship
+    # as a speedup_vs_default. Scale the dispatch count until the
+    # signal clears the floor (each 4x amortizes the roundtrip 4x).
+    while best < 2.0 * floor and n < 320:
+        n = min(n * 4, 320)  # cap is the ceiling, not a pre-check
+        best = run(n)
     return max(best - floor, 1e-3) / n
 
 

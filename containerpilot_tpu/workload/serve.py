@@ -527,7 +527,7 @@ class InferenceServer:
             # truncation the padded greedy path would get.
             return await in_exec(
                 self._executor, serve_strategies.run_speculative, self,
-                tokens, p["max_new"],
+                tokens, p["max_new"], p["eos_id"],
             )
         if self.slot_engine is not None and len(tokens) == 1:
             # joins the running chunk loop at the next boundary; output
@@ -941,34 +941,15 @@ class InferenceServer:
                     max_len=self.max_len,
                 )
                 if self.draft_params is not None and prompt_len == 4:
-                    # the DEFAULT path for greedy traffic: compile the
-                    # draft prefill and EVERY per-k draft/verify
-                    # variant — k varies 1..speculate at request time
-                    # with data-dependent acceptance, and any uncompiled
-                    # k would stall a live request
-                    from ..models.decode import prefill
-                    from ..models.speculative import (
-                        _jit_draft_round,
-                        _jit_verify_round,
-                    )
+                    # the DEFAULT path for greedy traffic: one shared
+                    # rule for which spec programs must compile inside
+                    # the grace (models/speculative.py)
+                    from ..models.speculative import warm_speculative
 
-                    _logits, cache = prefill(
-                        self.params, prompt, self.cfg, self.max_len
+                    warm_speculative(
+                        self.params, self.draft_params, self.cfg,
+                        self.draft_cfg, self.speculate, self.max_len,
                     )
-                    _dlogits, dcache = prefill(
-                        self.draft_params, prompt, self.draft_cfg,
-                        self.max_len,
-                    )
-                    prev = jnp.zeros((1,), jnp.int32)
-                    for k in range(1, self.speculate + 1):
-                        _jit_draft_round(self.draft_cfg, k)(
-                            self.draft_params, dcache, prev
-                        )
-                        # verify chunks are k+1 tokens ([prev, drafts])
-                        _jit_verify_round(self.cfg, k + 1)(
-                            self.params, cache,
-                            jnp.zeros((1, k + 1), jnp.int32),
-                        )
 
         await asyncio.get_event_loop().run_in_executor(self._executor, run)
         if self.slot_engine is not None:

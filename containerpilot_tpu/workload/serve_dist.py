@@ -457,7 +457,7 @@ def _spec_pod(params, draft, cfg, payload, max_len: int) -> List[int]:
     out, stats = speculative_generate(
         params, draft_params, prompt, cfg, draft_cfg,
         max_new_tokens=int(payload["max_new_req"]), max_len=max_len,
-        speculate=speculate,
+        speculate=speculate, eos_id=int(payload["eos_id"]),
     )
     if os.environ.get("CONTAINERPILOT_POD_DEBUG"):
         print("SPEC plen=%d stats=%s" % (plen, stats), flush=True)
@@ -1117,6 +1117,7 @@ def _run_frontend_loop(args, frontend: _Frontend, mirror: _SlotMirror,
         p["prompt"][: len(tokens)] = np.asarray(tokens, np.int32)
         p["plen"] = np.asarray(len(tokens), np.int32)
         p["max_new_req"] = np.asarray(work["max_new"], np.int32)
+        p["eos_id"] = np.asarray(work["eos_id"], np.int32)
         fill_extra(p)
         bcast(p)
         try:
@@ -1155,7 +1156,6 @@ def _run_frontend_loop(args, frontend: _Frontend, mirror: _SlotMirror,
                 p["beam_width"] = np.asarray(
                     work["beam_width"], np.int32
                 )
-                p["eos_id"] = np.asarray(work["eos_id"], np.int32)
                 p["length_penalty"] = np.asarray(
                     work["length_penalty"], np.float32
                 )
@@ -1593,38 +1593,14 @@ def main() -> int:
     )
     warm_pod(mirror)
     if draft is not None:
-        # compile the spec path's whole program set inside the grace:
-        # one tiny end-to-end generation for the glue, PLUS every
-        # per-k draft/verify variant explicitly — k varies 1..speculate
-        # at request time with data-dependent acceptance, so the tiny
-        # run alone would leave unwarmed k shapes to compile mid-way
-        # through a beat-less one-shot round (the single-host warmup's
-        # exact rule, serve.py)
-        from ..models.decode import prefill
-        from ..models.speculative import (
-            _jit_draft_round,
-            _jit_verify_round,
-            speculative_generate,
-        )
+        # compile the spec path's whole program set inside the grace —
+        # one shared rule for both servers (models/speculative.py)
+        from ..models.speculative import warm_speculative
 
         draft_params, draft_cfg, spec_k = draft
-        speculative_generate(
-            params, draft_params,
-            jnp.zeros((1, 4), jnp.int32), cfg, draft_cfg,
-            max_new_tokens=spec_k + 2, max_len=args.max_len,
-            speculate=spec_k,
+        warm_speculative(
+            params, draft_params, cfg, draft_cfg, spec_k, args.max_len,
         )
-        warm_prompt = jnp.zeros((1, 4), jnp.int32)
-        _logits, tcache = prefill(params, warm_prompt, cfg,
-                                  args.max_len)
-        _dlogits, dcache = prefill(draft_params, warm_prompt,
-                                   draft_cfg, args.max_len)
-        prev = jnp.zeros((1,), jnp.int32)
-        for k in range(1, spec_k + 1):
-            _jit_draft_round(draft_cfg, k)(draft_params, dcache, prev)
-            _jit_verify_round(cfg, k + 1)(
-                params, tcache, jnp.zeros((1, k + 1), jnp.int32)
-            )
     if dog is not None:
         dog.beat()  # startup done: tighten to the serve deadline
     if frontend is not None:

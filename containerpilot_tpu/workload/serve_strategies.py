@@ -36,17 +36,18 @@ def run_beam(
 
 
 def run_speculative(
-    srv: Any, tokens: List[List[int]], max_new: int
+    srv: Any, tokens: List[List[int]], max_new: int, eos_id: int = -1
 ) -> List[List[int]]:
     """Greedy single-sequence draft-and-verify: identical output,
-    ~accepted-per-round fewer target passes."""
+    ~accepted-per-round fewer target passes (and an eos early-exit —
+    the trim would discard the tail anyway)."""
     from ..models.speculative import speculative_generate
 
     out, _stats = speculative_generate(
         srv.params, srv.draft_params,
         jnp.asarray(tokens, jnp.int32), srv.cfg,
         srv.draft_cfg, max_new_tokens=max_new,
-        max_len=srv.max_len, speculate=srv.speculate,
+        max_len=srv.max_len, speculate=srv.speculate, eos_id=eos_id,
     )
     return jax.device_get(out).tolist()
 

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Keep the one-shot TPU measurement session (tpu_session.sh) alive
+# across tunnel flaps. Every minute: if a session is running, leave it
+# alone (ONE TPU client at a time); if none is running and the round-5
+# snapshot hasn't landed, probe the device and relaunch the session
+# the moment the tunnel answers. Log to /tmp/tpu_watcher_log.txt.
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_watcher_log.txt
+SNAP_GLOB="docs/bench-snapshots/round5-*.json"
+
+note() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
+
+note "watcher started (pid $$)"
+while true; do
+    # shellcheck disable=SC2086
+    if ls $SNAP_GLOB >/dev/null 2>&1; then
+        note "snapshot present; watcher done"
+        exit 0
+    fi
+    if pgrep -f 'scripts/tpu_session.sh' >/dev/null 2>&1 \
+       || pgrep -f 'containerpilot_tpu.ops.autotune' >/dev/null 2>&1 \
+       || pgrep -f 'python bench.py' >/dev/null 2>&1; then
+        sleep 60
+        continue
+    fi
+    if timeout 120 python -c "
+import jax
+assert any(d.platform != 'cpu' for d in jax.devices())
+" >/dev/null 2>&1; then
+        note "tunnel healthy + no session running: relaunching"
+        nohup bash scripts/tpu_session.sh > /tmp/tpu_session_r5.log 2>&1 &
+        sleep 120
+    else
+        note "tunnel down; waiting"
+        sleep 180
+    fi
+done

@@ -107,6 +107,4 @@ def _clear_jax_caches_between_modules():
     cross-module recompile cost is small because modules share almost
     no shapes."""
     yield
-    import jax
-
     jax.clear_caches()

@@ -1096,6 +1096,22 @@ def test_speculative_matches_vanilla_greedy():
     with pytest.raises(ValueError, match="draft layers"):
         layer_prefix_draft(params, cfg, 3)
 
+    # eos early-exit: pick the greedy row's 3rd token as "eos" — the
+    # spec loop must stop paying rounds once a round emits it, and the
+    # prefix through that token must still match vanilla greedy exactly
+    want_row = np.asarray(want)[0].tolist()
+    eos = want_row[2]
+    cut = want_row.index(eos) + 1  # first occurrence may be earlier
+    got3, stats3 = speculative_generate(
+        params, dparams, prompt, cfg, dcfg,
+        max_new_tokens=20, max_len=40, speculate=4, eos_id=eos,
+    )
+    row3 = np.asarray(got3)[0].tolist()
+    assert eos in row3 and row3.index(eos) == cut - 1
+    assert row3[:cut] == want_row[:cut]
+    assert stats3["tokens"] < 20  # stopped early, not padded to max
+    assert stats3["rounds"] < stats["rounds"]
+
 
 def test_inference_server_end_to_end(run):
     """The serving path: warmup -> health -> generate over HTTP."""
