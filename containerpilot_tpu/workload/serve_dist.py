@@ -1419,6 +1419,21 @@ def main() -> int:
                         "KV bytes; every process quantizes "
                         "identically, so lockstep answers are still "
                         "deterministic)")
+    parser.add_argument("--moe-experts", type=int, default=0,
+                        help="switch-MoE experts; must match the "
+                        "checkpoint being served and divide by the "
+                        "model-parallel axis (experts shard over it)")
+    parser.add_argument("--int8", action="store_true",
+                        help="weight-only int8: ~4x smaller resident "
+                        "params on every host (each process quantizes "
+                        "its shards identically in lockstep)")
+    parser.add_argument("--lora-dir", default="",
+                        help="merge a trained LoRA adapter checkpoint "
+                        "into the base weights at load — restored "
+                        "through the same orbax global barriers as "
+                        "--checkpoint-dir, before any --int8")
+    parser.add_argument("--lora-rank", type=int, default=0,
+                        help="rank of the adapter in --lora-dir")
     parser.add_argument("--text", action="store_true",
                         help="byte-tokenizer /v1/completions on the "
                         "frontend (vocab must be >= 259)")
@@ -1484,6 +1499,7 @@ def main() -> int:
         n_layers=args.n_layers,
         d_ff=derive_d_ff(args.d_model),
         max_seq_len=args.max_len,
+        moe_experts=args.moe_experts,
         kv_int8=args.kv_int8,
     )
     if args.text:
@@ -1507,6 +1523,13 @@ def main() -> int:
         raise SystemExit(
             f"model axis {n_model} must divide n_heads {cfg.n_heads}"
         )
+    if cfg.moe_experts > 1 and cfg.moe_experts % n_model:
+        # experts shard over the model axis (the ep x tp layout) —
+        # every process must fail here, not mid-rendezvous
+        raise SystemExit(
+            f"model axis {n_model} must divide moe_experts "
+            f"({cfg.moe_experts})"
+        )
     mesh = make_mesh(
         jax.devices(), plan=MeshPlan(data=args.dp, model=n_model)
     )
@@ -1526,6 +1549,32 @@ def main() -> int:
             np.asarray, init_params(jax.random.PRNGKey(0), cfg)
         )
         params = shard_params_global(host_params, mesh, cfg)
+
+    from .modelcfg import validate_lora_flags
+
+    validate_lora_flags(args.lora_dir, args.lora_rank)
+    if args.lora_dir:
+        # merge BEFORE any quantization (int8 bases aren't
+        # adaptable); the orbax restore barriers keep it lockstep
+        from .modelcfg import merge_lora
+
+        params, lora_step = merge_lora(
+            params, cfg, mesh, args.lora_dir, args.lora_rank
+        )
+        if args.process_id == 0:
+            print(
+                f"pod merged lora adapter (rank {args.lora_rank}, "
+                f"step {lora_step})", flush=True,
+            )
+    if args.int8:
+        # every process quantizes its shards with the same program
+        # (scales reduce over replicated-or-sharded axes under SPMD),
+        # so lockstep dispatch stays identical
+        from ..models.quantized import quantize_model_params
+
+        params = quantize_model_params(params)
+        if args.process_id == 0:
+            print("pod int8 weight-only params", flush=True)
 
     draft = None
     if args.draft_layers > 0:
@@ -1559,6 +1608,12 @@ def main() -> int:
                 "text": args.text,
                 "stream": True,
                 "kv_int8": args.kv_int8,
+                "moe_experts": cfg.moe_experts,
+                "int8": args.int8,
+                "lora": (
+                    {"rank": args.lora_rank}
+                    if args.lora_dir else None
+                ),
                 "speculative": (
                     {
                         "draft_layers": args.draft_layers,

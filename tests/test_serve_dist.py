@@ -833,6 +833,136 @@ def test_pod_restores_checkpoint_in_lockstep(tmp_path):
             fh.close()
 
 
+def test_pod_serves_moe_int8_lora(tmp_path):
+    """The load-time model knobs compose on the pod in ONE boot:
+    ``--moe-experts`` (experts shard over the model axis, all-to-alls
+    in lockstep), ``--lora-dir`` (adapter restored through orbax's
+    global barriers and merged before quantization), and ``--int8``
+    (weight-only; every process quantizes its shards identically).
+    Byte parity against a single-device reference that applies the
+    SAME transforms in the same order to the same PRNGKey(0) init."""
+    from containerpilot_tpu.models.transformer import (
+        TransformerConfig, init_params,
+    )
+    from containerpilot_tpu.parallel import (
+        MeshPlan,
+        make_lora_train_step,
+        make_mesh,
+        restore_params,
+        save_checkpoint,
+    )
+    from containerpilot_tpu.workload.modelcfg import derive_d_ff
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1,
+        d_ff=derive_d_ff(32), max_seq_len=48, moe_experts=2,
+    )
+    one_dev = make_mesh(jax.devices()[:1], plan=MeshPlan(1, 1))
+
+    # train a tiny adapter so the merge provably changes the weights
+    lora_dir = tmp_path / "lora"
+    init_fn, step_fn, abstract = make_lora_train_step(
+        cfg, one_dev, rank=4, learning_rate=1e-2
+    )
+    state = init_fn(jax.random.PRNGKey(3))
+    base = init_params(jax.random.PRNGKey(0), cfg)  # the pod's init
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (4, 17), 0, cfg.vocab_size, jnp.int32
+    )
+    for _ in range(3):
+        state, _loss = step_fn(state, base, tokens)
+    save_checkpoint(str(lora_dir), 3, state)
+
+    model_flags = [
+        "--max-len", "48", "--d-model", "32", "--n-layers", "1",
+        "--n-heads", "2", "--vocab", "64", "--moe-experts", "2",
+        "--int8", "--lora-dir", str(lora_dir), "--lora-rank", "4",
+    ]
+    catalog_port, coord_port, http_port = (
+        _free_port(), _free_port(), _free_port()
+    )
+    env = _sub_env()
+    catalog = subprocess.Popen(
+        [sys.executable, "-m", "containerpilot_tpu",
+         "-catalog-server", f"127.0.0.1:{catalog_port}"],
+        cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    procs = []
+    logs = []
+    try:
+        _wait_catalog(catalog_port)
+        wrapper = _write_cpu_wrapper(tmp_path)
+        for pid in (0, 1):
+            fh = open(tmp_path / f"pod{pid}.log", "w")
+            logs.append(fh)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-u", str(wrapper),
+                 "--process-id", str(pid), "--num-processes", "2",
+                 "--catalog", f"127.0.0.1:{catalog_port}",
+                 "--coordinator-port", str(coord_port),
+                 "--advertise-address", "127.0.0.1",
+                 "--host", "127.0.0.1", "--port", str(http_port)]
+                + model_flags,
+                cwd=REPO, env=env, stdout=fh, stderr=subprocess.STDOUT,
+            ))
+        base_url = f"http://127.0.0.1:{http_port}"
+        _wait_pod_healthy(base_url, procs, tmp_path, 2, 240)
+
+        log0 = (tmp_path / "pod0.log").read_text()
+        assert "pod merged lora adapter (rank 4, step 3)" in log0
+        assert "pod int8 weight-only params" in log0
+
+        with urllib.request.urlopen(
+            f"{base_url}/v1/model", timeout=30
+        ) as resp:
+            info = json.loads(resp.read().decode())
+        assert info["moe_experts"] == 2 and info["int8"] is True
+        assert info["lora"] == {"rank": 4}
+
+        def post(body):
+            req = urllib.request.Request(
+                f"{base_url}/v1/generate",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=240) as resp:
+                return json.loads(resp.read().decode())
+
+        # reference: same init -> same adapter merge -> same int8
+        from containerpilot_tpu.models.lora import apply_lora
+        from containerpilot_tpu.models.quantized import (
+            quantize_model_params,
+        )
+
+        adapter, step_n = restore_params(str(lora_dir), abstract)
+        assert int(step_n) == 3
+        ref_params = quantize_model_params(
+            apply_lora(base, adapter, cfg)
+        )
+
+        greedy = post({"tokens": [[1, 2, 3]], "max_new_tokens": 6})
+        assert greedy["tokens"][0] == _reference(
+            [1, 2, 3], 6, cfg=cfg, params=ref_params
+        )
+        sampled = post({
+            "tokens": [[5, 6]], "max_new_tokens": 5,
+            "temperature": 0.7, "top_k": 12, "seed": 4,
+        })
+        assert sampled["tokens"][0] == _reference(
+            [5, 6], 5, cfg=cfg, params=ref_params,
+            temperature=0.7, top_k=12, seed=4,
+        )
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        catalog.terminate()
+        catalog.wait(timeout=10)
+        for fh in logs:
+            fh.close()
+
+
 def test_pod_watchdog_turns_wedged_follower_into_exit(tmp_path):
     """A follower that stops making progress WITHOUT dying used to
     hang the frontend's collectives forever (the serve_dist docstring
