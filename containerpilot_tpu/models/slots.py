@@ -20,9 +20,13 @@ no matter what it shared the pool with (tested).
 
 Dead slots (finished rows not yet reused) keep decoding garbage —
 static shapes — but their writes are harmless: a linear cache's
-dynamic_update_slice clamps at the boundary and the row is wholesale
-overwritten by the next admission. Emitted tokens are masked to pad
-after eos, same as ``generate``.
+dynamic_update_slice clamps at the boundary, a sliding-window config's
+ring cache (decode.py) wraps within its own row, and either way the
+row is wholesale overwritten by the next admission (``insert_row``
+replaces the full row INCLUDING its position, so a reused slot holds
+nothing of its previous occupant — what makes windows compose with
+the pool). Emitted tokens are masked to pad after eos, same as
+``generate``.
 """
 from __future__ import annotations
 

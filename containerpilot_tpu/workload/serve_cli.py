@@ -107,8 +107,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--slots", type=int, default=0,
         help="continuous decode admission: single-row requests join a "
         "running chunked decode over a pool of N slots instead of "
-        "queueing behind whole generations; 0 = off (does not "
-        "compose with --prefix-cache or --window)",
+        "queueing behind whole generations; 0 = off (composes with "
+        "--window via per-slot ring caches; does not compose with "
+        "--prefix-cache)",
     )
     parser.add_argument(
         "--slot-chunk", type=int, default=8,

@@ -57,7 +57,10 @@ Parallelism: ``--dp`` splits the global device count into a
 dp x tp mesh (params sharded over model, replicated over data), so
 tensor parallelism crosses process boundaries exactly as a real pod's
 does. ``--kv-int8`` serves with the int8 KV cache (half the KV bytes;
-identical quantized numerics on every process).
+identical quantized numerics on every process); ``--window`` serves
+sliding-window attention over per-slot ring caches (KV memory bounded
+by the window, not --max-len) — both are static model config, so
+every process's lockstep dispatch is unchanged.
 
     python -m containerpilot_tpu.workload.serve_dist \
         --process-id 0 --num-processes 2 --catalog 127.0.0.1:8500 \
@@ -1419,6 +1422,14 @@ def main() -> int:
                         "KV bytes; every process quantizes "
                         "identically, so lockstep answers are still "
                         "deterministic)")
+    parser.add_argument("--window", type=int, default=0,
+                        help="sliding-window attention: each slot's "
+                        "KV cache is a ring of min(window, max_len) "
+                        "entries, bounding decode KV memory by the "
+                        "window instead of max_len (0 = full "
+                        "attention). Static config, so lockstep "
+                        "dispatch is unchanged; composes with "
+                        "--kv-int8 but not --draft-layers")
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="switch-MoE experts; must match the "
                         "checkpoint being served and divide by the "
@@ -1474,6 +1485,17 @@ def main() -> int:
 
     if args.slots < 1 or args.stream_chunk < 1:
         raise SystemExit("--slots and --stream-chunk must be >= 1")
+    if args.window < 0:
+        raise SystemExit("--window must be >= 0")
+    if args.window > 0 and args.draft_layers > 0:
+        # same composition rule as the single-host server
+        # (workload/serve.py): speculative rollback cannot undo
+        # ring-cache writes. Checked BEFORE rendezvous so every
+        # process fails at startup, not mid-collective.
+        raise SystemExit(
+            "--draft-layers does not compose with --window "
+            "(speculative rollback cannot undo ring-cache writes)"
+        )
     if 4 + args.stream_chunk + 1 > args.max_len:
         # warmup pushes a 4-id prompt + chunk+1 tokens through the
         # pool; a legal but tiny --max-len must fail loudly HERE
@@ -1501,6 +1523,7 @@ def main() -> int:
         max_seq_len=args.max_len,
         moe_experts=args.moe_experts,
         kv_int8=args.kv_int8,
+        window=args.window,
     )
     if args.text:
         from .text import ByteTokenizer
@@ -1608,6 +1631,7 @@ def main() -> int:
                 "text": args.text,
                 "stream": True,
                 "kv_int8": args.kv_int8,
+                "window": args.window or None,
                 "moe_experts": cfg.moe_experts,
                 "int8": args.int8,
                 "lora": (

@@ -95,13 +95,13 @@ class SlotEngine:
     ) -> None:
         if slots < 1 or chunk < 1:
             raise ValueError("slots and chunk must be >= 1")
-        if cfg.window > 0:
-            # a freed ring slot still holds live window context for
-            # its old row; re-admission would need a ring reset per
-            # slot — same reason the prefix cache rejects windows
-            raise ValueError(
-                "slot engine does not compose with sliding windows"
-            )
+        # sliding windows (cfg.window > 0) compose: each slot's ring
+        # cache is row-local, and admission writes the freshly
+        # prefilled row WHOLESALE (insert_row dynamic_update_slices
+        # the entire [layers, 1, ring, kv, hd] row plus its pos), so
+        # a reused slot carries zero context from its previous
+        # occupant — byte parity incl. re-admission is tested in
+        # tests/test_slots.py::test_window_*
         self.cfg = cfg
         self.params = params
         self.max_len = max_len

@@ -837,10 +837,14 @@ def test_pod_serves_moe_int8_lora(tmp_path):
     """The load-time model knobs compose on the pod in ONE boot:
     ``--moe-experts`` (experts shard over the model axis, all-to-alls
     in lockstep), ``--lora-dir`` (adapter restored through orbax's
-    global barriers and merged before quantization), and ``--int8``
-    (weight-only; every process quantizes its shards identically).
-    Byte parity against a single-device reference that applies the
-    SAME transforms in the same order to the same PRNGKey(0) init."""
+    global barriers and merged before quantization), ``--int8``
+    (weight-only; every process quantizes its shards identically),
+    and ``--window`` (sliding-window attention: the pod's slot pool
+    runs per-slot ring caches). The greedy request below decodes past
+    the window boundary (3 prompt + 6 new > window 8), so the ring
+    actually wraps. Byte parity against a single-device reference
+    that applies the SAME transforms in the same order to the same
+    PRNGKey(0) init."""
     from containerpilot_tpu.models.transformer import (
         TransformerConfig, init_params,
     )
@@ -856,6 +860,7 @@ def test_pod_serves_moe_int8_lora(tmp_path):
     cfg = TransformerConfig(
         vocab_size=64, d_model=32, n_heads=2, n_layers=1,
         d_ff=derive_d_ff(32), max_seq_len=48, moe_experts=2,
+        window=8,
     )
     one_dev = make_mesh(jax.devices()[:1], plan=MeshPlan(1, 1))
 
@@ -877,6 +882,7 @@ def test_pod_serves_moe_int8_lora(tmp_path):
         "--max-len", "48", "--d-model", "32", "--n-layers", "1",
         "--n-heads", "2", "--vocab", "64", "--moe-experts", "2",
         "--int8", "--lora-dir", str(lora_dir), "--lora-rank", "4",
+        "--window", "8",
     ]
     catalog_port, coord_port, http_port = (
         _free_port(), _free_port(), _free_port()
@@ -919,6 +925,7 @@ def test_pod_serves_moe_int8_lora(tmp_path):
             info = json.loads(resp.read().decode())
         assert info["moe_experts"] == 2 and info["int8"] is True
         assert info["lora"] == {"rank": 4}
+        assert info["window"] == 8
 
         def post(body):
             req = urllib.request.Request(

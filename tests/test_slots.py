@@ -145,12 +145,50 @@ def test_chunk_failure_recovers_pool(params):
         eng.stop()
 
 
-def test_window_rejected(params):
+@pytest.fixture(scope="module")
+def window_setup():
+    """A sliding-window engine (ring caches, decode.py) plus params
+    for the SAME windowed config — the solo reference must run the
+    identical ring-cache path."""
     import dataclasses
 
     cfg = dataclasses.replace(CFG, window=8)
-    with pytest.raises(ValueError, match="window"):
-        SlotEngine(cfg, params, MAX_LEN, slots=2, chunk=2)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    eng = SlotEngine(cfg, params, MAX_LEN, slots=2, chunk=3)
+    yield cfg, params, eng
+    eng.stop()
+
+
+def test_window_long_prompt_and_decode_cross_the_ring(window_setup):
+    """Prompt longer than the window AND decode past the wrap point:
+    every ring overwrite the engine performs matches solo generate."""
+    cfg, params, eng = window_setup
+    tokens = list(range(1, 13))  # 12 > window 8
+    got = eng.submit(tokens, max_new=9).result(timeout=180)
+    assert got == _solo(params, tokens, 9, cfg=cfg)
+
+
+def test_window_slot_reuse_carries_no_stale_context(window_setup):
+    """The historical hazard: a freed ring slot's cache rows are NOT
+    zeroed, so re-admission must prove the wholesale row overwrite
+    (insert_row) leaves nothing of the previous occupant. Fill both
+    slots, finish them, then reuse with fresh prompts."""
+    cfg, params, eng = window_setup
+    first = [
+        eng.submit([1, 2, 3, 4, 5, 6, 7, 8, 9], max_new=6, seed=1),
+        eng.submit([9, 8, 7], max_new=6, seed=2),
+    ]
+    for fut in first:
+        fut.result(timeout=180)
+    reused = [
+        ([5, 4, 3, 2], dict(max_new=10, seed=7)),
+        ([2, 2], dict(max_new=10, temperature=0.8, top_k=16, seed=4)),
+    ]
+    futs = [eng.submit(p, **kw) for p, kw in reused]
+    for (p, kw), fut in zip(reused, futs):
+        assert fut.result(timeout=180) == _solo(
+            params, p, kw.pop("max_new"), cfg=cfg, **kw
+        )
 
 
 def test_stats_and_stop(params):
