@@ -4,7 +4,12 @@ fallback (custom VJP), and ring/context-parallel."""
 from .attention import causal_attention
 from .flash import flash_attention, flash_attention_forward
 from .flash_training import memory_efficient_attention
-from .quant import int8_matmul, int8_matmul_pallas, quantize_int8
+from .quant import (
+    int8_matmul,
+    int8_matmul_padded,
+    int8_matmul_pallas,
+    quantize_int8,
+)
 from .ring_attention import ring_attention
 
 __all__ = [
@@ -16,4 +21,5 @@ __all__ = [
     "quantize_int8",
     "int8_matmul",
     "int8_matmul_pallas",
+    "int8_matmul_padded",
 ]

@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Round-5 second-pass watcher: the first session landed the tuned
+# table and the attention numbers but training/int8/decode failed on
+# tunnel flake + two first-exposure bench bugs (fixed since). Loop:
+# when the tunnel answers and no session is running, re-run the FULL
+# bench (tuned routing, fixed int8 padded path, split decode/admission
+# benches) and overwrite the round-5 snapshot ONLY when the training
+# bench produced an mfu (the headline the round needs). Log to
+# /tmp/tpu_watcher_b_log.txt.
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_watcher_b_log.txt
+SNAP=docs/bench-snapshots/round5-tpu-v5-lite.json
+DONE=/tmp/tpu_round5b_done
+
+note() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
+
+note "watcher-b started (pid $$)"
+while true; do
+    if [ -e "$DONE" ]; then
+        note "done marker present; watcher-b exiting"
+        exit 0
+    fi
+    if pgrep -f 'python bench.py' >/dev/null 2>&1; then
+        sleep 60
+        continue
+    fi
+    if timeout 120 python -c "
+import jax
+assert any(d.platform != 'cpu' for d in jax.devices())
+" >/dev/null 2>&1; then
+        note "tunnel healthy: running bench"
+        if timeout 12600 python bench.py > /tmp/bench_out_b.json 2>/tmp/bench_err_b.log; then
+            if python - <<'EOF'
+import json, sys
+j = json.load(open("/tmp/bench_out_b.json"))
+t = j.get("extras", {}).get("training", {})
+sys.exit(0 if "mfu" in t else 1)
+EOF
+            then
+                cp /tmp/bench_out_b.json "$SNAP"
+                touch "$DONE"
+                note "bench succeeded with mfu; snapshot updated; done"
+                exit 0
+            else
+                note "bench ran but no training mfu; will retry"
+            fi
+        else
+            note "bench run failed/timed out; will retry"
+        fi
+        sleep 60
+    else
+        note "tunnel down; waiting"
+        sleep 180
+    fi
+done

@@ -1463,7 +1463,7 @@ def test_decode_bench_plumbing():
     assert out["b1_tok_s"] > 0 and out["b8_tok_s"] > 0
     assert out["batch_throughput_x"] > 0
     assert "override" in out["model"]
-    adm = out["slot_admission"]
+    adm = bench.slot_admission_bench(cfg, max_new=8, prompt_len=16)
     assert adm["short_latency_ms_sequential"] > 0
     assert adm["short_latency_ms_slots"] > 0
     assert adm["admission_speedup_x"] > 0
