@@ -169,11 +169,15 @@ def training_bench() -> dict:
         }
 
     # remat policies trade HBM for recompute; measure what fits and
-    # headline the best. ONLY deterministic failures are swallowed
-    # per-variant (OOM is a data point, not a failure); anything else
-    # (e.g. a transient tunnel RPC error) propagates so the caller's
+    # headline the best. EVERY per-variant failure is recorded and the
+    # loop continues — a transient tunnel RPC error on variant 3 must
+    # not discard variants 1-2's measurements (that is exactly how the
+    # first round-5 run lost its MFU). If NO variant measured and at
+    # least one failure looked transient (not OOM/Value/Type), the
+    # last such error re-raises so the caller's subprocess-level
     # wedge retry still applies.
     variants: dict = {}
+    transient: Exception | None = None
     for name, remat, loss_chunk in (
         ("full", True, 0),
         ("dots", "dots", 0),
@@ -192,9 +196,15 @@ def training_bench() -> dict:
                 or isinstance(exc, (ValueError, TypeError))
             )
             if not deterministic:
-                raise
+                transient = exc
             variants[name] = {"error": msg[:300]}
     ok = {k: v for k, v in variants.items() if "mfu" in v}
+    if not ok and transient is not None:
+        raise transient
+    # partial run: some variants measured, others died on transient
+    # infra errors. Mark it so the artifact can't read as a complete
+    # sweep (best_remat/meets_target below cover only what measured).
+    partial = {"transient_failures": True} if transient is not None else {}
     if not ok:
         # deliberately NOT the top-level "error" key: per-variant
         # failures here are deterministic (OOM, bad config), and the
@@ -210,6 +220,7 @@ def training_bench() -> dict:
         "seq": seq,
         "remat_variants": variants,
         "best_remat": best_name,
+        **partial,
         **best,
         # the stated perf contract (docs/50-workload.md "MFU target"):
         # the measurement carries its own verdict so the artifact is
