@@ -118,6 +118,127 @@ def _cp_prefill_fn(cfg: TransformerConfig, mesh: Mesh, max_len: int,
     return jax.jit(fn)
 
 
+def cp_head_buckets(cp_min_len: int, max_len: int, axis: int):
+    """The static set of ring-head lengths a multi-process server
+    compiles AT STARTUP: the smallest axis-divisible length that can
+    satisfy cp_min_len, then doubling below max_len.
+
+    Why static: a ring program's ppermute needs a cross-process
+    communicator whose initialization carries a hard ~30s deadline
+    (observed as 'Gloo context initialization failed: GetKeyValue()
+    timed out' killing a live pod when two processes compiled a
+    first-use ring program with >30s skew). Replicated programs can
+    compile per-shape at request time — compile skew just delays the
+    slower process — but COLLECTIVE programs must all exist before
+    traffic, which means their shape set must be finite. Heads
+    bucket; the (local, collective-free) remainder extend stays
+    per-length."""
+    if axis < 2:
+        return []
+    floor = max(cp_min_len - cp_min_len % axis, axis)
+    out = []
+    b = floor
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    return out
+
+
+def pick_cp_head(plen: int, buckets) -> int:
+    """Largest startup-compiled ring head that fits the prompt
+    (0 = none fits; take the plain path)."""
+    head = 0
+    for b in buckets:
+        if b <= plen:
+            head = b
+    return head
+
+
+def cp_prefill_with_remainder(
+    params,
+    prompt_host,
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    max_len: int,
+    axis_name: str = "seq",
+    head: int = 0,
+):
+    """The ONE copy of the cp prefill recipe both ``cp_generate`` and
+    the pod's slot admission (workload/serve_dist.py) run: a HEAD of
+    the prompt rings through prefill sharded over ``axis_name``, the
+    remainder extends the gathered cache with one (local,
+    collective-free) chunk. Returns (last logits, cache), both
+    replicated.
+
+    ``head`` = 0 takes the largest axis-divisible head (the
+    single-process ``cp_generate`` default — maximal ring work); a
+    multi-process pod passes a STARTUP-COMPILED bucket from
+    ``cp_head_buckets`` instead, because a first-use ring program's
+    communicator init has a hard ~30s deadline that request-time
+    compile skew between processes can blow (see cp_head_buckets).
+
+    ``prompt_host`` is a host array ([1, plen], identical on every
+    process); placement uses ``make_array_from_callback`` so the same
+    code serves single-process meshes and multi-host pods (where a
+    plain device_put of a global sharding is not allowed)."""
+    import numpy as np
+
+    plen = int(prompt_host.shape[1])
+    axis = mesh.shape[axis_name]
+    if head == 0:
+        head = plen - plen % axis
+    if head <= 0:
+        raise ValueError(
+            f"prompt len {plen} is shorter than the {axis_name} axis "
+            f"({axis}): nothing to shard — use the plain path"
+        )
+    if head % axis or head > plen:
+        raise ValueError(
+            f"head {head} must be a multiple of the {axis_name} axis "
+            f"({axis}) and <= prompt len {plen}"
+        )
+    head_host = np.ascontiguousarray(prompt_host[:, :head], np.int32)
+    sharding = NamedSharding(mesh, P(None, axis_name))
+    sharded = jax.make_array_from_callback(
+        head_host.shape, sharding, lambda idx: head_host[idx]
+    )
+    logits, cache = _cp_prefill_fn(cfg, mesh, max_len, axis_name)(
+        params, sharded
+    )
+    # Extend the remainder in power-of-two chunks down to a < axis
+    # tail, NOT one remainder-length call: a bucketed head can leave a
+    # remainder up to head-1 tokens, and a single extend of that would
+    # (a) compile one program per distinct remainder length —
+    # unbounded shape set — and (b) run one local chunk-x-cache
+    # attention at up to half the full quadratic prefill, defeating
+    # the memory bound cp exists to provide. The chunk shapes here are
+    # data-independent: {2^k : axis <= 2^k} plus the < axis tail
+    # lengths — finite, so a long-lived server stops compiling. With a
+    # maximal head (head == plen - plen % axis, the cp_generate
+    # default) the remainder is < axis and this loop is exactly the
+    # original one-tiny-chunk behavior.
+    if head < plen:
+        from ..models.decode import _jitted_extend
+
+        pos = head
+        extend = _jitted_extend(cfg)
+        while pos < plen:
+            left = plen - pos
+            step = left
+            if left >= axis:
+                step = 1
+                while step * 2 <= left:
+                    step *= 2
+            logits, cache = extend(
+                params, cache,
+                jax.numpy.asarray(
+                    prompt_host[:, pos:pos + step], jax.numpy.int32
+                ),
+            )
+            pos += step
+    return logits, cache
+
+
 def cp_generate(
     params,
     prompt: jax.Array,
@@ -162,18 +283,14 @@ def cp_generate(
             f"prompt_len {plen} + max_new_tokens {max_new_tokens} "
             f"exceeds max_len {max_len}"
         )
-    from ..models.decode import _jitted_extend, generate_from_cache
+    import numpy as np
 
-    sharded_head = jax.device_put(
-        prompt[:, :head], NamedSharding(mesh, P(None, axis_name))
+    from ..models.decode import generate_from_cache
+
+    logits, cache = cp_prefill_with_remainder(
+        params, np.asarray(jax.device_get(prompt)), cfg, mesh,
+        max_len, axis_name,
     )
-    logits, cache = _cp_prefill_fn(cfg, mesh, max_len, axis_name)(
-        params, sharded_head
-    )
-    if head < plen:
-        logits, cache = _jitted_extend(cfg)(
-            params, cache, prompt[:, head:]
-        )
     return generate_from_cache(
         params, cache, logits, cfg, max_new_tokens, pos=plen,
         **sampling,

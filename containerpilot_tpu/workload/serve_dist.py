@@ -60,7 +60,12 @@ does. ``--kv-int8`` serves with the int8 KV cache (half the KV bytes;
 identical quantized numerics on every process); ``--window`` serves
 sliding-window attention over per-slot ring caches (KV memory bounded
 by the window, not --max-len) — both are static model config, so
-every process's lockstep dispatch is unchanged.
+every process's lockstep dispatch is unchanged. ``--sp`` adds a seq
+axis (dp x sp x tp mesh): prompts at least ``--cp-min-len`` long ring
+their prefill over it (parallel/context.py — per-device activation
+memory bounded by prompt/sp), then decode on the replicated slot
+pool; the cp decision reads only static flags plus the broadcast
+plen, so it is lockstep by construction.
 
     python -m containerpilot_tpu.workload.serve_dist \
         --process-id 0 --num-processes 2 --catalog 127.0.0.1:8500 \
@@ -186,7 +191,8 @@ class _SlotMirror:
     host-side."""
 
     def __init__(self, cfg, params, max_len: int, slots: int,
-                 chunk: int, mesh=None) -> None:
+                 chunk: int, mesh=None, sp: int = 1,
+                 cp_min_len: int = 0) -> None:
         from ..models.slots import slot_cache
 
         self.cfg = cfg
@@ -194,6 +200,27 @@ class _SlotMirror:
         self.max_len = max_len
         self.slots = slots
         self.chunk = chunk
+        self.mesh = mesh
+        # context-parallel admission (``--sp``): prompts at least
+        # cp_min_len long ring a STARTUP-COMPILED head bucket over the
+        # mesh's seq axis and extend the remainder locally
+        # (parallel/context.py — ring programs are the pod's only
+        # cross-process collectives outside the broadcast, and a
+        # first-use collective's communicator init has a hard ~30s
+        # deadline request-time compile skew can blow, so every ring
+        # shape must exist before traffic; see cp_head_buckets). Both
+        # knobs are static flags and plen rides the broadcast, so
+        # every process picks the same path — lockstep by
+        # construction.
+        self.sp = sp
+        self.cp_min_len = cp_min_len
+        self.cp_buckets = ()
+        if sp > 1:
+            from ..parallel.context import cp_head_buckets
+
+            self.cp_buckets = tuple(
+                cp_head_buckets(cp_min_len, max_len, sp)
+            )
         self.rep = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
@@ -248,10 +275,32 @@ class _SlotMirror:
 
         slot = int(payload["admit_slot"])
         plen = int(payload["plen"])
-        prompt = jnp.asarray(payload["prompt"][None, :plen], jnp.int32)
-        logits, row_cache = _jitted_prefill(self.cfg, self.max_len)(
-            self.params, prompt
-        )
+        # context-parallel admission: the quadratic prefill of a long
+        # prompt rings over the seq axis (each device holds head/sp
+        # tokens), the cache leaves the ring replicated — exactly the
+        # mirror's layout — and any non-axis-divisible remainder
+        # extends it with one short chunk (parallel/context.py's
+        # cp_generate recipe, minus its decode half: the slot pool IS
+        # the decode half here).
+        cp_head = 0
+        if self.sp > 1 and plen >= self.cp_min_len:
+            from ..parallel.context import pick_cp_head
+
+            cp_head = pick_cp_head(plen, self.cp_buckets)
+        if cp_head > 0:
+            from ..parallel.context import cp_prefill_with_remainder
+
+            logits, row_cache = cp_prefill_with_remainder(
+                self.params, payload["prompt"][None, :plen],
+                self.cfg, self.mesh, self.max_len, head=cp_head,
+            )
+        else:
+            prompt = jnp.asarray(
+                payload["prompt"][None, :plen], jnp.int32
+            )
+            logits, row_cache = _jitted_prefill(
+                self.cfg, self.max_len
+            )(self.params, prompt)
         row_key = jax.random.fold_in(
             jax.random.PRNGKey(int(payload["seed"])),
             int(payload["row_idx"]),
@@ -1017,6 +1066,24 @@ def warm_pod(mirror: _SlotMirror) -> None:
     warm_score = _payload_zeros(mirror.max_len, mirror.slots)
     warm_score["plen"] = np.asarray(5, np.int32)
     _score_pod(mirror.params, mirror.cfg, warm_score, mirror.max_len)
+    # EVERY cp ring program compiles here, inside the startup grace
+    # where the pod is freshly rendezvous-synchronized: ring prefills
+    # are the pod's only cross-process collectives outside the
+    # broadcast, and a first-use collective program's communicator
+    # init has a hard ~30s deadline that request-time compile skew
+    # between processes blows (observed killing a live pod). The
+    # remainder extend and plain prefill stay per-length request-time
+    # compiles — they are local programs, where skew only delays.
+    if mirror.cp_buckets:
+        from ..parallel.context import cp_prefill_with_remainder
+
+        for head in mirror.cp_buckets:
+            warm_prompt = np.zeros((1, head), np.int32)
+            logits_cp, cache_cp = cp_prefill_with_remainder(
+                mirror.params, warm_prompt, mirror.cfg, mirror.mesh,
+                mirror.max_len, head=head,
+            )
+            jax.block_until_ready((logits_cp, cache_cp))
 
 
 def _run_frontend_loop(args, frontend: _Frontend, mirror: _SlotMirror,
@@ -1448,6 +1515,19 @@ def main() -> int:
     parser.add_argument("--text", action="store_true",
                         help="byte-tokenizer /v1/completions on the "
                         "frontend (vocab must be >= 259)")
+    parser.add_argument("--sp", type=int, default=1,
+                        help="context-parallel admission: a seq axis "
+                        "of this many devices rings long-prompt "
+                        "prefills (ops/ring_attention.py) so prefill "
+                        "activation memory is bounded by prompt/sp "
+                        "per device; decode stays on the replicated "
+                        "slot pool. Composes with --dp and tensor "
+                        "parallelism (dp x sp x tp mesh); not with "
+                        "--window or --draft-layers")
+    parser.add_argument("--cp-min-len", type=int, default=0,
+                        help="minimum prompt length that rings over "
+                        "the seq axis (shorter prompts prefill "
+                        "replicated); 0 derives the seq axis size")
     parser.add_argument("--dp", type=int, default=1,
                         help="data-parallel axis size: the global "
                         "device count factors as (dp, devices/dp) — "
@@ -1487,6 +1567,49 @@ def main() -> int:
         raise SystemExit("--slots and --stream-chunk must be >= 1")
     if args.window < 0:
         raise SystemExit("--window must be >= 0")
+    if args.dp < 1 or args.sp < 1:
+        raise SystemExit("--dp and --sp must be >= 1")
+    if args.sp > 1 and args.window > 0:
+        raise SystemExit(
+            "--sp does not compose with --window (ring attention "
+            "rejects sliding windows)"
+        )
+    if args.sp > 1 and args.draft_layers > 0:
+        raise SystemExit(
+            "--sp does not compose with --draft-layers (speculative "
+            "prefill is chunk-driven)"
+        )
+    cp_min_len = args.cp_min_len
+    if args.sp <= 1 and cp_min_len:
+        raise SystemExit("--cp-min-len requires --sp > 1")
+    if args.sp > 1:
+        # same derivation/clamp/never-engages rules as the
+        # single-host server (workload/serve.py InferenceServer)
+        if args.sp >= args.max_len:
+            # no admissible prompt can cover the axis: cp could never
+            # engage no matter the threshold
+            raise SystemExit(
+                f"--sp never engages: the seq axis ({args.sp}) is "
+                f"not below --max-len ({args.max_len})"
+            )
+        if cp_min_len == 0:
+            # unset: default to something that amortizes a ring,
+            # self-clamped so the derived default always CAN engage
+            cp_min_len = min(8 * args.sp, args.max_len - 1)
+        elif cp_min_len < args.sp:
+            # an explicit value below the axis is unusable (the
+            # prompt's head must cover the axis) — honor the user's
+            # intent by clamping to the floor, not silently
+            # overriding with the default
+            cp_min_len = args.sp
+        elif cp_min_len >= args.max_len:
+            # the user's own threshold excludes every admissible
+            # prompt: fail at startup, not as a feature that silently
+            # never runs
+            raise SystemExit(
+                f"--sp never engages: --cp-min-len {cp_min_len} >= "
+                f"--max-len {args.max_len}"
+            )
     if args.window > 0 and args.draft_layers > 0:
         # same composition rule as the single-host server
         # (workload/serve.py): speculative rollback cannot undo
@@ -1537,11 +1660,12 @@ def main() -> int:
                 f"{args.vocab}"
             )
     n_global = jax.device_count()
-    if args.dp < 1 or n_global % args.dp:
+    if n_global % (args.dp * args.sp):
         raise SystemExit(
-            f"--dp {args.dp} must divide the {n_global} global devices"
+            f"--dp {args.dp} x --sp {args.sp} must divide the "
+            f"{n_global} global devices"
         )
-    n_model = n_global // args.dp
+    n_model = n_global // (args.dp * args.sp)
     if cfg.n_heads % n_model:
         raise SystemExit(
             f"model axis {n_model} must divide n_heads {cfg.n_heads}"
@@ -1554,7 +1678,8 @@ def main() -> int:
             f"({cfg.moe_experts})"
         )
     mesh = make_mesh(
-        jax.devices(), plan=MeshPlan(data=args.dp, model=n_model)
+        jax.devices(),
+        plan=MeshPlan(data=args.dp, model=n_model, seq=args.sp),
     )
     if args.checkpoint_dir:
         from .modelcfg import restore_params_only
@@ -1652,9 +1777,18 @@ def main() -> int:
                 "pod": {
                     "num_processes": args.num_processes,
                     "devices": n_global,
-                    "mesh": {"data": args.dp, "model": n_model},
+                    "mesh": {
+                        "data": args.dp, "seq": args.sp,
+                        "model": n_model,
+                    },
                     "watchdog_s": args.watchdog or None,
                 },
+                # same JSON shape as the single-host /v1/model cp
+                # block (workload/serve.py) so clients read one schema
+                "cp": (
+                    {"seq": args.sp, "min_len": cp_min_len}
+                    if args.sp > 1 else None
+                ),
             },
         )
         frontend.start()
@@ -1668,7 +1802,7 @@ def main() -> int:
     # the no-post-grace-compiles invariant)
     mirror = _SlotMirror(
         cfg, params, args.max_len, args.slots, args.stream_chunk,
-        mesh=mesh,
+        mesh=mesh, sp=args.sp, cp_min_len=cp_min_len,
     )
     warm_pod(mirror)
     if draft is not None:

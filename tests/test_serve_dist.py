@@ -416,7 +416,7 @@ def test_pod_serves_http(tmp_path, n_procs, dp):
         ).read().decode())
         assert info["pod"]["num_processes"] == n_procs
         assert info["pod"]["mesh"] == {
-            "data": dp, "model": n_procs // dp,
+            "data": dp, "seq": 1, "model": n_procs // dp,
         }
         assert info["slot_engine"]["slots"] == 4
         time.sleep(1)  # let the disconnected stream's close land
@@ -968,6 +968,167 @@ def test_pod_serves_moe_int8_lora(tmp_path):
         catalog.wait(timeout=10)
         for fh in logs:
             fh.close()
+
+
+def test_pod_serves_cp_long_prompt(tmp_path):
+    """``--sp``: context-parallel admission on the pod. Long prompts
+    ring their prefill over a 2-process seq axis (each process holds
+    half the prompt's activations) and then decode on the replicated
+    slot pool; short prompts take the plain path. The reference for
+    the cp path is ``cp_generate`` on an IN-PROCESS seq=2 mesh — ring
+    numerics against ring numerics, so parity is exact (plain-prefill
+    references would differ by the ring's softmax reassociation under
+    bf16). Also covered: the non-axis-divisible remainder (one extend
+    chunk), /v1/model topology, and the --sp composition rejections."""
+    from containerpilot_tpu.models.decode import generate_from_cache
+    from containerpilot_tpu.models.transformer import (
+        TransformerConfig, init_params,
+    )
+    from containerpilot_tpu.parallel import MeshPlan, make_mesh
+    from containerpilot_tpu.parallel.context import (
+        cp_head_buckets,
+        cp_prefill_with_remainder,
+        pick_cp_head,
+    )
+    from containerpilot_tpu.workload.modelcfg import derive_d_ff
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    max_len = 96
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1,
+        d_ff=derive_d_ff(32), max_seq_len=max_len,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ref_mesh = make_mesh(
+        jax.devices()[:2], plan=MeshPlan(data=1, model=1, seq=2)
+    )
+    # the pod's exact recipe: startup-bucketed ring head + local
+    # remainder extend + decode from the gathered cache (ring numerics
+    # against ring numerics — a plain-prefill reference would differ
+    # by the ring's softmax reassociation under bf16)
+    buckets = cp_head_buckets(24, max_len, 2)
+    assert buckets == [24, 48]
+
+    def cp_ref(tokens, max_new, seed=0, **kw):
+        head = pick_cp_head(len(tokens), buckets)
+        assert head > 0
+        logits, cache = cp_prefill_with_remainder(
+            params, np.asarray([tokens], np.int32), cfg, ref_mesh,
+            max_len, head=head,
+        )
+        out = generate_from_cache(
+            params, cache, logits, cfg, max_new, pos=len(tokens),
+            rng=jnp.stack(
+                [jax.random.fold_in(jax.random.PRNGKey(seed), 0)]
+            ),
+            **kw,
+        )
+        rows = [[int(t) for t in np.asarray(out)[0]]]
+        return InferenceServer._trim(rows, max_new, -1)[0]
+
+    model_flags = [
+        "--max-len", str(max_len), "--d-model", "32",
+        "--n-layers", "1", "--n-heads", "2", "--vocab", "64",
+        "--sp", "2", "--cp-min-len", "24",
+    ]
+    catalog_port, coord_port, http_port = (
+        _free_port(), _free_port(), _free_port()
+    )
+    env = _sub_env()
+    catalog = subprocess.Popen(
+        [sys.executable, "-m", "containerpilot_tpu",
+         "-catalog-server", f"127.0.0.1:{catalog_port}"],
+        cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    procs = []
+    logs = []
+    try:
+        _wait_catalog(catalog_port)
+        wrapper = _write_cpu_wrapper(tmp_path)
+        for pid in (0, 1):
+            fh = open(tmp_path / f"pod{pid}.log", "w")
+            logs.append(fh)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-u", str(wrapper),
+                 "--process-id", str(pid), "--num-processes", "2",
+                 "--catalog", f"127.0.0.1:{catalog_port}",
+                 "--coordinator-port", str(coord_port),
+                 "--advertise-address", "127.0.0.1",
+                 "--host", "127.0.0.1", "--port", str(http_port)]
+                + model_flags,
+                cwd=REPO, env=env, stdout=fh, stderr=subprocess.STDOUT,
+            ))
+        base_url = f"http://127.0.0.1:{http_port}"
+        _wait_pod_healthy(base_url, procs, tmp_path, 2, 240)
+
+        with urllib.request.urlopen(
+            f"{base_url}/v1/model", timeout=30
+        ) as resp:
+            info = json.loads(resp.read().decode())
+        assert info["cp"] == {"seq": 2, "min_len": 24}
+        assert info["pod"]["mesh"] == {"data": 1, "seq": 2, "model": 1}
+
+        def post(body):
+            req = urllib.request.Request(
+                f"{base_url}/v1/generate",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=240) as resp:
+                return json.loads(resp.read().decode())
+
+        # 40 tokens with buckets [24, 48]: head 24 rings, the
+        # 16-token remainder extends locally in one 16-chunk
+        long_even = [(i * 7 + 3) % 64 for i in range(40)]
+        got = post({"tokens": [long_even], "max_new_tokens": 8})
+        assert got["tokens"][0] == cp_ref(long_even, 8)
+
+        # 41 tokens: head 24 rings, remainder 17 extends as 16 + 1
+        # (the power-of-two decomposition's < axis tail)
+        long_odd = long_even + [11]
+        got = post({"tokens": [long_odd], "max_new_tokens": 8})
+        assert got["tokens"][0] == cp_ref(long_odd, 8)
+
+        # the sampling contract rides the cp admission unchanged
+        sampled = post({
+            "tokens": [long_even], "max_new_tokens": 6,
+            "temperature": 0.8, "top_k": 12, "seed": 9,
+        })
+        assert sampled["tokens"][0] == cp_ref(
+            long_even, 6, seed=9, temperature=0.8, top_k=12,
+        )
+
+        # short prompts stay on the plain replicated path
+        short = post({"tokens": [[1, 2, 3]], "max_new_tokens": 6})
+        assert short["tokens"][0] == _reference(
+            [1, 2, 3], 6, cfg=cfg, params=params
+        )
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        catalog.terminate()
+        catalog.wait(timeout=10)
+        for fh in logs:
+            fh.close()
+
+    # composition rejections fail fast, before any rendezvous
+    for extra, msg in (
+        (["--window", "8"], b"--sp does not compose with --window"),
+        (["--draft-layers", "1"],
+         b"--sp does not compose with --draft-layers"),
+    ):
+        res = subprocess.run(
+            [sys.executable, str(_write_cpu_wrapper(tmp_path)),
+             "--process-id", "0", "--num-processes", "2",
+             "--catalog", "127.0.0.1:1", "--sp", "2"] + extra
+            + ["--max-len", "96", "--d-model", "32", "--n-layers",
+               "2", "--n-heads", "2", "--vocab", "64"],
+            cwd=REPO, env=_sub_env(), capture_output=True, timeout=120,
+        )
+        assert res.returncode != 0
+        assert msg in res.stderr + res.stdout
 
 
 def test_pod_watchdog_turns_wedged_follower_into_exit(tmp_path):
