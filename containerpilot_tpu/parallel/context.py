@@ -118,6 +118,38 @@ def _cp_prefill_fn(cfg: TransformerConfig, mesh: Mesh, max_len: int,
     return jax.jit(fn)
 
 
+def resolve_cp_min_len(cp_min_len: int, seq_axis: int, max_len: int,
+                       flag: str = "cp") -> int:
+    """The ONE copy of the cp threshold policy both servers apply
+    (workload/serve.py and serve_dist.py): derive an unset threshold
+    to something that amortizes a ring (self-clamped so it always CAN
+    engage), clamp an explicit value below the axis up to the floor
+    (the prompt's head must cover the axis), and refuse configurations
+    where cp could never engage. Raises ValueError (callers map to
+    their own exit types)."""
+    if seq_axis >= max_len:
+        # no admissible prompt can cover the axis: cp could never
+        # engage no matter the threshold
+        raise ValueError(
+            f"--{flag} never engages: the seq axis ({seq_axis}) is "
+            f"not below max_len ({max_len})"
+        )
+    if cp_min_len == 0:
+        return min(8 * seq_axis, max_len - 1)
+    if cp_min_len < seq_axis:
+        return seq_axis
+    if cp_min_len >= max_len:
+        # the user's own threshold excludes every admissible prompt
+        # (prompt_len + max_new <= max_len): fail at startup, not as
+        # a feature that silently never runs
+        raise ValueError(
+            f"--{flag} never engages: cp_min_len {cp_min_len} >= "
+            f"max_len {max_len} (lower the threshold or raise "
+            "max_len)"
+        )
+    return cp_min_len
+
+
 def cp_head_buckets(cp_min_len: int, max_len: int, axis: int):
     """The static set of ring-head lengths a multi-process server
     compiles AT STARTUP: the smallest axis-divisible length that can
@@ -270,13 +302,6 @@ def cp_generate(
         raise ValueError(
             f"mesh has no {axis_name!r} axis: {mesh.axis_names} "
             "(build it with MeshPlan(seq=...))"
-        )
-    axis = mesh.shape[axis_name]
-    head = plen - plen % axis
-    if head == 0:
-        raise ValueError(
-            f"prompt len {plen} is shorter than the {axis_name} axis "
-            f"({axis}): nothing to shard — use the plain path"
         )
     if plen + max_new_tokens > max_len:
         raise ValueError(

@@ -120,35 +120,14 @@ class InferenceServer:
                     "--cp mesh needs a seq axis > 1 "
                     "(MeshPlan(seq=...))"
                 )
-            if seq_axis >= max_len:
-                # no admissible prompt can cover the axis: cp could
-                # never engage no matter the threshold
-                raise ValueError(
-                    f"--cp never engages: the seq axis ({seq_axis}) "
-                    f"is not below max_len ({max_len})"
-                )
-            if cp_min_len == 0:
-                # unset: default to something that amortizes a ring,
-                # self-clamped so the derived default always CAN
-                # engage under this max_len
-                self.cp_min_len = min(8 * seq_axis, max_len - 1)
-            elif cp_min_len < seq_axis:
-                # an explicit value below the axis is unusable (the
-                # prompt's head must cover the axis) — honor the
-                # user's intent by clamping to the floor, not by
-                # silently overriding with the default
-                self.cp_min_len = seq_axis
-            elif cp_min_len >= max_len:
-                # the user's own threshold excludes every admissible
-                # prompt (prompt_len + max_new <= max_len): fail at
-                # startup, not as a feature that silently never runs
-                raise ValueError(
-                    f"--cp never engages: cp_min_len {cp_min_len} "
-                    f">= max_len {max_len} (lower --cp-min-len or "
-                    "raise --max-len)"
-                )
+            # ONE policy for deriving/clamping/refusing the threshold,
+            # shared with the pod's --sp (parallel/context.py)
+            from ..parallel.context import resolve_cp_min_len
+
+            self.cp_min_len = resolve_cp_min_len(
+                cp_min_len, seq_axis, max_len
+            )
             for flag, why in (
-                (slots > 0, "--slots (the pool prefills per slot)"),
                 (draft_layers > 0, "--draft-layers (speculative "
                  "prefill is chunk-driven)"),
                 (prefix_cache_entries > 0, "--prefix-cache (cached "
@@ -213,8 +192,13 @@ class InferenceServer:
                 )
             from .serve_slots import SlotEngine
 
+            # --cp composes: long-prompt admissions ring their
+            # prefill over the cp mesh's seq axis before joining the
+            # pool (the engine runs the same cp_prefill_with_remainder
+            # recipe the pod's --sp path does)
             self.slot_engine = SlotEngine(
-                cfg, params, max_len, slots=slots, chunk=slot_chunk
+                cfg, params, max_len, slots=slots, chunk=slot_chunk,
+                cp_mesh=self.cp_mesh, cp_min_len=self.cp_min_len,
             )
         # prompts longer than this stream through decode_chunk pieces
         # (peak prefill activations O(chunk) instead of O(prompt))

@@ -126,8 +126,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="context-parallel prefill ways: long single-row prompts "
         "ring their prefill over a seq axis of N local devices "
         "(parallel.cp_generate); 1 = off. Composes with --tp (a "
-        "seq x model mesh over cp*tp devices); rejects "
-        "--slots/--draft-layers/--prefix-cache/--window",
+        "seq x model mesh over cp*tp devices) and --slots (engine "
+        "admissions ring long prompts); rejects "
+        "--draft-layers/--prefix-cache/--window",
     )
     parser.add_argument(
         "--cp-min-len", type=int, default=0,

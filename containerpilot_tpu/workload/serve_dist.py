@@ -1583,33 +1583,16 @@ def main() -> int:
     if args.sp <= 1 and cp_min_len:
         raise SystemExit("--cp-min-len requires --sp > 1")
     if args.sp > 1:
-        # same derivation/clamp/never-engages rules as the
-        # single-host server (workload/serve.py InferenceServer)
-        if args.sp >= args.max_len:
-            # no admissible prompt can cover the axis: cp could never
-            # engage no matter the threshold
-            raise SystemExit(
-                f"--sp never engages: the seq axis ({args.sp}) is "
-                f"not below --max-len ({args.max_len})"
+        # ONE policy for deriving/clamping/refusing the threshold,
+        # shared with the single-host --cp (parallel/context.py)
+        from ..parallel.context import resolve_cp_min_len
+
+        try:
+            cp_min_len = resolve_cp_min_len(
+                cp_min_len, args.sp, args.max_len, flag="sp"
             )
-        if cp_min_len == 0:
-            # unset: default to something that amortizes a ring,
-            # self-clamped so the derived default always CAN engage
-            cp_min_len = min(8 * args.sp, args.max_len - 1)
-        elif cp_min_len < args.sp:
-            # an explicit value below the axis is unusable (the
-            # prompt's head must cover the axis) — honor the user's
-            # intent by clamping to the floor, not silently
-            # overriding with the default
-            cp_min_len = args.sp
-        elif cp_min_len >= args.max_len:
-            # the user's own threshold excludes every admissible
-            # prompt: fail at startup, not as a feature that silently
-            # never runs
-            raise SystemExit(
-                f"--sp never engages: --cp-min-len {cp_min_len} >= "
-                f"--max-len {args.max_len}"
-            )
+        except ValueError as exc:
+            raise SystemExit(str(exc)) from None
     if args.window > 0 and args.draft_layers > 0:
         # same composition rule as the single-host server
         # (workload/serve.py): speculative rollback cannot undo

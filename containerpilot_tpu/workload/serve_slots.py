@@ -92,9 +92,25 @@ class SlotEngine:
         max_len: int,
         slots: int = 8,
         chunk: int = 8,
+        cp_mesh=None,
+        cp_min_len: int = 0,
     ) -> None:
         if slots < 1 or chunk < 1:
             raise ValueError("slots and chunk must be >= 1")
+        # context-parallel admission: prompts at least cp_min_len
+        # long ring their prefill over cp_mesh's seq axis
+        # (parallel/context.py cp_prefill_with_remainder — the same
+        # recipe the pod's --sp path runs) before joining the pool.
+        # Single-process here, so the maximal axis-divisible head
+        # applies (no cross-process compile-skew hazard; see
+        # cp_head_buckets for the pod's bucketed variant).
+        if cp_mesh is not None and cfg.window > 0:
+            raise ValueError(
+                "cp does not compose with sliding windows (ring "
+                "attention rejects them)"
+            )
+        self.cp_mesh = cp_mesh
+        self.cp_min_len = cp_min_len
         # sliding windows (cfg.window > 0) compose: each slot's ring
         # cache is row-local, and admission writes the freshly
         # prefilled row WHOLESALE (insert_row dynamic_update_slices
@@ -228,10 +244,26 @@ class SlotEngine:
         """Prefill the prompt into the slot and sample token 0 with
         generate's exact key schedule."""
         cfg = self.cfg
-        prompt = jnp.asarray([req.tokens], jnp.int32)
-        logits, row_cache = _jitted_prefill(cfg, self.max_len)(
-            self.params, prompt
-        )
+        if (
+            self.cp_mesh is not None
+            and len(req.tokens) >= self.cp_min_len
+            and len(req.tokens) >= self.cp_mesh.shape.get("seq", 1)
+        ):
+            import numpy as _np
+
+            from ..parallel.context import cp_prefill_with_remainder
+
+            logits, row_cache = cp_prefill_with_remainder(
+                self.params,
+                _np.asarray([req.tokens], _np.int32),
+                cfg, self.cp_mesh, self.max_len,
+            )
+        else:
+            # host->device transfer only on the path that uses it
+            prompt = jnp.asarray([req.tokens], jnp.int32)
+            logits, row_cache = _jitted_prefill(cfg, self.max_len)(
+                self.params, prompt
+            )
         # the server-wide convention: row i of a request samples from
         # fold_in(PRNGKey(seed), i) — single-row here, so i = 0
         # (serve_batcher/serve_prefix/serve_strategies do the same),

@@ -36,6 +36,14 @@ def _free_port() -> int:
 def _sub_env() -> dict:
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)  # exactly 1 CPU device per process
+    # pod boots across this suite recompile the same tiny-model
+    # program sets; the workload CLIs' opt-in persistent compile
+    # cache (modelcfg.enable_compile_cache) turns every boot after
+    # the first into cache re-warms — exactly the crash->restart
+    # path it exists for, and minutes off the suite on one core
+    env.setdefault(
+        "CONTAINERPILOT_COMPILE_CACHE", "/tmp/cp_test_compile_cache"
+    )
     return env
 
 

@@ -503,11 +503,13 @@ def test_serve_cp_long_prompt_matches_vanilla(run, plan_kw):
     )
     vanilla = InferenceServer(cfg, params, "127.0.0.1", 0, max_len=128)
 
-    with pytest.raises(ValueError, match="--cp does not compose"):
-        InferenceServer(
-            cfg, params, "127.0.0.1", 0, max_len=128, cp_mesh=mesh,
-            slots=2,
-        )
+    # --cp composes with --slots: the engine rings long-prompt
+    # admissions over the seq axis (the pod's --sp recipe), so a
+    # slot-pooled server answers long prompts identically too
+    slot_cp_srv = InferenceServer(
+        cfg, srv_params, "127.0.0.1", 0, max_len=128, cp_mesh=mesh,
+        cp_min_len=32, slots=2,
+    )
     # an explicit threshold no admissible prompt can reach fails at
     # startup; the DERIVED default instead self-clamps below max_len
     with pytest.raises(ValueError, match="never engages"):
@@ -540,6 +542,7 @@ def test_serve_cp_long_prompt_matches_vanilla(run, plan_kw):
 
         await cp_srv.run()
         await vanilla.run()
+        await slot_cp_srv.run()
         loop = asyncio.get_event_loop()
 
         def go():
@@ -550,7 +553,8 @@ def test_serve_cp_long_prompt_matches_vanilla(run, plan_kw):
                 {"tokens": [[1, 2, 3]], "max_new_tokens": 4},  # short
             ]
             pairs = [
-                (fetch(cp_srv.port, r), fetch(vanilla.port, r))
+                (fetch(cp_srv.port, r), fetch(vanilla.port, r),
+                 fetch(slot_cp_srv.port, r))
                 for r in reqs
             ]
             info = urllib.request.urlopen(
@@ -561,11 +565,15 @@ def test_serve_cp_long_prompt_matches_vanilla(run, plan_kw):
         out = await loop.run_in_executor(None, go)
         await cp_srv.stop()
         await vanilla.stop()
+        await slot_cp_srv.stop()
         return out
 
     pairs, info = run(scenario(), timeout=300)
-    for got, want in pairs:
+    for got, want, slot_got in pairs:
         assert got["tokens"] == want["tokens"]
+        # the slot-pooled cp server answers identically (engine
+        # admissions ring the same maximal head cp_generate uses)
+        assert slot_got["tokens"] == want["tokens"]
     assert info["cp"] == {"seq": plan_kw["seq"], "min_len": 32}
 
 
