@@ -172,12 +172,6 @@ class InferenceServer:
                     "(slot rows are recycled wholesale; there is no "
                     "cache to reuse a prefix from)"
                 )
-            if prefill_chunk > 0:
-                raise ValueError(
-                    "--slots does not compose with --prefill-chunk "
-                    "(slot admission prefills one-shot; chunked "
-                    "admission is future work)"
-                )
             # warmup() pushes a dummy request of 4 prompt ids +
             # (chunk+1) new tokens through the engine; a legal but
             # tiny --max-len must fail HERE with a clean message, not
@@ -196,9 +190,12 @@ class InferenceServer:
             # prefill over the cp mesh's seq axis before joining the
             # pool (the engine runs the same cp_prefill_with_remainder
             # recipe the pod's --sp path does)
+            # --prefill-chunk composes: admissions longer than the
+            # chunk prefill in pieces inside the engine
             self.slot_engine = SlotEngine(
                 cfg, params, max_len, slots=slots, chunk=slot_chunk,
                 cp_mesh=self.cp_mesh, cp_min_len=self.cp_min_len,
+                prefill_chunk=prefill_chunk,
             )
         # prompts longer than this stream through decode_chunk pieces
         # (peak prefill activations O(chunk) instead of O(prompt))

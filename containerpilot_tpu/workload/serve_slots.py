@@ -94,6 +94,7 @@ class SlotEngine:
         chunk: int = 8,
         cp_mesh=None,
         cp_min_len: int = 0,
+        prefill_chunk: int = 0,
     ) -> None:
         if slots < 1 or chunk < 1:
             raise ValueError("slots and chunk must be >= 1")
@@ -111,6 +112,25 @@ class SlotEngine:
             )
         self.cp_mesh = cp_mesh
         self.cp_min_len = cp_min_len
+        if cp_mesh is not None:
+            # the ONE threshold policy (derive/clamp/never-engages)
+            # applies no matter who constructs the engine — a direct
+            # SlotEngine(cp_mesh=...) must not silently ring every
+            # prompt or accept a threshold no prompt can reach
+            from ..parallel.context import resolve_cp_min_len
+
+            self.cp_min_len = resolve_cp_min_len(
+                cp_min_len, cp_mesh.shape.get("seq", 1), max_len
+            )
+        # chunked admission: prompts longer than prefill_chunk
+        # prefill in fixed-size pieces (models/decode.chunked_prefill
+        # — peak activation memory O(chunk) instead of O(prompt), a
+        # bounded piece-length set so compile churn stays finite).
+        # Prompts that take the cp ring skip this (the ring already
+        # bounds activations; its remainder decomposes separately).
+        self.prefill_chunk = prefill_chunk
+        if prefill_chunk < 0:
+            raise ValueError("prefill_chunk must be >= 0")
         # sliding windows (cfg.window > 0) compose: each slot's ring
         # cache is row-local, and admission writes the freshly
         # prefilled row WHOLESALE (insert_row dynamic_update_slices
@@ -247,7 +267,6 @@ class SlotEngine:
         if (
             self.cp_mesh is not None
             and len(req.tokens) >= self.cp_min_len
-            and len(req.tokens) >= self.cp_mesh.shape.get("seq", 1)
         ):
             import numpy as _np
 
@@ -257,6 +276,16 @@ class SlotEngine:
                 self.params,
                 _np.asarray([req.tokens], _np.int32),
                 cfg, self.cp_mesh, self.max_len,
+            )
+        elif (
+            self.prefill_chunk > 0
+            and len(req.tokens) > self.prefill_chunk
+        ):
+            from ..models.decode import chunked_prefill
+
+            logits, row_cache = chunked_prefill(
+                self.params, jnp.asarray([req.tokens], jnp.int32),
+                cfg, self.max_len, chunk_len=self.prefill_chunk,
             )
         else:
             # host->device transfer only on the path that uses it

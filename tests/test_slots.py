@@ -191,6 +191,28 @@ def test_window_slot_reuse_carries_no_stale_context(window_setup):
         )
 
 
+def test_chunked_admission_matches_generate(params):
+    """--prefill-chunk composes with the pool: admissions longer than
+    the chunk prefill in fixed-size pieces (chunked_prefill) and the
+    decode still byte-matches solo generate — long and short prompts,
+    greedy and sampled, plus slot reuse over the chunked path."""
+    eng = SlotEngine(CFG, params, MAX_LEN, slots=2, chunk=3,
+                     prefill_chunk=4)
+    try:
+        long_p = [(i * 3 + 1) % 64 for i in range(11)]  # 11 > 4
+        got = eng.submit(long_p, max_new=7).result(timeout=180)
+        assert got == _solo(params, long_p, 7)
+        # short prompts skip the chunked path entirely
+        got = eng.submit([5, 6], max_new=5).result(timeout=180)
+        assert got == _solo(params, [5, 6], 5)
+        # sampled + reuse of the chunk-admitted slot
+        kw = dict(temperature=0.9, top_k=12, seed=11)
+        got = eng.submit(long_p, max_new=6, **kw).result(timeout=180)
+        assert got == _solo(params, long_p, 6, **kw)
+    finally:
+        eng.stop()
+
+
 def test_stats_and_stop(params):
     eng = SlotEngine(CFG, params, MAX_LEN, slots=3, chunk=2)
     stats = eng.stats
