@@ -217,6 +217,22 @@ def chunked_prefill(
     cache = init_cache(cfg, b, max_len)
     if cfg.window > 0:
         chunk_len = min(chunk_len, cache["k"].shape[2])
+    return extend_pieces(params, cache, tokens, cfg, chunk_len)
+
+
+def extend_pieces(
+    params: Params,
+    cache: Cache,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    chunk_len: int,
+) -> Tuple[jax.Array, Cache]:
+    """Extend ``tokens`` into ``cache`` in bounded pieces — the
+    chunked_prefill piece plan ({1..15, 16, chunk_len} lengths), also
+    applied by the slot engine's prefix-hit path so a huge cached-hit
+    suffix honors the same O(chunk) activation bound as a cold
+    prompt. Returns (last logits, cache)."""
+    s = tokens.shape[1]
     bucket = min(16, chunk_len)
     lead = s % chunk_len
     plan = []

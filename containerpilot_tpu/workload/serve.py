@@ -166,12 +166,6 @@ class InferenceServer:
         # queueing behind whole generations (serve_slots.py)
         self.slot_engine = None
         if slots > 0:
-            if prefix_cache_entries > 0:
-                raise ValueError(
-                    "--slots does not compose with --prefix-cache "
-                    "(slot rows are recycled wholesale; there is no "
-                    "cache to reuse a prefix from)"
-                )
             # warmup() pushes a dummy request of 4 prompt ids +
             # (chunk+1) new tokens through the engine; a legal but
             # tiny --max-len must fail HERE with a clean message, not
@@ -190,12 +184,15 @@ class InferenceServer:
             # prefill over the cp mesh's seq axis before joining the
             # pool (the engine runs the same cp_prefill_with_remainder
             # recipe the pod's --sp path does)
-            # --prefill-chunk composes: admissions longer than the
-            # chunk prefill in pieces inside the engine
+            # --prefill-chunk composes (admissions longer than the
+            # chunk prefill in pieces) and so does --prefix-cache
+            # (admissions with a cached prefix rewind+extend; every
+            # admission seeds the cache) — both inside the engine
             self.slot_engine = SlotEngine(
                 cfg, params, max_len, slots=slots, chunk=slot_chunk,
                 cp_mesh=self.cp_mesh, cp_min_len=self.cp_min_len,
                 prefill_chunk=prefill_chunk,
+                prefix_cache=self.prefix_cache,
             )
         # prompts longer than this stream through decode_chunk pieces
         # (peak prefill activations O(chunk) instead of O(prompt))

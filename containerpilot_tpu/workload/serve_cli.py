@@ -107,9 +107,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--slots", type=int, default=0,
         help="continuous decode admission: single-row requests join a "
         "running chunked decode over a pool of N slots instead of "
-        "queueing behind whole generations; 0 = off (composes with "
-        "--window via per-slot ring caches; does not compose with "
-        "--prefix-cache)",
+        "queueing behind whole generations; 0 = off. Composes "
+        "with --window (per-slot ring caches), --cp (admissions "
+        "ring long prompts), --prefill-chunk (piecewise "
+        "admission), and --prefix-cache (admissions rewind+extend "
+        "cached prefixes)",
     )
     parser.add_argument(
         "--slot-chunk", type=int, default=8,

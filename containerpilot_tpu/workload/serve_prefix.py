@@ -68,6 +68,24 @@ class PrefixCache:
                 self._cache.popitem(last=False)
 
 
+def plan_reuse(pc: "PrefixCache", row: List[int]):
+    """The ONE reuse plan both the standalone prefix path and the
+    slot engine's admission apply: longest cached match, suffix
+    bucketed (a little of the matched prefix re-prefills so jit
+    compiles one extend program per BUCKET, not per suffix length).
+    Returns (reuse_len, base_cache_or_None); counts a miss when no
+    usable base exists."""
+    plen = len(row)
+    best_len, best_key = pc.best_match(row)
+    reuse = 0
+    if best_len >= MIN_REUSE:
+        suffix = plen - best_len
+        bucket = max(1, -(-suffix // BUCKET) * BUCKET) if suffix > 0 else 1
+        reuse = plen - min(bucket, plen)
+    base = pc.get(best_key) if reuse > 0 and best_key is not None else None
+    return (reuse, base) if base is not None else (0, None)
+
+
 def generate_with_prefix(
     srv: Any, row: List[int], max_new: int, temperature: float,
     top_k: int, top_p: float, eos_id: int, seed: int,
@@ -97,14 +115,7 @@ def generate_with_prefix(
     pc: PrefixCache = srv.prefix_cache
     key_row = tuple(row)
     plen = len(row)
-    best_len, best_key = pc.best_match(row)
-
-    reuse = 0
-    if best_len >= MIN_REUSE:
-        suffix = plen - best_len
-        bucket = max(1, -(-suffix // BUCKET) * BUCKET) if suffix > 0 else 1
-        reuse = plen - min(bucket, plen)
-    base = pc.get(best_key) if reuse > 0 and best_key is not None else None
+    reuse, base = plan_reuse(pc, row)
     if base is not None:
         # rewind: same arrays (incl. kv_int8 scales), earlier pos
         cache = {**base, "pos": jnp.asarray(reuse, jnp.int32)}

@@ -45,6 +45,7 @@ from ..models.slots import (
     slot_cache,
 )
 from ..models.transformer import TransformerConfig
+from .serve_prefix import MIN_REUSE as PREFIX_MIN_REUSE
 
 log = logging.getLogger("containerpilot.serve.slots")
 
@@ -95,6 +96,7 @@ class SlotEngine:
         cp_mesh=None,
         cp_min_len: int = 0,
         prefill_chunk: int = 0,
+        prefix_cache=None,
     ) -> None:
         if slots < 1 or chunk < 1:
             raise ValueError("slots and chunk must be >= 1")
@@ -131,6 +133,25 @@ class SlotEngine:
         self.prefill_chunk = prefill_chunk
         if prefill_chunk < 0:
             raise ValueError("prefill_chunk must be >= 0")
+        # prefix KV reuse under continuous batching: admissions with a
+        # cached prefix rewind+extend instead of full prefill, and
+        # every admission's prompt cache is stored for future turns.
+        # Sound because stored entries are standalone buffers: extend
+        # never donates its cache operand and insert_row COPIES the
+        # row into the (donated) pool, so pool churn can't touch them.
+        self.prefix_cache = prefix_cache
+        if prefix_cache is not None:
+            if cp_mesh is not None:
+                raise ValueError(
+                    "prefix cache does not compose with cp (cached "
+                    "prefixes bypass the ring)"
+                )
+            if cfg.window > 0:
+                raise ValueError(
+                    "prefix cache does not compose with sliding "
+                    "windows (a ring cache's stale rows are live "
+                    "window context)"
+                )
         # sliding windows (cfg.window > 0) compose: each slot's ring
         # cache is row-local, and admission writes the freshly
         # prefilled row WHOLESALE (insert_row dynamic_update_slices
@@ -264,35 +285,76 @@ class SlotEngine:
         """Prefill the prompt into the slot and sample token 0 with
         generate's exact key schedule."""
         cfg = self.cfg
-        if (
-            self.cp_mesh is not None
-            and len(req.tokens) >= self.cp_min_len
-        ):
-            import numpy as _np
+        logits = row_cache = None
+        pc = self.prefix_cache
+        # prompts shorter than MIN_REUSE skip the prefix machinery
+        # entirely: they can never be reused (plan_reuse requires a
+        # MIN_REUSE match) so storing them only pins dead LRU entries
+        # — this also keeps warmup's dummy request out of the cache
+        # and its stats
+        use_pc = pc is not None and len(req.tokens) >= PREFIX_MIN_REUSE
+        if use_pc:
+            from ..models.decode import _jitted_extend, extend_pieces
+            from .serve_prefix import plan_reuse
 
-            from ..parallel.context import cp_prefill_with_remainder
+            reuse, base = plan_reuse(pc, req.tokens)
+            if base is not None:
+                # rewind: same arrays (incl. kv_int8 scales), earlier
+                # pos; the bucketed suffix extends into a FRESH cache
+                cache = {**base, "pos": jnp.asarray(reuse, jnp.int32)}
+                suffix = jnp.asarray([req.tokens[reuse:]], jnp.int32)
+                if (
+                    self.prefill_chunk > 0
+                    and suffix.shape[1] > self.prefill_chunk
+                ):
+                    # a huge cached-hit suffix honors the SAME
+                    # O(chunk) activation bound as a cold prompt
+                    logits, row_cache = extend_pieces(
+                        self.params, cache, suffix, cfg,
+                        self.prefill_chunk,
+                    )
+                else:
+                    logits, row_cache = _jitted_extend(cfg)(
+                        self.params, cache, suffix
+                    )
+                pc.stats["hits"] += 1
+                pc.stats["tokens_reused"] += reuse
+            else:
+                pc.stats["misses"] += 1
+        if row_cache is None:
+            if (
+                self.cp_mesh is not None
+                and len(req.tokens) >= self.cp_min_len
+            ):
+                import numpy as _np
 
-            logits, row_cache = cp_prefill_with_remainder(
-                self.params,
-                _np.asarray([req.tokens], _np.int32),
-                cfg, self.cp_mesh, self.max_len,
-            )
-        elif (
-            self.prefill_chunk > 0
-            and len(req.tokens) > self.prefill_chunk
-        ):
-            from ..models.decode import chunked_prefill
+                from ..parallel.context import cp_prefill_with_remainder
 
-            logits, row_cache = chunked_prefill(
-                self.params, jnp.asarray([req.tokens], jnp.int32),
-                cfg, self.max_len, chunk_len=self.prefill_chunk,
-            )
-        else:
-            # host->device transfer only on the path that uses it
-            prompt = jnp.asarray([req.tokens], jnp.int32)
-            logits, row_cache = _jitted_prefill(cfg, self.max_len)(
-                self.params, prompt
-            )
+                logits, row_cache = cp_prefill_with_remainder(
+                    self.params,
+                    _np.asarray([req.tokens], _np.int32),
+                    cfg, self.cp_mesh, self.max_len,
+                )
+            elif (
+                self.prefill_chunk > 0
+                and len(req.tokens) > self.prefill_chunk
+            ):
+                from ..models.decode import chunked_prefill
+
+                logits, row_cache = chunked_prefill(
+                    self.params, jnp.asarray([req.tokens], jnp.int32),
+                    cfg, self.max_len, chunk_len=self.prefill_chunk,
+                )
+            else:
+                # host->device transfer only on the path that uses it
+                prompt = jnp.asarray([req.tokens], jnp.int32)
+                logits, row_cache = _jitted_prefill(
+                    cfg, self.max_len
+                )(self.params, prompt)
+        if use_pc:
+            # store the completed prompt's cache for future turns
+            # (standalone buffer — see the __init__ soundness note)
+            pc.store(tuple(req.tokens), row_cache)
         # the server-wide convention: row i of a request samples from
         # fold_in(PRNGKey(seed), i) — single-row here, so i = 0
         # (serve_batcher/serve_prefix/serve_strategies do the same),

@@ -651,14 +651,60 @@ def test_server_stream_rejects_bad_compositions(run, params):
     assert with_stop[0] == 422 and "stop" in with_stop[1]
 
 
-def test_slots_reject_prefix_cache(params):
-    from containerpilot_tpu.workload.serve import InferenceServer
+def test_prefix_cache_admission_matches_generate(params):
+    """--prefix-cache composes with the pool: an admission with a
+    cached prefix rewinds + bucket-extends instead of full prefill,
+    every admission seeds the cache, and output stays byte-identical
+    to solo generate — cold miss, exact-repeat hit, and the
+    chat-turn partial hit (extended prompt)."""
+    from containerpilot_tpu.workload.serve_prefix import PrefixCache
 
-    with pytest.raises(ValueError, match="prefix-cache"):
-        InferenceServer(
-            CFG, params, "127.0.0.1", 0, max_len=MAX_LEN, slots=2,
-            prefix_cache_entries=2,
-        )
+    pc = PrefixCache(entries=4)
+    # prefill_chunk too: the cold miss takes chunked_prefill and the
+    # chat-turn hit's bucketed suffix (16 > 4) takes extend_pieces —
+    # the prefix path honors the same O(chunk) activation bound
+    eng = SlotEngine(CFG, params, MAX_LEN, slots=2, chunk=3,
+                     prefix_cache=pc, prefill_chunk=4)
+    try:
+        base_p = [(i * 5 + 2) % 64 for i in range(20)]  # >= MIN_REUSE
+        got = eng.submit(base_p, max_new=6).result(timeout=180)
+        assert got == _solo(params, base_p, 6)
+        assert pc.stats["misses"] == 1 and len(pc) == 1
+
+        # exact repeat (sampled): rewind + bucketed extend, same bytes
+        got = eng.submit(base_p, max_new=6, temperature=0.7,
+                         seed=3).result(timeout=180)
+        assert got == _solo(params, base_p, 6, temperature=0.7, seed=3)
+        assert pc.stats["hits"] == 1 and pc.stats["tokens_reused"] > 0
+
+        # the chat-turn shape: history + a new suffix
+        turn2 = base_p + [9, 9, 5]
+        got = eng.submit(turn2, max_new=6).result(timeout=180)
+        assert got == _solo(params, turn2, 6)
+        assert pc.stats["hits"] == 2 and len(pc) == 2
+    finally:
+        eng.stop()
+
+
+def test_prefix_cache_rejects_cp_and_window(params):
+    """The fundamental non-compositions still refuse at construction:
+    cached prefixes bypass the ring, and a ring cache's stale rows
+    are live window context."""
+    import dataclasses
+
+    from containerpilot_tpu.parallel import MeshPlan, make_mesh
+    from containerpilot_tpu.workload.serve_prefix import PrefixCache
+
+    mesh = make_mesh(
+        jax.devices()[:2], plan=MeshPlan(data=1, model=1, seq=2)
+    )
+    with pytest.raises(ValueError, match="bypass the ring"):
+        SlotEngine(CFG, params, MAX_LEN, slots=2, chunk=3,
+                   cp_mesh=mesh, prefix_cache=PrefixCache(2))
+    win_cfg = dataclasses.replace(CFG, window=8)
+    with pytest.raises(ValueError, match="window"):
+        SlotEngine(win_cfg, params, MAX_LEN, slots=2, chunk=3,
+                   prefix_cache=PrefixCache(2))
 
 
 def test_slots_reject_max_len_too_small_for_warmup(params):
