@@ -192,7 +192,7 @@ class _SlotMirror:
 
     def __init__(self, cfg, params, max_len: int, slots: int,
                  chunk: int, mesh=None, sp: int = 1,
-                 cp_min_len: int = 0) -> None:
+                 cp_min_len: int = 0, prefix_entries: int = 0) -> None:
         from ..models.slots import slot_cache
 
         self.cfg = cfg
@@ -214,6 +214,19 @@ class _SlotMirror:
         # construction.
         self.sp = sp
         self.cp_min_len = cp_min_len
+        # prefix KV reuse, lockstep by construction: every process
+        # keeps an IDENTICAL PrefixCache instance whose state evolves
+        # only through broadcast admissions (same prompts, same order
+        # -> same matches, stores, and LRU evictions everywhere).
+        # Entries are standalone buffers: extend never donates its
+        # cache operand and insert_row copies the row into the
+        # (donated) pool. The frontend reads .stats for /v1/model.
+        self.prefix_cache = None
+        self._repin = None
+        if prefix_entries > 0:
+            from .serve_prefix import PrefixCache
+
+            self.prefix_cache = PrefixCache(prefix_entries)
         self.cp_buckets = ()
         if sp > 1:
             from ..parallel.context import cp_head_buckets
@@ -226,6 +239,16 @@ class _SlotMirror:
             from jax.sharding import NamedSharding, PartitionSpec
 
             self.rep = NamedSharding(mesh, PartitionSpec())
+
+        if self.rep is not None and self.prefix_cache is not None:
+            # stored prefix entries must stay fully replicated: a
+            # GSPMD-chosen layout persisting in the cache could make
+            # a later extend insert cross-process collectives — the
+            # exact first-use-communicator hazard the cp buckets
+            # exist to avoid (and the pool-drift lesson repeated)
+            self._repin = jax.jit(
+                lambda t: t, out_shardings=self.rep
+            )
 
         def g(x):
             if self.rep is None:
@@ -275,6 +298,25 @@ class _SlotMirror:
 
         slot = int(payload["admit_slot"])
         plen = int(payload["plen"])
+        logits = row_cache = None
+        pc = self.prefix_cache
+        # prompts shorter than MIN_REUSE skip the prefix machinery
+        # (never reusable; also keeps warmup's dummy admission out of
+        # the cache) — same rule as the single-host engine
+        use_pc = False
+        if pc is not None:
+            from .serve_prefix import MIN_REUSE
+
+            use_pc = plen >= MIN_REUSE
+        if use_pc:
+            from .serve_prefix import reuse_admission
+
+            row_tokens = [int(t) for t in payload["prompt"][:plen]]
+            hit = reuse_admission(
+                pc, row_tokens, self.cfg, self.params
+            )
+            if hit is not None:
+                logits, row_cache = hit
         # context-parallel admission: the quadratic prefill of a long
         # prompt rings over the seq axis (each device holds head/sp
         # tokens), the cache leaves the ring replicated — exactly the
@@ -282,25 +324,34 @@ class _SlotMirror:
         # extends it with one short chunk (parallel/context.py's
         # cp_generate recipe, minus its decode half: the slot pool IS
         # the decode half here).
-        cp_head = 0
-        if self.sp > 1 and plen >= self.cp_min_len:
-            from ..parallel.context import pick_cp_head
+        if row_cache is None:
+            cp_head = 0
+            if self.sp > 1 and plen >= self.cp_min_len:
+                from ..parallel.context import pick_cp_head
 
-            cp_head = pick_cp_head(plen, self.cp_buckets)
-        if cp_head > 0:
-            from ..parallel.context import cp_prefill_with_remainder
+                cp_head = pick_cp_head(plen, self.cp_buckets)
+            if cp_head > 0:
+                from ..parallel.context import (
+                    cp_prefill_with_remainder,
+                )
 
-            logits, row_cache = cp_prefill_with_remainder(
-                self.params, payload["prompt"][None, :plen],
-                self.cfg, self.mesh, self.max_len, head=cp_head,
+                logits, row_cache = cp_prefill_with_remainder(
+                    self.params, payload["prompt"][None, :plen],
+                    self.cfg, self.mesh, self.max_len, head=cp_head,
+                )
+            else:
+                prompt = jnp.asarray(
+                    payload["prompt"][None, :plen], jnp.int32
+                )
+                logits, row_cache = _jitted_prefill(
+                    self.cfg, self.max_len
+                )(self.params, prompt)
+        if use_pc:
+            stored = (
+                self._repin(row_cache)
+                if self._repin is not None else row_cache
             )
-        else:
-            prompt = jnp.asarray(
-                payload["prompt"][None, :plen], jnp.int32
-            )
-            logits, row_cache = _jitted_prefill(
-                self.cfg, self.max_len
-            )(self.params, prompt)
+            pc.store(tuple(row_tokens), stored)
         row_key = jax.random.fold_in(
             jax.random.PRNGKey(int(payload["seed"])),
             int(payload["row_idx"]),
@@ -660,8 +711,13 @@ class _Frontend:
 
     async def _model(self, _req):
         self._m_requests.labels("model", "200").inc()
+        info = dict(self.pod_info)
+        pc = getattr(self, "prefix_cache", None)
+        if pc is not None:
+            # live stats, same shape as the single-host /v1/model
+            info["prefix_cache"] = {"entries": pc.entries, **pc.stats}
         return self._Response(
-            200, json.dumps(self.pod_info).encode(),
+            200, json.dumps(info).encode(),
             content_type="application/json",
         )
 
@@ -1489,6 +1545,14 @@ def main() -> int:
                         "KV bytes; every process quantizes "
                         "identically, so lockstep answers are still "
                         "deterministic)")
+    parser.add_argument("--prefix-cache", type=int, default=0,
+                        help="prefix KV reuse on the pod: every "
+                        "process keeps an IDENTICAL LRU of the last "
+                        "N admitted prompts' KV rows (admissions are "
+                        "broadcast, so cache state stays lockstep by "
+                        "construction); admissions sharing a cached "
+                        "prefix rewind+extend instead of full "
+                        "prefill. 0 = off; rejects --sp and --window")
     parser.add_argument("--window", type=int, default=0,
                         help="sliding-window attention: each slot's "
                         "KV cache is a ring of min(window, max_len) "
@@ -1578,6 +1642,18 @@ def main() -> int:
         raise SystemExit(
             "--sp does not compose with --draft-layers (speculative "
             "prefill is chunk-driven)"
+        )
+    if args.prefix_cache < 0:
+        raise SystemExit("--prefix-cache must be >= 0")
+    if args.prefix_cache > 0 and args.sp > 1:
+        raise SystemExit(
+            "--prefix-cache does not compose with --sp (cached "
+            "prefixes bypass the ring)"
+        )
+    if args.prefix_cache > 0 and args.window > 0:
+        raise SystemExit(
+            "--prefix-cache does not compose with --window (a ring "
+            "cache's stale rows are live window context)"
         )
     cp_min_len = args.cp_min_len
     if args.sp <= 1 and cp_min_len:
@@ -1740,6 +1816,10 @@ def main() -> int:
                 "stream": True,
                 "kv_int8": args.kv_int8,
                 "window": args.window or None,
+                "prefix_cache": (
+                    {"entries": args.prefix_cache}
+                    if args.prefix_cache > 0 else None
+                ),
                 "moe_experts": cfg.moe_experts,
                 "int8": args.int8,
                 "lora": (
@@ -1786,6 +1866,7 @@ def main() -> int:
     mirror = _SlotMirror(
         cfg, params, args.max_len, args.slots, args.stream_chunk,
         mesh=mesh, sp=args.sp, cp_min_len=cp_min_len,
+        prefix_entries=args.prefix_cache,
     )
     warm_pod(mirror)
     if draft is not None:
@@ -1800,6 +1881,8 @@ def main() -> int:
     if dog is not None:
         dog.beat()  # startup done: tighten to the serve deadline
     if frontend is not None:
+        # live prefix stats for /v1/model (the mirror owns the cache)
+        frontend.prefix_cache = mirror.prefix_cache
         frontend.ready = True
         print("pod warm; accepting traffic", flush=True)
 

@@ -86,6 +86,40 @@ def plan_reuse(pc: "PrefixCache", row: List[int]):
     return (reuse, base) if base is not None else (0, None)
 
 
+def reuse_admission(pc: "PrefixCache", row_tokens: List[int], cfg,
+                    params, chunk_len: int = 0):
+    """The ONE admission-side reuse protocol both slot engines apply
+    (workload/serve_slots.py and the pod's serve_dist mirror): plan
+    the reuse, rewind the cached base (same arrays, earlier pos),
+    extend the bucketed suffix — in bounded pieces when ``chunk_len``
+    says the configured activation bound applies — and count the
+    hit/miss stats. Returns (logits, cache) on a hit, None on a miss.
+    Callers store the completed prompt's cache afterwards (with any
+    placement transform of their own, e.g. the pod's replicated
+    repin)."""
+    import jax.numpy as jnp
+
+    from ..models.decode import _jitted_extend, extend_pieces
+
+    reuse, base = plan_reuse(pc, row_tokens)
+    if base is None:
+        pc.stats["misses"] += 1
+        return None
+    cache = {**base, "pos": jnp.asarray(reuse, jnp.int32)}
+    suffix = jnp.asarray([row_tokens[reuse:]], jnp.int32)
+    if chunk_len > 0 and suffix.shape[1] > chunk_len:
+        # a huge cached-hit suffix honors the SAME O(chunk)
+        # activation bound as a cold prompt
+        logits, cache = extend_pieces(
+            params, cache, suffix, cfg, chunk_len
+        )
+    else:
+        logits, cache = _jitted_extend(cfg)(params, cache, suffix)
+    pc.stats["hits"] += 1
+    pc.stats["tokens_reused"] += reuse
+    return logits, cache
+
+
 def generate_with_prefix(
     srv: Any, row: List[int], max_new: int, temperature: float,
     top_k: int, top_p: float, eos_id: int, seed: int,

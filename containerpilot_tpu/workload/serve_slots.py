@@ -294,33 +294,14 @@ class SlotEngine:
         # and its stats
         use_pc = pc is not None and len(req.tokens) >= PREFIX_MIN_REUSE
         if use_pc:
-            from ..models.decode import _jitted_extend, extend_pieces
-            from .serve_prefix import plan_reuse
+            from .serve_prefix import reuse_admission
 
-            reuse, base = plan_reuse(pc, req.tokens)
-            if base is not None:
-                # rewind: same arrays (incl. kv_int8 scales), earlier
-                # pos; the bucketed suffix extends into a FRESH cache
-                cache = {**base, "pos": jnp.asarray(reuse, jnp.int32)}
-                suffix = jnp.asarray([req.tokens[reuse:]], jnp.int32)
-                if (
-                    self.prefill_chunk > 0
-                    and suffix.shape[1] > self.prefill_chunk
-                ):
-                    # a huge cached-hit suffix honors the SAME
-                    # O(chunk) activation bound as a cold prompt
-                    logits, row_cache = extend_pieces(
-                        self.params, cache, suffix, cfg,
-                        self.prefill_chunk,
-                    )
-                else:
-                    logits, row_cache = _jitted_extend(cfg)(
-                        self.params, cache, suffix
-                    )
-                pc.stats["hits"] += 1
-                pc.stats["tokens_reused"] += reuse
-            else:
-                pc.stats["misses"] += 1
+            hit = reuse_admission(
+                pc, req.tokens, cfg, self.params,
+                chunk_len=self.prefill_chunk,
+            )
+            if hit is not None:
+                logits, row_cache = hit
         if row_cache is None:
             if (
                 self.cp_mesh is not None

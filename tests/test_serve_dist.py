@@ -171,6 +171,9 @@ def test_pod_serves_http(tmp_path, n_procs, dp):
                  "--advertise-address", "127.0.0.1",
                  "--host", "127.0.0.1", "--port", str(http_port),
                  "--dp", str(dp)]
+                # tp2 also proves pod prefix reuse (lockstep LRU on
+                # every process); dp2xtp2 stays prefix-free
+                + (["--prefix-cache", "2"] if n_procs == 2 else [])
                 + MODEL_FLAGS,
                 cwd=REPO, env=env, stdout=fh, stderr=subprocess.STDOUT,
             ))
@@ -261,6 +264,29 @@ def test_pod_serves_http(tmp_path, n_procs, dp):
                 logit_bias={i: -100.0 for i in range(20)},
             )
             assert all(t >= 20 for t in wb["tokens"][0])
+
+            # pod prefix reuse: turn 1 (>= MIN_REUSE) misses and
+            # seeds every process's identical LRU; turn 2 extends the
+            # shared history through the cached rows — byte parity
+            # with the single-host reference either way, and the
+            # frontend's stats show exactly one miss + one hit
+            history = [(i * 5 + 2) % 128 for i in range(20)]
+            t1 = post({"tokens": [history], "max_new_tokens": 5})
+            assert t1["tokens"][0] == _reference(history, 5)
+            turn2 = history + [7, 3]
+            t2 = post({"tokens": [turn2], "max_new_tokens": 5,
+                       "temperature": 0.6, "seed": 5})
+            assert t2["tokens"][0] == _reference(
+                turn2, 5, temperature=0.6, seed=5
+            )
+            with urllib.request.urlopen(
+                f"{base}/v1/model", timeout=30
+            ) as resp:
+                pc_info = json.loads(resp.read().decode())
+            assert pc_info["prefix_cache"]["entries"] == 2
+            assert pc_info["prefix_cache"]["misses"] == 1
+            assert pc_info["prefix_cache"]["hits"] == 1
+            assert pc_info["prefix_cache"]["tokens_reused"] > 0
 
         # /v1/score rides the broadcast too: teacher-forced logprobs
         # match the single-host formula bit-for-bit
@@ -432,15 +458,17 @@ def test_pod_serves_http(tmp_path, n_procs, dp):
             f"{base}/metrics", timeout=30
         ).read().decode()
         # plain 200s + 3 streamed 200s (the disconnected stream
-        # still counts its 200); the knob matrix adds 6 at tp2
-        n_200 = 14.0 if knob_matrix else 8.0
+        # still counts its 200); the knob matrix adds 6 at tp2 and
+        # the prefix-reuse pair adds 2 more
+        n_200 = 16.0 if knob_matrix else 8.0
         assert (
             'containerpilot_pod_requests_total'
             '{endpoint="generate",status="200"} %s' % n_200
         ) in metrics
+        n_model = 2.0 if knob_matrix else 1.0
         assert (
             'containerpilot_pod_requests_total'
-            '{endpoint="model",status="200"} 1.0'
+            '{endpoint="model",status="200"} %s' % n_model
         ) in metrics
         assert "containerpilot_pod_generated_tokens_total" in metrics
 
