@@ -14,6 +14,17 @@ if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# persistent XLA compile cache for the in-process JAX tier: the
+# workload modules re-compile the same tiny-model programs on every
+# suite run, which dominates wall time on this one-core box. Same
+# cache dir the pod-boot subprocesses use (CONTAINERPILOT_COMPILE_CACHE
+# in _sub_env), so a full suite warms it once.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", "/tmp/cp_test_compile_cache"
+)
+os.environ.setdefault(
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5"
+)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -43,6 +43,11 @@ def _free_port() -> int:
 def _sub_env() -> dict:
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)  # exactly 1 CPU device per process
+    # conftest's in-process cache env must not leak: subprocess cache
+    # behavior is controlled ONLY by CONTAINERPILOT_COMPILE_CACHE
+    # (enable_compile_cache), so dedicated-cache tests stay cold
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env.pop("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", None)
     # pod boots across this suite recompile the same tiny-model
     # program sets; the workload CLIs' opt-in persistent compile
     # cache (modelcfg.enable_compile_cache) turns every boot after

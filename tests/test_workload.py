@@ -3072,6 +3072,9 @@ def test_compile_cache_env_populates_and_reuses(tmp_path):
     ]
     env = dict(os.environ, CONTAINERPILOT_COMPILE_CACHE=str(cache))
     env.pop("XLA_FLAGS", None)
+    # the dedicated cache dir must be the ONLY cache in play
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env.pop("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", None)
     first = subprocess.run(
         argv, env=env, capture_output=True, text=True, timeout=240,
     )
