@@ -192,7 +192,8 @@ class _SlotMirror:
 
     def __init__(self, cfg, params, max_len: int, slots: int,
                  chunk: int, mesh=None, sp: int = 1,
-                 cp_min_len: int = 0, prefix_entries: int = 0) -> None:
+                 cp_min_len: int = 0, prefix_entries: int = 0,
+                 prefill_chunk: int = 0) -> None:
         from ..models.slots import slot_cache
 
         self.cfg = cfg
@@ -221,6 +222,10 @@ class _SlotMirror:
         # Entries are standalone buffers: extend never donates its
         # cache operand and insert_row copies the row into the
         # (donated) pool. The frontend reads .stats for /v1/model.
+        # chunked admission (``--prefill-chunk``): local programs
+        # with a bounded piece-length set — compile skew between
+        # processes only delays the slower one, unlike collectives
+        self.prefill_chunk = prefill_chunk
         self.prefix_cache = None
         self._repin = None
         if prefix_entries > 0:
@@ -313,7 +318,8 @@ class _SlotMirror:
 
             row_tokens = [int(t) for t in payload["prompt"][:plen]]
             hit = reuse_admission(
-                pc, row_tokens, self.cfg, self.params
+                pc, row_tokens, self.cfg, self.params,
+                chunk_len=self.prefill_chunk,
             )
             if hit is not None:
                 logits, row_cache = hit
@@ -338,6 +344,19 @@ class _SlotMirror:
                 logits, row_cache = cp_prefill_with_remainder(
                     self.params, payload["prompt"][None, :plen],
                     self.cfg, self.mesh, self.max_len, head=cp_head,
+                )
+            elif (
+                self.prefill_chunk > 0
+                and plen > self.prefill_chunk
+            ):
+                from ..models.decode import chunked_prefill
+
+                logits, row_cache = chunked_prefill(
+                    self.params,
+                    jnp.asarray(payload["prompt"][None, :plen],
+                                jnp.int32),
+                    self.cfg, self.max_len,
+                    chunk_len=self.prefill_chunk,
                 )
             else:
                 prompt = jnp.asarray(
@@ -1545,6 +1564,13 @@ def main() -> int:
                         "KV bytes; every process quantizes "
                         "identically, so lockstep answers are still "
                         "deterministic)")
+    parser.add_argument("--prefill-chunk", type=int, default=0,
+                        help="admissions longer than N prefill in "
+                        "fixed-size pieces (O(N) peak activations, "
+                        "bounded piece-length set; local programs, "
+                        "so compile skew between processes only "
+                        "delays). 0 = one-shot admission prefill; "
+                        "prompts taking the --sp ring skip this")
     parser.add_argument("--prefix-cache", type=int, default=0,
                         help="prefix KV reuse on the pod: every "
                         "process keeps an IDENTICAL LRU of the last "
@@ -1645,6 +1671,8 @@ def main() -> int:
         )
     if args.prefix_cache < 0:
         raise SystemExit("--prefix-cache must be >= 0")
+    if args.prefill_chunk < 0:
+        raise SystemExit("--prefill-chunk must be >= 0")
     if args.prefix_cache > 0 and args.sp > 1:
         raise SystemExit(
             "--prefix-cache does not compose with --sp (cached "
@@ -1820,6 +1848,7 @@ def main() -> int:
                     {"entries": args.prefix_cache}
                     if args.prefix_cache > 0 else None
                 ),
+                "prefill_chunk": args.prefill_chunk or None,
                 "moe_experts": cfg.moe_experts,
                 "int8": args.int8,
                 "lora": (
@@ -1867,6 +1896,7 @@ def main() -> int:
         cfg, params, args.max_len, args.slots, args.stream_chunk,
         mesh=mesh, sp=args.sp, cp_min_len=cp_min_len,
         prefix_entries=args.prefix_cache,
+        prefill_chunk=args.prefill_chunk,
     )
     warm_pod(mirror)
     if draft is not None:

@@ -177,8 +177,12 @@ def test_pod_serves_http(tmp_path, n_procs, dp):
                  "--host", "127.0.0.1", "--port", str(http_port),
                  "--dp", str(dp)]
                 # tp2 also proves pod prefix reuse (lockstep LRU on
-                # every process); dp2xtp2 stays prefix-free
-                + (["--prefix-cache", "2"] if n_procs == 2 else [])
+                # every process) AND chunked admission (the 20-token
+                # history cold-prefills in 4-token pieces; the turn-2
+                # hit's bucketed suffix takes extend_pieces under the
+                # same bound); dp2xtp2 stays on one-shot admission
+                + (["--prefix-cache", "2", "--prefill-chunk", "4"]
+                   if n_procs == 2 else [])
                 + MODEL_FLAGS,
                 cwd=REPO, env=env, stdout=fh, stderr=subprocess.STDOUT,
             ))
