@@ -21,6 +21,12 @@ Two halves, matching what this framework is:
    - KV-cache generation throughput at batch 1 vs batch 8 (the
      continuous-batching multiplier).
 
+   One workload bench runs on ANY backend: ``host_overhead_bench``
+   measures the slot engine's per-round host overhead (device-
+   resident state + lookahead vs the legacy upload-per-round loop)
+   on a tiny CPU-sized config, so BENCH_r{N}.json records a real
+   serving number even when no TPU is reachable.
+
 Prints ONE JSON line:
     {"metric": ..., "value": <median ms>, "unit": "ms",
      "vs_baseline": r, "extras": {...workload numbers...}}
@@ -472,11 +478,241 @@ def slot_admission_bench(cfg=None, max_new: int = 64,
     return out
 
 
-def _bench_subprocess(fn_name: str, timeout_s: int) -> dict:
+def host_overhead_bench(rounds: int = 40) -> dict:
+    """Per-round HOST overhead of the continuous-batching decode loop,
+    runnable on ANY backend (tiny CPU-sized config) — the bench that
+    finally puts a real number in BENCH_r{N}.json when no TPU is
+    reachable.
+
+    Three measurements share one compiled chunk program:
+
+    - ``device``: pure ``decode_slots_chunk`` time, measured SERIALLY
+      (dispatch + block per round).
+    - ``legacy``: the pre-device-resident-state loop shape — every
+      round re-uploads the 12 host numpy knob arrays (step_idx, temp,
+      top_k, top_p, eos, pad, min_new, presence, frequency, bias_idx,
+      bias_val, done) into the state dict, dispatches, SERIALLY
+      fetches the tokens, advances step_idx on the host, then runs
+      the append-chunk bookkeeping.
+    - ``engine``: the REAL SlotEngine (device-resident state + one-
+      round lookahead dispatch), measured through round_times_ms()
+      over a long steady decode.
+
+    Host overhead is measured DIRECTLY, in-round, on both sides —
+    not inferred by subtracting two separately-run loops. Shared
+    small hosts show 2-3x scheduler tail noise per ~100ms round;
+    a cross-loop subtraction of ~1-2ms host work under +-50ms noise
+    is sign-flips all the way down (observed: the legacy loop's
+    median beating the pure-device loop's). Instead:
+
+    - ``legacy_host_overhead_ms``: inside each legacy round, bracket
+      the two host segments the old loop serialized with device
+      compute — the 12 ``jnp.asarray`` knob uploads + op_state dict
+      build before dispatch, and the step-advance + append-chunk
+      bookkeeping after the serial fetch. Median of their sum.
+    - ``engine_host_overhead_ms``: the engine brackets its own jax
+      calls; ``round_host_ms()`` is round wall time minus the time
+      inside the chunk dispatches and the token fetch (where any
+      device wait lands — CPU's bounded in-flight queue blocks in
+      the NEXT dispatch rather than in ``device_get``). What's left
+      — queue/cancel checks, token copy-out, bookkeeping, streaming
+      callbacks — is the same bracket shape as the legacy measure,
+      minus the uploads the device-resident state made unnecessary.
+      Median.
+
+    The round wall medians/mins for all three loops are reported as
+    context: with lookahead the engine's pipelined rounds track pure
+    device time (host work hides under chunk N+1's compute), and
+    ``engine_round_min_ms`` is a round whose lookahead chunk had
+    already finished — fetch + bookkeeping only, no device wait.
+    ``overhead_vs_legacy`` is the headline ratio — the PR's
+    acceptance bar is <= 0.5."""
+    import os
+    import statistics as stats_mod
+
+    import jax
+
+    # this image's sitecustomize pins every interpreter to the TPU
+    # plugin, overriding the env var; when the caller pinned a
+    # platform (workload_benches passes JAX_PLATFORMS=cpu when no TPU
+    # answers) re-assert it before first backend use — the same
+    # post-import, pre-use update the test suite applies
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update(
+            "jax_platforms", os.environ["JAX_PLATFORMS"]
+        )
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from containerpilot_tpu.models.decode import BIAS_SLOTS_MAX
+    from containerpilot_tpu.models.slots import (
+        append_chunk,
+        decode_slots_chunk,
+        init_slot_state,
+        slot_cache,
+    )
+    from containerpilot_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+    from containerpilot_tpu.workload.serve_slots import SlotEngine
+
+    slots, chunk = 4, 16
+    prompt_len = 8
+    max_len = prompt_len + rounds * chunk + chunk
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=128, n_heads=4, n_layers=2,
+        d_ff=512, max_seq_len=max_len, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    def fresh():
+        return (
+            slot_cache(cfg, slots, max_len),
+            init_slot_state(cfg, slots),
+        )
+
+    # --- pure device time: serial dispatch + block per round (see
+    # docstring). A dead pool decodes the IDENTICAL program (done
+    # only selects pad vs sampled token), so no admission is needed
+    # here.
+    pool, state = fresh()
+    for _ in range(3):  # compile + settle
+        pool, state, toks = decode_slots_chunk(
+            params, pool, state, cfg, chunk
+        )
+    jax.block_until_ready(toks)
+    dev_times: list = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        pool, state, toks = decode_slots_chunk(
+            params, pool, state, cfg, chunk
+        )
+        jax.block_until_ready(toks)
+        dev_times.append(time.perf_counter() - t0)
+
+    # --- legacy loop: the pre-PR per-round host path, reproduced
+    # faithfully against the same chunk program. last/keys/counts
+    # stayed device-resident in the old loop too; the other 12 leaves
+    # were host numpy re-uploaded via jnp.asarray EVERY round, the
+    # token fetch was serial (no lookahead — nothing overlapped), and
+    # step_idx advanced on the host.
+    pool, state = fresh()
+    step_idx = np.zeros((slots,), np.int32)
+    temp = np.zeros((slots,), np.float32)
+    top_k = np.zeros((slots,), np.int32)
+    top_p = np.zeros((slots,), np.float32)
+    eos = np.full((slots,), -1, np.int32)
+    pad = np.zeros((slots,), np.int32)
+    min_new = np.zeros((slots,), np.int32)
+    presence = np.zeros((slots,), np.float32)
+    frequency = np.zeros((slots,), np.float32)
+    bias_idx = np.full((slots, BIAS_SLOTS_MAX), -1, np.int32)
+    bias_val = np.zeros((slots, BIAS_SLOTS_MAX), np.float32)
+    done = np.zeros((slots,), bool)
+    emitted: list = [[] for _ in range(slots)]
+    legacy_times: list = []
+    legacy_host: list = []
+
+    def legacy_round(record: bool) -> None:
+        nonlocal pool, state, step_idx
+        t0 = time.perf_counter()
+        op_state = dict(
+            state,
+            step_idx=jnp.asarray(step_idx),
+            temperature=jnp.asarray(temp),
+            top_k=jnp.asarray(top_k),
+            top_p=jnp.asarray(top_p),
+            eos_id=jnp.asarray(eos),
+            pad_id=jnp.asarray(pad),
+            min_new=jnp.asarray(min_new),
+            presence=jnp.asarray(presence),
+            frequency=jnp.asarray(frequency),
+            bias_idx=jnp.asarray(bias_idx),
+            bias_val=jnp.asarray(bias_val),
+            done=jnp.asarray(done),
+        )
+        t1 = time.perf_counter()  # host segment A: uploads
+        pool, state, toks = decode_slots_chunk(
+            params, pool, op_state, cfg, chunk
+        )
+        toks_host = np.asarray(jax.device_get(toks))  # serial fetch
+        t2 = time.perf_counter()
+        step_idx = step_idx + chunk  # host-side position bookkeeping
+        for i in range(slots):
+            append_chunk(
+                emitted[i], toks_host[i], rounds * chunk + 1, -1
+            )
+        t3 = time.perf_counter()  # host segment B: bookkeeping
+        if record:
+            legacy_times.append(t3 - t0)
+            legacy_host.append((t1 - t0) + (t3 - t2))
+
+    for i in range(3):
+        legacy_round(record=False)
+    for _ in range(rounds):
+        legacy_round(record=True)
+
+    # --- the shipped engine: one long greedy request, decode-only
+    # round wall times from the worker loop itself (admission rounds
+    # excluded there)
+    engine = SlotEngine(cfg, params, max_len, slots=slots, chunk=chunk)
+    try:
+        # warm the prefill/admit programs so compile never lands in a
+        # timed round
+        engine.submit([1] * prompt_len, max_new=2).result(timeout=600)
+        engine.submit(
+            [1] * prompt_len, max_new=rounds * chunk
+        ).result(timeout=600)
+        engine_times = engine.round_times_ms()[-rounds:]
+        engine_host = engine.round_host_ms()[-rounds:]
+    finally:
+        engine.stop()
+
+    device_ms = stats_mod.median(dev_times) * 1e3
+    legacy_ms = stats_mod.median(legacy_times) * 1e3
+    engine_ms = stats_mod.median(engine_times)
+    legacy_over = stats_mod.median(legacy_host) * 1e3
+    engine_over = stats_mod.median(engine_host)
+    return {
+        "backend": jax.default_backend(),
+        "config": (
+            f"{cfg.n_layers}L d{cfg.d_model} v{cfg.vocab_size}, "
+            f"{slots} slots x {chunk}-token chunks, {rounds} rounds"
+        ),
+        "device_round_ms": round(device_ms, 3),
+        "device_round_min_ms": round(min(dev_times) * 1e3, 3),
+        "legacy_round_ms": round(legacy_ms, 3),
+        "legacy_round_min_ms": round(min(legacy_times) * 1e3, 3),
+        # in-round bracketed host segments (uploads + bookkeeping):
+        # what the old loop serialized with device compute per round
+        "legacy_host_overhead_ms": round(legacy_over, 3),
+        "engine_round_ms": round(engine_ms, 3),
+        # a lookahead round whose chunk already finished is fetch +
+        # bookkeeping ONLY — no device wait
+        "engine_round_min_ms": round(min(engine_times), 3),
+        # round wall minus the engine's own bracketed jax calls:
+        # the host work a shipped-engine round pays outside them
+        "engine_host_overhead_ms": round(engine_over, 3),
+        "overhead_vs_legacy": round(
+            engine_over / max(legacy_over, 1e-9), 3
+        ),
+        # the PR's stated bar: the device-resident-state + lookahead
+        # loop must at least halve per-round host overhead
+        "target_ratio": 0.5,
+        "meets_target": engine_over <= 0.5 * legacy_over,
+    }
+
+
+def _bench_subprocess(fn_name: str, timeout_s: int,
+                      env: dict | None = None) -> dict:
     """Run one workload bench in its own interpreter with a hard
     timeout: TPU-tunnel wedges and compile-helper crashes then cost a
     bounded slice of the bench budget instead of hanging it, and a
-    crashed backend can't poison the next bench."""
+    crashed backend can't poison the next bench. ``env`` overlays the
+    inherited environment (the host-overhead bench pins
+    JAX_PLATFORMS=cpu when no TPU answers)."""
     import os
     import subprocess
     import sys
@@ -491,6 +727,7 @@ def _bench_subprocess(fn_name: str, timeout_s: int) -> dict:
             [sys.executable, "-c", code],
             capture_output=True, text=True, timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=dict(os.environ, **env) if env else None,
         )
     except subprocess.TimeoutExpired:
         return {"error": f"timeout after {timeout_s}s"}
@@ -551,9 +788,20 @@ def _probe_backend(attempts: int = 4, timeout_s: int = 180) -> str:
 
 def workload_benches() -> dict:
     backend = _probe_backend()
-    if backend != "tpu":
-        return {"skipped": f"backend is {backend}, not a reachable tpu"}
     extras: dict = {}
+    # the host-overhead bench runs on ANY backend (tiny CPU-sized
+    # config): even a TPU-less round records a real serving-loop
+    # number in BENCH_r{N}.json instead of only {"skipped": ...}
+    extras["host_overhead"] = _bench_subprocess(
+        "host_overhead_bench", 900,
+        env=None if backend == "tpu" else {"JAX_PLATFORMS": "cpu"},
+    )
+    if backend != "tpu":
+        extras["skipped"] = (
+            f"backend is {backend}, not a reachable tpu "
+            "(host_overhead above ran on cpu)"
+        )
+        return extras
     for name, fn_name, timeout_s in (
         ("attention", "attention_bench", 900),
         ("int8_gemm", "int8_bench", 600),

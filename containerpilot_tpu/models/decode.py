@@ -555,6 +555,19 @@ def count_token(
     return counts + onehot * jnp.asarray(alive, counts.dtype)[:, None]
 
 
+def seed_counts_row(vocab_size: int, first, eos_id) -> jax.Array:
+    """The generated-token counts row right after sample 0 — the
+    just-drawn token counts once unless it ended the row, matching
+    generate's scan exactly. Lives here with count_token so the whole
+    penalty-counts convention has one home; runs INSIDE the slot
+    admission program (traceable), so seeding costs no host round
+    trip."""
+    row = jnp.zeros((vocab_size,), jnp.float32)
+    return row.at[first].set(
+        jnp.where(first == eos_id, 0.0, 1.0)
+    )
+
+
 def _sampling_scan(cfg, max_new_tokens: int, greedy: bool,
                    filtered: bool, penalized: bool = False,
                    biased: bool = False):

@@ -47,8 +47,24 @@ from .decode import (
     init_cache,
     mask_eos_before_min,
     sample_logits,
+    seed_counts_row,
 )
 from .transformer import Params, TransformerConfig
+
+# The device-resident per-slot sampling state both serving engines
+# carry between chunk rounds (one dict = one donated jit operand):
+# everything the chunk program reads besides params and the KV pool.
+# It changes ONLY at admission (one row) and retirement (one done
+# flag), so keeping it on device removes the ~12 host->device uploads
+# the old loop paid per round AND the whole class of zero-copied-
+# numpy-mutated-in-place hazards (there is no host buffer left to
+# mutate). step_idx advances on device inside the chunk program for
+# the same reason.
+SLOT_STATE_KEYS = (
+    "last", "keys", "step_idx", "temperature", "top_k", "top_p",
+    "eos_id", "pad_id", "min_new", "presence", "frequency",
+    "bias_idx", "bias_val", "counts", "done",
+)
 
 
 def append_chunk(emitted, toks, max_new: int, eos_id: int) -> bool:
@@ -69,14 +85,109 @@ def append_chunk(emitted, toks, max_new: int, eos_id: int) -> bool:
     )
 
 
-def seed_counts(vocab_size: int, first: int, eos_id: int) -> jax.Array:
-    """Fresh generated-token counts after sample 0: the just-drawn
-    token counts unless it ended the row — matching generate's scan
-    exactly (the other half of the shared convention)."""
-    counts = jnp.zeros((vocab_size,), jnp.float32)
-    if first != eos_id:
-        counts = counts.at[first].set(1.0)
-    return counts
+def init_slot_state(cfg: TransformerConfig, slots: int) -> dict:
+    """Fresh device-resident per-slot sampling state (all slots empty,
+    hence done). See SLOT_STATE_KEYS for the contract."""
+    return {
+        "last": jnp.zeros((slots,), jnp.int32),
+        "keys": jnp.zeros((slots, 2), jnp.uint32),
+        "step_idx": jnp.zeros((slots,), jnp.int32),
+        "temperature": jnp.zeros((slots,), jnp.float32),
+        "top_k": jnp.zeros((slots,), jnp.int32),
+        "top_p": jnp.zeros((slots,), jnp.float32),
+        "eos_id": jnp.full((slots,), -1, jnp.int32),
+        "pad_id": jnp.zeros((slots,), jnp.int32),
+        "min_new": jnp.zeros((slots,), jnp.int32),
+        "presence": jnp.zeros((slots,), jnp.float32),
+        "frequency": jnp.zeros((slots,), jnp.float32),
+        "bias_idx": jnp.full((slots, BIAS_SLOTS_MAX), -1, jnp.int32),
+        "bias_val": jnp.zeros((slots, BIAS_SLOTS_MAX), jnp.float32),
+        "counts": jnp.zeros((slots, cfg.vocab_size), jnp.float32),
+        "done": jnp.ones((slots,), jnp.bool_),
+    }
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_admit(cfg: TransformerConfig, out_sharding=None):
+    """ONE dispatch writing a whole admission's row into every state
+    leaf (the state dict is donated — single-row .at[slot].set per
+    leaf, no full-array copies). The counts row seeds on device
+    (seed_counts_row) from the first sample, so admission needs no
+    extra host round trip for it. ``out_sharding`` pins the output
+    placement exactly like _jitted_insert's."""
+
+    def admit(state, slot, last, key, step_idx, temperature, top_k,
+              top_p, eos_id, pad_id, min_new, presence, frequency,
+              bias_idx, bias_val, done):
+        vocab = state["counts"].shape[1]
+        row = {
+            "last": last, "keys": key, "step_idx": step_idx,
+            "temperature": temperature, "top_k": top_k,
+            "top_p": top_p, "eos_id": eos_id, "pad_id": pad_id,
+            "min_new": min_new, "presence": presence,
+            "frequency": frequency, "bias_idx": bias_idx,
+            "bias_val": bias_val,
+            "counts": seed_counts_row(vocab, last, eos_id),
+            "done": done,
+        }
+        return {
+            name: state[name].at[slot].set(
+                row[name].astype(state[name].dtype)
+            )
+            for name in state
+        }
+
+    return jax.jit(
+        admit, donate_argnums=(0,), out_shardings=out_sharding
+    )
+
+
+def admit_slot_state(
+    state: dict, slot: int, cfg: TransformerConfig, *,
+    last, key, temperature, top_k, top_p, eos_id, pad_id,
+    min_new, presence, frequency, bias_idx, bias_val, done,
+    step_idx: int = 1, out_sharding=None,
+) -> dict:
+    """Write one admitted request's sampling knobs into ``slot``
+    across the (donated) state dict in a single dispatch. ``last`` is
+    the first sampled token (device scalar or int); the slot's counts
+    row seeds from it on device."""
+    return _jitted_admit(cfg, out_sharding)(
+        state, jnp.asarray(slot, jnp.int32),
+        jnp.asarray(last, jnp.int32),
+        jnp.asarray(key, jnp.uint32),
+        jnp.asarray(step_idx, jnp.int32),
+        jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(top_k, jnp.int32),
+        jnp.asarray(top_p, jnp.float32),
+        jnp.asarray(eos_id, jnp.int32),
+        jnp.asarray(pad_id, jnp.int32),
+        jnp.asarray(min_new, jnp.int32),
+        jnp.asarray(presence, jnp.float32),
+        jnp.asarray(frequency, jnp.float32),
+        jnp.asarray(bias_idx, jnp.int32),
+        jnp.asarray(bias_val, jnp.float32),
+        jnp.asarray(done, jnp.bool_),
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_retire(out_sharding=None):
+    return jax.jit(
+        lambda done, slot: done.at[slot].set(True),
+        donate_argnums=(0,), out_shardings=out_sharding,
+    )
+
+
+def retire_slot(state: dict, slot: int, out_sharding=None) -> dict:
+    """Mark ``slot`` done (harvested/cancelled — pads from here until
+    re-admission). Only the done leaf is touched; the rest of the
+    state rides along untouched until the next admission."""
+    new = dict(state)
+    new["done"] = _jitted_retire(out_sharding)(
+        state["done"], jnp.asarray(slot, jnp.int32)
+    )
+    return new
 
 
 def slot_cache(cfg: TransformerConfig, slots: int, max_len: int) -> Cache:
@@ -136,10 +247,14 @@ def _jitted_chunk(cfg: TransformerConfig, slots: int, chunk: int,
                   out_sharding=None):
     """One compiled program advancing every slot ``chunk`` tokens.
 
-    Operands (all [S] unless noted): pool cache (donated), last
-    sampled token, stacked row keys [S, 2], next sample index,
-    temperature/top_k/top_p/eos/pad, done mask. Returns (pool, last,
-    done, tokens [S, chunk]).
+    Operands: the pool cache and the per-slot sampling-state dict
+    (SLOT_STATE_KEYS), BOTH donated — the per-round dispatch ships
+    exactly three operands (params, pool, state), all already on
+    device. Returns (pool, state, tokens [S, chunk]) where the state
+    carries the advanced last/done/counts AND step_idx (advanced on
+    device — no host buffer to mutate in place, so the historical
+    torn-step-index hazard cannot recur); the untouched knob leaves
+    alias straight through the donation.
     """
     vstep = jax.vmap(
         lambda params, cache, token: decode_step(
@@ -148,22 +263,30 @@ def _jitted_chunk(cfg: TransformerConfig, slots: int, chunk: int,
         in_axes=(None, 0, 0),
     )
 
-    def run(params, pool, last, row_keys, step_idx, temperature,
-            top_k, top_p, eos_id, pad_id, min_new, presence,
-            frequency, bias_idx, bias_val, counts, done):
+    def run(params, pool, state):
+        row_keys = state["keys"]
+        pad_id = state["pad_id"]
+        eos_id = state["eos_id"]
+
         def body(carry, _):
             pool, tok, done, idx, counts = carry
             logits, pool = vstep(params, pool, tok[:, None])  # [S,1,V]
             keys = jax.vmap(jax.random.fold_in)(row_keys, idx)
             masked = apply_token_penalties(
-                logits[:, 0, :], counts, presence, frequency
+                logits[:, 0, :], counts, state["presence"],
+                state["frequency"],
             )
             # always-on operand (the pool program is ONE compile):
             # idx -1 rows add exactly zero, bitwise-neutral
-            masked = apply_logit_bias(masked, bias_idx, bias_val)
-            masked = mask_eos_before_min(masked, idx, min_new, eos_id)
+            masked = apply_logit_bias(
+                masked, state["bias_idx"], state["bias_val"]
+            )
+            masked = mask_eos_before_min(
+                masked, idx, state["min_new"], eos_id
+            )
             nxt = sample_logits(
-                masked, keys, temperature, top_k, top_p
+                masked, keys, state["temperature"], state["top_k"],
+                state["top_p"],
             ).astype(jnp.int32)
             nxt = jnp.where(done, pad_id, nxt)
             done = done | (nxt == eos_id)
@@ -171,50 +294,42 @@ def _jitted_chunk(cfg: TransformerConfig, slots: int, chunk: int,
             return (pool, nxt, done, idx + 1, counts), nxt
 
         (pool, last, done, _, counts), toks = lax.scan(
-            body, (pool, last, done, step_idx, counts), None,
-            length=chunk,
+            body,
+            (pool, state["last"], state["done"], state["step_idx"],
+             state["counts"]),
+            None, length=chunk,
         )
-        return pool, last, done, counts, toks.T  # [S, chunk]
+        new_state = dict(
+            state, last=last, done=done, counts=counts,
+            step_idx=state["step_idx"] + chunk,
+        )
+        return pool, new_state, toks.T  # [S, chunk]
 
     return jax.jit(
-        run, donate_argnums=(1, 15), out_shardings=out_sharding
+        run, donate_argnums=(1, 2), out_shardings=out_sharding
     )
 
 
 def decode_slots_chunk(
     params: Params,
     pool: Cache,
-    last: jax.Array,
-    row_keys: jax.Array,
-    step_idx: jax.Array,
-    temperature: jax.Array,
-    top_k: jax.Array,
-    top_p: jax.Array,
-    eos_id: jax.Array,
-    pad_id: jax.Array,
-    min_new: jax.Array,
-    presence: jax.Array,
-    frequency: jax.Array,
-    bias_idx: jax.Array,
-    bias_val: jax.Array,
-    counts: jax.Array,
-    done: jax.Array,
+    state: dict,
     cfg: TransformerConfig,
     chunk: int,
     out_sharding=None,
 ):
     """Advance the whole pool ``chunk`` tokens; see _jitted_chunk.
-    ``bias_idx``/``bias_val`` are [S, K] per-slot logit_bias operands
-    (-1 = unused slot; serving uses K = BIAS_SLOTS_MAX so one program
-    covers every legal request). Returns (pool, last, done, counts,
-    tokens [S, chunk]); the pool AND the counts buffer are donated.
-    ``out_sharding`` pins every output's placement (see
-    _jitted_insert) — the pod passes fully-replicated."""
-    slots = int(last.shape[0])
+    ``state`` is the device-resident per-slot sampling dict
+    (init_slot_state / admit_slot_state); its bias_idx/bias_val are
+    [S, K] per-slot logit_bias operands (-1 = unused slot; serving
+    uses K = BIAS_SLOTS_MAX so one program covers every legal
+    request). Returns (pool, state, tokens [S, chunk]); the pool AND
+    the whole state dict are donated. ``out_sharding`` pins every
+    output's placement (see _jitted_insert) — the pod passes
+    fully-replicated."""
+    slots = int(state["last"].shape[0])
     return _jitted_chunk(cfg, slots, chunk, out_sharding)(
-        params, pool, last, row_keys, step_idx, temperature, top_k,
-        top_p, eos_id, pad_id, min_new, presence, frequency,
-        bias_idx, bias_val, counts, done,
+        params, pool, state
     )
 
 

@@ -194,6 +194,7 @@ def cp_prefill_with_remainder(
     max_len: int,
     axis_name: str = "seq",
     head: int = 0,
+    prefill_chunk: int = 0,
 ):
     """The ONE copy of the cp prefill recipe both ``cp_generate`` and
     the pod's slot admission (workload/serve_dist.py) run: a HEAD of
@@ -212,7 +213,12 @@ def cp_prefill_with_remainder(
     ``prompt_host`` is a host array ([1, plen], identical on every
     process); placement uses ``make_array_from_callback`` so the same
     code serves single-process meshes and multi-host pods (where a
-    plain device_put of a global sharding is not allowed)."""
+    plain device_put of a global sharding is not allowed).
+
+    ``prefill_chunk`` caps the remainder's extend pieces at
+    ``max(axis, prefill_chunk)`` — the pod passes its
+    ``--prefill-chunk`` so the per-device activation guarantee holds
+    even for the bucketed-head worst case (see the step cap below)."""
     import numpy as np
 
     plen = int(prompt_host.shape[1])
@@ -243,15 +249,22 @@ def cp_prefill_with_remainder(
     # (a) compile one program per distinct remainder length —
     # unbounded shape set — and (b) run one local chunk-x-cache
     # attention at up to half the full quadratic prefill, defeating
-    # the memory bound cp exists to provide. The chunk shapes here are
-    # data-independent: {2^k : axis <= 2^k} plus the < axis tail
-    # lengths — finite, so a long-lived server stops compiling. With a
-    # maximal head (head == plen - plen % axis, the cp_generate
-    # default) the remainder is < axis and this loop is exactly the
-    # original one-tiny-chunk behavior.
+    # the memory bound cp exists to provide. The power-of-two steps
+    # are CAPPED at max(axis, prefill_chunk): without the cap the
+    # largest step can reach head-1 tokens, whose chunk-x-cache
+    # attention peaks ~axis/2 times the ring's per-device bound —
+    # exactly the worst case --sp advertises protection against
+    # (ADVICE r5). The chunk shapes stay data-independent:
+    # {2^k : axis <= 2^k <= cap} plus the < axis tail lengths —
+    # finite, so a long-lived server stops compiling and the pod's
+    # compile-skew story is unchanged. With a maximal head
+    # (head == plen - plen % axis, the cp_generate default) the
+    # remainder is < axis and this loop is exactly the original
+    # one-tiny-chunk behavior.
     if head < plen:
         from ..models.decode import _jitted_extend
 
+        cap = max(axis, prefill_chunk)
         pos = head
         extend = _jitted_extend(cfg)
         while pos < plen:
@@ -259,7 +272,7 @@ def cp_prefill_with_remainder(
             step = left
             if left >= axis:
                 step = 1
-                while step * 2 <= left:
+                while step * 2 <= min(left, cap):
                     step *= 2
             logits, cache = extend(
                 params, cache,

@@ -194,7 +194,7 @@ class _SlotMirror:
                  chunk: int, mesh=None, sp: int = 1,
                  cp_min_len: int = 0, prefix_entries: int = 0,
                  prefill_chunk: int = 0) -> None:
-        from ..models.slots import slot_cache
+        from ..models.slots import init_slot_state, slot_cache
 
         self.cfg = cfg
         self.params = params
@@ -263,32 +263,28 @@ class _SlotMirror:
                 host.shape, self.rep, lambda idx: host[idx]
             )
 
-        # one shape-polymorphic pinned row-setter for the small
-        # per-slot device arrays (last/keys/counts)
-        self._set_row = jax.jit(
-            lambda a, i, v: a.at[i].set(v), out_shardings=self.rep
-        )
+        self._g = g
         self.pool = jax.tree.map(g, slot_cache(cfg, slots, max_len))
-        self.last = g(jnp.zeros((slots,), jnp.int32))
-        self.keys = g(jnp.zeros((slots, 2), jnp.uint32))
-        self.counts = g(
-            jnp.zeros((slots, cfg.vocab_size), jnp.float32)
-        )
-        self.step_idx = np.zeros((slots,), np.int32)
-        self.temp = np.zeros((slots,), np.float32)
-        self.top_k = np.zeros((slots,), np.int32)
-        self.top_p = np.zeros((slots,), np.float32)
-        self.eos = np.full((slots,), -1, np.int32)
-        self.pad = np.zeros((slots,), np.int32)  # server pad: 0
-        self.min_new = np.zeros((slots,), np.int32)
-        self.presence = np.zeros((slots,), np.float32)
-        self.frequency = np.zeros((slots,), np.float32)
-        self.bias_idx = np.full(
-            (slots, BIAS_SLOTS_MAX), -1, np.int32
-        )
-        self.bias_val = np.zeros(
-            (slots, BIAS_SLOTS_MAX), np.float32
-        )
+        # per-slot sampling state, ENTIRELY device-resident
+        # (models/slots.py SLOT_STATE_KEYS) and pinned replicated:
+        # written only at admission (one row-write dispatch), read by
+        # the chunk program every round with zero host->device
+        # uploads. The old mirror kept 10 host numpy knob arrays and
+        # re-uploaded them every round — and host-side numpy operands
+        # were exactly the zero-copy in-place-mutation hazard class
+        # behind the historical torn-state bugs (step_idx now
+        # advances on device inside the chunk program).
+        self.state = jax.tree.map(g, init_slot_state(cfg, slots))
+        # host shadow of the LAST done value written to the device
+        # state (admission writes False; run_chunk uploads the
+        # broadcast mask when it differs). Device-side eos flips can
+        # make the device value True where this says False, but any
+        # eos flip also ends the row in the frontend's bookkeeping,
+        # so the next broadcast mask carries a 1 there and the
+        # (redundant-but-harmless) upload converges the two. This is
+        # host BOOKKEEPING, never a program operand — no zero-copy
+        # hazard.
+        self._done_host = np.ones((slots,), bool)
 
     def admit(self, payload) -> int:
         """Prefill the broadcast prompt into the named slot with the
@@ -296,9 +292,9 @@ class _SlotMirror:
         the same value — the computation is SPMD)."""
         from ..models.decode import _jitted_prefill
         from ..models.slots import (
+            admit_slot_state,
             first_sample,
             insert_row,
-            seed_counts,
         )
 
         slot = int(payload["admit_slot"])
@@ -344,6 +340,7 @@ class _SlotMirror:
                 logits, row_cache = cp_prefill_with_remainder(
                     self.params, payload["prompt"][None, :plen],
                     self.cfg, self.mesh, self.max_len, head=cp_head,
+                    prefill_chunk=self.prefill_chunk,
                 )
             elif (
                 self.prefill_chunk > 0
@@ -389,75 +386,64 @@ class _SlotMirror:
             self.pool, row_cache, slot, self.cfg,
             out_sharding=self.rep,
         )
-        slot_dev = jnp.asarray(slot, jnp.int32)
-        self.last = self._set_row(self.last, slot_dev, first)
-        self.keys = self._set_row(self.keys, slot_dev, row_key)
-        self.counts = self._set_row(
-            self.counts, slot_dev,
-            seed_counts(self.cfg.vocab_size, first_host, eos_id),
+        # ONE dispatch writes the whole admission row into the
+        # device-resident state (incl. the counts row, seeded on
+        # device from the first sample). The barrier that used to sit
+        # here guarded in-flight donated updates against the host
+        # mutating zero-copied numpy operands (step_idx/knob arrays);
+        # with every operand device-resident that hazard class is
+        # gone by construction, device dataflow orders the donated
+        # pool/state into the next chunk, and the 2-process co-batch
+        # parity + torn-state tests hold without it.
+        self.state = admit_slot_state(
+            self.state, slot, self.cfg,
+            last=first, key=row_key,
+            temperature=float(payload["temperature"]),
+            top_k=int(payload["top_k"]),
+            top_p=float(payload["top_p"]),
+            eos_id=eos_id,
+            pad_id=0,  # server pad: 0
+            min_new=int(payload["min_new"]),
+            presence=float(payload["presence"]),
+            frequency=float(payload["frequency"]),
+            bias_idx=np.asarray(payload["bias_idx"], np.int32),
+            bias_val=np.asarray(payload["bias_val"], np.float32),
+            done=False,
+            out_sharding=self.rep,
         )
-        self.step_idx[slot] = 1
-        self.temp[slot] = float(payload["temperature"])
-        self.top_k[slot] = int(payload["top_k"])
-        self.top_p[slot] = float(payload["top_p"])
-        self.eos[slot] = eos_id
-        self.min_new[slot] = int(payload["min_new"])
-        self.presence[slot] = float(payload["presence"])
-        self.frequency[slot] = float(payload["frequency"])
-        self.bias_idx[slot] = payload["bias_idx"]
-        self.bias_val[slot] = payload["bias_val"]
-        # materialize the admission's writes before anything else is
-        # dispatched: letting the next (donating) program overlap
-        # these in-flight donated updates intermittently fed the
-        # chunk TORN pool state in the multi-process pod —
-        # deterministic wrong tokens, reproduced and closed by this
-        # barrier (2-process lab, 2026-07). Rounds are host-paced
-        # anyway, so the lost overlap is one dispatch gap.
-        jax.block_until_ready(
-            (self.pool, self.last, self.keys, self.counts)
-        )
+        self._done_host[slot] = False
         return first_host
 
     def run_chunk(self, done_mask) -> np.ndarray:
         """Advance every slot one chunk under the broadcast inactive
         mask; returns the [slots, chunk] sampled tokens (fetched on
         every process — the fetch is what synchronizes device work, so
-        a wedged computation stalls THIS cycle, not some later one)."""
+        a wedged computation stalls THIS cycle, not some later one).
+
+        The mask rides the device-resident state: it is re-uploaded
+        (one [S] bool array, pinned replicated) ONLY on rounds where
+        it differs from the last value written — retirements and
+        evictions — so a steady decode round ships zero host->device
+        transfers. The old full block_until_ready barrier is gone
+        with its root causes: there are no zero-copied numpy operands
+        left to mutate in place (step_idx advances on device), and
+        the donated pool/state order into the next dispatch by device
+        dataflow (the 2-process co-batch parity and torn-state tests
+        hold without the barrier — they decided)."""
         from ..models.slots import decode_slots_chunk
 
-        (self.pool, self.last, _done_dev, self.counts, toks) = (
-            decode_slots_chunk(
-                self.params, self.pool, self.last, self.keys,
-                jnp.asarray(self.step_idx),
-                jnp.asarray(self.temp),
-                jnp.asarray(self.top_k),
-                jnp.asarray(self.top_p),
-                jnp.asarray(self.eos),
-                jnp.asarray(self.pad),
-                jnp.asarray(self.min_new),
-                jnp.asarray(self.presence),
-                jnp.asarray(self.frequency),
-                jnp.asarray(self.bias_idx),
-                jnp.asarray(self.bias_val),
-                self.counts,
-                jnp.asarray(np.asarray(done_mask, bool)),
-                self.cfg, self.chunk,
-                out_sharding=self.rep,
+        mask = np.asarray(done_mask, bool)
+        if not np.array_equal(mask, self._done_host):
+            self.state = dict(
+                self.state, done=self._g(jnp.asarray(mask))
             )
+            self._done_host = mask.copy()
+        self.pool, self.state, toks = decode_slots_chunk(
+            self.params, self.pool, self.state,
+            self.cfg, self.chunk,
+            out_sharding=self.rep,
         )
-        out = np.asarray(jax.device_get(toks))
-        # same torn-state barrier as admit(): the toks fetch alone
-        # does NOT guarantee the donated pool/counts outputs are
-        # safely materialized before the next round dispatches over
-        # (and donates) them
-        jax.block_until_ready((self.pool, self.last, self.counts))
-        # mutate step_idx only AFTER the execution that read it has
-        # completed: jnp.asarray may zero-copy the numpy buffer, and
-        # an in-place `+=` racing the in-flight chunk fed it TORN
-        # step indices (per-position key flips — caught by the
-        # 2-process co-batch parity test)
-        self.step_idx += self.chunk
-        return out
+        return np.asarray(jax.device_get(toks))
 
 
 def _apply_round(mirror: _SlotMirror, payload):
@@ -478,9 +464,15 @@ def _apply_round(mirror: _SlotMirror, payload):
                 int(payload["seed"]), int(payload["row_idx"]),
                 np.asarray(payload["done"]).tolist(), first,
                 None if toks is None else toks.tolist(),
-                mirror.step_idx.tolist(),
-                np.asarray(jax.device_get(mirror.last)).tolist(),
-                np.asarray(jax.device_get(mirror.keys)).tolist(),
+                np.asarray(
+                    jax.device_get(mirror.state["step_idx"])
+                ).tolist(),
+                np.asarray(
+                    jax.device_get(mirror.state["last"])
+                ).tolist(),
+                np.asarray(
+                    jax.device_get(mirror.state["keys"])
+                ).tolist(),
             ),
             flush=True,
         )
@@ -634,6 +626,7 @@ class _Frontend:
                  vocab: int, pod_info: Optional[Dict[str, Any]] = None,
                  text: bool = False, stream_chunk: int = 8,
                  slots: int = 4, cfg: Any = None,
+                 prefix_entries: int = 0,
                  ) -> None:
         from prometheus_client import (
             CollectorRegistry,
@@ -648,6 +641,13 @@ class _Frontend:
         self.slots = slots
         self.cfg = cfg  # model config (beam validation); optional
         self.ready = False
+        # /v1/model prefix_cache schema stability: the mirror's live
+        # PrefixCache is assigned only after warm_pod, but a client
+        # polling during the boot window must see the SAME keys —
+        # until the live cache lands, a configured cache reports
+        # zeroed stats (the true counts: nothing served yet)
+        self.prefix_entries = prefix_entries
+        self.prefix_cache = None
         # /v1/model payload: model config + pod topology, set by main()
         self.pod_info = pod_info or {}
         self.stream_chunk = max(int(stream_chunk), 1)
@@ -731,10 +731,16 @@ class _Frontend:
     async def _model(self, _req):
         self._m_requests.labels("model", "200").inc()
         info = dict(self.pod_info)
-        pc = getattr(self, "prefix_cache", None)
+        pc = self.prefix_cache
         if pc is not None:
             # live stats, same shape as the single-host /v1/model
             info["prefix_cache"] = {"entries": pc.entries, **pc.stats}
+        elif self.prefix_entries > 0:
+            # boot window: same schema, zeroed counts
+            info["prefix_cache"] = {
+                "entries": self.prefix_entries,
+                "hits": 0, "misses": 0, "tokens_reused": 0,
+            }
         return self._Response(
             200, json.dumps(info).encode(),
             content_type="application/json",
@@ -1833,6 +1839,7 @@ def main() -> int:
             args.host, args.port, args.max_len, cfg.vocab_size,
             text=args.text, stream_chunk=args.stream_chunk,
             slots=args.slots, cfg=cfg,
+            prefix_entries=args.prefix_cache,
             pod_info={
                 "vocab_size": cfg.vocab_size,
                 "d_model": cfg.d_model,

@@ -141,7 +141,6 @@ def generate_with_prefix(
     import jax.numpy as jnp
 
     from ..models.decode import (
-        _jitted_extend,
         _jitted_prefill,
         generate_from_cache,
     )
@@ -149,29 +148,29 @@ def generate_with_prefix(
     pc: PrefixCache = srv.prefix_cache
     key_row = tuple(row)
     plen = len(row)
-    reuse, base = plan_reuse(pc, row)
-    if base is not None:
-        # rewind: same arrays (incl. kv_int8 scales), earlier pos
-        cache = {**base, "pos": jnp.asarray(reuse, jnp.int32)}
-        chunk = jnp.asarray([row[reuse:]], jnp.int32)
-        logits, cache = _jitted_extend(srv.cfg)(srv.params, cache, chunk)
-        pc.stats["hits"] += 1
-        pc.stats["tokens_reused"] += reuse
+    # the ONE admission-side reuse protocol (shared with both slot
+    # engines): rewind + bucketed extend, in bounded pieces when
+    # prefill_chunk applies — the standalone prefix path honors the
+    # same O(chunk) activation bound as the slot-engine paths
+    hit = reuse_admission(
+        pc, row, srv.cfg, srv.params, chunk_len=srv.prefill_chunk
+    )
+    if hit is not None:
+        logits, cache = hit
     elif srv.prefill_chunk and plen > srv.prefill_chunk:
         # cold long prompt: seed the prefix cache via the chunked
         # stream so the configured prefill HBM bound still holds
+        # (the miss was already counted by reuse_admission)
         from ..models.decode import chunked_prefill
 
         logits, cache = chunked_prefill(
             srv.params, jnp.asarray([row], jnp.int32), srv.cfg,
             srv.max_len, srv.prefill_chunk,
         )
-        pc.stats["misses"] += 1
     else:
         logits, cache = _jitted_prefill(srv.cfg, srv.max_len)(
             srv.params, jnp.asarray([row], jnp.int32)
         )
-        pc.stats["misses"] += 1
     # store the completed prompt's cache for future turns
     pc.store(key_row, cache)
     # the prefix path is a device call too — keep /v1/model's batching

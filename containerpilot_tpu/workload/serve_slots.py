@@ -23,6 +23,8 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import List, Optional
@@ -37,11 +39,13 @@ from ..models.decode import (
     normalize_logit_bias,
 )
 from ..models.slots import (
+    admit_slot_state,
     append_chunk,
     decode_slots_chunk,
     first_sample,
+    init_slot_state,
     insert_row,
-    seed_counts,
+    retire_slot,
     slot_cache,
 )
 from ..models.transformer import TransformerConfig
@@ -165,24 +169,23 @@ class SlotEngine:
         self.slots = slots
         self.chunk = chunk
         self._pool = slot_cache(cfg, slots, max_len)
-        self._last = jnp.zeros((slots,), jnp.int32)
-        self._keys = jnp.zeros((slots, 2), jnp.uint32)
-        self._step_idx = np.zeros((slots,), np.int32)
-        self._temp = np.zeros((slots,), np.float32)
-        self._top_k = np.zeros((slots,), np.int32)
-        self._top_p = np.zeros((slots,), np.float32)
-        self._eos = np.full((slots,), -1, np.int32)
-        self._pad = np.zeros((slots,), np.int32)
-        self._min_new = np.zeros((slots,), np.int32)
-        self._presence = np.zeros((slots,), np.float32)
-        self._frequency = np.zeros((slots,), np.float32)
-        self._bias_idx = np.full((slots, BIAS_SLOTS_MAX), -1, np.int32)
-        self._bias_val = np.zeros((slots, BIAS_SLOTS_MAX), np.float32)
-        # generated-token counts per slot, device-resident (the chunk
-        # program reads and donates it like the pool)
-        self._counts = jnp.zeros((slots, cfg.vocab_size), jnp.float32)
-        self._done = np.ones((slots,), bool)  # empty slots are "done"
+        # per-slot sampling state, ENTIRELY device-resident
+        # (models/slots.py SLOT_STATE_KEYS): written only at admission
+        # (one row) and retirement (one done flag), read by the chunk
+        # program every round with zero host->device uploads — and
+        # with no host-side numpy buffers left, the zero-copy
+        # in-place-mutation hazard class is gone by construction.
+        self._state = init_slot_state(cfg, slots)
         self._active: List[Optional[_Slot]] = [None] * slots
+        # per-round wall times for decode-only rounds (no admission),
+        # seconds; bench.py's host_overhead_bench reads these through
+        # round_times_ms(). _round_host_times is the same rounds with
+        # the blocking token wait excluded — the engine's per-round
+        # HOST cost, observed directly instead of inferred by
+        # subtracting a separately-timed device loop (which a noisy
+        # shared host can skew by more than the overhead itself).
+        self._round_times: "deque[float]" = deque(maxlen=1024)
+        self._round_host_times: "deque[float]" = deque(maxlen=1024)
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
         self._submit_lock = threading.Lock()
         self._stopped = threading.Event()
@@ -279,6 +282,22 @@ class SlotEngine:
             "queued": self._queue.qsize(),
         }
 
+    def round_times_ms(self) -> List[float]:
+        """Wall time of recent decode-only rounds (ms): dispatch +
+        token fetch + host bookkeeping, admission rounds excluded.
+        With lookahead this reflects the overlap actually achieved."""
+        return [t * 1e3 for t in list(self._round_times)]
+
+    def round_host_ms(self) -> List[float]:
+        """Host-only time of the same rounds (ms): round wall time
+        minus the time spent inside the jax calls (chunk dispatches
+        and the token fetch — where any device wait lands, whether
+        the backend blocks in ``device_get`` or, like CPU's bounded
+        in-flight queue, in the next dispatch). What remains —
+        queue/cancel checks, token copy-out, append bookkeeping,
+        streaming callbacks — is the host work each round pays."""
+        return [t * 1e3 for t in list(self._round_host_times)]
+
     # ----------------------------------------------------------- worker
 
     def _admit(self, slot_id: int, req: _Request) -> None:
@@ -315,6 +334,7 @@ class SlotEngine:
                     self.params,
                     _np.asarray([req.tokens], _np.int32),
                     cfg, self.cp_mesh, self.max_len,
+                    prefill_chunk=self.prefill_chunk,
                 )
             elif (
                 self.prefill_chunk > 0
@@ -350,26 +370,21 @@ class SlotEngine:
         )
         first_host = int(jax.device_get(first))
         self._pool = insert_row(self._pool, row_cache, slot_id, cfg)
-        self._last = self._last.at[slot_id].set(first)
-        self._keys = self._keys.at[slot_id].set(row_key)
-        self._step_idx[slot_id] = 1
-        self._temp[slot_id] = req.temperature
-        self._top_k[slot_id] = req.top_k
-        self._top_p[slot_id] = req.top_p
-        self._eos[slot_id] = req.eos_id
-        self._pad[slot_id] = req.pad_id
-        self._min_new[slot_id] = req.min_new
-        self._presence[slot_id] = req.presence
-        self._frequency[slot_id] = req.frequency
-        self._bias_idx[slot_id] = req.bias_idx
-        self._bias_val[slot_id] = req.bias_val
-        self._counts = self._counts.at[slot_id].set(
-            seed_counts(self.cfg.vocab_size, first_host, req.eos_id)
-        )
         state = _Slot(req=req, emitted=[first_host])
         if first_host == req.eos_id or req.max_new <= 1:
             state.finished = True
-        self._done[slot_id] = state.finished
+        # ONE dispatch writes the whole admission row into the
+        # device-resident state (incl. the counts row, seeded on
+        # device from the first sample)
+        self._state = admit_slot_state(
+            self._state, slot_id, cfg,
+            last=first, key=row_key,
+            temperature=req.temperature, top_k=req.top_k,
+            top_p=req.top_p, eos_id=req.eos_id, pad_id=req.pad_id,
+            min_new=req.min_new, presence=req.presence,
+            frequency=req.frequency, bias_idx=req.bias_idx,
+            bias_val=req.bias_val, done=state.finished,
+        )
         self._active[slot_id] = state
         self._notify(req, [first_host])
 
@@ -382,7 +397,7 @@ class SlotEngine:
             # after its own trim step)
             out = out[: out.index(req.eos_id) + 1]
         self._active[slot_id] = None
-        self._done[slot_id] = True
+        self._state = retire_slot(self._state, slot_id)
         if not req.future.done():
             req.future.set_result(out)
 
@@ -411,7 +426,7 @@ class SlotEngine:
                 and s.req.cancel.is_set()
             ):
                 self._active[i] = None
-                self._done[i] = True
+                self._state = retire_slot(self._state, i)
                 if not s.req.future.done():
                     s.req.future.set_result(list(s.emitted))
                 log.info(
@@ -419,82 +434,120 @@ class SlotEngine:
                     "request cancelled", i, len(s.emitted), s.req.max_new,
                 )
 
+    def _fail_and_rebuild(self, exc: Exception) -> None:
+        """Fail every in-flight request loudly, once, and rebuild the
+        device buffers: the failed chunk DONATED the pool and state,
+        so every later admission would die on a deleted array while
+        /health stays 200."""
+        log.exception("slot chunk failed")
+        for i, s in enumerate(self._active):
+            if s is not None and not s.req.future.done():
+                s.req.future.set_exception(exc)
+            self._active[i] = None
+        self._pool = slot_cache(self.cfg, self.slots, self.max_len)
+        self._state = init_slot_state(self.cfg, self.slots)
+
+    def _cancel_pending(self) -> bool:
+        return any(
+            s is not None
+            and s.req.cancel is not None
+            and s.req.cancel.is_set()
+            for s in self._active
+        )
+
     def _run(self) -> None:
+        # one-round lookahead: the [S, chunk] token output of a chunk
+        # already dispatched for the NEXT round (None = serial)
+        pending = None
         while not self._stopped.is_set():
-            self._sweep_cancelled()
-            free = [i for i, s in enumerate(self._active) if s is None]
-            any_active = any(s is not None for s in self._active)
-            # block for work only when fully idle; otherwise drain
-            # whatever is queued into free slots and keep decoding
-            try:
-                block = not any_active
-                while free:
-                    req = self._queue.get(block=block, timeout=None)
-                    if req is None:  # stop sentinel
-                        return
-                    block = False
-                    if req.cancel is not None and req.cancel.is_set():
-                        req.future.cancel()  # left before admission
-                        continue
-                    try:
-                        self._admit(free.pop(0), req)
-                    except Exception as exc:  # noqa: BLE001
-                        if not req.future.done():
-                            req.future.set_exception(exc)
-            except queue.Empty:
-                pass
-            # harvest admissions that finished at token 0
-            for i, s in enumerate(self._active):
-                if s is not None and s.finished:
-                    self._harvest(i)
-            if not any(s is not None for s in self._active):
-                continue
-            try:
-                (self._pool, self._last, done_dev, self._counts,
-                 toks) = (
-                    decode_slots_chunk(
-                        self.params, self._pool, self._last,
-                        self._keys, jnp.asarray(self._step_idx),
-                        jnp.asarray(self._temp),
-                        jnp.asarray(self._top_k),
-                        jnp.asarray(self._top_p),
-                        jnp.asarray(self._eos),
-                        jnp.asarray(self._pad),
-                        jnp.asarray(self._min_new),
-                        jnp.asarray(self._presence),
-                        jnp.asarray(self._frequency),
-                        jnp.asarray(self._bias_idx),
-                        jnp.asarray(self._bias_val),
-                        self._counts,
-                        jnp.asarray(self._done),
+            t0 = time.perf_counter()
+            jax_s = 0.0  # time inside jax calls this round
+            admitted = False
+            if pending is None:
+                self._sweep_cancelled()
+                free = [
+                    i for i, s in enumerate(self._active) if s is None
+                ]
+                any_active = any(
+                    s is not None for s in self._active
+                )
+                # block for work only when fully idle; otherwise drain
+                # whatever is queued into free slots and keep decoding
+                try:
+                    block = not any_active
+                    while free:
+                        req = self._queue.get(block=block, timeout=None)
+                        if req is None:  # stop sentinel
+                            return
+                        block = False
+                        t0 = time.perf_counter()  # exclude idle wait
+                        admitted = True
+                        if (
+                            req.cancel is not None
+                            and req.cancel.is_set()
+                        ):
+                            req.future.cancel()  # left before admission
+                            continue
+                        try:
+                            self._admit(free.pop(0), req)
+                        except Exception as exc:  # noqa: BLE001
+                            if not req.future.done():
+                                req.future.set_exception(exc)
+                except queue.Empty:
+                    pass
+                # harvest admissions that finished at token 0
+                for i, s in enumerate(self._active):
+                    if s is not None and s.finished:
+                        self._harvest(i)
+                if not any(s is not None for s in self._active):
+                    continue
+                tj = time.perf_counter()
+                try:
+                    self._pool, self._state, toks = decode_slots_chunk(
+                        self.params, self._pool, self._state,
                         self.cfg, self.chunk,
                     )
-                )
+                except Exception as exc:  # noqa: BLE001
+                    self._fail_and_rebuild(exc)
+                    continue
+                jax_s += time.perf_counter() - tj
+            else:
+                toks, pending = pending, None
+            # one-round lookahead: when no admission, cancel, or stop
+            # decision is pending, dispatch chunk N+1 BEFORE fetching
+            # chunk N's tokens — device dataflow orders the donated
+            # pool/state, so the token fetch, host bookkeeping, and
+            # streaming callbacks below overlap chunk N+1's device
+            # compute instead of serializing with it. Whenever a
+            # decision IS needed (queued work, a cancel flag, stop)
+            # the serial path runs and the decision lands at the very
+            # next chunk boundary, exactly as before.
+            if (
+                any(s is not None for s in self._active)
+                and self._queue.empty()
+                and not self._cancel_pending()
+            ):
+                tj = time.perf_counter()
+                try:
+                    (self._pool, self._state, pending) = (
+                        decode_slots_chunk(
+                            self.params, self._pool, self._state,
+                            self.cfg, self.chunk,
+                        )
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    self._fail_and_rebuild(exc)
+                    pending = None
+                    continue
+                jax_s += time.perf_counter() - tj
+            tj = time.perf_counter()
+            try:
+                toks_host = np.asarray(jax.device_get(toks))
             except Exception as exc:  # noqa: BLE001 — fail loud, once
-                log.exception("slot chunk failed")
-                for i, s in enumerate(self._active):
-                    if s is not None and not s.req.future.done():
-                        s.req.future.set_exception(exc)
-                    self._active[i] = None
-                    self._done[i] = True
-                # the failed call DONATED the pool buffer; rebuild it
-                # (all slots are free now) or every later admission
-                # would die on a deleted array while /health stays 200
-                self._pool = slot_cache(
-                    self.cfg, self.slots, self.max_len
-                )
-                self._last = jnp.zeros((self.slots,), jnp.int32)
-                self._keys = jnp.zeros((self.slots, 2), jnp.uint32)
-                self._counts = jnp.zeros(
-                    (self.slots, self.cfg.vocab_size), jnp.float32
-                )
+                self._fail_and_rebuild(exc)
+                pending = None
                 continue
-            # fetch BEFORE mutating step_idx: jnp.asarray may have
-            # zero-copied the numpy buffer into the in-flight chunk,
-            # and an in-place += racing the execution feeds it torn
-            # step indices (the pod mirror learned this the hard way)
-            toks_host = np.asarray(jax.device_get(toks))
-            self._step_idx += self.chunk
+            jax_s += time.perf_counter() - tj
             for i, state in enumerate(self._active):
                 if state is None:
                     continue
@@ -508,3 +561,7 @@ class SlotEngine:
                     self._notify(req, state.emitted[before:])
                 if ended:
                     self._harvest(i)
+            if not admitted:
+                wall = time.perf_counter() - t0
+                self._round_times.append(wall)
+                self._round_host_times.append(max(wall - jax_s, 0.0))

@@ -4,13 +4,15 @@
 # tunnel flake + two first-exposure bench bugs (fixed since). Loop:
 # when the tunnel answers and no session is running, re-run the FULL
 # bench (tuned routing, fixed int8 padded path, split decode/admission
-# benches) and overwrite the round-5 snapshot ONLY when the training
-# bench produced an mfu (the headline the round needs). Log to
+# benches) and write the capture to a NEW timestamped snapshot ONLY
+# when the training bench produced an mfu (the headline the round
+# needs). The round-5 snapshot is a historical artifact the committed
+# narrative (CHANGELOG/PARITY) cites by number — a re-run must never
+# cp-replace it (ADVICE r5); each capture gets its own file. Log to
 # /tmp/tpu_watcher_b_log.txt.
 set -u
 cd "$(dirname "$0")/.."
 LOG=/tmp/tpu_watcher_b_log.txt
-SNAP=docs/bench-snapshots/round5-tpu-v5-lite.json
 DONE=/tmp/tpu_round5b_done
 
 note() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
@@ -38,9 +40,10 @@ t = j.get("extras", {}).get("training", {})
 sys.exit(0 if "mfu" in t else 1)
 EOF
             then
+                SNAP="docs/bench-snapshots/round5b-rerun-$(date -u +%Y%m%dT%H%M%SZ).json"
                 cp /tmp/bench_out_b.json "$SNAP"
                 touch "$DONE"
-                note "bench succeeded with mfu; snapshot updated; done"
+                note "bench succeeded with mfu; wrote $SNAP; done"
                 exit 0
             else
                 note "bench ran but no training mfu; will retry"

@@ -18,10 +18,31 @@ if "--xla_force_host_platform_device_count" not in _flags:
 # workload modules re-compile the same tiny-model programs on every
 # suite run, which dominates wall time on this one-core box. Same
 # cache dir the pod-boot subprocesses use (CONTAINERPILOT_COMPILE_CACHE
-# in _sub_env), so a full suite warms it once.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR", "/tmp/cp_test_compile_cache"
+# in _sub_env), so a full suite warms it once. The default is
+# PER-USER (tmpdir + username): a fixed shared /tmp path let one
+# user's stale or corrupted entries poison another's suite on
+# multi-user hosts. CONTAINERPILOT_COMPILE_CACHE stays the explicit
+# override for both the in-process tier and the pod subprocesses.
+
+
+def _default_compile_cache() -> str:
+    import getpass
+    import tempfile
+
+    try:
+        user = getpass.getuser()
+    except Exception:  # no passwd entry (containers)
+        user = f"uid{os.getuid()}" if hasattr(os, "getuid") else "user"
+    return os.path.join(
+        tempfile.gettempdir(), f"cp_test_compile_cache_{user}"
+    )
+
+
+COMPILE_CACHE_DIR = (
+    os.environ.get("CONTAINERPILOT_COMPILE_CACHE")
+    or _default_compile_cache()
 )
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", COMPILE_CACHE_DIR)
 os.environ.setdefault(
     "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5"
 )

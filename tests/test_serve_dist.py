@@ -45,9 +45,14 @@ def _sub_env() -> dict:
     # program sets; the workload CLIs' opt-in persistent compile
     # cache (modelcfg.enable_compile_cache) turns every boot after
     # the first into cache re-warms — exactly the crash->restart
-    # path it exists for, and minutes off the suite on one core
+    # path it exists for, and minutes off the suite on one core.
+    # Shares conftest's per-user default dir (JAX_COMPILATION_CACHE_DIR
+    # was set from it at session start) so one suite run warms both.
     env.setdefault(
-        "CONTAINERPILOT_COMPILE_CACHE", "/tmp/cp_test_compile_cache"
+        "CONTAINERPILOT_COMPILE_CACHE",
+        os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR", "/tmp/cp_test_compile_cache"
+        ),
     )
     return env
 
@@ -611,6 +616,123 @@ def test_pod_warmup_covers_serve_path():
         jax.config.update("jax_log_compiles", False)
         jax_logger.removeHandler(handler)
         jax_logger.setLevel(old_level)
+
+
+def test_mirror_rounds_match_generate():
+    """In-process parity for the device-resident slot mirror: the
+    exact per-round device ops every pod process replays — admission
+    row-writes into the state dict, chunk rounds under a churning
+    broadcast done mask, retirement, and slot REUSE — byte-match solo
+    generate. This is the single-process half of the 2-process
+    co-batch parity story, and it pins the refactor that removed the
+    per-round knob uploads and the torn-state barriers: a request
+    admitted mid-flight must change nothing for the row already
+    decoding, and a reused slot must carry nothing of its previous
+    occupant."""
+    from containerpilot_tpu.models.slots import append_chunk
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload import serve_dist as sd
+
+    cfg = _default_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    S, chunk = 4, 8
+    mirror = sd._SlotMirror(cfg, params, 48, S, chunk)
+    sd.warm_pod(mirror)
+
+    def round_payload(mask, admit_work=None, slot=0, row_idx=0):
+        p = sd._payload_zeros(48, S)
+        p["op"] = np.asarray(sd.OP_ROUND, np.int32)
+        if admit_work is not None:
+            sd._fill_admission(p, admit_work, row_idx=row_idx,
+                               slot=slot)
+        p["run_chunk"] = np.asarray(1, np.int32)
+        p["done"] = np.asarray(mask, np.int32)
+        return p
+
+    def work(tokens, max_new, **kw):
+        w = {
+            "tokens": tokens, "max_new": max_new, "temperature": 0.0,
+            "top_k": 0, "top_p": 0.0, "eos_id": -1, "seed": 0,
+            "min_new": 0, "presence": 0.0, "frequency": 0.0,
+            "logit_bias": {},
+        }
+        w.update(kw)
+        return w
+
+    # A (slot 0, greedy, 20 new) decodes alone for one round...
+    a_work = work([1, 2, 3, 4], 20)
+    em_a: list = []
+    first, toks = sd._apply_round(
+        mirror, round_payload([0, 1, 1, 1], a_work, slot=0)
+    )
+    em_a.append(first)
+    append_chunk(em_a, toks[0], 20, -1)
+    # ...then B (slot 1, SAMPLED — different knobs mid-flight) joins
+    b_work = work([5, 6, 7, 8], 12, temperature=0.8, top_k=20, seed=9)
+    em_b: list = []
+    first, toks = sd._apply_round(
+        mirror, round_payload([0, 0, 1, 1], b_work, slot=1)
+    )
+    em_b.append(first)
+    append_chunk(em_a, toks[0], 20, -1)
+    append_chunk(em_b, toks[1], 12, -1)
+    # third co-batched round finishes A (20 = 1 + 8 + 8 + 3)
+    _f, toks = sd._apply_round(mirror, round_payload([0, 0, 1, 1]))
+    append_chunk(em_a, toks[0], 20, -1)
+    append_chunk(em_b, toks[1], 12, -1)
+    assert len(em_a) == 20
+    # A retired (mask flips its slot dead); B finishes alone
+    _f, toks = sd._apply_round(mirror, round_payload([1, 0, 1, 1]))
+    append_chunk(em_b, toks[1], 12, -1)
+    assert len(em_b) == 12
+    assert em_a == _reference([1, 2, 3, 4], 20)
+    assert em_b == _reference(
+        [5, 6, 7, 8], 12, temperature=0.8, top_k=20, seed=9
+    )
+    # slot 0 REUSED: the admission row-write + pool insert must leave
+    # nothing of A (and the sampled knobs of B must not leak into a
+    # greedy neighbor)
+    c_work = work([9, 8, 7, 6], 9, seed=3)
+    em_c: list = []
+    first, toks = sd._apply_round(
+        mirror, round_payload([0, 0, 1, 1], c_work, slot=0)
+    )
+    em_c.append(first)
+    append_chunk(em_c, toks[0], 9, -1)
+    _f, toks = sd._apply_round(mirror, round_payload([0, 1, 1, 1]))
+    append_chunk(em_c, toks[0], 9, -1)
+    assert len(em_c) == 9
+    assert em_c == _reference([9, 8, 7, 6], 9, seed=3)
+
+
+def test_pod_model_prefix_schema_stable_across_boot(run):
+    """/v1/model's prefix_cache block must carry the SAME keys during
+    the boot window (before warm_pod hands the mirror's live cache to
+    the frontend) as after it — a client polling at startup must not
+    see the schema change shape."""
+    from containerpilot_tpu.workload.serve_dist import _Frontend
+    from containerpilot_tpu.workload.serve_prefix import PrefixCache
+
+    f = _Frontend(
+        "127.0.0.1", 0, max_len=48, vocab=128,
+        pod_info={"prefix_cache": {"entries": 2}}, prefix_entries=2,
+    )
+    before = json.loads(run(f._model(None)).body.decode())
+    assert before["prefix_cache"] == {
+        "entries": 2, "hits": 0, "misses": 0, "tokens_reused": 0,
+    }
+    # after warm: the live cache (with counted traffic) — same keys
+    pc = PrefixCache(2)
+    pc.stats["misses"] = 1
+    f.prefix_cache = pc
+    after = json.loads(run(f._model(None)).body.decode())
+    assert set(after["prefix_cache"]) == set(before["prefix_cache"])
+    assert after["prefix_cache"]["misses"] == 1
+    # unconfigured cache: no block at all, before or after (the
+    # single-host server's contract)
+    bare = _Frontend("127.0.0.1", 0, max_len=48, vocab=128)
+    none = json.loads(run(bare._model(None)).body.decode())
+    assert "prefix_cache" not in none
 
 
 def test_pod_text_completions(tmp_path):

@@ -91,7 +91,10 @@ def test_eos_trims_like_generate(params, engine):
         timeout=120
     )
     assert got == _solo(params, tokens, 6, eos_id=eos)
-    assert got[-1] == eos and len(got) == 2
+    # the chosen token may ALSO be the greedy first draw (numerics
+    # vary across backends), so derive the expected stop point from
+    # the free-running output instead of assuming position 1
+    assert got[-1] == eos and len(got) == free.index(eos) + 1
 
 
 def test_more_requests_than_slots_all_complete(params, engine):

@@ -463,6 +463,54 @@ def test_cp_generate_matches_unsharded(run):
         cp_generate(params, prompt, cfg, no_seq, 4, 128)
 
 
+def test_cp_remainder_extend_steps_are_capped(monkeypatch):
+    """The bucketed-head remainder must extend in pieces no larger
+    than max(axis, prefill_chunk): a pod bucket can leave a remainder
+    just under head tokens, and an uncapped power-of-two step would
+    run one chunk-x-cache attention far above the ring's per-device
+    activation bound — the worst case --sp advertises protection
+    against (ADVICE r5). Host-only: the ring head and the extend
+    program are stubbed so just the decomposition runs, and the piece
+    set stays the finite {2^k <= cap} + tails that keeps the pod's
+    compile-skew story intact."""
+    import containerpilot_tpu.models.decode as dec
+    from containerpilot_tpu.parallel import MeshPlan, make_mesh
+    from containerpilot_tpu.parallel import context as ctx
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=128, dtype=jnp.float32,
+    )
+    mesh = make_mesh(
+        jax.devices()[:2], plan=MeshPlan(data=1, model=1, seq=2)
+    )
+    monkeypatch.setattr(
+        ctx, "_cp_prefill_fn",
+        lambda *a: lambda params, sharded: ("logits", {}),
+    )
+    widths = []
+
+    def fake_extend(_cfg):
+        def ext(params, cache, chunk):
+            widths.append(int(chunk.shape[1]))
+            return "logits", cache
+
+        return ext
+
+    monkeypatch.setattr(dec, "_jitted_extend", fake_extend)
+    prompt = np.zeros((1, 39), np.int32)
+    # head 8 leaves a 31-token remainder — the uncapped decomposition
+    # would run a single 16-wide piece even with --prefill-chunk 8
+    for prefill_chunk, cap in ((8, 8), (0, 2)):
+        widths.clear()
+        ctx.cp_prefill_with_remainder(
+            None, prompt, cfg, mesh, 128, head=8,
+            prefill_chunk=prefill_chunk,
+        )
+        assert sum(widths) == 39 - 8, widths
+        assert max(widths) <= cap, widths
+
+
 @pytest.mark.parametrize(
     "plan_kw", [dict(model=1, seq=8), dict(model=2, seq=4)],
     ids=["cp8", "cp4xtp2"],
@@ -2472,6 +2520,78 @@ def test_inference_server_prefix_cache(run):
     assert stats["hits"] >= 2, stats
     assert stats["tokens_reused"] >= 40, stats
     assert n_entries == 2  # LRU evicted down to the cap
+
+
+def test_generate_with_prefix_hit_honors_prefill_chunk():
+    """The STANDALONE prefix path (generate_with_prefix) routes a
+    long cached-hit suffix through the shared reuse_admission /
+    extend_pieces protocol, so the documented O(prefill_chunk)
+    activation bound covers it like the slot-engine paths — with
+    byte-identical output to the unchunked server, and hit/miss
+    stats counted exactly once (the refactor must not double-count
+    misses)."""
+    from types import SimpleNamespace
+
+    import containerpilot_tpu.models.decode as dec
+    from containerpilot_tpu.models.transformer import init_params
+    from containerpilot_tpu.workload.serve_prefix import (
+        PrefixCache,
+        generate_with_prefix,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=128, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    def srv(prefill_chunk):
+        return SimpleNamespace(
+            cfg=cfg, params=params, max_len=128,
+            prefill_chunk=prefill_chunk,
+            prefix_cache=PrefixCache(4),
+            batch_stats={"calls": 0, "rows": 0},
+        )
+
+    pieces = []
+    real_pieces = dec.extend_pieces
+
+    def counting_pieces(params_, cache, suffix, cfg_, chunk_len):
+        pieces.append((int(suffix.shape[1]), int(chunk_len)))
+        return real_pieces(params_, cache, suffix, cfg_, chunk_len)
+
+    dec.extend_pieces = counting_pieces
+    try:
+        shared = list(range(1, 41))       # 40-token history
+        turn2 = shared + [50] * 24        # 24-token suffix > chunk 8
+        outs = {}
+        hit_pieces = {}
+        for name, chunk_len in (("plain", 0), ("chunked", 8)):
+            s = srv(chunk_len)
+            cold = generate_with_prefix(
+                s, shared, 8, 0.0, 0, 0.0, -1, 0
+            )
+            pieces.clear()  # isolate the HIT call's extend pieces
+            hit = generate_with_prefix(
+                s, turn2, 8, 0.0, 0, 0.0, -1, 0
+            )
+            hit_pieces[name] = list(pieces)
+            outs[name] = [cold, hit]
+            assert s.prefix_cache.stats["misses"] == 1, (
+                s.prefix_cache.stats
+            )
+            assert s.prefix_cache.stats["hits"] == 1, (
+                s.prefix_cache.stats
+            )
+            # suffix 24 buckets to 32 (BUCKET=16), so 32 of the 40
+            # matched tokens are reused and 32 re-extend
+            assert s.prefix_cache.stats["tokens_reused"] == 32
+    finally:
+        dec.extend_pieces = real_pieces
+    assert outs["plain"] == outs["chunked"]
+    # the chunked server's hit actually took the bounded-piece path;
+    # the unchunked server's hit stayed on the one-shot extend
+    assert hit_pieces == {"plain": [], "chunked": [(32, 8)]}
 
 
 def test_chunked_prefill_matches_prefill():
