@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all build test test-fast test-workload integration bench lint clean image
+.PHONY: all build test test-fast test-workload integration fleet-smoke bench lint clean image
 
 all: build test
 
@@ -28,6 +28,11 @@ test-workload:
 # the integration-grade scenarios only (real CLI, real processes)
 integration: build
 	$(PYTHON) -m pytest tests/test_integration.py tests/test_app.py -q
+
+# the inference-fleet scenarios (gateway routing units + the
+# two-replica drain-mid-traffic integration test) on the CPU backend
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_fleet.py -q
 
 bench:
 	$(PYTHON) bench.py

@@ -705,6 +705,114 @@ def host_overhead_bench(rounds: int = 40) -> dict:
     }
 
 
+def gateway_overhead_bench(rounds: int = 60) -> dict:
+    """Per-request latency the fleet gateway adds over direct replica
+    access, runnable on ANY backend (tiny CPU-sized config).
+
+    Boots one in-process InferenceServer, registers it in a file
+    catalog via a FleetMember, fronts it with a FleetGateway, then
+    measures /v1/generate round trips both direct-to-replica and
+    through the gateway — same request, same process, interleaved so
+    scheduler drift hits both sides equally. The reported
+    ``gateway_added_ms`` (median via-gateway minus median direct) is
+    the cost of the extra hop: one accept, one proxied connect, header
+    parse, and the routing/metrics bookkeeping."""
+    import os
+    import tempfile
+    import urllib.request
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import jax.numpy as jnp
+
+    from containerpilot_tpu.discovery import FileCatalogBackend
+    from containerpilot_tpu.fleet import FleetGateway, FleetMember
+    from containerpilot_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=64, n_heads=4, n_layers=1, d_ff=256,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server = InferenceServer(cfg, params, "127.0.0.1", 0, max_len=64)
+    body = json.dumps(
+        {"tokens": [[1, 2, 3, 4]], "max_new_tokens": 8}
+    ).encode()
+
+    def post(port: int) -> float:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            resp.read()
+        return (time.perf_counter() - t0) * 1e3
+
+    direct: list = []
+    via: list = []
+    with tempfile.TemporaryDirectory() as root:
+        backend = FileCatalogBackend(root)
+
+        async def scenario() -> None:
+            loop = asyncio.get_event_loop()
+            await server.run()
+            member = FleetMember(
+                server, backend, "bench-infer", ttl=30,
+                heartbeat_interval=0.2,
+            )
+            await member.start()
+            gateway = FleetGateway(
+                backend, "bench-infer", "127.0.0.1", 0,
+                poll_interval=0.2, hedge=False,
+            )
+            await gateway.run()
+            for _ in range(200):
+                if gateway.replica_count:
+                    break
+                await asyncio.sleep(0.05)
+            assert gateway.replica_count == 1
+            for _ in range(5):  # warm both paths (compiles, routes)
+                await loop.run_in_executor(None, post, server.port)
+                await loop.run_in_executor(None, post, gateway.port)
+            for _ in range(rounds):
+                direct.append(
+                    await loop.run_in_executor(None, post, server.port)
+                )
+                via.append(
+                    await loop.run_in_executor(None, post, gateway.port)
+                )
+            await gateway.stop()
+            await member.stop()
+            await server.stop()
+
+        asyncio.run(scenario())
+
+    direct_ms = statistics.median(direct)
+    via_ms = statistics.median(via)
+    return {
+        "backend": jax.default_backend(),
+        "config": (
+            f"{cfg.n_layers}L d{cfg.d_model} v{cfg.vocab_size}, "
+            f"8 new tokens, {rounds} interleaved rounds"
+        ),
+        "direct_ms": round(direct_ms, 3),
+        "direct_min_ms": round(min(direct), 3),
+        "gateway_ms": round(via_ms, 3),
+        "gateway_min_ms": round(min(via), 3),
+        "gateway_added_ms": round(via_ms - direct_ms, 3),
+        "gateway_added_min_ms": round(min(via) - min(direct), 3),
+    }
+
+
 def _bench_subprocess(fn_name: str, timeout_s: int,
                       env: dict | None = None) -> dict:
     """Run one workload bench in its own interpreter with a hard
@@ -796,10 +904,16 @@ def workload_benches() -> dict:
         "host_overhead_bench", 900,
         env=None if backend == "tpu" else {"JAX_PLATFORMS": "cpu"},
     )
+    # the fleet gateway's added per-request latency is a host-side
+    # number too: measure it on every backend
+    extras["gateway_overhead"] = _bench_subprocess(
+        "gateway_overhead_bench", 600,
+        env=None if backend == "tpu" else {"JAX_PLATFORMS": "cpu"},
+    )
     if backend != "tpu":
         extras["skipped"] = (
             f"backend is {backend}, not a reachable tpu "
-            "(host_overhead above ran on cpu)"
+            "(host_overhead/gateway_overhead above ran on cpu)"
         )
         return extras
     for name, fn_name, timeout_s in (

@@ -20,6 +20,8 @@ Layer map (bottom-up; see SURVEY.md §1 for the reference layout):
     control/     unix-socket HTTP control plane; client/ is its SDK
     config/      JSON5 + template config pipeline
     core/        the App generation loop, signals, CLI flags
+    fleet/       inference fleet: replica registration/drain (FleetMember)
+                 + discovery-driven routing gateway (FleetGateway)
     models/ ops/ parallel/ workload/   the TPU workload half: a JAX/pjit
                  training harness (flagship transformer, sharding rules,
                  pallas-ready op library) run *under* the supervisor.

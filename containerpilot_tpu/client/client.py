@@ -7,14 +7,26 @@ step-rate metrics).
 """
 from __future__ import annotations
 
+import errno
 import http.client
 import json
 import socket
+import time
 from typing import Any, Dict, Optional
 
 
 class ControlClientError(RuntimeError):
     pass
+
+
+# connect-phase failures worth retrying briefly: the socket file does
+# not exist yet (supervisor still booting), nothing is accepting on it
+# yet, or the kernel pushed back transiently. All three happen on the
+# FIRST control call after `containerpilot start` and nothing has been
+# sent when they fire, so a retry cannot double-apply a request.
+_TRANSIENT_ERRNOS = frozenset(
+    {errno.ECONNREFUSED, errno.EAGAIN, errno.ENOENT, errno.EALREADY}
+)
 
 
 class _UnixHTTPConnection(http.client.HTTPConnection):
@@ -30,29 +42,56 @@ class _UnixHTTPConnection(http.client.HTTPConnection):
 
 
 class ControlClient:
-    def __init__(self, socket_path: str, timeout: float = 10.0) -> None:
+    def __init__(
+        self,
+        socket_path: str,
+        timeout: float = 10.0,
+        retries: int = 3,
+        retry_delay: float = 0.05,
+    ) -> None:
         self.socket_path = socket_path
         self.timeout = timeout
+        # >= 0 so _request's loop always makes at least one attempt
+        # (its last iteration always returns or raises)
+        self.retries = max(retries, 0)
+        self.retry_delay = retry_delay
 
     def _request(
         self, method: str, path: str, body: Optional[Dict[str, Any]] = None
     ) -> str:
-        conn = _UnixHTTPConnection(self.socket_path, self.timeout)
-        try:
-            payload = json.dumps(body) if body is not None else None
-            headers = {"Content-Type": "application/json"} if payload else {}
-            conn.request(method, path, body=payload, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read().decode("utf-8", "replace")
-            if resp.status != 200:
-                raise ControlClientError(
-                    f"{method} {path}: HTTP {resp.status}: {data.strip()}"
+        """One control-plane round trip. Transient connect-phase
+        socket errors (ECONNREFUSED/EAGAIN/ENOENT while the supervisor
+        is still binding its socket) retry with short exponential
+        backoff instead of failing the first control call after
+        start; anything else surfaces immediately."""
+        delay = self.retry_delay
+        for attempt in range(self.retries + 1):
+            conn = _UnixHTTPConnection(self.socket_path, self.timeout)
+            try:
+                payload = json.dumps(body) if body is not None else None
+                headers = (
+                    {"Content-Type": "application/json"} if payload else {}
                 )
-            return data
-        except (OSError, http.client.HTTPException) as exc:
-            raise ControlClientError(f"{method} {path}: {exc}") from None
-        finally:
-            conn.close()
+                conn.request(method, path, body=payload, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read().decode("utf-8", "replace")
+                if resp.status != 200:
+                    raise ControlClientError(
+                        f"{method} {path}: HTTP {resp.status}: {data.strip()}"
+                    )
+                return data
+            except (OSError, http.client.HTTPException) as exc:
+                transient = (
+                    isinstance(exc, OSError)
+                    and exc.errno in _TRANSIENT_ERRNOS
+                )
+                if transient and attempt < self.retries:
+                    time.sleep(delay)
+                    delay = min(delay * 2, 0.5)
+                    continue
+                raise ControlClientError(f"{method} {path}: {exc}") from None
+            finally:
+                conn.close()
 
     def reload(self) -> None:
         """POST /v3/reload (reference: client.go:45-52)."""
@@ -75,6 +114,14 @@ class ControlClient:
         """GET /v3/ping (reference: client.go:104-115)."""
         self._request("GET", "/v3/ping")
         return True
+
+    def get_maintenance_status(self) -> bool:
+        """GET /v3/maintenance/status: whether the supervisor is in
+        maintenance mode right now (an extension over the reference's
+        write-only maintenance verbs — drain runbooks need to confirm
+        the flip actually landed)."""
+        data = json.loads(self._request("GET", "/v3/maintenance/status"))
+        return bool(data.get("maintenance"))
 
     def get_events(self) -> list:
         """GET /v3/events: the supervisor's recent-event ring (an

@@ -8,6 +8,8 @@ control/endpoints.go). Endpoints (all under /v3):
 - ``POST /v3/metric``               publish {METRIC, "name|value"} events
 - ``POST /v3/maintenance/enable``   publish GlobalEnterMaintenance
 - ``POST /v3/maintenance/disable``  publish GlobalExitMaintenance
+- ``GET  /v3/maintenance/status``   {"maintenance": bool} (extension:
+  drain runbooks confirm the flip landed)
 - ``GET  /v3/ping``                 liveness of the socket
 
 Binding retries while a prior generation's socket file lingers
@@ -63,6 +65,10 @@ class ControlServer:
     def __init__(self, cfg: ControlConfig) -> None:
         self.cfg = cfg
         self.bus: Optional[EventBus] = None
+        # the last maintenance verb posted through THIS generation's
+        # socket; /v3/maintenance/status reads it back so operators
+        # (and fleet drain runbooks) can confirm the flip landed
+        self.maintenance = False
         self._server = HTTPServer()
         self._server.route("GET", "/v3/ping", self._ping)
         self._server.route("POST", "/v3/environ", self._put_environ)
@@ -73,6 +79,9 @@ class ControlServer:
         )
         self._server.route(
             "POST", "/v3/maintenance/disable", self._post_maintenance_disable
+        )
+        self._server.route(
+            "GET", "/v3/maintenance/status", self._get_maintenance_status
         )
         # observability beyond the reference: the bus's recent-event
         # ring and the live actor-task table, for debugging live
@@ -199,10 +208,16 @@ class ControlServer:
 
     async def _post_maintenance_enable(self, req: Request) -> Response:
         assert self.bus is not None
+        self.maintenance = True
         self.bus.publish(GLOBAL_ENTER_MAINTENANCE)
         return self._respond(200, req.path)
 
     async def _post_maintenance_disable(self, req: Request) -> Response:
         assert self.bus is not None
+        self.maintenance = False
         self.bus.publish(GLOBAL_EXIT_MAINTENANCE)
         return self._respond(200, req.path)
+
+    async def _get_maintenance_status(self, req: Request) -> Response:
+        body = json.dumps({"maintenance": self.maintenance}).encode()
+        return self._respond(200, req.path, body, "application/json")

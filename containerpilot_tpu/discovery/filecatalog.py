@@ -119,25 +119,38 @@ class FileCatalogBackend(Backend):
         now = time.time()
         out: List[ServiceInstance] = []
         for fname in sorted(os.listdir(sdir)):
+            # only settled records. This also skips writer scratch
+            # files (`<id>.json.tmp`, left behind by a crash between
+            # write and os.replace): they don't end in ".json"
             if not fname.endswith(".json"):
                 continue
+            # a torn/partial write (concurrent writer on NFS, killed
+            # host) or a malformed record is CRITICAL — skipped from
+            # the healthy set — never an exception that kills the
+            # whole listing for every healthy peer next to it
             try:
                 with open(os.path.join(sdir, fname), encoding="utf-8") as f:
                     record = json.load(f)
-            except (OSError, ValueError):
-                continue
-            if record.get("status") != "passing" or record.get("expires", 0) < now:
-                continue
-            if tag and tag not in (record.get("tags") or []):
-                continue
-            out.append(
-                ServiceInstance(
+                if not isinstance(record, dict):
+                    continue
+                instance = ServiceInstance(
                     id=record["id"],
                     name=record["name"],
-                    address=record.get("address", ""),
+                    address=str(record.get("address") or ""),
                     port=int(record.get("port") or 0),
                 )
-            )
+                healthy = (
+                    record.get("status") == "passing"
+                    and float(record.get("expires") or 0) >= now
+                )
+                tags = record.get("tags") or []
+            except (OSError, ValueError, KeyError, TypeError):
+                continue
+            if not healthy:
+                continue
+            if tag and (not isinstance(tags, list) or tag not in tags):
+                continue
+            out.append(instance)
         return out
 
     def check_for_upstream_changes(

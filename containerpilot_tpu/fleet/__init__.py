@@ -1,0 +1,26 @@
+"""Inference fleet: discovery-driven serving replicas + gateway.
+
+ContainerPilot's whole point is lifecycle — register a service,
+heartbeat its health, watch upstreams, drain on maintenance — and
+this package joins that supervisor half to the serving half
+(workload/serve.py) as a FLEET:
+
+- ``FleetMember`` (member.py): registers one running InferenceServer
+  in a discovery Backend with a TTL check, heartbeats the TTL off the
+  replica's real health state, and implements the drain path (health
+  503 + reject new work + deregister while in-flight requests
+  finish). Wired to a supervisor bus, the control plane's
+  ``POST /v3/maintenance/enable`` drains the replica.
+- ``FleetGateway`` (gateway.py): discovers healthy replicas through a
+  watches-style catalog poll and proxies the inference API over them
+  with least-outstanding-requests routing, optional session/prefix
+  affinity, retry-on-a-different-replica, tail-latency hedging, and
+  per-replica counters on ``/metrics``.
+
+Every later scale direction (autoscaling, multi-backend, spillover)
+routes through this seam.
+"""
+from .gateway import FleetGateway, Replica
+from .member import FleetMember
+
+__all__ = ["FleetGateway", "FleetMember", "Replica"]

@@ -1,0 +1,4 @@
+"""``python -m containerpilot_tpu.fleet`` runs the gateway CLI."""
+from .gateway import main
+
+raise SystemExit(main())

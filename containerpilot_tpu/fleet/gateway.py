@@ -1,0 +1,885 @@
+"""FleetGateway: health-aware HTTP routing over discovered replicas.
+
+The fleet's data plane. A gateway process discovers healthy
+``InferenceServer`` replicas through a watches-style poll on the
+discovery Backend (the same ``check_for_upstream_changes`` discipline
+supervisor Watch actors use) and proxies the inference API over them:
+
+- **Routing**: least-outstanding-requests across the healthy set,
+  with optional affinity — requests carrying a ``session_id`` (or an
+  ``X-Affinity-Key`` header, or — in ``prefix`` mode — sharing a
+  prompt prefix) stick to one replica so its prefix KV cache keeps
+  hitting. A sticky key whose replica drained away is re-routed and
+  counted (``drained_away``).
+- **Retries**: generation requests are idempotent under a fixed seed,
+  so a transport failure or a 503 (a draining or warming replica)
+  retries on a DIFFERENT replica with capped exponential backoff —
+  the drain path's client-visible half: zero 5xx while a replica
+  leaves the fleet.
+- **Hedging**: once enough latency samples exist, a buffered request
+  still unanswered at the observed tail quantile dispatches a hedge
+  to a second replica; first success wins, the loser is cancelled
+  (its connection closes, and the replica's continuous-batching loop
+  absorbs the wasted decode).
+- **Streaming**: SSE responses (``"stream": true``) relay chunk-by-
+  chunk; retries apply only BEFORE the first upstream byte, never
+  mid-stream.
+- **Metrics**: per-replica counters (routed, retried, hedged,
+  drained_away) plus request/latency series in a private registry on
+  ``GET /metrics`` (utils/prom exposition), and a ``GET /fleet`` JSON
+  snapshot for runbooks.
+
+The gateway holds no model state: it is restartable at will, N
+gateways can front one fleet, and every later scale PR (autoscaling,
+multi-backend, spillover) slots in behind this surface.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, Iterable, List, Optional, Set, Tuple
+
+from ..discovery import Backend
+from ..utils.http import HTTPServer, Request, Response, StreamingResponse
+from ..utils.prom import exposition
+from ..watches import poll_upstream
+
+log = logging.getLogger("containerpilot.fleet")
+
+# upstream statuses worth moving to another replica for: 503 is a
+# draining/warming replica by this repo's own convention
+RETRYABLE_STATUSES = frozenset({503})
+AFFINITY_MODES = ("none", "session", "prefix")
+STICKY_CAPACITY = 4096
+PREFIX_TOKENS = 16  # ids of the prompt prefix hashed in "prefix" mode
+PREFIX_CHARS = 64   # chars of a text prompt hashed in "prefix" mode
+HEDGE_MIN_SAMPLES = 20
+
+
+class UpstreamError(RuntimeError):
+    """Transport-level failure talking to one replica."""
+
+
+@dataclass
+class Replica:
+    """One healthy replica as the router sees it."""
+
+    id: str
+    address: str
+    port: int
+    outstanding: int = 0
+    first_seen: float = field(default_factory=time.monotonic)
+
+    @property
+    def authority(self) -> str:
+        return f"{self.address}:{self.port}"
+
+
+async def _open_and_send(
+    replica: Replica,
+    method: str,
+    path: str,
+    body: bytes,
+    connect_timeout: float,
+    read_timeout: float,
+) -> Tuple[asyncio.StreamReader, asyncio.StreamWriter, int, Dict[str, str]]:
+    """Connect, send one request, parse the status line + headers.
+    The caller owns the (reader, writer) pair afterwards.
+
+    ``connect_timeout`` bounds only the dial; the status line is
+    bounded by ``read_timeout`` — the replica's HTTP server writes it
+    after the handler finishes, so for a buffered generation it
+    arrives only once the whole decode is done (seconds to minutes)."""
+    try:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(replica.address, replica.port),
+            connect_timeout,
+        )
+    except (OSError, asyncio.TimeoutError) as exc:
+        raise UpstreamError(f"connect {replica.authority}: {exc}") from None
+    try:
+        head = (
+            f"{method} {path} HTTP/1.1\r\n"
+            f"Host: {replica.authority}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: close\r\n\r\n"
+        )
+        writer.write(head.encode() + body)
+        await writer.drain()
+        status_line = await asyncio.wait_for(
+            reader.readline(), read_timeout
+        )
+        parts = status_line.decode("latin-1").split(None, 2)
+        if len(parts) < 2 or not parts[1].isdigit():
+            raise UpstreamError(
+                f"{replica.authority}: malformed status line "
+                f"{status_line!r}"
+            )
+        status = int(parts[1])
+        headers: Dict[str, str] = {}
+        while True:
+            line = await asyncio.wait_for(reader.readline(), read_timeout)
+            if line in (b"\r\n", b"\n", b""):
+                break
+            key, _, value = line.decode("latin-1").partition(":")
+            headers[key.strip().lower()] = value.strip()
+        return reader, writer, status, headers
+    except UpstreamError:
+        writer.close()
+        raise
+    except (OSError, asyncio.TimeoutError, UnicodeDecodeError) as exc:
+        writer.close()
+        raise UpstreamError(f"{replica.authority}: {exc}") from None
+    except BaseException:  # CancelledError: close the socket on the way out
+        writer.close()
+        raise
+
+
+async def _read_body(
+    reader: asyncio.StreamReader, headers: Dict[str, str], timeout: float
+) -> bytes:
+    """Read a buffered response body: Content-Length when present,
+    else until EOF (the servers here send Connection: close)."""
+    length = headers.get("content-length")
+    if length is not None and length.isdigit():
+        return await asyncio.wait_for(reader.readexactly(int(length)), timeout)
+    chunks: List[bytes] = []
+    while True:
+        chunk = await asyncio.wait_for(reader.read(65536), timeout)
+        if not chunk:
+            return b"".join(chunks)
+        chunks.append(chunk)
+
+
+class FleetGateway:
+    def __init__(
+        self,
+        backend: Backend,
+        service_name: str = "inference",
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        tag: str = "",
+        poll_interval: float = 1.0,
+        retries: int = 2,
+        retry_backoff: float = 0.05,
+        retry_backoff_cap: float = 0.5,
+        hedge: bool = True,
+        hedge_quantile: float = 0.95,
+        hedge_min_ms: float = 50.0,
+        hedge_after_ms: Optional[float] = None,
+        affinity: str = "session",
+        connect_timeout: float = 5.0,
+        request_timeout: float = 600.0,
+    ) -> None:
+        if affinity not in AFFINITY_MODES:
+            raise ValueError(f"affinity must be one of {AFFINITY_MODES}")
+        self.backend = backend
+        self.service_name = service_name
+        self.host = host
+        self.port = port
+        self.tag = tag
+        self.poll_interval = poll_interval
+        self.retries = retries
+        self.retry_backoff = retry_backoff
+        self.retry_backoff_cap = retry_backoff_cap
+        self.hedge = hedge
+        self.hedge_quantile = hedge_quantile
+        self.hedge_min_ms = hedge_min_ms
+        # fixed hedge deadline override (tests, known-SLO deployments);
+        # None = learn the tail from observed latencies
+        self.hedge_after_ms = hedge_after_ms
+        self.affinity = affinity
+        self.connect_timeout = connect_timeout
+        self.request_timeout = request_timeout
+
+        self._replicas: Dict[str, Replica] = {}
+        self._sticky: "OrderedDict[str, str]" = OrderedDict()
+        # per-endpoint pools of recent 200-latencies (seconds): the
+        # hedge threshold for generate must not be poisoned by
+        # millisecond score/model samples sharing one tail estimate
+        self._latencies: Dict[str, Deque[float]] = {}
+        self._poll_task: Optional["asyncio.Task[None]"] = None
+
+        # private registry: N gateways (or a gateway next to a
+        # supervisor) in one process must not collide (utils/prom.py)
+        from prometheus_client import (
+            CollectorRegistry,
+            Counter,
+            Gauge,
+            Histogram,
+        )
+
+        self._registry = CollectorRegistry()
+        self._m_requests = Counter(
+            "containerpilot_gateway_requests",
+            "gateway requests by endpoint and status code",
+            ["endpoint", "code"], registry=self._registry,
+        )
+        self._m_latency = Histogram(
+            "containerpilot_gateway_request_seconds",
+            "gateway request wall time, by endpoint",
+            ["endpoint"], registry=self._registry,
+            buckets=(.005, .02, .05, .1, .25, .5, 1, 2.5, 5, 10, 30, 60),
+        )
+        self._m_routed = Counter(
+            "containerpilot_gateway_routed",
+            "requests dispatched to a replica",
+            ["replica"], registry=self._registry,
+        )
+        self._m_retried = Counter(
+            "containerpilot_gateway_retried",
+            "requests retried away from a replica "
+            "(transport failure or retryable status)",
+            ["replica"], registry=self._registry,
+        )
+        self._m_hedged = Counter(
+            "containerpilot_gateway_hedged",
+            "hedge dispatches launched against a slow replica",
+            ["replica"], registry=self._registry,
+        )
+        self._m_drained = Counter(
+            "containerpilot_gateway_drained_away",
+            "sticky keys re-routed because their replica left the fleet",
+            ["replica"], registry=self._registry,
+        )
+        self._g_replicas = Gauge(
+            "containerpilot_gateway_healthy_replicas",
+            "replicas currently in the healthy routing set",
+            registry=self._registry,
+        )
+
+        self._server = HTTPServer()
+        self._server.route("GET", "/health", self._health)
+        self._server.route("GET", "/metrics", self._metrics)
+        self._server.route("GET", "/fleet", self._fleet_status)
+        self._server.route("GET", "/v1/model", self._model_info)
+        for path, endpoint in (
+            ("/v1/generate", "generate"),
+            ("/v1/completions", "completions"),
+            ("/v1/score", "score"),
+        ):
+            self._server.route("POST", path, self._api(endpoint, path))
+
+    # -- lifecycle ------------------------------------------------------
+
+    async def run(self) -> None:
+        await self._server.start_tcp(self.host, self.port)
+        self.port = self._server.bound_port or self.port
+        await self._poll_once()  # first routing set before traffic
+        self._poll_task = asyncio.get_event_loop().create_task(
+            self._poll_loop(), name=f"fleet-gateway:{self.service_name}"
+        )
+        log.info(
+            "gateway: %s:%d fronting service %r (%d replicas)",
+            self.host, self.port, self.service_name, len(self._replicas),
+        )
+
+    async def stop(self) -> None:
+        if self._poll_task is not None and not self._poll_task.done():
+            self._poll_task.cancel()
+            try:
+                await self._poll_task
+            except asyncio.CancelledError:
+                pass
+            self._poll_task = None
+        await self._server.stop()
+
+    @property
+    def replica_count(self) -> int:
+        return len(self._replicas)
+
+    # -- discovery ------------------------------------------------------
+
+    async def _poll_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.poll_interval)
+            try:
+                await self._poll_once()
+            except Exception as exc:  # a flaky catalog isn't fatal
+                log.warning("gateway: catalog poll failed: %s", exc)
+
+    async def _poll_once(self) -> None:
+        loop = asyncio.get_event_loop()
+        did_change, healthy = await poll_upstream(
+            self.backend, self.service_name, self.tag
+        )
+        # change detection already scanned the catalog; re-list only
+        # when membership moved (or when this gateway holds nothing a
+        # freshly-shared backend considers unchanged, or the healthy
+        # set emptied) — steady state costs ONE catalog scan per poll
+        if not did_change:
+            if healthy and self._replicas:
+                return
+            if not healthy and not self._replicas:
+                return
+        instances = await loop.run_in_executor(
+            None, self.backend.instances, self.service_name, self.tag
+        )
+        fresh: Dict[str, Replica] = {}
+        for inst in instances:
+            address = inst.address or "127.0.0.1"
+            known = self._replicas.get(inst.id)
+            if known is not None and (known.address, known.port) == (
+                address, inst.port,
+            ):
+                fresh[inst.id] = known  # keep live outstanding counts
+            else:
+                fresh[inst.id] = Replica(inst.id, address, inst.port)
+        if did_change or set(fresh) != set(self._replicas):
+            log.info(
+                "gateway: healthy set -> %s",
+                sorted(f"{r.id}@{r.authority}" for r in fresh.values()),
+            )
+        self._replicas = fresh
+        self._g_replicas.set(len(fresh))
+
+    # -- routing --------------------------------------------------------
+
+    def _pick(self, exclude: Iterable[str] = ()) -> Optional[Replica]:
+        """Least-outstanding-requests; replica id breaks ties so the
+        choice is deterministic under equal load."""
+        excluded = set(exclude)
+        candidates = [
+            r for r in self._replicas.values() if r.id not in excluded
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: (r.outstanding, r.id))
+
+    def _affinity_key(
+        self, req: Request, body: Dict[str, Any]
+    ) -> Optional[str]:
+        if self.affinity == "none":
+            return None
+        session = body.get("session_id")
+        if isinstance(session, (str, int)) and str(session):
+            return f"s:{session}"
+        header = req.headers.get("x-affinity-key", "")
+        if header:
+            return f"h:{header}"
+        if self.affinity != "prefix":
+            return None
+        tokens = body.get("tokens")
+        if (
+            isinstance(tokens, list) and len(tokens) == 1
+            and isinstance(tokens[0], list) and tokens[0]
+        ):
+            prefix = ",".join(map(str, tokens[0][:PREFIX_TOKENS]))
+            return "p:" + hashlib.sha1(prefix.encode()).hexdigest()
+        prompt = body.get("prompt")
+        if isinstance(prompt, str) and prompt:
+            return "p:" + hashlib.sha1(
+                prompt[:PREFIX_CHARS].encode()
+            ).hexdigest()
+        return None
+
+    def _route(
+        self, key: Optional[str], exclude: Iterable[str] = ()
+    ) -> Optional[Replica]:
+        """Sticky affinity first, least-outstanding otherwise. A
+        sticky target that LEFT the fleet (drained/crashed) re-pins
+        and counts as drained_away; one that is merely excluded by
+        this request's retry re-routes this request only — the pin
+        (and the replica's warm prefix cache) survives a transient
+        failure."""
+        excluded = set(exclude)
+        repin = True
+        if key is not None:
+            pinned = self._sticky.get(key)
+            if pinned is not None:
+                replica = self._replicas.get(pinned)
+                if replica is None:
+                    self._m_drained.labels(pinned).inc()
+                    self._sticky.pop(key, None)
+                elif pinned not in excluded:
+                    self._sticky.move_to_end(key)
+                    return replica
+                else:
+                    repin = False  # transient exclusion: keep the pin
+        replica = self._pick(excluded)
+        if replica is not None and key is not None and repin:
+            self._sticky[key] = replica.id
+            self._sticky.move_to_end(key)
+            while len(self._sticky) > STICKY_CAPACITY:
+                self._sticky.popitem(last=False)
+        return replica
+
+    def _hedge_threshold(self, endpoint: str) -> Optional[float]:
+        """Seconds after which a second dispatch is justified for
+        ``endpoint``, or None while there's no basis to hedge on."""
+        if not self.hedge or len(self._replicas) < 2:
+            return None
+        if self.hedge_after_ms is not None:
+            return self.hedge_after_ms / 1e3
+        pool = self._latencies.get(endpoint)
+        if pool is None or len(pool) < HEDGE_MIN_SAMPLES:
+            return None
+        ordered = sorted(pool)
+        idx = min(
+            int(len(ordered) * self.hedge_quantile), len(ordered) - 1
+        )
+        return max(ordered[idx], self.hedge_min_ms / 1e3)
+
+    # -- local handlers -------------------------------------------------
+
+    async def _health(self, _req: Request) -> Response:
+        if not self._replicas:
+            return Response(
+                503, b"no healthy replicas\n",
+                headers={"Retry-After": "1"},
+            )
+        return Response(200, b"ok\n")
+
+    async def _metrics(self, _req: Request) -> Response:
+        body, content_type = exposition(self._registry)
+        return Response(200, body, content_type=content_type)
+
+    async def _fleet_status(self, _req: Request) -> Response:
+        body = json.dumps(
+            {
+                "service": self.service_name,
+                "poll_interval": self.poll_interval,
+                "replicas": [
+                    {
+                        "id": r.id,
+                        "address": r.address,
+                        "port": r.port,
+                        "outstanding": r.outstanding,
+                        "age_s": round(
+                            time.monotonic() - r.first_seen, 1
+                        ),
+                    }
+                    for r in sorted(
+                        self._replicas.values(), key=lambda r: r.id
+                    )
+                ],
+            }
+        ).encode()
+        return Response(200, body, content_type="application/json")
+
+    async def _model_info(self, req: Request) -> Response:
+        return await self._proxy_buffered("model", "GET", "/v1/model", b"", None)
+
+    # -- proxying -------------------------------------------------------
+
+    def _api(self, endpoint: str, path: str):
+        async def handler(req: Request) -> Response:
+            t0 = time.perf_counter()
+            body = req.body
+            try:
+                parsed = json.loads(body.decode() or "{}")
+            except (ValueError, UnicodeDecodeError):
+                parsed = {}  # the replica will 4xx it; just forward
+            if not isinstance(parsed, dict):
+                parsed = {}
+            key = self._affinity_key(req, parsed)
+            if parsed.get("stream"):
+                resp = await self._proxy_stream(endpoint, path, body, key)
+            else:
+                resp = await self._proxy_buffered(
+                    endpoint, "POST", path, body, key
+                )
+            self._m_latency.labels(endpoint).observe(
+                time.perf_counter() - t0
+            )
+            self._m_requests.labels(endpoint, str(resp.status)).inc()
+            return resp
+
+        return handler
+
+    async def _retry_pause(
+        self,
+        tried: Set[str],
+        failed_ids: Iterable[str],
+        attempt: int,
+        backoff: float,
+    ) -> float:
+        """The ONE retry bookkeeping discipline: exclude the failed
+        replicas, and — only when another attempt will actually
+        happen — count the retry and pay the capped exponential
+        backoff. Returns the advanced backoff."""
+        retrying = attempt < self.retries
+        for rid in failed_ids:
+            tried.add(rid)
+            if retrying:
+                self._m_retried.labels(rid).inc()
+        if retrying:
+            await asyncio.sleep(backoff)
+        return min(backoff * 2, self.retry_backoff_cap)
+
+    @staticmethod
+    def _failure_response(exc: Exception) -> Response:
+        return Response(
+            503,
+            f"upstream failure: {exc}\n".encode(),
+            headers={"Retry-After": "1"},
+        )
+
+    async def _fetch_from(
+        self,
+        endpoint: str,
+        replica: Replica,
+        method: str,
+        path: str,
+        body: bytes,
+    ) -> Tuple[int, Dict[str, str], bytes]:
+        """One buffered round trip to one replica, with routing
+        accounting. Raises UpstreamError on transport failure."""
+        self._m_routed.labels(replica.id).inc()
+        replica.outstanding += 1
+        t0 = time.perf_counter()
+        try:
+            reader, writer, status, headers = await _open_and_send(
+                replica, method, path, body,
+                self.connect_timeout, self.request_timeout,
+            )
+            try:
+                payload = await _read_body(
+                    reader, headers, self.request_timeout
+                )
+            except (OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError) as exc:
+                raise UpstreamError(
+                    f"{replica.authority}: {exc}"
+                ) from None
+            finally:
+                writer.close()
+        finally:
+            replica.outstanding -= 1
+        if status == 200:
+            self._latencies.setdefault(
+                endpoint, deque(maxlen=512)
+            ).append(time.perf_counter() - t0)
+        return status, headers, payload
+
+    async def _fetch_with_hedge(
+        self,
+        endpoint: str,
+        replica: Replica,
+        method: str,
+        path: str,
+        body: bytes,
+        tried: Set[str],
+    ) -> Tuple[int, Dict[str, str], bytes, Replica]:
+        """Dispatch to ``replica``; if the response is still not back
+        at the hedge threshold, race a second replica. First success
+        wins; the loser is cancelled (closing its connection). The
+        returned replica is the one whose response was taken, so the
+        caller blames retries/exclusions on the right instance; a
+        raised UpstreamError carries ``failed_ids`` naming every
+        replica that transport-failed in the race."""
+        primary = asyncio.ensure_future(
+            self._fetch_from(endpoint, replica, method, path, body)
+        )
+        threshold = self._hedge_threshold(endpoint)
+        if threshold is None:
+            status, headers, payload = await primary
+            return status, headers, payload, replica
+        done, _ = await asyncio.wait({primary}, timeout=threshold)
+        if done:
+            return (*primary.result(), replica)
+        hedge_replica = self._pick(tried | {replica.id})
+        if hedge_replica is None:
+            status, headers, payload = await primary
+            return status, headers, payload, replica
+        self._m_hedged.labels(replica.id).inc()
+        log.debug(
+            "gateway: hedging %s after %.0fms on %s",
+            path, threshold * 1e3, hedge_replica.id,
+        )
+        hedge = asyncio.ensure_future(
+            self._fetch_from(
+                endpoint, hedge_replica, method, path, body
+            )
+        )
+        owners = {primary: replica, hedge: hedge_replica}
+        pending = {primary, hedge}
+        fallback: Optional[Tuple[int, Dict[str, str], bytes, Replica]] = None
+        failed_ids: Set[str] = set()
+        error: Optional[BaseException] = None
+        try:
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                for task in done:
+                    try:
+                        status, headers, payload = task.result()
+                    except Exception as exc:
+                        # a transport-failed leg is excluded from
+                        # future attempts even when the OTHER leg's
+                        # response ends up being the one taken
+                        failed_ids.add(owners[task].id)
+                        tried.add(owners[task].id)
+                        error = exc
+                        continue
+                    if status not in RETRYABLE_STATUSES or not pending:
+                        return status, headers, payload, owners[task]
+                    # a leg that answered a retryable 503 is excluded
+                    # from future attempts too, even if the OTHER
+                    # leg's answer wins this race
+                    tried.add(owners[task].id)
+                    fallback = (status, headers, payload, owners[task])
+            if fallback is not None:
+                return fallback
+            assert error is not None
+            error.failed_ids = failed_ids  # type: ignore[attr-defined]
+            raise error
+        finally:
+            for task in (primary, hedge):
+                if not task.done():
+                    task.cancel()
+                    try:
+                        await task
+                    except (asyncio.CancelledError, Exception):
+                        pass
+
+    async def _proxy_buffered(
+        self,
+        endpoint: str,
+        method: str,
+        path: str,
+        body: bytes,
+        key: Optional[str],
+    ) -> Response:
+        tried: Set[str] = set()
+        backoff = self.retry_backoff
+        last: Optional[Response] = None
+        for attempt in range(self.retries + 1):
+            replica = self._route(key, tried)
+            if replica is None:
+                break
+            try:
+                status, headers, payload, served_by = (
+                    await self._fetch_with_hedge(
+                        endpoint, replica, method, path, body, tried
+                    )
+                )
+            except UpstreamError as exc:
+                log.warning("gateway: %s failed: %s", endpoint, exc)
+                last = self._failure_response(exc)
+                backoff = await self._retry_pause(
+                    tried,
+                    getattr(exc, "failed_ids", None) or {replica.id},
+                    attempt, backoff,
+                )
+                continue
+            if status in RETRYABLE_STATUSES and attempt < self.retries:
+                # blame the replica whose response this actually is —
+                # under hedging that may be the hedge, not the primary
+                last = self._relay(status, headers, payload)
+                backoff = await self._retry_pause(
+                    tried, {served_by.id}, attempt, backoff
+                )
+                continue
+            return self._relay(status, headers, payload)
+        return last or Response(
+            503, b"no healthy replicas\n", headers={"Retry-After": "1"}
+        )
+
+    @staticmethod
+    def _relay(
+        status: int, headers: Dict[str, str], payload: bytes
+    ) -> Response:
+        extra = {}
+        if "retry-after" in headers:
+            extra["Retry-After"] = headers["retry-after"]
+        return Response(
+            status,
+            payload,
+            content_type=headers.get(
+                "content-type", "text/plain; charset=utf-8"
+            ),
+            headers=extra,
+        )
+
+    async def _proxy_stream(
+        self,
+        endpoint: str,
+        path: str,
+        body: bytes,
+        key: Optional[str],
+    ) -> Response:
+        """SSE relay. Retries/re-routing apply only while nothing has
+        been sent downstream; once the upstream stream starts, the
+        gateway forwards bytes verbatim until EOF and mirrors client
+        disconnects upstream (closing the connection sets the
+        replica's cancel path at the next chunk boundary)."""
+        tried: Set[str] = set()
+        backoff = self.retry_backoff
+        last: Optional[Response] = None
+        for attempt in range(self.retries + 1):
+            replica = self._route(key, tried)
+            if replica is None:
+                break
+            self._m_routed.labels(replica.id).inc()
+            # count the stream as outstanding from the CONNECT on, not
+            # from first byte: a burst of concurrent streams must not
+            # all tie-break onto one replica while none has started
+            replica.outstanding += 1
+            held = True
+            try:
+                try:
+                    reader, writer, status, headers = (
+                        await _open_and_send(
+                            replica, "POST", path, body,
+                            self.connect_timeout, self.request_timeout,
+                        )
+                    )
+                except UpstreamError as exc:
+                    log.warning(
+                        "gateway: %s stream failed: %s", endpoint, exc
+                    )
+                    last = self._failure_response(exc)
+                    backoff = await self._retry_pause(
+                        tried, {replica.id}, attempt, backoff
+                    )
+                    continue
+                content_type = headers.get("content-type", "")
+                if "text/event-stream" not in content_type:
+                    # not a stream: a 422/503/500 error body (or a
+                    # server without --slots) — buffer and relay,
+                    # retrying the retryable statuses like the
+                    # buffered path
+                    try:
+                        payload = await _read_body(
+                            reader, headers, self.request_timeout
+                        )
+                    except (OSError, asyncio.TimeoutError,
+                            asyncio.IncompleteReadError) as exc:
+                        log.warning(
+                            "gateway: %s body read failed: %s",
+                            endpoint, exc,
+                        )
+                        last = self._failure_response(exc)
+                        backoff = await self._retry_pause(
+                            tried, {replica.id}, attempt, backoff
+                        )
+                        continue
+                    finally:
+                        writer.close()
+                    if (
+                        status in RETRYABLE_STATUSES
+                        and attempt < self.retries
+                    ):
+                        last = self._relay(status, headers, payload)
+                        backoff = await self._retry_pause(
+                            tried, {replica.id}, attempt, backoff
+                        )
+                        continue
+                    return self._relay(status, headers, payload)
+                held = False  # ownership moves to the relay's close()
+                return self._relay_stream(replica, reader, writer, status)
+            finally:
+                if held:
+                    replica.outstanding -= 1
+        return last or Response(
+            503, b"no healthy replicas\n", headers={"Retry-After": "1"}
+        )
+
+    def _relay_stream(
+        self,
+        replica: Replica,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        status: int,
+    ) -> StreamingResponse:
+        """Relay an upstream SSE stream; the caller's outstanding
+        count transfers here and is released by close()."""
+        closed = [False]
+
+        def close() -> None:
+            # idempotent: generator-finally AND the response's close
+            # callback both fire on some paths
+            if closed[0]:
+                return
+            closed[0] = True
+            replica.outstanding -= 1
+            writer.close()
+
+        async def chunks():
+            try:
+                while True:
+                    chunk = await asyncio.wait_for(
+                        reader.read(65536), self.request_timeout
+                    )
+                    if not chunk:
+                        return
+                    yield chunk
+            except (OSError, asyncio.TimeoutError):
+                return  # upstream died mid-stream; downstream sees EOF
+            finally:
+                close()
+
+        return StreamingResponse(chunks(), status=status, close=close)
+
+
+def main() -> int:
+    """Run a standalone gateway:
+    ``python -m containerpilot_tpu.fleet --catalog file:/shared/catalog``
+    """
+    import argparse
+    import logging as logging_mod
+    import signal as signal_mod
+
+    from ..discovery.factory import new_backend
+
+    parser = argparse.ArgumentParser(
+        description="inference fleet gateway"
+    )
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8800)
+    parser.add_argument(
+        "--catalog", required=True,
+        help="discovery backend URI, as the supervisor's 'consul' "
+        "config key: 'file:/shared/catalog' or 'consul:8500'",
+    )
+    parser.add_argument("--service", default="inference")
+    parser.add_argument("--tag", default="")
+    parser.add_argument("--poll-interval", type=float, default=1.0)
+    parser.add_argument("--retries", type=int, default=2)
+    parser.add_argument(
+        "--affinity", choices=AFFINITY_MODES, default="session"
+    )
+    parser.add_argument(
+        "--hedge-after-ms", type=float, default=None,
+        help="fixed hedge deadline; default learns the tail quantile",
+    )
+    parser.add_argument("--no-hedge", action="store_true")
+    args = parser.parse_args()
+
+    logging_mod.basicConfig(
+        level=logging_mod.INFO, format="%(asctime)s %(name)s %(message)s"
+    )
+    backend = new_backend(args.catalog)
+    if backend is None:
+        raise SystemExit("--catalog resolved to no discovery backend")
+    gateway = FleetGateway(
+        backend, args.service, args.host, args.port,
+        tag=args.tag, poll_interval=args.poll_interval,
+        retries=args.retries, affinity=args.affinity,
+        hedge=not args.no_hedge, hedge_after_ms=args.hedge_after_ms,
+    )
+
+    async def serve() -> None:
+        await gateway.run()
+        stop = asyncio.Event()
+        loop = asyncio.get_event_loop()
+        for sig in (signal_mod.SIGTERM, signal_mod.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await gateway.stop()
+
+    asyncio.run(serve())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

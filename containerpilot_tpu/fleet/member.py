@@ -1,0 +1,199 @@
+"""FleetMember: one serving replica's registration + drain lifecycle.
+
+The supervisor registers *jobs* in discovery (discovery/service.py);
+the serving half used to run as a lone replica nothing registered,
+watched, or drained. A FleetMember closes that gap for an in-process
+``InferenceServer``:
+
+- **Registration + heartbeats.** The replica is advertised under a
+  service name with a TTL check (the exact ServiceRegistration /
+  ServiceDefinition machinery jobs use, FIFO catalog queue included).
+  Heartbeats fire only while the replica is genuinely serveable
+  (``server.ready`` and not draining), so a wedged or warming replica
+  goes catalog-critical by TTL expiry exactly like a wedged job.
+- **Drain.** ``drain()`` flips the server into maintenance (health
+  503, new generate/completions rejected with 503 + Retry-After),
+  deregisters the catalog record so gateways route away within one
+  poll interval, and waits for in-flight requests — including running
+  slot-engine rows — to finish. ``resume()`` undoes it; the next
+  heartbeat lazily re-registers.
+- **Control plane.** ``attach_bus(bus)`` subscribes to the event
+  bus's maintenance events, so the supervisor's
+  ``POST /v3/maintenance/enable|disable`` drains/resumes the replica
+  the same way it deregisters jobs.
+
+The ``server`` only needs the drain surface (``ready``, ``draining``,
+``enter_maintenance``/``exit_maintenance``, ``inflight``, ``port``) —
+anything duck-typing it (tests, future pod frontends) can join a
+fleet.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import uuid
+from typing import Any, Iterable, Optional
+
+from ..discovery import Backend, ServiceDefinition, ServiceRegistration
+from ..events import (
+    EventBus,
+    EventHandler,
+    GLOBAL_ENTER_MAINTENANCE,
+    GLOBAL_EXIT_MAINTENANCE,
+    GLOBAL_SHUTDOWN,
+    QUIT_BY_TEST,
+)
+
+log = logging.getLogger("containerpilot.fleet")
+
+
+class FleetMember(EventHandler):
+    def __init__(
+        self,
+        server: Any,
+        backend: Backend,
+        service_name: str = "inference",
+        *,
+        ttl: int = 10,
+        heartbeat_interval: float = 0.0,
+        address: str = "127.0.0.1",
+        instance_id: str = "",
+        tags: Iterable[str] = (),
+    ) -> None:
+        super().__init__()
+        if ttl < 1:
+            raise ValueError("ttl must be >= 1 second")
+        self.server = server
+        self.backend = backend
+        self.service_name = service_name
+        self.ttl = ttl
+        # default cadence: two beats per TTL window, like the
+        # reference's heartbeat guidance — one missed beat never
+        # flips a healthy replica critical
+        self.heartbeat_interval = heartbeat_interval or ttl / 2.0
+        self.instance_id = (
+            instance_id or f"{service_name}-{uuid.uuid4().hex[:8]}"
+        )
+        self.service = ServiceDefinition(
+            ServiceRegistration(
+                id=self.instance_id,
+                name=service_name,
+                port=int(getattr(server, "port", 0) or 0),
+                ttl=ttl,
+                tags=list(tags),
+                address=address,
+            ),
+            backend,
+        )
+        self._beat_task: Optional["asyncio.Task[None]"] = None
+        self._bus_task: Optional["asyncio.Task[None]"] = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    async def start(self) -> None:
+        """Start heartbeating. Call after ``server.run()`` so a
+        port-0 bind has resolved to the real port."""
+        self.service.registration.port = int(
+            getattr(self.server, "port", 0) or 0
+        )
+        self._beat_task = asyncio.get_event_loop().create_task(
+            self._beat_loop(), name=f"fleet-member:{self.instance_id}"
+        )
+
+    async def stop(self, deregister: bool = True) -> None:
+        for task in (self._beat_task, self._bus_task):
+            if task is not None and not task.done():
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+        self._beat_task = self._bus_task = None
+        if deregister:
+            await self._deregister()
+
+    async def _beat_loop(self) -> None:
+        while True:
+            self._beat_once()
+            await asyncio.sleep(self.heartbeat_interval)
+
+    def _beat_once(self) -> None:
+        if getattr(self.server, "draining", False):
+            return  # drained replicas stay out of the catalog
+        if getattr(self.server, "ready", False):
+            # lazy-register + TTL refresh; enqueued FIFO off-loop
+            self.service.send_heartbeat()
+        # not ready (warming, or wedged enough that ready regressed):
+        # no beat — an existing record's TTL expiry flips it critical
+
+    async def _deregister(self) -> None:
+        future = self.service.deregister()
+        if future is not None:
+            try:
+                await asyncio.wrap_future(future)
+            except Exception as exc:  # catalog gone is not fatal here
+                log.warning(
+                    "%s: deregister failed: %s", self.instance_id, exc
+                )
+
+    # -- drain ----------------------------------------------------------
+
+    async def drain(
+        self, wait: bool = True, timeout: float = 30.0
+    ) -> bool:
+        """Maintenance: stop advertising, stop accepting, finish
+        in-flight. Returns True once the replica is idle (always True
+        for ``wait=False``; False only on timeout)."""
+        self.server.enter_maintenance()
+        await self._deregister()
+        if not wait:
+            return True
+        deadline = time.monotonic() + timeout
+        while getattr(self.server, "inflight", 0) > 0:
+            if time.monotonic() >= deadline:
+                log.warning(
+                    "%s: drain timed out with %d in flight",
+                    self.instance_id,
+                    self.server.inflight,
+                )
+                return False
+            await asyncio.sleep(0.02)
+        log.info("%s: drained", self.instance_id)
+        return True
+
+    def resume(self) -> None:
+        """Exit maintenance; the next heartbeat lazily re-registers
+        (deregister reset ``was_registered``)."""
+        self.server.exit_maintenance()
+
+    # -- control-plane hookup -------------------------------------------
+
+    def attach_bus(self, bus: EventBus) -> "asyncio.Task[None]":
+        """Subscribe to the supervisor bus so the control plane's
+        maintenance verbs drain/resume this replica."""
+        self.subscribe(bus)
+        self.register(bus)
+        self._bus_task = asyncio.get_event_loop().create_task(
+            self._bus_loop(), name=f"fleet-member-bus:{self.instance_id}"
+        )
+        return self._bus_task
+
+    async def _bus_loop(self) -> None:
+        try:
+            while True:
+                event = await self.next_event()
+                if event in (GLOBAL_SHUTDOWN, QUIT_BY_TEST):
+                    return
+                if event == GLOBAL_ENTER_MAINTENANCE:
+                    await self.drain()
+                elif event == GLOBAL_EXIT_MAINTENANCE:
+                    self.resume()
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self.unsubscribe()
+            self.unregister()
+
+    def __repr__(self) -> str:  # pragma: no cover - trivial
+        return f"fleet.FleetMember[{self.instance_id}]"

@@ -20,9 +20,10 @@ import json
 import logging
 from typing import TYPE_CHECKING, Any, Dict, List, Optional
 
-from prometheus_client import REGISTRY, generate_latest
+from prometheus_client import REGISTRY
 
 from ..utils.http import HTTPServer, Request, Response
+from ..utils.prom import exposition
 from ..version import VERSION
 from .config import TelemetryConfig
 from .metrics import Metric
@@ -55,8 +56,10 @@ class Telemetry:
         self._watch_names = [w.name for w in watches]
 
     async def _handle_metrics(self, _req: Request) -> Response:
-        payload = generate_latest(REGISTRY)
-        return Response(200, payload, content_type="text/plain; version=0.0.4")
+        # ONE exposition convention for every /metrics surface in-tree
+        # (supervisor, serving, fleet gateway): utils/prom.py
+        payload, content_type = exposition(REGISTRY)
+        return Response(200, payload, content_type=content_type)
 
     async def _handle_status(self, _req: Request) -> Response:
         jobs_out: List[Dict[str, Any]] = []

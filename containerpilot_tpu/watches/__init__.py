@@ -1,5 +1,12 @@
 """Watches: upstream-change pollers (reference: watches/ package)."""
-from .watches import Watch, WatchConfig, WatchConfigError, from_configs, new_watch_configs
+from .watches import (
+    Watch,
+    WatchConfig,
+    WatchConfigError,
+    from_configs,
+    new_watch_configs,
+    poll_upstream,
+)
 
 __all__ = [
     "Watch",
@@ -7,4 +14,5 @@ __all__ = [
     "WatchConfigError",
     "from_configs",
     "new_watch_configs",
+    "poll_upstream",
 ]

@@ -38,6 +38,23 @@ class WatchConfigError(ValueError):
     pass
 
 
+async def poll_upstream(
+    backend: Backend, service_name: str, tag: str = "", dc: str = ""
+) -> tuple:
+    """One catalog poll for healthy instances of ``service_name``,
+    run OFF the event loop (catalog polls are blocking HTTP/file
+    I/O — on the single asyncio loop a slow catalog would stall every
+    actor's timers). Returns the backend's (did_change, is_healthy).
+
+    Shared by the supervisor's Watch actors and the fleet gateway's
+    replica-discovery loop so both sides poll with one discipline.
+    """
+    return await asyncio.get_event_loop().run_in_executor(
+        None,
+        lambda: backend.check_for_upstream_changes(service_name, tag, dc),
+    )
+
+
 class WatchConfig:
     """One validated watch definition (reference: watches/config.go)."""
 
@@ -96,12 +113,6 @@ class Watch(EventHandler):
         self._timer: Optional["asyncio.Task[None]"] = None
         self._task: Optional["asyncio.Task[None]"] = None
 
-    def check_for_upstream_changes(self) -> tuple:
-        assert self.backend is not None
-        return self.backend.check_for_upstream_changes(
-            self.service_name, self.tag, self.dc
-        )
-
     def run(self, bus: EventBus) -> "asyncio.Task[None]":
         """Register, start the poll ticker, and run the event loop
         (reference: watches/watches.go:66-103). Unlike jobs, watches
@@ -133,14 +144,11 @@ class Watch(EventHandler):
                 if event == QUIT_BY_TEST:
                     return
                 if event == Event(EventCode.TIMER_EXPIRED, timer_source):
+                    assert self.backend is not None
                     try:
-                        # catalog polls are blocking HTTP/file I/O: run
-                        # off-loop so a slow catalog stalls only this
-                        # watch, not every actor's timers
-                        did_change, is_healthy = (
-                            await asyncio.get_event_loop().run_in_executor(
-                                None, self.check_for_upstream_changes
-                            )
+                        did_change, is_healthy = await poll_upstream(
+                            self.backend, self.service_name,
+                            self.tag, self.dc,
                         )
                     except Exception as exc:  # a flaky catalog isn't fatal
                         log.warning("%s: poll failed: %s", self.name, exc)

@@ -107,6 +107,14 @@ class InferenceServer:
         self.port = port
         self.max_len = max_len
         self.ready = False
+        # maintenance drain: /health goes 503 and NEW generate/
+        # completions are rejected with 503 + Retry-After while
+        # everything already admitted (including running slot-engine
+        # rows) decodes to completion. Flipped by enter_maintenance/
+        # exit_maintenance — the hook fleet.FleetMember drives off the
+        # control plane's /v3/maintenance endpoints.
+        self.draining = False
+        self._inflight = 0
         # context-parallel prefill: single-row prompts at least
         # cp_min_len long ring over the mesh's seq axis
         # (parallel.cp_generate); everything else takes the usual
@@ -266,6 +274,13 @@ class InferenceServer:
     # -- handlers -------------------------------------------------------
 
     async def _health(self, _req: Request) -> Response:
+        if self.draining:
+            # draining ranks above warming: a supervisor health check
+            # (or a fleet gateway) must route away NOW even if the
+            # model is warm
+            return Response(
+                503, b"draining\n", headers={"Retry-After": "1"}
+            )
         if not self.ready:
             return Response(503, b"warming up\n")
         return Response(200, b"ok\n")
@@ -282,7 +297,17 @@ class InferenceServer:
         import time as time_mod
 
         async def wrapped(req: Request) -> Response:
+            if self.draining and endpoint in ("generate", "completions"):
+                # drain rejects NEW decode work only; reads (model,
+                # score) stay up for the last consumers of this
+                # replica, and everything already admitted runs to
+                # completion
+                self._m_requests.labels(endpoint, "503").inc()
+                return Response(
+                    503, b"draining\n", headers={"Retry-After": "1"}
+                )
             t0 = time_mod.perf_counter()
+            self._inflight += 1
             try:
                 resp = await handler(req)
             except Exception:
@@ -294,6 +319,8 @@ class InferenceServer:
                 )
                 self._m_requests.labels(endpoint, "500").inc()
                 raise
+            finally:
+                self._inflight -= 1
             self._m_latency.labels(endpoint).observe(
                 time_mod.perf_counter() - t0
             )
@@ -354,6 +381,7 @@ class InferenceServer:
                 ),
                 # SSE streaming rides the slot engine's chunks
                 "stream": self.slot_engine is not None,
+                "draining": self.draining,
                 "cp": (
                     {
                         "seq": int(self.cp_mesh.shape["seq"]),
@@ -900,6 +928,33 @@ class InferenceServer:
         )
 
     # -- lifecycle ------------------------------------------------------
+
+    @property
+    def inflight(self) -> int:
+        """Requests still being served: handler-held requests plus
+        slot-engine rows still decoding (a streamed generation's
+        handler returns immediately; its row lives in the engine).
+        The double count while a buffered request waits on its slot
+        future only makes drain-waiting conservative."""
+        n = self._inflight
+        if self.slot_engine is not None:
+            stats = self.slot_engine.stats
+            n += stats["active"] + stats["queued"]
+        return n
+
+    def enter_maintenance(self) -> None:
+        """Start draining: health 503, new generate/completions 503 +
+        Retry-After, in-flight work (including running slot-engine
+        rows) finishes. Idempotent."""
+        if not self.draining:
+            log.info("serve: entering maintenance (draining)")
+        self.draining = True
+
+    def exit_maintenance(self) -> None:
+        """Stop draining and accept traffic again. Idempotent."""
+        if self.draining:
+            log.info("serve: exiting maintenance")
+        self.draining = False
 
     async def warmup(self) -> None:
         """Compile the default-shaped programs before reporting healthy.

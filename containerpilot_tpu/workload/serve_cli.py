@@ -137,6 +137,33 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="prompts at least this long take the --cp ring "
         "(default 8x the seq axis)",
     )
+    # fleet membership: register this replica in the discovery
+    # catalog with a TTL heartbeat so a FleetGateway
+    # (python -m containerpilot_tpu.fleet) routes to it; deregisters
+    # on SIGTERM, and a crash expires critical by TTL
+    parser.add_argument(
+        "--fleet-catalog", default="",
+        help="join an inference fleet: discovery backend URI "
+        "('file:/shared/catalog' or 'consul:8500'); empty = lone "
+        "replica (no registration)",
+    )
+    parser.add_argument(
+        "--fleet-service", default="inference",
+        help="service name to register under",
+    )
+    parser.add_argument(
+        "--fleet-ttl", type=int, default=10,
+        help="TTL seconds on the catalog health check",
+    )
+    parser.add_argument(
+        "--fleet-address", default="127.0.0.1",
+        help="address to advertise in the catalog",
+    )
+    parser.add_argument(
+        "--fleet-id", default="",
+        help="instance id in the catalog (default: "
+        "<service>-<random>)",
+    )
     return parser
 
 
@@ -277,16 +304,37 @@ def main() -> int:
         text=args.text,
         cp_mesh=cp_mesh, cp_min_len=getattr(args, "cp_min_len", 0),
     )
+    member = None
+    if getattr(args, "fleet_catalog", ""):
+        from ..discovery.factory import new_backend
+        from ..fleet import FleetMember
+
+        backend = new_backend(args.fleet_catalog)
+        if backend is None:
+            raise SystemExit(
+                "--fleet-catalog resolved to no discovery backend"
+            )
+        member = FleetMember(
+            server, backend, args.fleet_service,
+            ttl=args.fleet_ttl, address=args.fleet_address,
+            instance_id=args.fleet_id,
+        )
 
     async def serve() -> None:
         import signal as signal_mod
 
         await server.run()
+        if member is not None:
+            # after run(): a --port 0 bind has resolved, and the
+            # heartbeat only fires once warmup flips ready
+            await member.start()
         stop = asyncio.Event()
         loop = asyncio.get_event_loop()
         for sig in (signal_mod.SIGTERM, signal_mod.SIGINT):
             loop.add_signal_handler(sig, stop.set)
         await stop.wait()
+        if member is not None:
+            await member.stop()  # deregister before the port dies
         await server.stop()
 
     asyncio.run(serve())

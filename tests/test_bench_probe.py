@@ -78,9 +78,10 @@ def test_workload_benches_record_both_errors_when_retry_fails(monkeypatch):
 
 
 def test_workload_benches_skip_still_runs_host_overhead(monkeypatch):
-    """No reachable TPU still returns a REAL host_overhead entry
-    (pinned to the cpu backend) next to the skip marker — the perf
-    trajectory must never be empty just because the tunnel is down."""
+    """No reachable TPU still returns REAL host_overhead and
+    gateway_overhead entries (pinned to the cpu backend) next to the
+    skip marker — the perf trajectory must never be empty just
+    because the tunnel is down."""
     monkeypatch.setattr(
         bench, "_probe_backend", lambda attempts=4, timeout_s=180: "cpu"
     )
@@ -94,7 +95,9 @@ def test_workload_benches_skip_still_runs_host_overhead(monkeypatch):
     extras = bench.workload_benches()
     assert "skipped" in extras
     assert extras["host_overhead"] == {"engine_host_overhead_ms": 0.1}
-    # only the any-backend bench ran, pinned to cpu
+    assert extras["gateway_overhead"] == {"engine_host_overhead_ms": 0.1}
+    # only the any-backend benches ran, pinned to cpu
     assert calls == [
-        ("host_overhead_bench", {"JAX_PLATFORMS": "cpu"})
+        ("host_overhead_bench", {"JAX_PLATFORMS": "cpu"}),
+        ("gateway_overhead_bench", {"JAX_PLATFORMS": "cpu"}),
     ]

@@ -182,3 +182,42 @@ def test_protocol_errors_get_400_not_500(run, socket_path):
 
     for response in run(scenario()):
         assert response.startswith(b"HTTP/1.1 400"), response[:60]
+
+
+def test_maintenance_status_reads_back_the_flip(run, socket_path):
+    """Drain runbooks confirm maintenance landed: the status endpoint
+    tracks the last verb posted through this generation's socket."""
+
+    def toggle_and_read(c):
+        before = c.get_maintenance_status()
+        c.set_maintenance(True)
+        during = c.get_maintenance_status()
+        c.set_maintenance(False)
+        after = c.get_maintenance_status()
+        return before, during, after
+
+    _bus, (before, during, after) = drive(run, socket_path, toggle_and_read)
+    assert (before, during, after) == (False, True, False)
+
+
+def test_client_retries_connect_while_supervisor_boots(run, socket_path):
+    """The first control call after `containerpilot start` races the
+    socket bind; ECONNREFUSED/ENOENT during that window retries with
+    backoff instead of failing the call."""
+
+    async def scenario():
+        bus = EventBus()
+        server = ControlServer(ControlConfig({"socket": socket_path}))
+        client = ControlClient(
+            socket_path, timeout=2.0, retries=8, retry_delay=0.05
+        )
+        loop = asyncio.get_event_loop()
+        # the client starts dialing BEFORE the socket exists
+        ping = loop.run_in_executor(None, client.get_ping)
+        await asyncio.sleep(0.15)
+        await server.run(bus)
+        result = await ping
+        await server.stop()
+        return result
+
+    assert run(scenario(), timeout=30) is True

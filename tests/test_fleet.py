@@ -1,0 +1,646 @@
+"""Fleet subsystem tests: routing units, drain hook, catalog
+robustness, control-plane drain, and the two-replica gateway
+integration scenario (drain mid-traffic, zero client-visible 5xx).
+
+The gateway unit tests run against stub HTTP servers (no JAX); the
+integration test boots two real tiny InferenceServers behind a
+FleetGateway on the CPU backend.
+"""
+import asyncio
+import json
+import time
+import urllib.error
+import urllib.request
+
+from containerpilot_tpu.discovery import (
+    FileCatalogBackend,
+    NoopBackend,
+    ServiceRegistration,
+)
+from containerpilot_tpu.fleet import FleetGateway, FleetMember
+from containerpilot_tpu.fleet.gateway import Replica
+from containerpilot_tpu.utils.http import HTTPServer, Response
+
+
+def _counter(metric, label: str) -> float:
+    return metric.labels(label)._value.get()  # noqa: SLF001
+
+
+def _post(port, path, payload, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode(), dict(exc.headers)
+
+
+def _get(port, path, timeout=30):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode(), dict(exc.headers)
+
+
+def _register(backend, instance_id, port, name="svc"):
+    backend.service_register(
+        ServiceRegistration(
+            id=instance_id, name=name, port=port, ttl=60,
+            address="127.0.0.1",
+        ),
+        status="passing",
+    )
+
+
+# -- routing units (no servers, no JAX) ---------------------------------
+
+
+def test_least_outstanding_pick_is_deterministic():
+    gw = FleetGateway(NoopBackend(), "svc")
+    gw._replicas = {
+        "a": Replica("a", "h", 1, outstanding=2),
+        "b": Replica("b", "h", 2, outstanding=0),
+        "c": Replica("c", "h", 3, outstanding=1),
+    }
+    assert gw._pick().id == "b"
+    assert gw._pick(exclude={"b"}).id == "c"
+    assert gw._pick(exclude={"a", "b", "c"}) is None
+    # ties break on id, so equal load routes reproducibly
+    gw._replicas["b"].outstanding = 1
+    assert gw._pick().id == "b"
+
+
+def test_sticky_affinity_and_drained_away_accounting():
+    gw = FleetGateway(NoopBackend(), "svc", affinity="session")
+    gw._replicas = {
+        "a": Replica("a", "h", 1),
+        "b": Replica("b", "h", 2),
+    }
+    first = gw._route("s:user1")
+    # load elsewhere must not move a sticky key
+    other_id = "b" if first.id == "a" else "a"
+    gw._replicas[other_id].outstanding = 0
+    gw._replicas[first.id].outstanding = 5
+    assert gw._route("s:user1").id == first.id
+    # a pin excluded by one request's retry re-routes THAT request
+    # but keeps the pin (warm prefix cache survives a transient
+    # failure) and does NOT count as drained_away
+    assert gw._route("s:user1", exclude={first.id}).id == other_id
+    assert gw._sticky["s:user1"] == first.id
+    assert _counter(gw._m_drained, first.id) == 0
+    # …but a replica that LEFT the fleet re-pins and counts
+    del gw._replicas[first.id]
+    rerouted = gw._route("s:user1")
+    assert rerouted.id == other_id
+    assert gw._sticky["s:user1"] == other_id
+    assert _counter(gw._m_drained, first.id) == 1
+    # keyless requests never stick
+    assert gw._route(None).id == other_id
+
+
+def test_affinity_key_extraction_modes():
+    from containerpilot_tpu.utils.http import Request
+
+    def req(headers=None):
+        return Request("POST", "/v1/generate", {}, headers or {}, b"")
+
+    session_gw = FleetGateway(NoopBackend(), "svc", affinity="session")
+    prefix_gw = FleetGateway(NoopBackend(), "svc", affinity="prefix")
+    none_gw = FleetGateway(NoopBackend(), "svc", affinity="none")
+
+    body = {"session_id": "u1", "tokens": [[1, 2, 3]]}
+    assert session_gw._affinity_key(req(), body) == "s:u1"
+    assert none_gw._affinity_key(req(), body) is None
+    # header beats prompt-derived keys, loses to session_id
+    assert session_gw._affinity_key(
+        req({"x-affinity-key": "k9"}), {}
+    ) == "h:k9"
+    # prefix mode: same token prefix -> same key; different -> different
+    k1 = prefix_gw._affinity_key(req(), {"tokens": [[1, 2, 3]]})
+    k2 = prefix_gw._affinity_key(req(), {"tokens": [[1, 2, 3]]})
+    k3 = prefix_gw._affinity_key(req(), {"tokens": [[9, 9, 9]]})
+    assert k1 == k2 and k1 != k3 and k1.startswith("p:")
+    # session mode does NOT key on prompts (every unique prompt would
+    # otherwise occupy a sticky slot)
+    assert session_gw._affinity_key(req(), {"tokens": [[1, 2, 3]]}) is None
+
+
+def test_hedge_threshold_is_learned_per_endpoint():
+    """Millisecond /v1/score samples must not set the hedge deadline
+    for second-long /v1/generate requests (and vice versa)."""
+    from collections import deque
+
+    gw = FleetGateway(NoopBackend(), "svc", hedge_min_ms=1.0)
+    gw._replicas = {
+        "a": Replica("a", "h", 1),
+        "b": Replica("b", "h", 2),
+    }
+    gw._latencies["score"] = deque([0.002] * 30)
+    # no generate samples yet -> no basis to hedge generate
+    assert gw._hedge_threshold("generate") is None
+    gw._latencies["generate"] = deque([0.5] * 30)
+    assert gw._hedge_threshold("generate") >= 0.5
+    assert gw._hedge_threshold("score") < 0.01
+    # hedging needs somewhere to hedge TO
+    del gw._replicas["b"]
+    assert gw._hedge_threshold("generate") is None
+
+
+# -- gateway behavior against stub replicas (no JAX) --------------------
+
+
+def test_gateway_retries_on_a_different_replica(run, tmp_path):
+    """A 503 from the first-picked replica (draining/warming) moves
+    the request to another replica; the client sees only the 200."""
+    backend = FileCatalogBackend(str(tmp_path))
+    calls = {"aaa": 0, "bbb": 0}
+
+    async def scenario():
+        draining, healthy = HTTPServer(), HTTPServer()
+
+        async def handler_draining(_req):
+            calls["aaa"] += 1
+            return Response(
+                503, b"draining\n", headers={"Retry-After": "1"}
+            )
+
+        async def handler_healthy(_req):
+            calls["bbb"] += 1
+            return Response(
+                200, json.dumps({"tokens": [[9]]}).encode(),
+                content_type="application/json",
+            )
+
+        draining.route("POST", "/v1/generate", handler_draining)
+        healthy.route("POST", "/v1/generate", handler_healthy)
+        await draining.start_tcp("127.0.0.1", 0)
+        await healthy.start_tcp("127.0.0.1", 0)
+        # ids chosen so the load tie breaks to the draining replica
+        _register(backend, "aaa", draining.bound_port)
+        _register(backend, "bbb", healthy.bound_port)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0,
+            poll_interval=0.2, hedge=False, retry_backoff=0.01,
+        )
+        await gw.run()
+        assert gw.replica_count == 2
+        status, text, _ = await asyncio.get_event_loop().run_in_executor(
+            None, _post, gw.port, "/v1/generate",
+            {"tokens": [[1]], "max_new_tokens": 2},
+        )
+        retried = _counter(gw._m_retried, "aaa")
+        await gw.stop()
+        await draining.stop()
+        await healthy.stop()
+        return status, text, retried
+
+    status, text, retried = run(scenario(), timeout=60)
+    assert status == 200 and json.loads(text)["tokens"] == [[9]]
+    assert calls == {"aaa": 1, "bbb": 1}
+    assert retried == 1
+
+
+def test_gateway_exhausted_retries_surface_503_with_retry_after(
+    run, tmp_path
+):
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0, poll_interval=5.0,
+        )
+        await gw.run()  # catalog is empty: no replicas at all
+        status, _text, headers = (
+            await asyncio.get_event_loop().run_in_executor(
+                None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+            )
+        )
+        health = await asyncio.get_event_loop().run_in_executor(
+            None, _get, gw.port, "/health"
+        )
+        await gw.stop()
+        return status, headers, health
+
+    status, headers, health = run(scenario(), timeout=60)
+    assert status == 503
+    assert {k.lower(): v for k, v in headers.items()}["retry-after"]
+    assert health[0] == 503
+
+
+def test_gateway_hedges_slow_replica_and_takes_the_fast_result(
+    run, tmp_path
+):
+    """A request still unanswered at the hedge deadline races a second
+    replica; the fast replica's answer wins and the slow dispatch is
+    cancelled (its connection drops)."""
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        slow, fast = HTTPServer(), HTTPServer()
+
+        async def handler_slow(_req):
+            await asyncio.sleep(1.0)
+            return Response(200, b'{"who": "slow"}',
+                            content_type="application/json")
+
+        async def handler_fast(_req):
+            return Response(200, b'{"who": "fast"}',
+                            content_type="application/json")
+
+        slow.route("POST", "/v1/generate", handler_slow)
+        fast.route("POST", "/v1/generate", handler_fast)
+        await slow.start_tcp("127.0.0.1", 0)
+        await fast.start_tcp("127.0.0.1", 0)
+        _register(backend, "aaa", slow.bound_port)  # tie -> slow first
+        _register(backend, "bbb", fast.bound_port)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0,
+            poll_interval=5.0, retries=0, hedge_after_ms=80.0,
+        )
+        await gw.run()
+        t0 = time.perf_counter()
+        status, text, _ = await asyncio.get_event_loop().run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+        )
+        elapsed = time.perf_counter() - t0
+        hedged = _counter(gw._m_hedged, "aaa")
+        routed_fast = _counter(gw._m_routed, "bbb")
+        await gw.stop()
+        await slow.stop()
+        await fast.stop()
+        return status, text, elapsed, hedged, routed_fast
+
+    status, text, elapsed, hedged, routed_fast = run(
+        scenario(), timeout=60
+    )
+    assert status == 200 and json.loads(text)["who"] == "fast"
+    assert elapsed < 0.8, f"hedge did not preempt the slow replica: {elapsed}"
+    assert hedged == 1 and routed_fast == 1
+
+
+# -- satellite: filecatalog robustness ----------------------------------
+
+
+def test_filecatalog_listing_survives_torn_and_leftover_records(tmp_path):
+    """Torn JSON (partial NFS write), writer scratch files, and
+    records missing required keys are skipped as critical — never an
+    exception that hides the healthy peers next to them."""
+    backend = FileCatalogBackend(str(tmp_path))
+    _register(backend, "good", 8001)
+    sdir = tmp_path / "services" / "svc"
+    (sdir / "torn.json").write_text('{"id": "torn", "na')
+    (sdir / "scratch.json.tmp").write_text("{}")
+    (sdir / "nokeys.json").write_text(
+        json.dumps({"status": "passing", "expires": time.time() + 60})
+    )
+    (sdir / "notdict.json").write_text("[1, 2, 3]")
+    (sdir / "badport.json").write_text(json.dumps({
+        "id": "badport", "name": "svc", "port": "eighty",
+        "status": "passing", "expires": time.time() + 60,
+    }))
+    instances = backend.instances("svc")
+    assert [i.id for i in instances] == ["good"]
+    did_change, healthy = backend.check_for_upstream_changes("svc")
+    assert healthy
+
+
+# -- member lifecycle (stub server, no JAX) -----------------------------
+
+
+class _StubReplica:
+    """Duck-types the InferenceServer drain surface."""
+
+    def __init__(self):
+        self.ready = True
+        self.draining = False
+        self.inflight = 0
+        self.port = 4242
+
+    def enter_maintenance(self):
+        self.draining = True
+
+    def exit_maintenance(self):
+        self.draining = False
+
+
+def test_member_heartbeats_and_ttl_expiry(run, tmp_path):
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        stub = _StubReplica()
+        member = FleetMember(
+            stub, backend, "svc", ttl=1, heartbeat_interval=0.05,
+            instance_id="r1",
+        )
+        await member.start()
+        for _ in range(100):
+            if backend.instances("svc"):
+                break
+            await asyncio.sleep(0.02)
+        assert [i.id for i in backend.instances("svc")] == ["r1"]
+        # a replica that stops being ready stops beating; the record
+        # flips critical by TTL expiry, like a wedged job
+        stub.ready = False
+        await asyncio.sleep(1.3)
+        assert backend.instances("svc") == []
+        # recovery: ready again -> next heartbeat revives the record
+        stub.ready = True
+        for _ in range(100):
+            if backend.instances("svc"):
+                break
+            await asyncio.sleep(0.02)
+        assert [i.id for i in backend.instances("svc")] == ["r1"]
+        await member.stop()
+        assert backend.instances("svc") == []
+
+    run(scenario(), timeout=60)
+
+
+def test_member_drains_via_control_plane(run, tmp_path):
+    """POST /v3/maintenance/enable on the control socket drains the
+    replica: maintenance flag set, catalog record gone; disable
+    resumes and the next heartbeat re-registers."""
+    from containerpilot_tpu.client import ControlClient
+    from containerpilot_tpu.control import ControlConfig, ControlServer
+    from containerpilot_tpu.events import EventBus
+
+    socket_path = str(tmp_path / "cp.sock")
+    backend = FileCatalogBackend(str(tmp_path / "catalog"))
+
+    async def scenario():
+        bus = EventBus()
+        control = ControlServer(ControlConfig({"socket": socket_path}))
+        await control.run(bus)
+        stub = _StubReplica()
+        member = FleetMember(
+            stub, backend, "svc", ttl=2, heartbeat_interval=0.05,
+            instance_id="r1",
+        )
+        await member.start()
+        member.attach_bus(bus)
+        loop = asyncio.get_event_loop()
+        client = ControlClient(socket_path)
+        for _ in range(100):
+            if backend.instances("svc"):
+                break
+            await asyncio.sleep(0.02)
+        assert backend.instances("svc")
+
+        await loop.run_in_executor(None, client.set_maintenance, True)
+        for _ in range(100):
+            if stub.draining and not backend.instances("svc"):
+                break
+            await asyncio.sleep(0.02)
+        assert stub.draining
+        assert backend.instances("svc") == []
+        assert await loop.run_in_executor(
+            None, client.get_maintenance_status
+        )
+
+        await loop.run_in_executor(None, client.set_maintenance, False)
+        for _ in range(100):
+            if not stub.draining and backend.instances("svc"):
+                break
+            await asyncio.sleep(0.02)
+        assert not stub.draining
+        assert backend.instances("svc")
+
+        await member.stop()
+        await control.stop()
+
+    run(scenario(), timeout=60)
+
+
+# -- serve.py drain hook (tiny model, CPU) ------------------------------
+
+
+def test_inference_server_drain_hook(run):
+    import jax
+    import jax.numpy as jnp
+
+    from containerpilot_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server = InferenceServer(cfg, params, "127.0.0.1", 0, max_len=32)
+
+    async def scenario():
+        loop = asyncio.get_event_loop()
+        await server.run()
+        body = {"tokens": [[1, 2, 3]], "max_new_tokens": 4}
+        before = await loop.run_in_executor(
+            None, _post, server.port, "/v1/generate", body
+        )
+        server.enter_maintenance()
+        health = await loop.run_in_executor(
+            None, _get, server.port, "/health"
+        )
+        rejected = await loop.run_in_executor(
+            None, _post, server.port, "/v1/generate", body
+        )
+        # reads stay up for the replica's last consumers
+        model = await loop.run_in_executor(
+            None, _get, server.port, "/v1/model"
+        )
+        score = await loop.run_in_executor(
+            None, _post, server.port, "/v1/score",
+            {"tokens": [[1, 2, 3, 4]]},
+        )
+        server.exit_maintenance()
+        after = await loop.run_in_executor(
+            None, _post, server.port, "/v1/generate", body
+        )
+        await server.stop()
+        return before, health, rejected, model, score, after
+
+    before, health, rejected, model, score, after = run(
+        scenario(), timeout=300
+    )
+    assert before[0] == 200
+    assert health[0] == 503 and "draining" in health[1]
+    assert rejected[0] == 503
+    assert {k.lower(): v for k, v in rejected[2].items()}["retry-after"]
+    assert model[0] == 200 and json.loads(model[1])["draining"] is True
+    assert score[0] == 200
+    assert after[0] == 200
+    assert server.inflight == 0
+
+
+# -- the tier-1 integration scenario ------------------------------------
+
+
+def test_fleet_gateway_drain_mid_traffic_zero_5xx(run, tmp_path):
+    """Two replicas behind the gateway; one drains mid-traffic. Every
+    client request completes 200 (the drain 503s are absorbed by
+    retry-on-another-replica), the drained replica leaves the healthy
+    set immediately, and SSE streaming keeps working through the
+    gateway afterwards."""
+    import jax
+    import jax.numpy as jnp
+
+    from containerpilot_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    replica1 = InferenceServer(
+        cfg, params, "127.0.0.1", 0, max_len=64, slots=2, slot_chunk=4
+    )
+    replica2 = InferenceServer(
+        cfg, params, "127.0.0.1", 0, max_len=64, slots=2, slot_chunk=4
+    )
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        loop = asyncio.get_event_loop()
+        await replica1.run()
+        await replica2.run()
+        member1 = FleetMember(
+            replica1, backend, "inference", ttl=5,
+            heartbeat_interval=0.1, instance_id="replica-1",
+        )
+        member2 = FleetMember(
+            replica2, backend, "inference", ttl=5,
+            heartbeat_interval=0.1, instance_id="replica-2",
+        )
+        await member1.start()
+        await member2.start()
+        gateway = FleetGateway(
+            backend, "inference", "127.0.0.1", 0,
+            poll_interval=0.2, hedge=False, retry_backoff=0.01,
+        )
+        await gateway.run()
+        for _ in range(100):
+            if gateway.replica_count == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert gateway.replica_count == 2
+
+        results = []
+
+        async def client_loop(worker, n):
+            for i in range(n):
+                status, text, _ = await loop.run_in_executor(
+                    None, _post, gateway.port, "/v1/generate",
+                    {
+                        "tokens": [[1, 2, 3, 4]],
+                        "max_new_tokens": 16,
+                        "seed": worker * 100 + i,
+                    },
+                )
+                results.append((status, text))
+
+        clients = [
+            asyncio.ensure_future(client_loop(w, 6)) for w in range(3)
+        ]
+        await asyncio.sleep(0.1)  # let traffic get in flight
+
+        drained = await member1.drain()
+        assert drained is True
+        assert replica1.draining
+        # the drained replica is out of the healthy set immediately
+        # (deregistration, not TTL decay): well within one gateway
+        # poll interval
+        instances = await loop.run_in_executor(
+            None, backend.instances, "inference"
+        )
+        assert [i.id for i in instances] == ["replica-2"]
+
+        await asyncio.gather(*clients)
+        assert len(results) == 18
+        assert all(status == 200 for status, _ in results), [
+            status for status, _ in results
+        ]
+        for _status, text in results:
+            out = json.loads(text)["tokens"]
+            assert len(out) == 1 and 1 <= len(out[0]) <= 16
+
+        # the gateway's routing set converges to the one survivor
+        for _ in range(50):
+            if gateway.replica_count == 1:
+                break
+            await asyncio.sleep(0.05)
+        assert gateway.replica_count == 1
+
+        # SSE streaming through the gateway still works post-drain
+        stream_status, stream_text, stream_headers = (
+            await loop.run_in_executor(
+                None, _post, gateway.port, "/v1/generate",
+                {
+                    "tokens": [[1, 2, 3, 4]],
+                    "max_new_tokens": 8,
+                    "stream": True,
+                },
+            )
+        )
+        # proxied /v1/model answers from a healthy replica
+        model = await loop.run_in_executor(
+            None, _get, gateway.port, "/v1/model"
+        )
+        fleet_view = await loop.run_in_executor(
+            None, _get, gateway.port, "/fleet"
+        )
+        metrics = await loop.run_in_executor(
+            None, _get, gateway.port, "/metrics"
+        )
+
+        await gateway.stop()
+        await member1.stop()
+        await member2.stop()
+        await replica1.stop()
+        await replica2.stop()
+        return (
+            stream_status, stream_text, stream_headers, model,
+            fleet_view, metrics,
+        )
+
+    (
+        stream_status, stream_text, stream_headers, model,
+        fleet_view, metrics,
+    ) = run(scenario(), timeout=600)
+
+    assert stream_status == 200
+    content_type = {
+        k.lower(): v for k, v in stream_headers.items()
+    }["content-type"]
+    assert "text/event-stream" in content_type
+    events = [
+        json.loads(line[len("data: "):])
+        for line in stream_text.splitlines()
+        if line.startswith("data: ")
+    ]
+    assert events and events[-1].get("done") is True
+    streamed = [t for e in events if "tokens" in e for t in e["tokens"]]
+    assert len(streamed) == events[-1]["count"] and streamed
+
+    assert model[0] == 200 and "vocab_size" in model[1]
+    fleet = json.loads(fleet_view[1])
+    assert [r["id"] for r in fleet["replicas"]] == ["replica-2"]
+    assert metrics[0] == 200
+    # the metrics pipeline recorded the traffic: dispatches to both
+    # replicas and the client-visible 200s
+    assert 'containerpilot_gateway_routed_total{replica="replica-1"}' in metrics[1]
+    assert 'containerpilot_gateway_routed_total{replica="replica-2"}' in metrics[1]
+    assert (
+        'containerpilot_gateway_requests_total'
+        '{code="200",endpoint="generate"}'
+    ) in metrics[1]
