@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all build test test-fast test-workload integration fleet-smoke bench lint clean image
+.PHONY: all build test test-fast test-workload integration fleet-smoke bench lint lint-baseline clean image
 
 all: build test
 
@@ -37,8 +37,14 @@ fleet-smoke:
 bench:
 	$(PYTHON) bench.py
 
+# cpcheck (AST invariant rules vs analysis/baseline.json) + compileall;
+# see docs/70-static-analysis.md. Non-zero on any non-baselined finding.
 lint:
-	$(PYTHON) -m compileall -q containerpilot_tpu
+	$(PYTHON) -m containerpilot_tpu.analysis
+
+# regenerate the committed baseline (shrink it, never grow it)
+lint-baseline:
+	$(PYTHON) -m containerpilot_tpu.analysis --write-baseline
 
 # release tarball (reference: makefile release target); VERSION expands
 # lazily so only the release target pays the interpreter startup

@@ -1,0 +1,43 @@
+"""Static + dynamic invariant checking for this repo (cpcheck).
+
+``python -m containerpilot_tpu.analysis`` is the ``make lint`` body:
+it byte-compiles the package (the old lint) and then runs the cpcheck
+AST rules over every module, comparing findings against the committed
+``analysis/baseline.json``. New findings exit non-zero; the baseline
+enumerates pre-existing, justified debt instead of hiding it.
+
+See ``docs/70-static-analysis.md`` for the rule catalog, the pragma
+escape hatches, and the baseline workflow; ``racecheck.py`` is the
+opt-in runtime lock-order/publish-discipline harness tests use.
+"""
+from .cpcheck import (
+    ALL_RULES,
+    Finding,
+    RULES_BY_ID,
+    baseline_path,
+    diff_against_baseline,
+    hotpath,
+    load_baseline,
+    scan_file,
+    scan_package,
+    scan_source,
+    write_baseline,
+)
+from .racecheck import CheckedLock, RaceCheck, Violation
+
+__all__ = [
+    "ALL_RULES",
+    "RULES_BY_ID",
+    "Finding",
+    "scan_source",
+    "scan_file",
+    "scan_package",
+    "baseline_path",
+    "load_baseline",
+    "write_baseline",
+    "diff_against_baseline",
+    "RaceCheck",
+    "CheckedLock",
+    "Violation",
+    "hotpath",
+]

@@ -1,0 +1,149 @@
+"""CLI: ``python -m containerpilot_tpu.analysis`` — the lint gate.
+
+Exit status:
+    0  byte-compile clean AND no findings beyond the baseline
+    1  new findings (or --write-baseline wrote nothing because the
+       scan itself failed)
+    2  a module failed to byte-compile / parse
+
+Modes:
+    (default)          scan the whole package against the baseline
+    --files F [F ...]  scan only those files (scripts/cpcheck_diff.sh),
+                       still filtered through the baseline
+    --write-baseline   regenerate analysis/baseline.json from a fresh
+                       full scan (the `make lint-baseline` body)
+    --list-rules       print the rule catalog (id + first doc line)
+"""
+from __future__ import annotations
+
+import argparse
+import compileall
+import os
+import sys
+from typing import List, Optional
+
+from .cpcheck import (
+    ALL_RULES,
+    Finding,
+    baseline_path,
+    diff_against_baseline,
+    load_baseline,
+    scan_file,
+    scan_package,
+    write_baseline,
+)
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m containerpilot_tpu.analysis",
+        description="cpcheck: repo-specific AST invariant analysis",
+    )
+    parser.add_argument(
+        "--files", nargs="+", metavar="FILE",
+        help="scan only these files (default: the whole package)",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help=f"baseline path (default: {baseline_path()})",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="regenerate the baseline from a fresh full scan and exit",
+    )
+    parser.add_argument(
+        "--no-compileall", action="store_true",
+        help="skip the byte-compile pass (cpcheck rules only)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.write_baseline and args.files:
+        # a partial scan must never replace the full ledger (it would
+        # silently delete every other file's justified entries)
+        parser.error("--write-baseline requires a full package scan; "
+                     "drop --files")
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            doc = (rule.__doc__ or "").strip().splitlines()
+            first = doc[0] if doc else ""
+            print(f"{rule.rule_id}: {first}")
+        return 0
+
+    root = _package_root()
+    repo = os.path.dirname(root)
+
+    if not args.no_compileall and not args.files:
+        # the old `make lint` body, kept: parse errors beat style errors
+        if not compileall.compile_dir(root, quiet=1):
+            print("cpcheck: byte-compilation failed", file=sys.stderr)
+            return 2
+
+    try:
+        if args.files:
+            findings: List[Finding] = []
+            for path in args.files:
+                findings.extend(scan_file(path, relative_to=repo))
+            findings.sort(key=lambda f: (f.file, f.line, f.rule))
+        else:
+            findings = scan_package(root, relative_to=repo)
+    except SyntaxError as exc:
+        print(f"cpcheck: parse failure: {exc}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        path = write_baseline(findings, args.baseline)
+        print(
+            f"cpcheck: wrote {len(findings)} baseline entr"
+            f"{'y' if len(findings) == 1 else 'ies'} to {path}"
+        )
+        return 0
+
+    entries = load_baseline(args.baseline)
+    new, stale = diff_against_baseline(findings, entries)
+
+    scanned = (
+        f"{len(args.files)} file(s)" if args.files else "package"
+    )
+    if new:
+        print(
+            f"cpcheck: {len(new)} new finding(s) over {scanned} "
+            f"(baseline: {len(entries)} known):"
+        )
+        for f in new:
+            print(f.render())
+        print(
+            "\ncpcheck: fix the finding, add an inline "
+            "`# cpcheck: disable=<RULE>` with a justification, or — "
+            "for genuinely pre-existing debt — `make lint-baseline`.",
+        )
+        return 1
+    if stale and not args.files:
+        # full scans know an entry is truly gone; partial scans don't
+        print(
+            f"cpcheck: warning: {len(stale)} stale baseline entr"
+            f"{'y' if len(stale) == 1 else 'ies'} (fixed? run "
+            "`make lint-baseline` to shrink the baseline):"
+        )
+        for entry in stale:
+            print(
+                f"    {entry.get('file')} [{entry.get('scope')}] "
+                f"{entry.get('rule')}"
+            )
+    print(
+        f"cpcheck: clean ({scanned}; {len(findings)} finding(s), "
+        f"all baselined)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -128,80 +128,93 @@ class Command:
         )
 
     async def _run(self, bus: EventBus) -> Optional[int]:
-        async with self._lock:  # never more than one live instance
-            log.debug("%s.run start", self.name)
-            started = time.monotonic()
-            capture = self.fields is not None
-            # drop the previous run's handle so a term/kill arriving
-            # mid-spawn queues instead of hitting the dead process
-            self._proc = None
-            try:
-                self._proc = await asyncio.create_subprocess_exec(
-                    self.exec,
-                    *self.args,
-                    stdout=asyncio.subprocess.PIPE if capture else None,
-                    stderr=asyncio.subprocess.PIPE if capture else None,
-                    start_new_session=True,
-                )
-            except Exception as exc:  # spawn failure (ENOENT, EACCES, ...)
-                log.error("unable to start %s: %s", self.name, exc)
-                self._spawn_pending = False
-                self._pending_signal = None
-                bus.publish(Event(EventCode.EXIT_FAILED, self.name))
-                bus.publish(Event(EventCode.ERROR, str(exc)))
-                return None
-            proc = self._proc
+        # Exit events are collected while the run lock is held and
+        # published only after it is released: fan-out is synchronous,
+        # and a subscriber reacting to an exit event may re-enter this
+        # command (restart paths) — publishing under the lock is the
+        # CP-LOCKPUB deadlock shape.
+        events: List[Event] = []
+        try:
+            async with self._lock:  # never more than one live instance
+                return await self._run_locked(events)
+        finally:
+            for event in events:
+                bus.publish(event)
+
+    async def _run_locked(self, events: List[Event]) -> Optional[int]:
+        log.debug("%s.run start", self.name)
+        started = time.monotonic()
+        capture = self.fields is not None
+        # drop the previous run's handle so a term/kill arriving
+        # mid-spawn queues instead of hitting the dead process
+        self._proc = None
+        try:
+            self._proc = await asyncio.create_subprocess_exec(
+                self.exec,
+                *self.args,
+                stdout=asyncio.subprocess.PIPE if capture else None,
+                stderr=asyncio.subprocess.PIPE if capture else None,
+                start_new_session=True,
+            )
+        except Exception as exc:  # spawn failure (ENOENT, EACCES, ...)
+            log.error("unable to start %s: %s", self.name, exc)
             self._spawn_pending = False
-            if self._pending_signal is not None:
-                sig, self._pending_signal = self._pending_signal, None
-                log.debug(
-                    "%s: delivering %s queued before spawn", self.name, sig.name
-                )
-                try:
-                    os.killpg(proc.pid, sig)
-                except ProcessLookupError:
-                    pass
-            env_key = f"CONTAINERPILOT_{self.env_name()}_PID"
-            os.environ[env_key] = str(proc.pid)
-            if capture:
-                fields = dict(self.fields or {})
-                fields["pid"] = proc.pid
-                self._reader_tasks = [
-                    asyncio.ensure_future(self._log_stream(proc.stdout, fields)),
-                    asyncio.ensure_future(self._log_stream(proc.stderr, fields)),
-                ]
+            self._pending_signal = None
+            events.append(Event(EventCode.EXIT_FAILED, self.name))
+            events.append(Event(EventCode.ERROR, str(exc)))
+            return None
+        proc = self._proc
+        self._spawn_pending = False
+        if self._pending_signal is not None:
+            sig, self._pending_signal = self._pending_signal, None
+            log.debug(
+                "%s: delivering %s queued before spawn", self.name, sig.name
+            )
             try:
-                returncode = await self._wait_with_timeout(proc)
-            finally:
-                if os.environ.get(env_key) == str(proc.pid):
-                    os.environ.pop(env_key, None)
-                if self._reader_tasks:
-                    # streams EOF once the child exits; drain them fully
-                    # so trailing output isn't lost
-                    try:
-                        await asyncio.wait_for(
-                            asyncio.gather(*self._reader_tasks), timeout=5.0
-                        )
-                    except asyncio.TimeoutError:
-                        for t in self._reader_tasks:
-                            if not t.done():
-                                t.cancel()
-                self._reader_tasks = []
-                log.debug(
-                    "%s.run end (%.1fms)",
-                    self.name,
-                    (time.monotonic() - started) * 1e3,
-                )
-            if returncode == 0:
-                log.debug("%s exited without error", self.name)
-                bus.publish(Event(EventCode.EXIT_SUCCESS, self.name))
-            else:
-                log.error("%s exited with error: code %s", self.name, returncode)
-                bus.publish(Event(EventCode.EXIT_FAILED, self.name))
-                bus.publish(
-                    Event(EventCode.ERROR, f"{self.name}: exit code {returncode}")
-                )
-            return returncode
+                os.killpg(proc.pid, sig)
+            except ProcessLookupError:
+                pass
+        env_key = f"CONTAINERPILOT_{self.env_name()}_PID"
+        os.environ[env_key] = str(proc.pid)
+        if capture:
+            fields = dict(self.fields or {})
+            fields["pid"] = proc.pid
+            self._reader_tasks = [
+                asyncio.ensure_future(self._log_stream(proc.stdout, fields)),
+                asyncio.ensure_future(self._log_stream(proc.stderr, fields)),
+            ]
+        try:
+            returncode = await self._wait_with_timeout(proc)
+        finally:
+            if os.environ.get(env_key) == str(proc.pid):
+                os.environ.pop(env_key, None)
+            if self._reader_tasks:
+                # streams EOF once the child exits; drain them fully
+                # so trailing output isn't lost
+                try:
+                    await asyncio.wait_for(
+                        asyncio.gather(*self._reader_tasks), timeout=5.0
+                    )
+                except asyncio.TimeoutError:
+                    for t in self._reader_tasks:
+                        if not t.done():
+                            t.cancel()
+            self._reader_tasks = []
+            log.debug(
+                "%s.run end (%.1fms)",
+                self.name,
+                (time.monotonic() - started) * 1e3,
+            )
+        if returncode == 0:
+            log.debug("%s exited without error", self.name)
+            events.append(Event(EventCode.EXIT_SUCCESS, self.name))
+        else:
+            log.error("%s exited with error: code %s", self.name, returncode)
+            events.append(Event(EventCode.EXIT_FAILED, self.name))
+            events.append(
+                Event(EventCode.ERROR, f"{self.name}: exit code {returncode}")
+            )
+        return returncode
 
     async def _wait_with_timeout(self, proc: asyncio.subprocess.Process) -> int:
         if self.timeout and self.timeout > 0:

@@ -133,7 +133,7 @@ class ControlServer:
         if _REQUEST_COUNTER is not None:
             try:
                 _REQUEST_COUNTER.labels(status=str(status), path=path).inc()
-            except Exception:  # pragma: no cover
+            except Exception:  # pragma: no cover — cpcheck: disable=CP-SWALLOW metrics must never break the handler
                 pass
 
     def _respond(

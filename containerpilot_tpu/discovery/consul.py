@@ -203,7 +203,7 @@ class ConsulBackend(Backend):
         if _INSTANCE_GAUGE is not None:
             try:
                 _INSTANCE_GAUGE.labels(service=service_name).set(len(instances))
-            except Exception:  # pragma: no cover
+            except Exception:  # pragma: no cover — cpcheck: disable=CP-SWALLOW metrics must never break the poll
                 pass
         last = self._last_seen.get(service_name)
         did_change = (last is not None and last != instances) or (

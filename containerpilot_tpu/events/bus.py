@@ -69,6 +69,15 @@ class EventBus:
 
     def __init__(self, ring_size: int = DEBUG_RING_SIZE) -> None:
         self._lock = threading.RLock()
+        # Serializes fan-out WITHOUT coupling it to the state lock:
+        # delivery-only, reentrant (a subscriber may publish from its
+        # receive callback on the same thread), taken by no other code
+        # path — so it cannot participate in a lock-order cycle with
+        # application locks. It matters only on the direct off-loop
+        # publish path (no home loop yet, or the loop already closed):
+        # two foreign threads publishing concurrently must not
+        # interleave unsynchronized mailbox puts.
+        self._fanout_lock = threading.RLock()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._subscribers: List["Subscriber"] = []
         self._registered: int = 0
@@ -154,19 +163,30 @@ class EventBus:
         self._publish_on_loop(event)
 
     def _publish_on_loop(self, event: Event) -> None:
-        with self._lock:
-            self._remember_home_loop()
-            log.debug("event: %s", event)
-            self._ring.append(event)
+        # Bookkeeping under the STATE lock, fan-out outside it:
+        # delivering into subscriber mailboxes while holding the lock
+        # that register/unregister/wait also take is the reference's
+        # classic deadlock shape (a subscriber callback that touches
+        # the bus re-enters it) — cpcheck's CP-LOCKPUB exists to keep
+        # it out of this codebase, starting here. The snapshot keeps
+        # subscription order; the delivery-only _fanout_lock keeps
+        # concurrent direct publishes (off-loop fallback path) from
+        # interleaving mailbox puts, as the old state lock did.
+        with self._fanout_lock:
+            with self._lock:
+                self._remember_home_loop()
+                log.debug("event: %s", event)
+                self._ring.append(event)
+                subscribers = list(self._subscribers)
             if _EVENT_COUNTER is not None:
                 try:
                     _EVENT_COUNTER.labels(
                         code=event.code.value, source=event.source
                     ).inc()
-                except Exception:  # pragma: no cover
+                except Exception:  # pragma: no cover — cpcheck: disable=CP-SWALLOW metrics must never break publish
                     pass
-            for sub in list(self._subscribers):
-                sub.receive(event)
+            for sub in subscribers:
+                sub.receive(event)  # cpcheck: disable=CP-LOCKPUB delivery-only reentrant lock, taken by no other code path
 
     def shutdown(self) -> None:
         """Broadcast GLOBAL_SHUTDOWN (reference: events/bus.go:156-160)."""

@@ -104,7 +104,7 @@ class Subscriber(Publisher):
                     _DROP_COUNTER.labels(
                         code=event.code.value, source=event.source
                     ).inc()
-                except Exception:  # pragma: no cover
+                except Exception:  # pragma: no cover — cpcheck: disable=CP-SWALLOW metrics must never break fan-out
                     pass
 
     async def next_event(self) -> Event:

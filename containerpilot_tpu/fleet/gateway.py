@@ -638,8 +638,15 @@ class FleetGateway:
                     task.cancel()
                     try:
                         await task
-                    except (asyncio.CancelledError, Exception):
+                    except asyncio.CancelledError:
                         pass
+                    except Exception as exc:
+                        # the losing leg's transport error is expected
+                        # (we closed its connection); log it so a
+                        # systematic failure is still visible
+                        log.debug(
+                            "gateway: cancelled race leg failed: %s", exc
+                        )
 
     async def _proxy_buffered(
         self,

@@ -115,7 +115,15 @@ class FleetMember(EventHandler):
 
     async def _beat_loop(self) -> None:
         while True:
-            self._beat_once()
+            try:
+                self._beat_once()
+            except Exception as exc:
+                # a flaky catalog must not kill the heartbeat task: a
+                # dead loop silently TTL-expires a HEALTHY replica out
+                # of every gateway's routing set within one window
+                log.warning(
+                    "%s: heartbeat failed: %s", self.instance_id, exc
+                )
             await asyncio.sleep(self.heartbeat_interval)
 
     def _beat_once(self) -> None:

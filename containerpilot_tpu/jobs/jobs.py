@@ -339,7 +339,7 @@ class Job(EventHandler):
                         asyncio.shield(asyncio.wrap_future(future)),
                         timeout=10.0,
                     )
-                except Exception:  # noqa: BLE001 - cleanup never raises
+                except Exception:  # noqa: BLE001 — cpcheck: disable=CP-SWALLOW cleanup never raises; deregister failure already logged by the service queue
                     pass
         self.unsubscribe()
         self.unregister()

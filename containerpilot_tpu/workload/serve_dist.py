@@ -414,6 +414,8 @@ class _SlotMirror:
         self._done_host[slot] = False
         return first_host
 
+    # cpcheck: hotpath — the pod's per-round chunk step; one annotated
+    # fetch, and the mask upload only on rounds where it changed
     def run_chunk(self, done_mask) -> np.ndarray:
         """Advance every slot one chunk under the broadcast inactive
         mask; returns the [slots, chunk] sampled tokens (fetched on
@@ -432,7 +434,7 @@ class _SlotMirror:
         hold without the barrier — they decided)."""
         from ..models.slots import decode_slots_chunk
 
-        mask = np.asarray(done_mask, bool)
+        mask = np.asarray(done_mask, bool)  # cpcheck: disable=CP-HOTSYNC host-side numpy only, no device operand
         if not np.array_equal(mask, self._done_host):
             self.state = dict(
                 self.state, done=self._g(jnp.asarray(mask))
@@ -443,9 +445,36 @@ class _SlotMirror:
             self.cfg, self.chunk,
             out_sharding=self.rep,
         )
-        return np.asarray(jax.device_get(toks))
+        return np.asarray(jax.device_get(toks))  # cpcheck: disable=CP-HOTSYNC the per-round token fetch
 
 
+def _debug_round(mirror: _SlotMirror, payload, first, toks) -> None:
+    """Dump one round's inputs and full device state
+    (CONTAINERPILOT_POD_DEBUG only). Deliberately a separate,
+    non-hot function: every fetch below is a host sync."""
+    print(
+        "ROUND admit=%d plen=%d seed=%d row=%d mask=%s first=%s "
+        "toks=%s step=%s last=%s keys=%s"
+        % (
+            int(payload["admit_slot"]), int(payload["plen"]),
+            int(payload["seed"]), int(payload["row_idx"]),
+            np.asarray(payload["done"]).tolist(), first,
+            None if toks is None else toks.tolist(),
+            np.asarray(
+                jax.device_get(mirror.state["step_idx"])
+            ).tolist(),
+            np.asarray(
+                jax.device_get(mirror.state["last"])
+            ).tolist(),
+            np.asarray(
+                jax.device_get(mirror.state["keys"])
+            ).tolist(),
+        ),
+        flush=True,
+    )
+
+
+# cpcheck: hotpath — the device ops of one pod round
 def _apply_round(mirror: _SlotMirror, payload):
     """The device ops of one ROUND, identical on every process:
     optional admission, then optionally one chunk. Returns (first
@@ -456,26 +485,7 @@ def _apply_round(mirror: _SlotMirror, payload):
     if int(payload["run_chunk"]):
         toks = mirror.run_chunk(payload["done"])
     if os.environ.get("CONTAINERPILOT_POD_DEBUG"):
-        print(
-            "ROUND admit=%d plen=%d seed=%d row=%d mask=%s first=%s "
-            "toks=%s step=%s last=%s keys=%s"
-            % (
-                int(payload["admit_slot"]), int(payload["plen"]),
-                int(payload["seed"]), int(payload["row_idx"]),
-                np.asarray(payload["done"]).tolist(), first,
-                None if toks is None else toks.tolist(),
-                np.asarray(
-                    jax.device_get(mirror.state["step_idx"])
-                ).tolist(),
-                np.asarray(
-                    jax.device_get(mirror.state["last"])
-                ).tolist(),
-                np.asarray(
-                    jax.device_get(mirror.state["keys"])
-                ).tolist(),
-            ),
-            flush=True,
-        )
+        _debug_round(mirror, payload, first, toks)
     return first, toks
 
 

@@ -455,6 +455,8 @@ class SlotEngine:
             for s in self._active
         )
 
+    # cpcheck: hotpath — the continuous-batching decode round; a steady
+    # round must ship zero host syncs beyond the one annotated fetch
     def _run(self) -> None:
         # one-round lookahead: the [S, chunk] token output of a chunk
         # already dispatched for the NEXT round (None = serial)
@@ -542,7 +544,9 @@ class SlotEngine:
                 jax_s += time.perf_counter() - tj
             tj = time.perf_counter()
             try:
-                toks_host = np.asarray(jax.device_get(toks))
+                # the ONE deliberate sync per round: everything after
+                # it overlaps the lookahead chunk's device compute
+                toks_host = np.asarray(jax.device_get(toks))  # cpcheck: disable=CP-HOTSYNC the per-round token fetch
             except Exception as exc:  # noqa: BLE001 — fail loud, once
                 self._fail_and_rebuild(exc)
                 pending = None

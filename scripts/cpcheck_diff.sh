@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# cpcheck over ONLY the .py files your working tree changed — the fast
+# precommit-style loop (the full gate is `make lint`; CI runs it via
+# the tier-1 test_lint_gate test).
+#
+# Usage:
+#   scripts/cpcheck_diff.sh            # changed vs HEAD (staged + unstaged + untracked)
+#   scripts/cpcheck_diff.sh origin/main  # changed vs a base ref
+#
+# Exits 0 when nothing relevant changed or every finding is baselined;
+# non-zero on any new finding (same contract as `make lint`).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+BASE="${1:-HEAD}"
+
+# a typo'd ref must fail loudly, not scan nothing and exit 0 (process
+# substitution below would swallow git's error)
+git rev-parse --verify --quiet "$BASE^{commit}" >/dev/null || {
+    echo "cpcheck_diff: unknown base ref: $BASE" >&2
+    exit 2
+}
+
+mapfile -t files < <(
+    {
+        git diff --name-only --diff-filter=d "$BASE" -- 'containerpilot_tpu/*.py'
+        git ls-files --others --exclude-standard -- 'containerpilot_tpu/*.py'
+    } | sort -u
+)
+
+if [ "${#files[@]}" -eq 0 ]; then
+    echo "cpcheck_diff: no changed python files under containerpilot_tpu/"
+    exit 0
+fi
+
+echo "cpcheck_diff: scanning ${#files[@]} changed file(s) vs ${BASE}"
+exec "${PYTHON:-python}" -m containerpilot_tpu.analysis --files "${files[@]}"

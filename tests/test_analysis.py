@@ -1,0 +1,495 @@
+"""cpcheck tests: one firing and one non-firing fixture per rule ID,
+pragma escape hatches, baseline workflow (including drift against the
+committed baseline), the CLI/`make lint` gate, and the racecheck
+runtime harness.
+
+These are the analyzer's own unit tests; the rules' value against the
+REAL codebase is enforced by test_baseline_matches_fresh_scan and
+test_lint_gate below.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from containerpilot_tpu.analysis import (
+    ALL_RULES,
+    RULES_BY_ID,
+    RaceCheck,
+    diff_against_baseline,
+    load_baseline,
+    scan_package,
+    scan_source,
+    write_baseline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "containerpilot_tpu")
+
+
+def findings_for(src: str, rule: str):
+    return [
+        f for f in scan_source(textwrap.dedent(src), "fixture.py")
+        if f.rule == rule
+    ]
+
+
+# ---------------------------------------------------------------- rules
+
+def test_rule_catalog_complete():
+    ids = {r.rule_id for r in ALL_RULES}
+    assert ids == {
+        "CP-HOTSYNC", "CP-DONATE", "CP-LOCKPUB",
+        "CP-SWALLOW", "CP-THREAD", "CP-TOPIC",
+    }
+    for rule in ALL_RULES:
+        assert rule.__doc__, f"{rule.rule_id} must document itself"
+        assert RULES_BY_ID[rule.rule_id] is rule
+
+
+def test_hotsync_fires_in_marked_function():
+    src = """
+    # cpcheck: hotpath
+    def round(state):
+        x = state.tokens.item()
+        return x
+    """
+    found = findings_for(src, "CP-HOTSYNC")
+    assert len(found) == 1 and found[0].scope == "round"
+
+
+def test_hotsync_decorator_and_blocking_calls():
+    src = """
+    @hotpath
+    def round(toks):
+        time.sleep(0.1)
+        a = np.asarray(toks)
+        toks.block_until_ready()
+        return a
+    """
+    assert len(findings_for(src, "CP-HOTSYNC")) == 3
+
+
+def test_hotsync_silent_on_unmarked_function():
+    src = """
+    def warmup(state):
+        state.tokens.block_until_ready()
+        return state.tokens.item()
+    """
+    assert findings_for(src, "CP-HOTSYNC") == []
+
+
+def test_hotsync_inline_disable_pragma():
+    src = """
+    # cpcheck: hotpath — the decode round
+    def round(toks):
+        host = np.asarray(jax.device_get(toks))  # cpcheck: disable=CP-HOTSYNC the one fetch
+        return host
+    """
+    assert findings_for(src, "CP-HOTSYNC") == []
+
+
+def test_donate_read_after_donation_fires():
+    src = """
+    def step(pool, row, cfg):
+        new_pool = insert_row(pool, row, 0, cfg)
+        return pool["k"]
+    """
+    found = findings_for(src, "CP-DONATE")
+    assert len(found) == 1 and "`pool`" in found[0].message
+
+
+def test_donate_rebind_by_same_call_is_clean():
+    src = """
+    def step(pool, state, params, cfg, chunk):
+        pool = insert_row(pool, make_row(), 0, cfg)
+        pool, state, toks = decode_slots_chunk(
+            params, pool, state,
+            cfg, chunk,
+        )
+        return pool, state, toks
+    """
+    assert findings_for(src, "CP-DONATE") == []
+
+
+def test_donate_branch_aware():
+    """A donation in one if-arm neither taints the sibling arm's read
+    (mutually exclusive) nor is absolved by a sibling arm's rebind."""
+    exclusive = """
+    def f(state, row, cfg, cond):
+        if cond:
+            new = insert_row(state, row, 0, cfg)
+            return new
+        else:
+            return state.total()
+    """
+    assert findings_for(exclusive, "CP-DONATE") == []
+    after_join = """
+    def f(state, row, cfg, cond):
+        if cond:
+            new = insert_row(state, row, 0, cfg)
+        return state.total()
+    """
+    assert len(findings_for(after_join, "CP-DONATE")) == 1
+    sibling_heal = """
+    def f(state, row, cfg, cond):
+        new = insert_row(state, row, 0, cfg)
+        if cond:
+            state = rebuild()
+        else:
+            x = state.total()
+        return new
+    """
+    assert len(findings_for(sibling_heal, "CP-DONATE")) == 1
+
+
+def test_hotpath_decorator_is_exported_noop():
+    from containerpilot_tpu.analysis import hotpath
+
+    @hotpath
+    def f():
+        return 7
+
+    assert f() == 7
+
+
+def test_donate_tracks_local_jit_bindings():
+    src = """
+    step = jax.jit(_step, donate_argnums=(0,))
+
+    def train(state, batch):
+        new_state = step(state, batch)
+        return new_state, state.opt
+    """
+    found = findings_for(src, "CP-DONATE")
+    assert len(found) == 1 and found[0].scope == "train"
+
+
+def test_lockpub_fires_under_lock():
+    src = """
+    def deregister(self, rid):
+        with self._lock:
+            del self._replicas[rid]
+            self.bus.publish(Event(EventCode.STOPPED, rid))
+    """
+    found = findings_for(src, "CP-LOCKPUB")
+    assert len(found) == 1 and "bus.publish" in found[0].text
+
+
+def test_lockpub_clean_outside_lock_and_in_nested_def():
+    src = """
+    def deregister(self, rid):
+        with self._lock:
+            del self._replicas[rid]
+            def later():
+                self.bus.publish(STOPPED)
+        self.bus.publish(Event(EventCode.STOPPED, rid))
+    """
+    assert findings_for(src, "CP-LOCKPUB") == []
+
+
+def test_swallow_fires_on_broad_pass():
+    src = """
+    def worker(self):
+        try:
+            self.step()
+        except Exception:
+            pass
+    """
+    assert len(findings_for(src, "CP-SWALLOW")) == 1
+
+
+def test_swallow_allows_narrow_or_handled():
+    src = """
+    def worker(self):
+        try:
+            self.step()
+        except ValueError:
+            pass
+        try:
+            self.step()
+        except Exception:
+            log.exception("step failed")
+    """
+    assert findings_for(src, "CP-SWALLOW") == []
+
+
+def test_thread_requires_explicit_daemon():
+    src = """
+    def start(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+    """
+    assert len(findings_for(src, "CP-THREAD")) == 1
+
+
+def test_thread_with_daemon_is_clean():
+    src = """
+    def start(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+    """
+    assert findings_for(src, "CP-THREAD") == []
+
+
+def test_topic_fires_on_inline_string_code():
+    src = """
+    def notify(bus, name):
+        bus.publish(Event("exitSuccess", name))
+    """
+    found = findings_for(src, "CP-TOPIC")
+    assert len(found) == 1 and "exitSuccess" in found[0].message
+
+
+def test_topic_clean_on_registry_codes():
+    src = """
+    def notify(bus, name):
+        bus.publish(Event(EventCode.EXIT_SUCCESS, name))
+        bus.publish(GLOBAL_SHUTDOWN)
+    """
+    assert findings_for(src, "CP-TOPIC") == []
+
+
+def test_disable_pragma_comma_in_justification_is_not_a_rule():
+    """Prose after the rule ids may contain commas without widening
+    the suppression to phantom rule names."""
+    src = """
+    def f(self, bus):
+        with self._lock:
+            bus.publish(GS)  # cpcheck: disable=CP-SWALLOW host-side only, no fan-out here
+    """
+    # CP-LOCKPUB still fires: only CP-SWALLOW was named; "no fan-out
+    # here" must not parse as rules "NO"/...
+    assert len(findings_for(src, "CP-LOCKPUB")) == 1
+
+
+def test_disable_pragma_suppresses_named_rule_only():
+    src = """
+    def worker(self):
+        try:
+            self.step()
+        except Exception:  # cpcheck: disable=CP-SWALLOW justified because test
+            pass
+        try:
+            self.step()
+        except Exception:  # cpcheck: disable=CP-TOPIC wrong rule id
+            pass
+    """
+    assert len(findings_for(src, "CP-SWALLOW")) == 1
+
+
+# ------------------------------------------------------------- baseline
+
+def test_baseline_matches_fresh_scan():
+    """The committed baseline exactly mirrors a fresh scan: no new
+    findings (would fail CI anyway) and no stale entries (fixed debt
+    must leave the ledger)."""
+    findings = scan_package(PACKAGE, relative_to=REPO)
+    new, stale = diff_against_baseline(findings, load_baseline())
+    assert new == [], "new findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    assert stale == [], "stale baseline entries (run make lint-baseline):\n" + "\n".join(
+        str(e) for e in stale
+    )
+
+
+def test_baseline_multiset_semantics(tmp_path):
+    src = """
+    def a(self):
+        try:
+            self.x()
+        except Exception:
+            pass
+
+    def b(self):
+        try:
+            self.x()
+        except Exception:
+            pass
+    """
+    findings = [
+        f for f in scan_source(textwrap.dedent(src), "m.py")
+        if f.rule == "CP-SWALLOW"
+    ]
+    assert len(findings) == 2
+    # one baseline entry cannot absolve two identical findings
+    path = str(tmp_path / "baseline.json")
+    write_baseline(findings[:1], path)
+    new, stale = diff_against_baseline(findings, load_baseline(path))
+    assert len(new) == 1 and stale == []
+
+
+def test_write_baseline_preserves_reasons(tmp_path):
+    findings = [
+        f for f in scan_source(
+            "try:\n    pass\nexcept Exception:\n    pass\n", "m.py"
+        )
+        if f.rule == "CP-SWALLOW"
+    ]
+    path = str(tmp_path / "baseline.json")
+    write_baseline(findings, path)
+    data = json.load(open(path))
+    data["entries"][0]["reason"] = "because"
+    json.dump(data, open(path, "w"))
+    write_baseline(findings, path)
+    assert json.load(open(path))["entries"][0]["reason"] == "because"
+
+
+# ------------------------------------------------------------ CLI gate
+
+def _run_cli(*argv, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "containerpilot_tpu.analysis", *argv],
+        cwd=cwd, capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_lint_gate():
+    """The tier-1 gate: the exact `make lint` body must pass on the
+    tree as committed."""
+    proc = _run_cli()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_lint_gate_fails_on_seeded_violation(tmp_path):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(
+        "# cpcheck: hotpath\n"
+        "def round(toks):\n"
+        "    toks.block_until_ready()\n"
+    )
+    proc = _run_cli("--files", str(bad))
+    assert proc.returncode == 1
+    assert "CP-HOTSYNC" in proc.stdout
+
+
+def test_lint_gate_fails_on_seeded_lockpub(tmp_path):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(
+        "def f(self):\n"
+        "    with self._lock:\n"
+        "        self.bus.publish(GLOBAL_SHUTDOWN)\n"
+    )
+    proc = _run_cli("--files", str(bad))
+    assert proc.returncode == 1
+    assert "CP-LOCKPUB" in proc.stdout
+
+
+def test_cli_rejects_partial_baseline_write(tmp_path):
+    """--write-baseline over a partial --files scan would silently
+    drop every other file's justified entries; it must be refused."""
+    f = tmp_path / "x.py"
+    f.write_text("x = 1\n")
+    proc = _run_cli("--files", str(f), "--write-baseline")
+    assert proc.returncode == 2  # argparse usage error
+
+
+def test_cli_list_rules():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule in ALL_RULES:
+        assert rule.rule_id in proc.stdout
+
+
+def test_make_lint_target():
+    """`make lint` is wired to the analyzer (satellite contract)."""
+    import shutil
+
+    if shutil.which("make") is None:
+        pytest.skip("make not available")
+    proc = subprocess.run(
+        ["make", "lint"], cwd=REPO, capture_output=True, text=True,
+        timeout=180,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "cpcheck" in proc.stdout
+
+
+# ------------------------------------------------------------ racecheck
+
+def test_racecheck_detects_lock_order_cycle():
+    rc = RaceCheck()
+    l1, l2 = rc.lock("L1"), rc.lock("L2")
+
+    def ab():
+        with l1:
+            with l2:
+                pass
+
+    def ba():
+        with l2:
+            with l1:
+                pass
+
+    for fn in (ab, ba):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        t.join(5)
+    with pytest.raises(AssertionError, match="lock-order-cycle"):
+        rc.assert_clean()
+    kinds = {v.kind for v in rc.violations()}
+    assert kinds == {"lock-order-cycle"}
+
+
+def test_racecheck_consistent_order_is_clean():
+    rc = RaceCheck()
+    l1, l2 = rc.lock("L1"), rc.lock("L2")
+
+    def ab():
+        with l1:
+            with l2:
+                pass
+
+    for _ in range(2):
+        t = threading.Thread(target=ab, daemon=True)
+        t.start()
+        t.join(5)
+    rc.assert_clean()
+
+
+def test_racecheck_reentrant_rlock_no_self_edge():
+    rc = RaceCheck()
+    lock = rc.rlock("R")
+    with lock:
+        with lock:
+            pass
+    rc.assert_clean()
+
+
+def test_racecheck_publish_while_held(run):
+    from containerpilot_tpu.events import EventBus, GLOBAL_STARTUP
+
+    async def scenario():
+        rc = RaceCheck()
+        bus = rc.wrap_bus(EventBus())
+        table = rc.lock("replica-table")
+        with table:
+            bus.publish(GLOBAL_STARTUP)
+        with pytest.raises(AssertionError, match="publish-while-held"):
+            rc.assert_clean()
+        rc.unwrap()
+        # unwrapped: back to the plain publish
+        bus.publish(GLOBAL_STARTUP)
+
+    run(scenario())
+
+
+def test_racecheck_publish_outside_lock_is_clean(run):
+    from containerpilot_tpu.events import EventBus, GLOBAL_STARTUP
+
+    async def scenario():
+        with RaceCheck() as rc:
+            bus = rc.wrap_bus(EventBus())
+            table = rc.lock("replica-table")
+            with table:
+                pass
+            bus.publish(GLOBAL_STARTUP)
+        # context-manager exit ran assert_clean and unwrap
+
+    run(scenario())

@@ -216,3 +216,58 @@ def test_config_facing_event_aliases():
     assert code_from_string("healthy") is EventCode.STATUS_HEALTHY
     assert code_from_string("unhealthy") is EventCode.STATUS_UNHEALTHY
     assert code_from_string("changed") is EventCode.STATUS_CHANGED
+
+
+# -- racecheck: the dynamic analog of cpcheck's CP-LOCKPUB --------------
+
+
+def test_fanout_delivers_outside_bus_lock(run):
+    """Regression guard for the bus's own publish discipline: fan-out
+    must happen AFTER the internal lock is released (a subscriber
+    callback that touches the bus again must never find it held)."""
+    from containerpilot_tpu.analysis import RaceCheck
+
+    async def scenario():
+        rc = RaceCheck()
+        bus = EventBus()
+        bus._lock = rc.rlock("bus-internal")  # noqa: SLF001
+        held_at_delivery = []
+
+        class Probe(CollectingActor):
+            def receive(self, event):
+                held_at_delivery.append(list(rc._held()))  # noqa: SLF001
+                super().receive(event)
+
+        Probe("probe").subscribe(bus)
+        bus.publish(GLOBAL_STARTUP)
+        assert held_at_delivery == [[]]
+        rc.assert_clean()
+
+    run(scenario())
+
+
+def test_subscriber_may_publish_from_receive(run):
+    """A subscriber reacting to an event by publishing another one
+    must not deadlock or corrupt fan-out: delivery runs outside the
+    bus lock, over a snapshot of the subscriber list."""
+
+    async def scenario():
+        bus = EventBus()
+        probe = CollectingActor("probe")
+
+        class Reactor(CollectingActor):
+            def receive(self, event):
+                super().receive(event)
+                if event.code is EventCode.STARTUP:
+                    # re-entrant publish AND a subscription mutation
+                    # mid-fan-out: both safe over the snapshot
+                    CollectingActor("late").subscribe(bus)
+                    bus.publish(Event(EventCode.STATUS_CHANGED, "react"))
+
+        Reactor("reactor").subscribe(bus)
+        probe.subscribe(bus)
+        bus.publish(GLOBAL_STARTUP)
+        codes = [e.code for e in bus.debug_events()]
+        assert codes == [EventCode.STARTUP, EventCode.STATUS_CHANGED]
+
+    run(scenario())

@@ -644,3 +644,110 @@ def test_fleet_gateway_drain_mid_traffic_zero_5xx(run, tmp_path):
         'containerpilot_gateway_requests_total'
         '{code="200",endpoint="generate"}'
     ) in metrics[1]
+
+
+def test_member_drain_cycle_racecheck_clean(run, tmp_path):
+    """Run the full control-plane drain/resume cycle with the
+    racecheck harness watching the bus: no maintenance-path publish
+    may happen while an application lock is held (the dynamic analog
+    of cpcheck's CP-LOCKPUB, which PRs must keep true as the drain
+    path grows)."""
+    from containerpilot_tpu.analysis import RaceCheck
+    from containerpilot_tpu.events import (
+        EventBus,
+        GLOBAL_ENTER_MAINTENANCE,
+        GLOBAL_EXIT_MAINTENANCE,
+    )
+
+    backend = FileCatalogBackend(str(tmp_path / "catalog"))
+
+    async def scenario():
+        rc = RaceCheck()
+        bus = rc.wrap_bus(EventBus())
+        stub = _StubReplica()
+        member = FleetMember(
+            stub, backend, "svc", ttl=2, heartbeat_interval=0.05,
+            instance_id="r1",
+        )
+        # instrument the REAL locks the drain path crosses, so the
+        # harness actually has something to catch: the discovery
+        # FIFO-queue lock (taken on both the loop thread and the
+        # catalog pool threads) and the bus's internal lock
+        member.service._lock = rc.lock("service-queue")  # noqa: SLF001
+        bus._lock = rc.rlock("bus-internal")  # noqa: SLF001
+        await member.start()
+        member.attach_bus(bus)
+        for _ in range(100):
+            if backend.instances("svc"):
+                break
+            await asyncio.sleep(0.02)
+        assert backend.instances("svc")
+
+        bus.publish(GLOBAL_ENTER_MAINTENANCE)
+        for _ in range(100):
+            if stub.draining and not backend.instances("svc"):
+                break
+            await asyncio.sleep(0.02)
+        assert stub.draining and backend.instances("svc") == []
+
+        bus.publish(GLOBAL_EXIT_MAINTENANCE)
+        for _ in range(100):
+            if not stub.draining and backend.instances("svc"):
+                break
+            await asyncio.sleep(0.02)
+        assert not stub.draining and backend.instances("svc")
+
+        await member.stop()
+        rc.unwrap()
+        rc.assert_clean()
+
+    run(scenario(), timeout=60)
+
+
+def test_member_heartbeat_survives_transient_exception(run, tmp_path):
+    """An exception thrown synchronously inside one beat (here: the
+    server's drain-surface property glitching) must not kill the
+    heartbeat task — a dead loop would silently TTL-expire a healthy
+    replica out of every gateway's routing set."""
+
+    class _GlitchyReplica:
+        """Drain surface whose `draining` property raises a few times."""
+
+        def __init__(self):
+            self.ready = True
+            self.inflight = 0
+            self.port = 4242
+            self.glitches = 0
+
+        @property
+        def draining(self):
+            if self.glitches > 0:
+                self.glitches -= 1
+                raise RuntimeError("transient state glitch")
+            return False
+
+    backend = FileCatalogBackend(str(tmp_path / "catalog"))
+
+    async def scenario():
+        stub = _GlitchyReplica()
+        member = FleetMember(
+            stub, backend, "svc", ttl=2, heartbeat_interval=0.05,
+            instance_id="r1",
+        )
+        await member.start()
+        for _ in range(100):
+            if backend.instances("svc"):
+                break
+            await asyncio.sleep(0.02)
+        assert backend.instances("svc")
+
+        stub.glitches = 3  # three beats in a row blow up
+        await asyncio.sleep(0.3)
+        assert stub.glitches == 0  # the loop kept beating through them
+        assert member._beat_task is not None  # noqa: SLF001
+        assert not member._beat_task.done()  # noqa: SLF001 — loop alive
+        assert backend.instances("svc")  # replica never left the catalog
+        await member.stop()
+        assert backend.instances("svc") == []
+
+    run(scenario(), timeout=60)
