@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all build test test-fast test-workload integration fleet-smoke bench lint lint-baseline clean image
+.PHONY: all build test test-fast test-workload integration fleet-smoke bench bench-gateway lint lint-baseline clean image
 
 all: build test
 
@@ -36,6 +36,12 @@ fleet-smoke:
 
 bench:
 	$(PYTHON) bench.py
+
+# the gateway hop's pooled-vs-per-dial cost on this box (host-side
+# number; the CPU backend is representative)
+bench-gateway:
+	JAX_PLATFORMS=cpu $(PYTHON) -c "import json, bench; \
+		print(json.dumps(bench.gateway_overhead_bench(), indent=2))"
 
 # cpcheck (AST invariant rules vs analysis/baseline.json) + compileall;
 # see docs/70-static-analysis.md. Non-zero on any non-baselined finding.

@@ -707,16 +707,25 @@ def host_overhead_bench(rounds: int = 40) -> dict:
 
 def gateway_overhead_bench(rounds: int = 60) -> dict:
     """Per-request latency the fleet gateway adds over direct replica
-    access, runnable on ANY backend (tiny CPU-sized config).
+    access — pooled vs per-dial, runnable on ANY backend (tiny
+    CPU-sized config).
 
     Boots one in-process InferenceServer, registers it in a file
-    catalog via a FleetMember, fronts it with a FleetGateway, then
-    measures /v1/generate round trips both direct-to-replica and
-    through the gateway — same request, same process, interleaved so
-    scheduler drift hits both sides equally. The reported
-    ``gateway_added_ms`` (median via-gateway minus median direct) is
-    the cost of the extra hop: one accept, one proxied connect, header
-    parse, and the routing/metrics bookkeeping."""
+    catalog via a FleetMember, and fronts it with TWO gateways: one
+    with the default keep-alive connection pool, one with pooling
+    disabled (``pool_max_idle=0``, the pre-pool behavior). Each round
+    measures /v1/generate four ways, interleaved so scheduler drift
+    hits every path equally:
+
+    - direct per-dial (fresh ``Connection: close`` client per request)
+    - direct keep-alive (one persistent client connection)
+    - via the pool-disabled gateway over a per-dial client
+    - via the pooled gateway over a keep-alive client
+
+    ``gateway_added_per_dial_ms`` vs ``gateway_added_pooled_ms`` is
+    the PR's claim: the hop's cost was mostly connection churn, and
+    reuse on both sides of the gateway removes it."""
+    import http.client
     import os
     import tempfile
     import urllib.request
@@ -746,7 +755,9 @@ def gateway_overhead_bench(rounds: int = 60) -> dict:
         {"tokens": [[1, 2, 3, 4]], "max_new_tokens": 8}
     ).encode()
 
-    def post(port: int) -> float:
+    def post_dial(port: int) -> float:
+        """urllib dials per request and sends Connection: close —
+        exactly the pre-keep-alive client behavior."""
         req = urllib.request.Request(
             f"http://127.0.0.1:{port}/v1/generate",
             data=body,
@@ -757,8 +768,46 @@ def gateway_overhead_bench(rounds: int = 60) -> dict:
             resp.read()
         return (time.perf_counter() - t0) * 1e3
 
-    direct: list = []
-    via: list = []
+    class _KeepAliveClient:
+        """One persistent http.client connection, redialed at most
+        once per post if the server reaped it between rounds."""
+
+        def __init__(self, port: int) -> None:
+            self.port = port
+            self.conn = None
+
+        def post(self) -> float:
+            t0 = time.perf_counter()
+            for _ in range(2):
+                if self.conn is None:
+                    self.conn = http.client.HTTPConnection(
+                        "127.0.0.1", self.port, timeout=300
+                    )
+                try:
+                    self.conn.request(
+                        "POST", "/v1/generate", body,
+                        {"Content-Type": "application/json"},
+                    )
+                    resp = self.conn.getresponse()
+                    resp.read()
+                    if resp.will_close:
+                        self.close()
+                    return (time.perf_counter() - t0) * 1e3
+                except (ConnectionError, http.client.BadStatusLine):
+                    self.close()
+            raise RuntimeError("keep-alive post failed twice")
+
+        def close(self) -> None:
+            if self.conn is not None:
+                self.conn.close()
+                self.conn = None
+
+    series: dict = {
+        "direct_per_dial": [],
+        "direct_keepalive": [],
+        "gateway_per_dial": [],
+        "gateway_pooled": [],
+    }
     with tempfile.TemporaryDirectory() as root:
         backend = FileCatalogBackend(root)
 
@@ -770,46 +819,81 @@ def gateway_overhead_bench(rounds: int = 60) -> dict:
                 heartbeat_interval=0.2,
             )
             await member.start()
-            gateway = FleetGateway(
+            gw_pooled = FleetGateway(
                 backend, "bench-infer", "127.0.0.1", 0,
                 poll_interval=0.2, hedge=False,
             )
-            await gateway.run()
+            gw_dial = FleetGateway(
+                backend, "bench-infer", "127.0.0.1", 0,
+                poll_interval=0.2, hedge=False, pool_max_idle=0,
+            )
+            await gw_pooled.run()
+            await gw_dial.run()
             for _ in range(200):
-                if gateway.replica_count:
+                if gw_pooled.replica_count and gw_dial.replica_count:
                     break
                 await asyncio.sleep(0.05)
-            assert gateway.replica_count == 1
-            for _ in range(5):  # warm both paths (compiles, routes)
-                await loop.run_in_executor(None, post, server.port)
-                await loop.run_in_executor(None, post, gateway.port)
+            assert gw_pooled.replica_count == 1
+            assert gw_dial.replica_count == 1
+            ka_direct = _KeepAliveClient(server.port)
+            ka_pooled = _KeepAliveClient(gw_pooled.port)
+            paths = (
+                ("direct_per_dial", lambda: post_dial(server.port)),
+                ("direct_keepalive", ka_direct.post),
+                ("gateway_per_dial", lambda: post_dial(gw_dial.port)),
+                ("gateway_pooled", ka_pooled.post),
+            )
+            for _ in range(5):  # warm every path (compiles, routes)
+                for _name, fn in paths:
+                    await loop.run_in_executor(None, fn)
             for _ in range(rounds):
-                direct.append(
-                    await loop.run_in_executor(None, post, server.port)
-                )
-                via.append(
-                    await loop.run_in_executor(None, post, gateway.port)
-                )
-            await gateway.stop()
+                for name, fn in paths:
+                    series[name].append(
+                        await loop.run_in_executor(None, fn)
+                    )
+            ka_direct.close()
+            ka_pooled.close()
+            await gw_pooled.stop()
+            await gw_dial.stop()
             await member.stop()
             await server.stop()
 
         asyncio.run(scenario())
 
-    direct_ms = statistics.median(direct)
-    via_ms = statistics.median(via)
+    med = {k: statistics.median(v) for k, v in series.items()}
+    added_per_dial = med["gateway_per_dial"] - med["direct_per_dial"]
+    added_pooled = med["gateway_pooled"] - med["direct_keepalive"]
     return {
         "backend": jax.default_backend(),
         "config": (
             f"{cfg.n_layers}L d{cfg.d_model} v{cfg.vocab_size}, "
             f"8 new tokens, {rounds} interleaved rounds"
         ),
-        "direct_ms": round(direct_ms, 3),
-        "direct_min_ms": round(min(direct), 3),
-        "gateway_ms": round(via_ms, 3),
-        "gateway_min_ms": round(min(via), 3),
-        "gateway_added_ms": round(via_ms - direct_ms, 3),
-        "gateway_added_min_ms": round(min(via) - min(direct), 3),
+        "direct_per_dial_ms": round(med["direct_per_dial"], 3),
+        "direct_keepalive_ms": round(med["direct_keepalive"], 3),
+        "gateway_per_dial_ms": round(med["gateway_per_dial"], 3),
+        "gateway_pooled_ms": round(med["gateway_pooled"], 3),
+        "gateway_added_per_dial_ms": round(added_per_dial, 3),
+        "gateway_added_pooled_ms": round(added_pooled, 3),
+        "gateway_added_per_dial_min_ms": round(
+            min(series["gateway_per_dial"])
+            - min(series["direct_per_dial"]), 3
+        ),
+        "gateway_added_pooled_min_ms": round(
+            min(series["gateway_pooled"])
+            - min(series["direct_keepalive"]), 3
+        ),
+        # the PR's stated bar: pooled overhead at most half per-dial
+        "target_ratio": 0.5,
+        "pooled_over_per_dial": (
+            round(added_pooled / added_per_dial, 3)
+            if added_per_dial > 0 else None
+        ),
+        "meets_target": (
+            added_pooled <= 0.5 * added_per_dial
+            if added_per_dial > 0
+            else added_pooled <= 0
+        ),
     }
 
 

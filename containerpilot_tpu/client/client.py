@@ -4,6 +4,14 @@ Capability parity with the reference (reference: client/client.go):
 one verb per control endpoint, used by the CLI subcommands and usable
 as an SDK by supervised workloads (e.g. a JAX training loop POSTing
 step-rate metrics).
+
+The client keeps ONE unix-socket connection across verbs (the control
+server speaks HTTP/1.1 keep-alive): an SDK posting a metric every
+step no longer pays a dial per call. If the server reaped the idle
+connection (restart, idle timeout), the next verb sees the close
+before any response byte and transparently redials once — the server
+answered nothing, so nothing was applied. ``close()`` drops the kept
+connection; ``keep_alive=False`` restores dial-per-verb.
 """
 from __future__ import annotations
 
@@ -11,8 +19,11 @@ import errno
 import http.client
 import json
 import socket
+import threading
 import time
 from typing import Any, Dict, Optional
+
+from ..utils.httpclient import keepalive_request
 
 
 class ControlClientError(RuntimeError):
@@ -48,6 +59,7 @@ class ControlClient:
         timeout: float = 10.0,
         retries: int = 3,
         retry_delay: float = 0.05,
+        keep_alive: bool = True,
     ) -> None:
         self.socket_path = socket_path
         self.timeout = timeout
@@ -55,31 +67,72 @@ class ControlClient:
         # (its last iteration always returns or raises)
         self.retries = max(retries, 0)
         self.retry_delay = retry_delay
+        self.keep_alive = keep_alive
+        # the kept connection is taken/put under a lock so the client
+        # stays thread-safe (each verb previously built a private
+        # connection); concurrent verbs simply dial extra connections
+        # and only one is kept
+        self._conn: Optional[_UnixHTTPConnection] = None
+        self._conn_lock = threading.Lock()
+
+    def _take_conn(self) -> Optional[_UnixHTTPConnection]:
+        with self._conn_lock:
+            conn, self._conn = self._conn, None
+        return conn
+
+    def _put_conn(self, conn: _UnixHTTPConnection) -> None:
+        with self._conn_lock:
+            if self._conn is None:
+                self._conn = conn
+                return
+        conn.close()
+
+    def close(self) -> None:
+        """Drop the kept connection (idempotent; the next verb
+        redials)."""
+        conn = self._take_conn()
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "ControlClient":
+        return self
+
+    def __exit__(self, *_exc) -> None:
+        self.close()
 
     def _request(
         self, method: str, path: str, body: Optional[Dict[str, Any]] = None
     ) -> str:
-        """One control-plane round trip. Transient connect-phase
-        socket errors (ECONNREFUSED/EAGAIN/ENOENT while the supervisor
-        is still binding its socket) retry with short exponential
-        backoff instead of failing the first control call after
-        start; anything else surfaces immediately."""
+        """One control-plane round trip over the kept connection
+        (utils/httpclient.py owns the redial discipline: a kept
+        connection that failed before any response byte is resent
+        once on a fresh dial; anything after response bytes is NOT —
+        the server may have processed the verb).
+
+        Transient connect-phase socket errors (ECONNREFUSED/EAGAIN/
+        ENOENT while the supervisor is still binding its socket) retry
+        with short exponential backoff instead of failing the first
+        control call after start; anything else surfaces
+        immediately."""
+        payload = json.dumps(body) if body is not None else None
+        headers = {"Content-Type": "application/json"} if payload else {}
+        put = self._put_conn if self.keep_alive else (
+            lambda conn: conn.close()
+        )
         delay = self.retry_delay
         for attempt in range(self.retries + 1):
-            conn = _UnixHTTPConnection(self.socket_path, self.timeout)
             try:
-                payload = json.dumps(body) if body is not None else None
-                headers = (
-                    {"Content-Type": "application/json"} if payload else {}
+                status, data = keepalive_request(
+                    self._take_conn,
+                    put,
+                    lambda: _UnixHTTPConnection(
+                        self.socket_path, self.timeout
+                    ),
+                    method, path, body=payload, headers=headers,
                 )
-                conn.request(method, path, body=payload, headers=headers)
-                resp = conn.getresponse()
-                data = resp.read().decode("utf-8", "replace")
-                if resp.status != 200:
-                    raise ControlClientError(
-                        f"{method} {path}: HTTP {resp.status}: {data.strip()}"
-                    )
-                return data
             except (OSError, http.client.HTTPException) as exc:
                 transient = (
                     isinstance(exc, OSError)
@@ -90,8 +143,12 @@ class ControlClient:
                     delay = min(delay * 2, 0.5)
                     continue
                 raise ControlClientError(f"{method} {path}: {exc}") from None
-            finally:
-                conn.close()
+            text = data.decode("utf-8", "replace")
+            if status != 200:
+                raise ControlClientError(
+                    f"{method} {path}: HTTP {status}: {text.strip()}"
+                )
+            return text
 
     def reload(self) -> None:
         """POST /v3/reload (reference: client.go:45-52)."""

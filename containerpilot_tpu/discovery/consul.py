@@ -9,17 +9,27 @@ needs, URI/map config with ``CONSUL_HTTP_ADDR`` / ``CONSUL_HTTP_SSL`` /
 last-seen instance list with compare-for-change
 (reference: discovery/consul.go:102-125), and a Prometheus gauge of
 watched instance counts (reference: discovery/consul.go:16-22).
+
+Catalog calls ride PERSISTENT keep-alive connections, one per thread
+(heartbeats run on the discovery FIFO thread, watch/gateway polls on a
+small poll executor — each keeps its own warm connection to the
+agent): TTL refreshes every ttl/2 seconds and membership polls every
+interval no longer dial per call. A connection the agent closed while
+idle is detected before any response byte and redialed transparently
+once; agents that answer ``Connection: close`` (or any non-keep-alive
+proxy in front of one) degrade gracefully to dial-per-call.
 """
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import os
-import urllib.error
+import threading
 import urllib.parse
-import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..utils.httpclient import keepalive_request
 from .backend import (
     Backend,
     DiscoveryError,
@@ -62,6 +72,12 @@ class ConsulBackend(Backend):
         self.token = token
         self.timeout = timeout
         self._last_seen: Dict[str, List[ServiceInstance]] = {}
+        # one persistent agent connection PER THREAD:
+        # http.client.HTTPConnection is not thread-safe, and catalog
+        # traffic comes from a handful of long-lived threads (the
+        # discovery FIFO drain, the poll executor) that each get to
+        # keep their own warm connection
+        self._local = threading.local()
 
     # -- construction ---------------------------------------------------
 
@@ -95,24 +111,47 @@ class ConsulBackend(Backend):
 
     # -- HTTP plumbing --------------------------------------------------
 
+    def _take_conn(self) -> Optional[http.client.HTTPConnection]:
+        conn = getattr(self._local, "conn", None)
+        self._local.conn = None
+        return conn
+
+    def _put_conn(self, conn: http.client.HTTPConnection) -> None:
+        self._local.conn = conn
+
+    def _new_conn(self) -> http.client.HTTPConnection:
+        cls = (
+            http.client.HTTPSConnection
+            if self.scheme == "https"
+            else http.client.HTTPConnection
+        )
+        # http.client parses a "host:port" string itself
+        return cls(self.address, timeout=self.timeout)
+
     def _request(
         self, method: str, path: str, body: Optional[Dict[str, Any]] = None
     ) -> Any:
-        url = f"{self.scheme}://{self.address}{path}"
+        """One agent round trip over this thread's kept connection
+        (utils/httpclient.py owns the redial discipline: a kept
+        connection the agent reaped while idle fails before any
+        response byte and is resent once on a fresh dial)."""
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Content-Type", "application/json")
+        headers = {"Content-Type": "application/json"}
         if self.token:
-            req.add_header("X-Consul-Token", self.token)
+            headers["X-Consul-Token"] = self.token
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                payload = resp.read()
-        except urllib.error.HTTPError as exc:
+            status, payload = keepalive_request(
+                self._take_conn, self._put_conn, self._new_conn,
+                method, path, body=data, headers=headers,
+            )
+        except (OSError, http.client.HTTPException) as exc:
             raise DiscoveryError(
-                f"consul {method} {path}: {exc.code} {exc.read()[:200]!r}"
+                f"consul {method} {path}: {exc}"
             ) from None
-        except (urllib.error.URLError, OSError) as exc:
-            raise DiscoveryError(f"consul {method} {path}: {exc}") from None
+        if status >= 400:
+            raise DiscoveryError(
+                f"consul {method} {path}: {status} {payload[:200]!r}"
+            )
         if not payload:
             return None
         try:

@@ -14,7 +14,8 @@ this package joins that supervisor half to the serving half
 - ``FleetGateway`` (gateway.py): discovers healthy replicas through a
   watches-style catalog poll and proxies the inference API over them
   with least-outstanding-requests routing, optional session/prefix
-  affinity, retry-on-a-different-replica, tail-latency hedging, and
+  affinity, retry-on-a-different-replica, tail-latency hedging,
+  per-replica keep-alive connection pooling (pool.py), and
   per-replica counters on ``/metrics``.
 
 Every later scale direction (autoscaling, multi-backend, spillover)
@@ -22,5 +23,13 @@ routes through this seam.
 """
 from .gateway import FleetGateway, Replica
 from .member import FleetMember
+from .pool import ConnectionPool, StaleConnection, UpstreamError
 
-__all__ = ["FleetGateway", "FleetMember", "Replica"]
+__all__ = [
+    "ConnectionPool",
+    "FleetGateway",
+    "FleetMember",
+    "Replica",
+    "StaleConnection",
+    "UpstreamError",
+]

@@ -24,10 +24,16 @@ supervisor Watch actors use) and proxies the inference API over them:
 - **Streaming**: SSE responses (``"stream": true``) relay chunk-by-
   chunk; retries apply only BEFORE the first upstream byte, never
   mid-stream.
+- **Connection pooling**: every buffered hop reuses a bounded LIFO
+  pool of keep-alive connections per replica (pool.py) instead of
+  dialing per request; pooled connections are evicted when a replica
+  leaves the healthy set or fails a request, a stale pooled
+  connection gets ONE transparent redial, and hedged/retried legs
+  always take distinct connections.
 - **Metrics**: per-replica counters (routed, retried, hedged,
-  drained_away) plus request/latency series in a private registry on
-  ``GET /metrics`` (utils/prom exposition), and a ``GET /fleet`` JSON
-  snapshot for runbooks.
+  drained_away, pool_hit/pool_miss/pool_evicted) plus request/latency
+  series in a private registry on ``GET /metrics`` (utils/prom
+  exposition), and a ``GET /fleet`` JSON snapshot for runbooks.
 
 The gateway holds no model state: it is restartable at will, N
 gateways can front one fleet, and every later scale PR (autoscaling,
@@ -45,9 +51,21 @@ from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, Iterable, List, Optional, Set, Tuple
 
 from ..discovery import Backend
-from ..utils.http import HTTPServer, Request, Response, StreamingResponse
+from ..utils.http import (
+    HTTPServer,
+    Request,
+    Response,
+    StreamingResponse,
+    timed_read,
+)
 from ..utils.prom import exposition
 from ..watches import poll_upstream
+from .pool import (
+    ConnectionPool,
+    PooledConnection,
+    StaleConnection,
+    UpstreamError,
+)
 
 log = logging.getLogger("containerpilot.fleet")
 
@@ -59,10 +77,10 @@ STICKY_CAPACITY = 4096
 PREFIX_TOKENS = 16  # ids of the prompt prefix hashed in "prefix" mode
 PREFIX_CHARS = 64   # chars of a text prompt hashed in "prefix" mode
 HEDGE_MIN_SAMPLES = 20
-
-
-class UpstreamError(RuntimeError):
-    """Transport-level failure talking to one replica."""
+# bound on a single upstream response body, Content-Length-declared or
+# accumulated on the read-to-EOF (close-delimited) path: a replica that
+# lies about its framing can't balloon the gateway's memory
+MAX_UPSTREAM_BODY = 64 * 1024 * 1024
 
 
 @dataclass
@@ -80,81 +98,147 @@ class Replica:
         return f"{self.address}:{self.port}"
 
 
-async def _open_and_send(
-    replica: Replica,
+async def _send_on(
+    conn: PooledConnection,
     method: str,
     path: str,
     body: bytes,
-    connect_timeout: float,
     read_timeout: float,
-) -> Tuple[asyncio.StreamReader, asyncio.StreamWriter, int, Dict[str, str]]:
-    """Connect, send one request, parse the status line + headers.
-    The caller owns the (reader, writer) pair afterwards.
+) -> Tuple[int, Dict[str, str]]:
+    """Send one request on an already-open connection and parse the
+    status line + headers. The caller keeps ownership of ``conn`` (and
+    decides pool release vs discard after the body).
 
-    ``connect_timeout`` bounds only the dial; the status line is
-    bounded by ``read_timeout`` — the replica's HTTP server writes it
-    after the handler finishes, so for a buffered generation it
-    arrives only once the whole decode is done (seconds to minutes)."""
-    try:
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(replica.address, replica.port),
-            connect_timeout,
-        )
-    except (OSError, asyncio.TimeoutError) as exc:
-        raise UpstreamError(f"connect {replica.authority}: {exc}") from None
+    The status line is bounded by ``read_timeout`` — the replica's
+    HTTP server writes it after the handler finishes, so for a
+    buffered generation it arrives only once the whole decode is done
+    (seconds to minutes). Failures on a REUSED connection before any
+    response byte raise StaleConnection: the server answered nothing,
+    so resending on a fresh dial cannot double-apply the request."""
+    reader, writer = conn.reader, conn.writer
     try:
         head = (
             f"{method} {path} HTTP/1.1\r\n"
-            f"Host: {replica.authority}\r\n"
+            f"Host: {conn.authority}\r\n"
             f"Content-Type: application/json\r\n"
             f"Content-Length: {len(body)}\r\n"
-            f"Connection: close\r\n\r\n"
+            f"Connection: keep-alive\r\n\r\n"
         )
         writer.write(head.encode() + body)
         await writer.drain()
-        status_line = await asyncio.wait_for(
-            reader.readline(), read_timeout
-        )
-        parts = status_line.decode("latin-1").split(None, 2)
-        if len(parts) < 2 or not parts[1].isdigit():
+        # ONE timed read for the whole response head: a wait_for per
+        # header line costs a Task + timer each, which is measurable
+        # on this hot path
+        try:
+            head_blob = await timed_read(
+                reader, reader.readuntil(b"\r\n\r\n"), read_timeout
+            )
+        except asyncio.IncompleteReadError as exc:
+            if not exc.partial:
+                # EOF before any response byte
+                if conn.reused:
+                    raise StaleConnection(
+                        f"{conn.authority}: pooled connection was "
+                        f"closed by the server"
+                    ) from None
+                raise UpstreamError(
+                    f"{conn.authority}: closed before the status line"
+                ) from None
+            # EOF inside the status line or header block: a replica
+            # that died after the status line is a FAILED request,
+            # never an empty-header success — surfacing it here is
+            # what arms the retry/hedge path
             raise UpstreamError(
-                f"{replica.authority}: malformed status line "
-                f"{status_line!r}"
+                f"{conn.authority}: EOF inside response headers "
+                f"({exc.partial[:80]!r})"
+            ) from None
+        except asyncio.LimitOverrunError:
+            raise UpstreamError(
+                f"{conn.authority}: response head too large"
+            ) from None
+        lines = head_blob.split(b"\r\n")
+        parts = lines[0].decode("latin-1").split(None, 2)
+        if (
+            len(parts) < 2
+            or not parts[1].isascii()
+            or not parts[1].isdigit()
+        ):
+            raise UpstreamError(
+                f"{conn.authority}: malformed status line "
+                f"{lines[0]!r}"
             )
         status = int(parts[1])
         headers: Dict[str, str] = {}
-        while True:
-            line = await asyncio.wait_for(reader.readline(), read_timeout)
-            if line in (b"\r\n", b"\n", b""):
-                break
+        for line in lines[1:]:
+            if not line:
+                continue
             key, _, value = line.decode("latin-1").partition(":")
             headers[key.strip().lower()] = value.strip()
-        return reader, writer, status, headers
-    except UpstreamError:
-        writer.close()
-        raise
+        return status, headers
+    except (ConnectionResetError, BrokenPipeError) as exc:
+        # a write that bounced off a dead pooled connection: the
+        # server reaped it while idle (its FIN can race our send)
+        if conn.reused:
+            raise StaleConnection(f"{conn.authority}: {exc}") from None
+        raise UpstreamError(f"{conn.authority}: {exc}") from None
     except (OSError, asyncio.TimeoutError, UnicodeDecodeError) as exc:
-        writer.close()
-        raise UpstreamError(f"{replica.authority}: {exc}") from None
-    except BaseException:  # CancelledError: close the socket on the way out
-        writer.close()
-        raise
+        raise UpstreamError(f"{conn.authority}: {exc}") from None
+
+
+def _parse_content_length(headers: Dict[str, str]) -> Optional[int]:
+    """Strict Content-Length: ASCII decimal digits only. ``int()`` and
+    ``str.isdigit()`` both accept Unicode digits ("١٢٣"), and the old
+    isdigit() gate silently fell back to read-to-EOF on garbage — a
+    malformed value now fails the request instead of mis-framing it."""
+    raw = headers.get("content-length")
+    if raw is None:
+        return None
+    if not raw.isascii() or not raw.isdigit():
+        raise UpstreamError(f"malformed Content-Length {raw!r}")
+    return int(raw)
 
 
 async def _read_body(
     reader: asyncio.StreamReader, headers: Dict[str, str], timeout: float
 ) -> bytes:
     """Read a buffered response body: Content-Length when present,
-    else until EOF (the servers here send Connection: close)."""
-    length = headers.get("content-length")
-    if length is not None and length.isdigit():
-        return await asyncio.wait_for(reader.readexactly(int(length)), timeout)
+    else until EOF (close-delimited). Both paths are capped at
+    MAX_UPSTREAM_BODY; every failure mode raises UpstreamError."""
+    length = _parse_content_length(headers)
+    if length is not None:
+        if length > MAX_UPSTREAM_BODY:
+            raise UpstreamError(f"Content-Length {length} exceeds cap")
+        try:
+            return await timed_read(
+                reader, reader.readexactly(length), timeout
+            )
+        except (
+            OSError, asyncio.TimeoutError, asyncio.IncompleteReadError,
+        ) as exc:
+            raise UpstreamError(f"body read failed: {exc}") from None
     chunks: List[bytes] = []
+    total = 0
     while True:
-        chunk = await asyncio.wait_for(reader.read(65536), timeout)
+        try:
+            chunk = await timed_read(reader, reader.read(65536), timeout)
+        except (OSError, asyncio.TimeoutError) as exc:
+            raise UpstreamError(f"body read failed: {exc}") from None
         if not chunk:
             return b"".join(chunks)
+        total += len(chunk)
+        if total > MAX_UPSTREAM_BODY:
+            raise UpstreamError("close-delimited body exceeds cap")
         chunks.append(chunk)
+
+
+def _reusable(headers: Dict[str, str]) -> bool:
+    """A connection goes back to the pool only when the response was
+    Content-Length-framed (so the body had a definite end) and the
+    server didn't announce ``Connection: close``."""
+    return (
+        "content-length" in headers
+        and "close" not in headers.get("connection", "").lower()
+    )
 
 
 class FleetGateway:
@@ -177,6 +261,9 @@ class FleetGateway:
         affinity: str = "session",
         connect_timeout: float = 5.0,
         request_timeout: float = 600.0,
+        pool_max_idle: int = 8,
+        pool_idle_ttl: float = 30.0,
+        pool_max_uses: int = 1000,
     ) -> None:
         if affinity not in AFFINITY_MODES:
             raise ValueError(f"affinity must be one of {AFFINITY_MODES}")
@@ -200,6 +287,12 @@ class FleetGateway:
         self.request_timeout = request_timeout
 
         self._replicas: Dict[str, Replica] = {}
+        self._pool = ConnectionPool(
+            max_idle=pool_max_idle,
+            idle_ttl=pool_idle_ttl,
+            max_uses=pool_max_uses,
+            on_event=self._pool_event,
+        )
         self._sticky: "OrderedDict[str, str]" = OrderedDict()
         # per-endpoint pools of recent 200-latencies (seconds): the
         # hedge threshold for generate must not be poisoned by
@@ -254,6 +347,22 @@ class FleetGateway:
             "replicas currently in the healthy routing set",
             registry=self._registry,
         )
+        self._m_pool_hits = Counter(
+            "containerpilot_gateway_pool_hit",
+            "proxied requests served over a reused pooled connection",
+            ["replica"], registry=self._registry,
+        )
+        self._m_pool_misses = Counter(
+            "containerpilot_gateway_pool_miss",
+            "proxied requests that had to dial a fresh connection",
+            ["replica"], registry=self._registry,
+        )
+        self._m_pool_evicted = Counter(
+            "containerpilot_gateway_pool_evicted",
+            "pooled connections dropped (replica left the healthy "
+            "set, failed a request, or the connection went stale)",
+            ["replica"], registry=self._registry,
+        )
 
         self._server = HTTPServer()
         self._server.route("GET", "/health", self._health)
@@ -289,7 +398,18 @@ class FleetGateway:
             except asyncio.CancelledError:
                 pass
             self._poll_task = None
+        self._pool.close_all()
         await self._server.stop()
+
+    def _pool_event(self, event: str, replica_id: str) -> None:
+        """Mirror pool bookkeeping into the prometheus registry."""
+        counter = {
+            "hit": self._m_pool_hits,
+            "miss": self._m_pool_misses,
+            "evicted": self._m_pool_evicted,
+        }.get(event)
+        if counter is not None:
+            counter.labels(replica_id).inc()
 
     @property
     def replica_count(self) -> int:
@@ -339,6 +459,11 @@ class FleetGateway:
             )
         self._replicas = fresh
         self._g_replicas.set(len(fresh))
+        # pooled connections to a replica that LEFT the healthy set
+        # (drained, deregistered, TTL-expired) are evicted, never
+        # reused: a draining replica would answer them 503, a dead one
+        # not at all
+        self._pool.prune(set(fresh))
 
     # -- routing --------------------------------------------------------
 
@@ -446,6 +571,11 @@ class FleetGateway:
             {
                 "service": self.service_name,
                 "poll_interval": self.poll_interval,
+                "pool": {
+                    "max_idle": self._pool.max_idle,
+                    "idle_ttl_s": self._pool.idle_ttl,
+                    "max_uses": self._pool.max_uses,
+                },
                 "replicas": [
                     {
                         "id": r.id,
@@ -455,6 +585,7 @@ class FleetGateway:
                         "age_s": round(
                             time.monotonic() - r.first_seen, 1
                         ),
+                        "pool": self._pool.stats(r.id),
                     }
                     for r in sorted(
                         self._replicas.values(), key=lambda r: r.id
@@ -522,6 +653,52 @@ class FleetGateway:
             headers={"Retry-After": "1"},
         )
 
+    def _evict_replica_pool(self, replica_id: str) -> None:
+        """A request to this replica just transport-failed: its other
+        pooled connections can't be trusted either."""
+        self._pool.evict(replica_id)
+
+    async def _upstream_request(
+        self,
+        replica: Replica,
+        method: str,
+        path: str,
+        body: bytes,
+    ) -> Tuple[PooledConnection, int, Dict[str, str]]:
+        """Acquire a connection (pooled or fresh), send one request,
+        parse the response head. A REUSED connection that turns out
+        stale (the server reaped it while idle) is discarded and the
+        acquire repeats; the loop is bounded because each stale conn
+        leaves the pool and a FRESH dial (reused=False) can never
+        raise StaleConnection. The caller owns ``conn`` and must
+        release/discard it after the body."""
+        while True:
+            try:
+                conn = await self._pool.acquire(
+                    replica, self.connect_timeout
+                )
+            except UpstreamError:
+                self._evict_replica_pool(replica.id)
+                raise
+            try:
+                status, headers = await _send_on(
+                    conn, method, path, body, self.request_timeout
+                )
+            except StaleConnection as exc:
+                self._pool.discard_stale(conn)
+                log.debug("gateway: redialing stale connection: %s", exc)
+                continue
+            except UpstreamError:
+                self._pool.discard(conn)
+                self._evict_replica_pool(replica.id)
+                raise
+            except BaseException:
+                # CancelledError (a losing hedge leg): close on the
+                # way out, never pool a connection mid-request
+                self._pool.discard(conn)
+                raise
+            return conn, status, headers
+
     async def _fetch_from(
         self,
         endpoint: str,
@@ -531,26 +708,33 @@ class FleetGateway:
         body: bytes,
     ) -> Tuple[int, Dict[str, str], bytes]:
         """One buffered round trip to one replica, with routing
-        accounting. Raises UpstreamError on transport failure."""
+        accounting. Raises UpstreamError on transport failure. The
+        connection returns to the pool only after the body was fully
+        read on an intact, length-framed exchange."""
         self._m_routed.labels(replica.id).inc()
         replica.outstanding += 1
         t0 = time.perf_counter()
         try:
-            reader, writer, status, headers = await _open_and_send(
-                replica, method, path, body,
-                self.connect_timeout, self.request_timeout,
+            conn, status, headers = await self._upstream_request(
+                replica, method, path, body
             )
             try:
                 payload = await _read_body(
-                    reader, headers, self.request_timeout
+                    conn.reader, headers, self.request_timeout
                 )
-            except (OSError, asyncio.TimeoutError,
-                    asyncio.IncompleteReadError) as exc:
-                raise UpstreamError(
-                    f"{replica.authority}: {exc}"
-                ) from None
-            finally:
-                writer.close()
+            except UpstreamError:
+                self._pool.discard(conn)
+                self._evict_replica_pool(replica.id)
+                raise
+            except BaseException:
+                # a cancelled leg may leave unread response bytes —
+                # that connection must never serve another request
+                self._pool.discard(conn)
+                raise
+            if _reusable(headers):
+                self._pool.release(conn)
+            else:
+                self._pool.discard(conn)
         finally:
             replica.outstanding -= 1
         if status == 200:
@@ -734,11 +918,8 @@ class FleetGateway:
             held = True
             try:
                 try:
-                    reader, writer, status, headers = (
-                        await _open_and_send(
-                            replica, "POST", path, body,
-                            self.connect_timeout, self.request_timeout,
-                        )
+                    conn, status, headers = await self._upstream_request(
+                        replica, "POST", path, body
                     )
                 except UpstreamError as exc:
                     log.warning(
@@ -757,10 +938,11 @@ class FleetGateway:
                     # buffered path
                     try:
                         payload = await _read_body(
-                            reader, headers, self.request_timeout
+                            conn.reader, headers, self.request_timeout
                         )
-                    except (OSError, asyncio.TimeoutError,
-                            asyncio.IncompleteReadError) as exc:
+                    except UpstreamError as exc:
+                        self._pool.discard(conn)
+                        self._evict_replica_pool(replica.id)
                         log.warning(
                             "gateway: %s body read failed: %s",
                             endpoint, exc,
@@ -770,8 +952,13 @@ class FleetGateway:
                             tried, {replica.id}, attempt, backoff
                         )
                         continue
-                    finally:
-                        writer.close()
+                    except BaseException:
+                        self._pool.discard(conn)
+                        raise
+                    if _reusable(headers):
+                        self._pool.release(conn)
+                    else:
+                        self._pool.discard(conn)
                     if (
                         status in RETRYABLE_STATUSES
                         and attempt < self.retries
@@ -783,7 +970,7 @@ class FleetGateway:
                         continue
                     return self._relay(status, headers, payload)
                 held = False  # ownership moves to the relay's close()
-                return self._relay_stream(replica, reader, writer, status)
+                return self._relay_stream(replica, conn, status)
             finally:
                 if held:
                     replica.outstanding -= 1
@@ -794,12 +981,13 @@ class FleetGateway:
     def _relay_stream(
         self,
         replica: Replica,
-        reader: asyncio.StreamReader,
-        writer: asyncio.StreamWriter,
+        conn: PooledConnection,
         status: int,
     ) -> StreamingResponse:
         """Relay an upstream SSE stream; the caller's outstanding
-        count transfers here and is released by close()."""
+        count transfers here and is released by close(). Streams are
+        close-delimited, so the connection never returns to the pool
+        — close() discards it."""
         closed = [False]
 
         def close() -> None:
@@ -809,13 +997,15 @@ class FleetGateway:
                 return
             closed[0] = True
             replica.outstanding -= 1
-            writer.close()
+            self._pool.discard(conn)
 
         async def chunks():
             try:
                 while True:
-                    chunk = await asyncio.wait_for(
-                        reader.read(65536), self.request_timeout
+                    chunk = await timed_read(
+                        conn.reader,
+                        conn.reader.read(65536),
+                        self.request_timeout,
                     )
                     if not chunk:
                         return
@@ -860,6 +1050,19 @@ def main() -> int:
         help="fixed hedge deadline; default learns the tail quantile",
     )
     parser.add_argument("--no-hedge", action="store_true")
+    parser.add_argument(
+        "--pool-max-idle", type=int, default=8,
+        help="idle keep-alive connections kept per replica "
+        "(0 disables reuse: every request dials)",
+    )
+    parser.add_argument(
+        "--pool-idle-ttl", type=float, default=30.0,
+        help="seconds an idle pooled connection stays reusable",
+    )
+    parser.add_argument(
+        "--no-pool", action="store_true",
+        help="shorthand for --pool-max-idle 0",
+    )
     args = parser.parse_args()
 
     logging_mod.basicConfig(
@@ -873,6 +1076,8 @@ def main() -> int:
         tag=args.tag, poll_interval=args.poll_interval,
         retries=args.retries, affinity=args.affinity,
         hedge=not args.no_hedge, hedge_after_ms=args.hedge_after_ms,
+        pool_max_idle=0 if args.no_pool else args.pool_max_idle,
+        pool_idle_ttl=args.pool_idle_ttl,
     )
 
     async def serve() -> None:

@@ -11,6 +11,9 @@ watched, or drained. A FleetMember closes that gap for an in-process
   Heartbeats fire only while the replica is genuinely serveable
   (``server.ready`` and not draining), so a wedged or warming replica
   goes catalog-critical by TTL expiry exactly like a wedged job.
+  Because catalog ops drain through the discovery FIFO's long-lived
+  thread, an HTTP backend (consul) serves every TTL refresh over ONE
+  persistent keep-alive connection instead of dialing each beat.
 - **Drain.** ``drain()`` flips the server into maintenance (health
   503, new generate/completions rejected with 503 + Retry-After),
   deregisters the catalog record so gateways route away within one

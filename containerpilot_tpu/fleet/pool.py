@@ -1,0 +1,220 @@
+"""Per-replica keep-alive connection pool for the fleet gateway.
+
+PR 3's ``gateway_overhead_bench`` put the gateway's added latency at
++6.2 ms median per request, and nearly all of it was connection churn:
+every proxied request dialed a fresh TCP connection and tore it down
+(dial + slow-start + TIME_WAIT on every hop). With ``utils/http.py``
+serving HTTP/1.1 keep-alive, the gateway can instead hold a small
+stack of warm connections per replica and reuse them:
+
+- **LIFO reuse.** Idle connections are a per-replica stack; the most
+  recently used connection is handed out first, so under light load
+  one connection stays hot (warm TCP window, warm kernel path) while
+  the rest age out.
+- **Bounded.** At most ``max_idle`` idle connections per replica;
+  each connection is retired after ``max_uses`` requests; idle
+  connections older than ``idle_ttl`` are dropped at the next acquire
+  rather than reused (the server's own idle reaper has a similar
+  clock, and racing it is what the stale-redial path is for).
+- **Health-aware.** The gateway evicts a replica's idle connections
+  when the replica leaves the healthy set (drain/deregister/TTL
+  expiry) and when any request to it raises ``UpstreamError`` — a
+  replica that just failed one request cannot be trusted to honor the
+  others' pooled connections either.
+- **Stale detection.** A pooled connection can die between uses
+  (server idle reap, replica restart). When a REUSED connection fails
+  before yielding a single response byte, ``StaleConnection`` tells
+  the caller a transparent redial is safe: the server cannot have
+  processed a request it never answered a byte of, and generation
+  requests are idempotent under a fixed seed besides.
+
+``max_idle=0`` disables reuse entirely: every acquire dials and every
+release closes — the per-dial baseline ``gateway_overhead_bench``
+measures against.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = [
+    "ConnectionPool",
+    "PooledConnection",
+    "StaleConnection",
+    "UpstreamError",
+]
+
+
+class UpstreamError(RuntimeError):
+    """Transport-level failure talking to one replica."""
+
+
+class StaleConnection(UpstreamError):
+    """A pooled connection died between uses (server idle reap,
+    replica restart): raised only for REUSED connections that failed
+    before any response byte arrived, so one transparent redial is
+    always safe."""
+
+
+class PooledConnection:
+    """One upstream connection plus the bookkeeping reuse needs."""
+
+    __slots__ = (
+        "reader", "writer", "replica_id", "authority",
+        "reused", "uses", "idle_since",
+    )
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        replica_id: str,
+        authority: str,
+    ) -> None:
+        self.reader = reader
+        self.writer = writer
+        self.replica_id = replica_id
+        self.authority = authority
+        self.reused = False  # True when handed out from the idle pool
+        self.uses = 0
+        self.idle_since = 0.0
+
+    def close(self) -> None:
+        self.writer.close()
+
+
+# pool events the gateway mirrors into its prometheus counters
+POOL_HIT = "hit"
+POOL_MISS = "miss"
+POOL_EVICTED = "evicted"
+
+
+class ConnectionPool:
+    """Bounded LIFO pool of idle keep-alive connections per replica."""
+
+    def __init__(
+        self,
+        max_idle: int = 8,
+        idle_ttl: float = 30.0,
+        max_uses: int = 1000,
+        on_event: Optional[Callable[[str, str], None]] = None,
+    ) -> None:
+        self.max_idle = max_idle
+        self.idle_ttl = idle_ttl
+        self.max_uses = max_uses
+        self._on_event = on_event
+        self._idle: Dict[str, List[PooledConnection]] = {}
+        # plain counters for the /fleet JSON snapshot; the gateway's
+        # prometheus counters are fed through on_event
+        self.hits: Dict[str, int] = {}
+        self.misses: Dict[str, int] = {}
+        self.evicted: Dict[str, int] = {}
+
+    def _event(self, table: Dict[str, int], event: str, rid: str) -> None:
+        table[rid] = table.get(rid, 0) + 1
+        if self._on_event is not None:
+            self._on_event(event, rid)
+
+    async def acquire(
+        self, replica, connect_timeout: float
+    ) -> PooledConnection:
+        """Pop the freshest usable idle connection to ``replica``, or
+        dial a new one. Raises UpstreamError when the dial fails.
+        Concurrent acquires (retry legs, hedge legs) can never share a
+        connection: an idle connection is handed to exactly one caller
+        by the pop, and a dial is private to its caller."""
+        stack = self._idle.get(replica.id)
+        now = time.monotonic()
+        while stack:
+            conn = stack.pop()
+            if (
+                conn.writer.is_closing()
+                or conn.reader.at_eof()
+                or now - conn.idle_since > self.idle_ttl
+            ):
+                # already dead (server FIN arrived while idle) or aged
+                # out: drop it rather than hand out a known-bad socket
+                self._event(self.evicted, POOL_EVICTED, replica.id)
+                conn.close()
+                continue
+            conn.reused = True
+            self._event(self.hits, POOL_HIT, replica.id)
+            return conn
+        self._event(self.misses, POOL_MISS, replica.id)
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(replica.address, replica.port),
+                connect_timeout,
+            )
+        except (OSError, asyncio.TimeoutError) as exc:
+            raise UpstreamError(
+                f"connect {replica.authority}: {exc}"
+            ) from None
+        return PooledConnection(reader, writer, replica.id, replica.authority)
+
+    def release(self, conn: PooledConnection) -> None:
+        """Return a connection whose response was FULLY read (and was
+        Content-Length-framed with no ``Connection: close``) for
+        reuse; retires it instead when the pool is full, reuse is
+        disabled, or the connection hit its use cap."""
+        conn.uses += 1
+        stack = self._idle.setdefault(conn.replica_id, [])
+        if (
+            self.max_idle <= 0
+            or len(stack) >= self.max_idle
+            or conn.uses >= self.max_uses
+            or conn.writer.is_closing()
+        ):
+            conn.close()
+            return
+        conn.reused = False
+        conn.idle_since = time.monotonic()
+        stack.append(conn)
+
+    def discard(self, conn: PooledConnection) -> None:
+        """Close a connection that must never be reused: transport
+        failure, streamed (close-delimited) response, or a cancelled
+        hedge/retry leg that may have left unread response bytes."""
+        conn.close()
+
+    def discard_stale(self, conn: PooledConnection) -> None:
+        """Close a reused connection that died between uses; counted
+        as an eviction (the reuse attempt was voided)."""
+        self._event(self.evicted, POOL_EVICTED, conn.replica_id)
+        conn.close()
+
+    def evict(self, replica_id: str) -> int:
+        """Drop every idle connection to one replica (it drained,
+        deregistered, or just failed a request)."""
+        stack = self._idle.pop(replica_id, [])
+        for conn in stack:
+            self._event(self.evicted, POOL_EVICTED, replica_id)
+            conn.close()
+        return len(stack)
+
+    def prune(self, keep_ids) -> int:
+        """Evict pools for replicas no longer in the healthy set."""
+        return sum(
+            self.evict(rid)
+            for rid in list(self._idle)
+            if rid not in keep_ids
+        )
+
+    def close_all(self) -> None:
+        """Shutdown: close everything idle (not counted as eviction)."""
+        for rid in list(self._idle):
+            for conn in self._idle.pop(rid):
+                conn.close()
+
+    def idle_count(self, replica_id: str) -> int:
+        return len(self._idle.get(replica_id, ()))
+
+    def stats(self, replica_id: str) -> Dict[str, int]:
+        """Per-replica snapshot for the /fleet JSON."""
+        return {
+            "idle": self.idle_count(replica_id),
+            "hits": self.hits.get(replica_id, 0),
+            "misses": self.misses.get(replica_id, 0),
+            "evicted": self.evicted.get(replica_id, 0),
+        }

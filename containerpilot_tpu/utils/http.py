@@ -1,22 +1,69 @@
-"""Minimal asyncio HTTP/1.1 server.
+"""Minimal asyncio HTTP/1.1 server with keep-alive.
 
-Shared plumbing for the two in-process servers the supervisor runs —
-the telemetry endpoint on TCP (reference: telemetry/telemetry.go) and
-the control plane on a unix domain socket (reference: control/control.go).
-Requests are tiny and local, so this deliberately supports only what
-those servers need: one request per connection, optional content-length
-bodies, no keep-alive, no chunked encoding.
+Shared plumbing for every in-process server in the tree — the
+telemetry endpoint on TCP (reference: telemetry/telemetry.go), the
+control plane on a unix domain socket (reference: control/control.go),
+the inference servers, the fleet gateway, and the catalog emulator.
+
+Connection contract:
+
+- **Buffered responses are Content-Length-framed and the connection
+  stays open** (HTTP/1.1 keep-alive): sequential requests on one
+  connection skip the dial + teardown tax, which is what the fleet
+  gateway's replica pool, the ControlClient, and the catalog
+  heartbeat/poll clients rely on. A client sends ``Connection:
+  close`` (or speaks HTTP/1.0 without ``keep-alive``) to get the old
+  one-shot behavior. Idle connections are reaped after
+  ``KEEPALIVE_IDLE_TIMEOUT`` and capped at ``KEEPALIVE_MAX_REQUESTS``
+  requests; protocol-level errors (400/408) always close, since the
+  connection's framing can no longer be trusted.
+- **StreamingResponse keeps its close-delimited contract**: sent with
+  ``Connection: close`` and no Content-Length, the closing connection
+  ends the stream.
+- No chunked encoding; bodies need Content-Length.
 """
 from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Awaitable, Callable, Dict, Optional, Tuple
+from typing import Awaitable, Callable, Dict, Optional, Set, Tuple
 from urllib.parse import parse_qs, urlsplit
 
 log = logging.getLogger("containerpilot.http")
 
 MAX_BODY = 4 * 1024 * 1024
+
+
+async def timed_read(reader: asyncio.StreamReader, coro, timeout: float):
+    """Await one read (or a multi-read coroutine) on ``reader`` under
+    a deadline WITHOUT ``asyncio.wait_for``: wait_for creates a Task
+    plus a timer per call (~100us on a busy host), which at one-per-
+    header-line dominates a proxied request's hot path. A plain timer
+    handle costs ~1us; on expiry it poisons the reader with
+    ``asyncio.TimeoutError``, which the pending await raises.
+
+    A reader poisoned by a TRUE timeout stays failed — correct here,
+    because every caller abandons the connection after a read
+    timeout. But the timer can also fire in the same event-loop tick
+    in which the read completed (data callback and due timer both run
+    before the awaiting task resumes and cancels the handle); in that
+    race the read returns normally while the poison would fail the
+    connection's NEXT read — so after a successful await, this call's
+    own sentinel exception is cleared."""
+    exc = asyncio.TimeoutError()
+    handle = asyncio.get_event_loop().call_later(
+        timeout, reader.set_exception, exc
+    )
+    try:
+        result = await coro
+    finally:
+        handle.cancel()
+        if reader.exception() is exc:
+            # the timer fired after the read already completed: the
+            # connection is healthy, un-poison it (on the raise path
+            # this is dead state either way — the conn is abandoned)
+            reader._exception = None  # noqa: SLF001
+    return result
 
 
 class Request:
@@ -27,12 +74,26 @@ class Request:
         query: Dict[str, list],
         headers: Dict[str, str],
         body: bytes,
+        version: str = "HTTP/1.1",
     ) -> None:
         self.method = method
         self.path = path
         self.query = query
         self.headers = headers
         self.body = body
+        self.version = version
+
+    def wants_keepalive(self) -> bool:
+        """The client side of the connection-reuse handshake:
+        HTTP/1.1 defaults to keep-alive unless the request says
+        ``Connection: close``; HTTP/1.0 defaults to close unless it
+        says ``Connection: keep-alive``."""
+        connection = self.headers.get("connection", "").lower()
+        if "close" in connection:
+            return False
+        if self.version.upper().startswith("HTTP/1.0"):
+            return "keep-alive" in connection
+        return True
 
 
 class Response:
@@ -54,8 +115,8 @@ class StreamingResponse:
     iterator of byte chunks (SSE events, chunk-boundary token
     deltas). Sent with ``Connection: close`` and no Content-Length:
     the closing connection delimits the stream, which every HTTP/1.1
-    client understands and which keeps this server's one-request-per-
-    connection model intact.
+    client understands. A stream therefore always ENDS its connection
+    — streaming responses opt out of the server's keep-alive.
 
     Client disconnects are detected promptly (the reader hits EOF)
     and the iterator is ``aclose()``d, so a handler generator's
@@ -109,6 +170,15 @@ class HTTPServer:
             Callable[[Request], Awaitable[Optional[Response]]]
         ] = None
         self._server: Optional[asyncio.AbstractServer] = None
+        # live connection writers, so stop() can force-close lingering
+        # keep-alive connections instead of leaving their handler
+        # coroutines parked on a readline forever
+        self._conns: Set[asyncio.StreamWriter] = set()
+        # observability (and the keep-alive test suite's ground truth):
+        # how many connections were accepted vs requests served — a
+        # reuse ratio of requests/connections >> 1 means pooling works
+        self.connections_accepted = 0
+        self.requests_served = 0
 
     def route(self, method: str, path: str, handler: Handler) -> None:
         self.routes[(method.upper(), path)] = handler
@@ -129,48 +199,150 @@ class HTTPServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
+            # force-close lingering keep-alive connections BEFORE
+            # awaiting wait_closed(): on Python >= 3.12.1 wait_closed
+            # blocks until every connection handler finishes, and an
+            # idle handler is parked on its next-request read for up
+            # to KEEPALIVE_IDLE_TIMEOUT
+            for conn_writer in list(self._conns):
+                conn_writer.close()
             await self._server.wait_closed()
             self._server = None
+        else:
+            for conn_writer in list(self._conns):
+                conn_writer.close()
+        # yield once so the force-closed handlers observe EOF and exit
+        await asyncio.sleep(0)
 
     # bound on reading one request (headers+body): a stalled client
     # can't pin a connection open indefinitely. Handler execution is
     # deliberately unbounded (inference warmup can be slow).
     REQUEST_READ_TIMEOUT = 30.0
+    # how long a keep-alive connection may sit idle between requests
+    # before the server reaps it, and how many requests one connection
+    # may carry before being retired (bounds fd/state lifetime under
+    # misbehaving clients)
+    KEEPALIVE_IDLE_TIMEOUT = 75.0
+    KEEPALIVE_MAX_REQUESTS = 1000
 
     async def _handle(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
-        # the narrow client-error excepts cover only the READ phase;
-        # a handler raising TimeoutError must surface as a logged 500,
-        # not be misblamed on the client as a 408
+        self.connections_accepted += 1
+        self._conns.add(writer)
         try:
-            request = await asyncio.wait_for(
-                self._read_request(reader), timeout=self.REQUEST_READ_TIMEOUT
+            await self._serve_connection(reader, writer)
+        finally:
+            self._conns.discard(writer)
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _serve_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        """The keep-alive loop: requests are served off one connection
+        until the client closes, asks to close, idles out, hits the
+        per-connection request cap, or trips a protocol error."""
+        served = 0
+        while True:
+            # the FIRST request on a fresh connection is bounded by the
+            # read timeout (a stalled half-request earns a 408, see the
+            # slow-loris path below); BETWEEN requests the bound is the
+            # idle timeout and expiry is a quiet reap, not an error —
+            # an idle pooled client did nothing wrong
+            try:
+                request_line = await timed_read(
+                    reader,
+                    reader.readline(),
+                    self.REQUEST_READ_TIMEOUT
+                    if served == 0
+                    else self.KEEPALIVE_IDLE_TIMEOUT,
+                )
+            except asyncio.TimeoutError:
+                if served == 0:
+                    await self._write_response(
+                        writer, Response(408, b"request timeout\n"),
+                        close=True,
+                    )
+                return
+            except (ConnectionError, asyncio.IncompleteReadError):
+                return
+            except Exception:
+                # e.g. ValueError from a request line overrunning the
+                # StreamReader limit: a client error must still get an
+                # answer, never an unhandled task exception
+                log.exception("request line read failed")
+                await self._write_response(
+                    writer,
+                    Response(400, b"malformed request line\n"),
+                    close=True,
+                )
+                return
+            if not request_line:
+                return  # client closed the connection cleanly
+            # the narrow client-error excepts cover only the READ
+            # phase; a handler raising TimeoutError must surface as a
+            # logged 500, not be misblamed on the client as a 408
+            try:
+                request = await timed_read(
+                    reader,
+                    self._read_request(reader, request_line),
+                    self.REQUEST_READ_TIMEOUT,
+                )
+            except asyncio.TimeoutError:
+                request = Response(408, b"request timeout\n")
+            except asyncio.IncompleteReadError:
+                request = Response(400, b"truncated request\n")
+            except ConnectionError:
+                return
+            except Exception:
+                log.exception("request read failed")
+                request = Response(500, b"internal server error\n")
+            if isinstance(request, Response):
+                # protocol-level failure: request framing can no
+                # longer be trusted, so answer and close
+                await self._write_response(writer, request, close=True)
+                return
+            served += 1
+            self.requests_served += 1
+            keep = (
+                request.wants_keepalive()
+                and served < self.KEEPALIVE_MAX_REQUESTS
             )
-        except asyncio.TimeoutError:
-            request = Response(408, b"request timeout\n")
-        except asyncio.IncompleteReadError:
-            request = Response(400, b"truncated request\n")
-        except Exception:
-            log.exception("request read failed")
-            request = Response(500, b"internal server error\n")
-        if isinstance(request, Response):
-            response = request
-        else:
             try:
                 response = await self._dispatch(request)
             except Exception:
                 log.exception("request handling failed")
                 response = Response(500, b"internal server error\n")
-        if isinstance(response, StreamingResponse):
-            await self._write_stream(reader, writer, response)
-            return
+            if isinstance(response, StreamingResponse):
+                # close-delimited by contract; ends the connection
+                await self._write_stream(reader, writer, response)
+                return
+            if not await self._write_response(
+                writer, response, close=not keep
+            ):
+                return  # client went away mid-write
+            if not keep:
+                return
+
+    async def _write_response(
+        self,
+        writer: asyncio.StreamWriter,
+        response: Response,
+        *,
+        close: bool,
+    ) -> bool:
+        """Send one Content-Length-framed response. Returns False when
+        the client is gone (the connection is unusable either way)."""
         try:
             reason = _REASONS.get(response.status, "Unknown")
             headers = {
                 "Content-Type": response.content_type,
                 "Content-Length": str(len(response.body)),
-                "Connection": "close",
+                "Connection": "close" if close else "keep-alive",
                 **response.headers,
             }
             head = f"HTTP/1.1 {response.status} {reason}\r\n" + "".join(
@@ -178,14 +350,9 @@ class HTTPServer:
             )
             writer.write(head.encode() + b"\r\n" + response.body)
             await writer.drain()
+            return True
         except (ConnectionError, BrokenPipeError):
-            pass
-        finally:
-            try:
-                writer.close()
-                await writer.wait_closed()
-            except Exception:
-                pass
+            return False
 
     async def _write_stream(
         self,
@@ -264,14 +431,13 @@ class HTTPServer:
             except Exception:
                 pass
 
-    async def _read_request(self, reader: asyncio.StreamReader):
-        """Parse one request; returns a Request, or a Response for
-        protocol-level errors."""
-        request_line = await reader.readline()
-        if not request_line:
-            return Response(400, b"empty request\n")
+    async def _read_request(
+        self, reader: asyncio.StreamReader, request_line: bytes
+    ):
+        """Parse one request whose request line was already read;
+        returns a Request, or a Response for protocol-level errors."""
         try:
-            method, target, _version = request_line.decode().split(None, 2)
+            method, target, version = request_line.decode().split(None, 2)
         except (ValueError, UnicodeDecodeError):
             return Response(400, b"malformed request line\n")
         headers: Dict[str, str] = {}
@@ -296,7 +462,8 @@ class HTTPServer:
         body = await reader.readexactly(length) if length else b""
         parts = urlsplit(target)
         return Request(
-            method.upper(), parts.path, parse_qs(parts.query), headers, body
+            method.upper(), parts.path, parse_qs(parts.query), headers,
+            body, version=version.strip(),
         )
 
     async def _dispatch(self, request: Request) -> Response:

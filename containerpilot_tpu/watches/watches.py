@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 from ..config.decode import coerce_number
@@ -38,6 +39,20 @@ class WatchConfigError(ValueError):
     pass
 
 
+# catalog polls run on a SMALL dedicated pool, not the default
+# executor: HTTP backends keep one persistent agent connection per
+# thread (discovery/consul.py), so concentrating every poll onto a
+# few long-lived threads means the poll reuses a warm connection each
+# interval instead of spreading dials across whatever transient
+# default-executor thread happens to be free. Eight workers bounds
+# head-of-line blocking when a backend call blackholes for its full
+# timeout (every watch actor AND every gateway in the process shares
+# this pool) while still keeping the per-thread connections warm.
+_POLL_EXECUTOR = ThreadPoolExecutor(
+    max_workers=8, thread_name_prefix="catalog-poll"
+)
+
+
 async def poll_upstream(
     backend: Backend, service_name: str, tag: str = "", dc: str = ""
 ) -> tuple:
@@ -47,10 +62,11 @@ async def poll_upstream(
     actor's timers). Returns the backend's (did_change, is_healthy).
 
     Shared by the supervisor's Watch actors and the fleet gateway's
-    replica-discovery loop so both sides poll with one discipline.
+    replica-discovery loop so both sides poll with one discipline —
+    and with one persistent catalog connection per poll thread.
     """
     return await asyncio.get_event_loop().run_in_executor(
-        None,
+        _POLL_EXECUTOR,
         lambda: backend.check_for_upstream_changes(service_name, tag, dc),
     )
 

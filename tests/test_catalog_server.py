@@ -256,3 +256,43 @@ def test_catalog_metrics_endpoint(run):
     assert 'cp_catalog_services{status="passing"} 1' in body
     assert 'cp_catalog_services{status="critical"} 0' in body
     assert "cp_catalog_snapshot_enabled 0" in body
+
+
+def test_backend_reuses_catalog_connection_per_thread(run):
+    """TTL heartbeats and health polls from one thread ride ONE
+    persistent keep-alive connection to the agent — the dial-per-call
+    pattern is what made every heartbeat interval pay a connect."""
+
+    async def scenario():
+        server = CatalogServer("127.0.0.1", PORT)
+        await server.run()
+        backend = ConsulBackend(address=f"127.0.0.1:{PORT}")
+        loop = asyncio.get_event_loop()
+
+        def fn():
+            backend.service_register(
+                ServiceRegistration(
+                    id="ka-1", name="ka", port=4000,
+                    address="10.0.0.1", ttl=10,
+                ),
+                status="passing",
+            )
+            for _ in range(5):
+                backend.update_ttl("service:ka-1", "ok", "pass")
+                backend.check_for_upstream_changes("ka")
+            backend.service_deregister("ka-1")
+
+        try:
+            # one worker thread => one kept backend connection
+            await loop.run_in_executor(None, fn)
+            http_server = server._server  # noqa: SLF001
+            return (
+                http_server.connections_accepted,
+                http_server.requests_served,
+            )
+        finally:
+            await server.stop()
+
+    conns, reqs = run(scenario(), timeout=30)
+    assert reqs == 12  # register + 5*(ttl+poll) + deregister
+    assert conns == 1  # ... over a single dial

@@ -221,3 +221,59 @@ def test_client_retries_connect_while_supervisor_boots(run, socket_path):
         return result
 
     assert run(scenario(), timeout=30) is True
+
+
+def test_client_reuses_control_connection_across_verbs(run, socket_path):
+    """The client keeps ONE unix-socket connection across verbs (the
+    control server speaks keep-alive): an SDK posting a metric every
+    training step must not dial per call."""
+
+    async def scenario():
+        bus = EventBus()
+        server = ControlServer(ControlConfig({"socket": socket_path}))
+        await server.run(bus)
+
+        def verbs(c):
+            c.get_ping()
+            c.put_metric({"zz_keepalive_probe": 1})
+            c.get_maintenance_status()
+            c.get_events()
+            return True
+
+        with ControlClient(socket_path) as client:
+            result = await asyncio.get_event_loop().run_in_executor(
+                None, verbs, client
+            )
+        http_server = server._server  # noqa: SLF001
+        counters = (
+            http_server.connections_accepted,
+            http_server.requests_served,
+        )
+        await server.stop()
+        return result, counters
+
+    result, (conns, reqs) = run(scenario(), timeout=30)
+    assert result is True
+    assert conns == 1 and reqs == 4  # four verbs, one dial
+
+
+def test_client_redials_after_server_restart(run, socket_path):
+    """A kept connection from a previous server generation is stale;
+    the next verb must transparently redial, not error out."""
+
+    async def scenario():
+        bus = EventBus()
+        loop = asyncio.get_event_loop()
+        server = ControlServer(ControlConfig({"socket": socket_path}))
+        await server.run(bus)
+        client = ControlClient(socket_path)
+        first = await loop.run_in_executor(None, client.get_ping)
+        await server.stop()  # kept client connection force-closed
+        server2 = ControlServer(ControlConfig({"socket": socket_path}))
+        await server2.run(EventBus())
+        second = await loop.run_in_executor(None, client.get_ping)
+        client.close()
+        await server2.stop()
+        return first, second
+
+    assert run(scenario(), timeout=30) == (True, True)

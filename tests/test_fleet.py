@@ -1,6 +1,7 @@
-"""Fleet subsystem tests: routing units, drain hook, catalog
-robustness, control-plane drain, and the two-replica gateway
-integration scenario (drain mid-traffic, zero client-visible 5xx).
+"""Fleet subsystem tests: routing units, connection-pool behavior,
+drain hook, catalog robustness, control-plane drain, and the
+two-replica gateway integration scenario (drain mid-traffic, zero
+client-visible 5xx).
 
 The gateway unit tests run against stub HTTP servers (no JAX); the
 integration test boots two real tiny InferenceServers behind a
@@ -11,6 +12,8 @@ import json
 import time
 import urllib.error
 import urllib.request
+
+import pytest
 
 from containerpilot_tpu.discovery import (
     FileCatalogBackend,
@@ -282,6 +285,331 @@ def test_gateway_hedges_slow_replica_and_takes_the_fast_result(
     assert status == 200 and json.loads(text)["who"] == "fast"
     assert elapsed < 0.8, f"hedge did not preempt the slow replica: {elapsed}"
     assert hedged == 1 and routed_fast == 1
+
+
+# -- gateway connection pool (stub replicas, no JAX) --------------------
+
+
+def test_gateway_pool_reuses_connections_across_requests(run, tmp_path):
+    """Sequential buffered requests ride ONE upstream connection: the
+    replica accepts a single connection, the pool counts one miss and
+    the rest hits, and /fleet + /metrics expose the counters."""
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        replica = HTTPServer()
+
+        async def handler(_req):
+            return Response(
+                200, json.dumps({"tokens": [[7]]}).encode(),
+                content_type="application/json",
+            )
+
+        replica.route("POST", "/v1/generate", handler)
+        await replica.start_tcp("127.0.0.1", 0)
+        _register(backend, "aaa", replica.bound_port)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0, poll_interval=5.0,
+            hedge=False,
+        )
+        await gw.run()
+        loop = asyncio.get_event_loop()
+        for _ in range(4):
+            status, _text, _ = await loop.run_in_executor(
+                None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+            )
+            assert status == 200
+        fleet_view = await loop.run_in_executor(
+            None, _get, gw.port, "/fleet"
+        )
+        metrics = await loop.run_in_executor(
+            None, _get, gw.port, "/metrics"
+        )
+        stats = gw._pool.stats("aaa")  # noqa: SLF001
+        accepted = replica.connections_accepted
+        served = replica.requests_served
+        await gw.stop()
+        await replica.stop()
+        return stats, accepted, served, fleet_view, metrics
+
+    stats, accepted, served, fleet_view, metrics = run(
+        scenario(), timeout=60
+    )
+    assert accepted == 1 and served == 4  # one dial, four requests
+    assert stats["misses"] == 1 and stats["hits"] == 3
+    assert stats["idle"] == 1  # the warm connection went back
+    pool_view = {
+        r["id"]: r["pool"]
+        for r in json.loads(fleet_view[1])["replicas"]
+    }
+    assert pool_view["aaa"]["hits"] == 3
+    assert (
+        'containerpilot_gateway_pool_hit_total{replica="aaa"} 3.0'
+        in metrics[1]
+    )
+    assert (
+        'containerpilot_gateway_pool_miss_total{replica="aaa"} 1.0'
+        in metrics[1]
+    )
+
+
+def test_gateway_pool_evicts_on_deregister(run, tmp_path):
+    """Pooled connections to a replica that left the healthy set
+    (drain deregisters it) are evicted at the next poll, never
+    reused."""
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        replica = HTTPServer()
+
+        async def handler(_req):
+            return Response(200, b"{}", content_type="application/json")
+
+        replica.route("POST", "/v1/generate", handler)
+        await replica.start_tcp("127.0.0.1", 0)
+        _register(backend, "aaa", replica.bound_port)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0, poll_interval=0.1,
+            hedge=False,
+        )
+        await gw.run()
+        loop = asyncio.get_event_loop()
+        status, _, _ = await loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+        )
+        assert status == 200
+        assert gw._pool.idle_count("aaa") == 1  # noqa: SLF001
+        backend.service_deregister("aaa")
+        for _ in range(100):
+            if gw.replica_count == 0:
+                break
+            await asyncio.sleep(0.05)
+        idle = gw._pool.idle_count("aaa")  # noqa: SLF001
+        evicted = gw._pool.evicted.get("aaa", 0)  # noqa: SLF001
+        await gw.stop()
+        await replica.stop()
+        return idle, evicted
+
+    idle, evicted = run(scenario(), timeout=60)
+    assert idle == 0 and evicted == 1
+
+
+def test_gateway_pool_redials_stale_connection_transparently(
+    run, tmp_path
+):
+    """A pooled connection the replica reaped while idle is detected
+    and redialed without the client seeing a failure."""
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        replica = HTTPServer()
+        replica.KEEPALIVE_IDLE_TIMEOUT = 0.15
+
+        async def handler(_req):
+            return Response(200, b"{}", content_type="application/json")
+
+        replica.route("POST", "/v1/generate", handler)
+        await replica.start_tcp("127.0.0.1", 0)
+        _register(backend, "aaa", replica.bound_port)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0, poll_interval=5.0,
+            hedge=False,
+        )
+        await gw.run()
+        loop = asyncio.get_event_loop()
+        first, _, _ = await loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+        )
+        await asyncio.sleep(0.4)  # let the replica reap the idle conn
+        second, _, _ = await loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+        )
+        stats = gw._pool.stats("aaa")  # noqa: SLF001
+        retried = _counter(gw._m_retried, "aaa")  # noqa: SLF001
+        await gw.stop()
+        await replica.stop()
+        return first, second, stats, retried
+
+    first, second, stats, retried = run(scenario(), timeout=60)
+    assert first == 200 and second == 200
+    # the reap voided the pooled connection: two dials total, the
+    # stale one evicted, and NO routing-level retry was consumed
+    assert stats["misses"] == 2 and stats["hits"] == 0
+    assert stats["evicted"] >= 1
+    assert retried == 0
+
+
+def test_hedge_legs_take_distinct_connections(run, tmp_path):
+    """The losing hedge leg's connection is discarded (it may carry a
+    half-written response), never pooled; the winner's goes back."""
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        slow, fast = HTTPServer(), HTTPServer()
+
+        async def handler_slow(_req):
+            await asyncio.sleep(1.0)
+            return Response(200, b'{"who": "slow"}',
+                            content_type="application/json")
+
+        async def handler_fast(_req):
+            return Response(200, b'{"who": "fast"}',
+                            content_type="application/json")
+
+        slow.route("POST", "/v1/generate", handler_slow)
+        fast.route("POST", "/v1/generate", handler_fast)
+        await slow.start_tcp("127.0.0.1", 0)
+        await fast.start_tcp("127.0.0.1", 0)
+        _register(backend, "aaa", slow.bound_port)  # tie -> slow first
+        _register(backend, "bbb", fast.bound_port)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0,
+            poll_interval=5.0, retries=0, hedge_after_ms=80.0,
+        )
+        await gw.run()
+        status, text, _ = await asyncio.get_event_loop().run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+        )
+        idle_slow = gw._pool.idle_count("aaa")  # noqa: SLF001
+        idle_fast = gw._pool.idle_count("bbb")  # noqa: SLF001
+        dials = (slow.connections_accepted, fast.connections_accepted)
+        await gw.stop()
+        await slow.stop()
+        await fast.stop()
+        return status, text, idle_slow, idle_fast, dials
+
+    status, text, idle_slow, idle_fast, dials = run(
+        scenario(), timeout=60
+    )
+    assert status == 200 and json.loads(text)["who"] == "fast"
+    assert dials == (1, 1)  # one private connection per leg
+    assert idle_slow == 0  # cancelled leg: discarded, not pooled
+    assert idle_fast == 1  # winning leg: released for reuse
+
+
+# -- satellite bugfixes: upstream response parsing ----------------------
+
+
+def test_content_length_parsed_strictly():
+    """int() and str.isdigit() both accept Unicode digits; the parser
+    must not — and garbage must raise instead of silently switching
+    to read-to-EOF framing."""
+    from containerpilot_tpu.fleet.gateway import (
+        UpstreamError,
+        _parse_content_length,
+    )
+
+    assert _parse_content_length({"content-length": "42"}) == 42
+    assert _parse_content_length({}) is None
+    for bad in ("١٢٣", "12abc", "-1", "+5", "", "4 2"):
+        with pytest.raises(UpstreamError):
+            _parse_content_length({"content-length": bad})
+
+
+async def _raw_replica(respond: bytes):
+    """A server that reads one full request, writes ``respond``
+    verbatim, and closes — for malformed-upstream scenarios a real
+    HTTPServer can't produce."""
+    hits = []
+
+    async def handle(reader, writer):
+        head = await reader.readuntil(b"\r\n\r\n")
+        length = 0
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                length = int(line.split(b":")[1])
+        if length:
+            await reader.readexactly(length)
+        hits.append(1)
+        writer.write(respond)
+        await writer.drain()
+        writer.close()
+
+    server = await asyncio.start_server(handle, "127.0.0.1", 0)
+    return server, server.sockets[0].getsockname()[1], hits
+
+
+def test_replica_dying_after_status_line_is_retried(run, tmp_path):
+    """EOF inside the response header block is an UpstreamError (not
+    an empty-header 'success'), so the retry path fires and the
+    client still gets a 200 from the healthy replica."""
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        broken, broken_port, hits = await _raw_replica(
+            b"HTTP/1.1 200 OK\r\n"  # dies mid-header-block
+        )
+        healthy = HTTPServer()
+
+        async def handler(_req):
+            return Response(
+                200, json.dumps({"tokens": [[9]]}).encode(),
+                content_type="application/json",
+            )
+
+        healthy.route("POST", "/v1/generate", handler)
+        await healthy.start_tcp("127.0.0.1", 0)
+        _register(backend, "aaa", broken_port)  # tie -> broken first
+        _register(backend, "bbb", healthy.bound_port)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0,
+            poll_interval=5.0, hedge=False, retry_backoff=0.01,
+        )
+        await gw.run()
+        status, text, _ = await asyncio.get_event_loop().run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+        )
+        retried = _counter(gw._m_retried, "aaa")
+        await gw.stop()
+        broken.close()
+        await broken.wait_closed()
+        await healthy.stop()
+        return status, text, retried, len(hits)
+
+    status, text, retried, hits = run(scenario(), timeout=60)
+    assert status == 200 and json.loads(text)["tokens"] == [[9]]
+    assert hits == 1 and retried == 1
+
+
+def test_malformed_content_length_is_retried(run, tmp_path):
+    """Garbage Content-Length fails the leg (UpstreamError) instead
+    of silently mis-framing the body as read-to-EOF."""
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        broken, broken_port, hits = await _raw_replica(
+            b"HTTP/1.1 200 OK\r\nContent-Length: 12abc\r\n\r\nhello"
+        )
+        healthy = HTTPServer()
+
+        async def handler(_req):
+            return Response(
+                200, json.dumps({"tokens": [[9]]}).encode(),
+                content_type="application/json",
+            )
+
+        healthy.route("POST", "/v1/generate", handler)
+        await healthy.start_tcp("127.0.0.1", 0)
+        _register(backend, "aaa", broken_port)
+        _register(backend, "bbb", healthy.bound_port)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0,
+            poll_interval=5.0, hedge=False, retry_backoff=0.01,
+        )
+        await gw.run()
+        status, text, _ = await asyncio.get_event_loop().run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+        )
+        retried = _counter(gw._m_retried, "aaa")
+        await gw.stop()
+        broken.close()
+        await broken.wait_closed()
+        await healthy.stop()
+        return status, text, retried
+
+    status, text, retried = run(scenario(), timeout=60)
+    assert status == 200 and json.loads(text)["tokens"] == [[9]]
+    assert retried == 1
 
 
 # -- satellite: filecatalog robustness ----------------------------------

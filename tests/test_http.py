@@ -1,0 +1,380 @@
+"""Keep-alive conformance suite for the shared HTTP server
+(utils/http.py): multiple requests per connection, the opt-outs
+(``Connection: close``, HTTP/1.0), idle/cap reaping, the streaming
+close-delimited contract, and no leaked handler state on abrupt
+client disconnects. Every server in the tree (control plane,
+telemetry, inference, gateway, catalog emulator) sits on this.
+"""
+import asyncio
+import http.client
+import socket
+
+from containerpilot_tpu.utils.http import (
+    HTTPServer,
+    Response,
+    StreamingResponse,
+)
+
+
+async def _start_server(**attrs):
+    server = HTTPServer()
+    for key, value in attrs.items():
+        setattr(server, key, value)
+
+    async def ok(req):
+        return Response(200, b"hello\n")
+
+    async def echo(req):
+        return Response(200, req.body, content_type="application/json")
+
+    async def stream(_req):
+        async def gen():
+            yield b"data: 1\n\n"
+            yield b"data: 2\n\n"
+
+        return StreamingResponse(gen())
+
+    server.route("GET", "/ok", ok)
+    server.route("POST", "/echo", echo)
+    server.route("GET", "/stream", stream)
+    await server.start_tcp("127.0.0.1", 0)
+    return server
+
+
+def _recv_all(sock, timeout=5.0):
+    """Read until EOF (or timeout, which fails the test loudly)."""
+    sock.settimeout(timeout)
+    chunks = []
+    while True:
+        chunk = sock.recv(65536)
+        if not chunk:
+            return b"".join(chunks)
+        chunks.append(chunk)
+
+
+def test_sequential_requests_reuse_one_connection(run):
+    """N requests on one http.client connection: one accept, N
+    responses, each advertising keep-alive."""
+
+    async def scenario():
+        server = await _start_server()
+        loop = asyncio.get_event_loop()
+
+        def client():
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.bound_port, timeout=5
+            )
+            out = []
+            for _ in range(5):
+                conn.request("GET", "/ok")
+                resp = conn.getresponse()
+                out.append(
+                    (resp.status, resp.read(), resp.getheader("Connection"))
+                )
+            conn.close()
+            return out
+
+        out = await loop.run_in_executor(None, client)
+        counters = (server.connections_accepted, server.requests_served)
+        await server.stop()
+        return out, counters
+
+    out, (conns, reqs) = run(scenario(), timeout=30)
+    assert out == [(200, b"hello\n", "keep-alive")] * 5
+    assert conns == 1 and reqs == 5
+
+
+def test_connection_close_header_is_honored(run):
+    """A request carrying ``Connection: close`` mid-keep-alive gets a
+    closing response and EOF; earlier requests on the same connection
+    were served keep-alive."""
+
+    async def scenario():
+        server = await _start_server()
+        loop = asyncio.get_event_loop()
+
+        def client():
+            sock = socket.create_connection(
+                ("127.0.0.1", server.bound_port), timeout=5
+            )
+            sock.sendall(b"GET /ok HTTP/1.1\r\nHost: x\r\n\r\n")
+            first = b""
+            while b"hello\n" not in first:
+                first += sock.recv(65536)
+            sock.sendall(
+                b"GET /ok HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"
+            )
+            rest = _recv_all(sock)
+            sock.close()
+            return first, rest
+
+        first, rest = await loop.run_in_executor(None, client)
+        counters = (server.connections_accepted, server.requests_served)
+        await server.stop()
+        return first, rest, counters
+
+    first, rest, (conns, reqs) = run(scenario(), timeout=30)
+    assert b"Connection: keep-alive" in first
+    assert b"Connection: close" in rest and rest.endswith(b"hello\n")
+    assert conns == 1 and reqs == 2
+
+
+def test_http10_defaults_to_close(run):
+    """HTTP/1.0 without ``Connection: keep-alive`` is one-shot."""
+
+    async def scenario():
+        server = await _start_server()
+        loop = asyncio.get_event_loop()
+
+        def client():
+            sock = socket.create_connection(
+                ("127.0.0.1", server.bound_port), timeout=5
+            )
+            sock.sendall(b"GET /ok HTTP/1.0\r\n\r\n")
+            data = _recv_all(sock)
+            sock.close()
+            return data
+
+        data = await loop.run_in_executor(None, client)
+        await server.stop()
+        return data
+
+    data = run(scenario(), timeout=30)
+    assert data.startswith(b"HTTP/1.1 200")
+    assert b"Connection: close" in data
+
+
+def test_idle_keepalive_connection_is_reaped(run):
+    """A connection idle past KEEPALIVE_IDLE_TIMEOUT between requests
+    is closed by the server (quietly — no 408: the client did nothing
+    wrong)."""
+
+    async def scenario():
+        server = await _start_server(KEEPALIVE_IDLE_TIMEOUT=0.2)
+        loop = asyncio.get_event_loop()
+
+        def client():
+            sock = socket.create_connection(
+                ("127.0.0.1", server.bound_port), timeout=5
+            )
+            sock.sendall(b"GET /ok HTTP/1.1\r\nHost: x\r\n\r\n")
+            first = b""
+            while b"hello\n" not in first:
+                first += sock.recv(65536)
+            data = _recv_all(sock)  # EOF, with no error response
+            sock.close()
+            return data
+
+        data = await loop.run_in_executor(None, client)
+        tracked = len(server._conns)  # noqa: SLF001
+        await server.stop()
+        return data, tracked
+
+    data, tracked = run(scenario(), timeout=30)
+    assert data == b""  # reaped: EOF only, no 408 bytes
+    assert tracked == 0  # the handler exited and untracked itself
+
+
+def test_max_requests_cap_retires_the_connection(run):
+    async def scenario():
+        server = await _start_server(KEEPALIVE_MAX_REQUESTS=2)
+        loop = asyncio.get_event_loop()
+
+        def client():
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.bound_port, timeout=5
+            )
+            headers = []
+            for _ in range(2):
+                conn.request("GET", "/ok")
+                resp = conn.getresponse()
+                resp.read()
+                headers.append(resp.getheader("Connection"))
+            conn.close()
+            return headers
+
+        headers = await loop.run_in_executor(None, client)
+        await server.stop()
+        return headers
+
+    headers = run(scenario(), timeout=30)
+    assert headers == ["keep-alive", "close"]
+
+
+def test_streaming_response_still_closes_the_connection(run):
+    """StreamingResponse keeps its close-delimited contract: no
+    Content-Length, ``Connection: close``, EOF ends the stream even
+    when the request asked for keep-alive."""
+
+    async def scenario():
+        server = await _start_server()
+        loop = asyncio.get_event_loop()
+
+        def client():
+            sock = socket.create_connection(
+                ("127.0.0.1", server.bound_port), timeout=5
+            )
+            sock.sendall(
+                b"GET /stream HTTP/1.1\r\nHost: x\r\n"
+                b"Connection: keep-alive\r\n\r\n"
+            )
+            data = _recv_all(sock)
+            sock.close()
+            return data
+
+        data = await loop.run_in_executor(None, client)
+        await server.stop()
+        return data
+
+    data = run(scenario(), timeout=30)
+    assert b"Connection: close" in data
+    assert b"Content-Length" not in data
+    assert data.endswith(b"data: 1\n\ndata: 2\n\n")
+
+
+def test_client_disconnect_mid_keepalive_frees_the_handler(run):
+    """A client that vanishes between keep-alive requests must not
+    leave its handler coroutine parked forever: the read sees EOF and
+    the connection untracks itself."""
+
+    async def scenario():
+        server = await _start_server()
+        loop = asyncio.get_event_loop()
+
+        def client():
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.bound_port, timeout=5
+            )
+            conn.request("GET", "/ok")
+            conn.getresponse().read()
+            conn.close()  # abrupt: no Connection: close handshake
+
+        await loop.run_in_executor(None, client)
+        for _ in range(100):
+            if not server._conns:  # noqa: SLF001
+                break
+            await asyncio.sleep(0.02)
+        tracked = len(server._conns)  # noqa: SLF001
+        await server.stop()
+        return tracked
+
+    assert run(scenario(), timeout=30) == 0
+
+
+def test_protocol_error_closes_the_connection(run):
+    """After a malformed request the framing is untrusted: 400 is
+    answered with ``Connection: close`` and the socket ends, even
+    mid-keep-alive."""
+
+    async def scenario():
+        server = await _start_server()
+        loop = asyncio.get_event_loop()
+
+        def client():
+            sock = socket.create_connection(
+                ("127.0.0.1", server.bound_port), timeout=5
+            )
+            sock.sendall(b"GET /ok HTTP/1.1\r\nHost: x\r\n\r\n")
+            first = b""
+            while b"hello\n" not in first:
+                first += sock.recv(65536)
+            sock.sendall(
+                b"GET /ok HTTP/1.1\r\nContent-Length: nope\r\n\r\n"
+            )
+            data = _recv_all(sock)
+            sock.close()
+            return data
+
+        data = await loop.run_in_executor(None, client)
+        await server.stop()
+        return data
+
+    data = run(scenario(), timeout=30)
+    assert data.startswith(b"HTTP/1.1 400")
+    assert b"Connection: close" in data
+
+
+def test_pipelined_requests_are_both_answered(run):
+    async def scenario():
+        server = await _start_server()
+        loop = asyncio.get_event_loop()
+
+        def client():
+            sock = socket.create_connection(
+                ("127.0.0.1", server.bound_port), timeout=5
+            )
+            sock.sendall(
+                b"GET /ok HTTP/1.1\r\nHost: x\r\n\r\n"
+                b"GET /ok HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"
+            )
+            data = _recv_all(sock)
+            sock.close()
+            return data
+
+        data = await loop.run_in_executor(None, client)
+        counters = (server.connections_accepted, server.requests_served)
+        await server.stop()
+        return data, counters
+
+    data, (conns, reqs) = run(scenario(), timeout=30)
+    assert data.count(b"hello\n") == 2
+    assert conns == 1 and reqs == 2
+
+
+def test_stop_force_closes_idle_keepalive_connections(run):
+    """stop() must not leave parked keep-alive handlers behind (nor
+    hang on them): lingering idle connections are closed."""
+
+    async def scenario():
+        server = await _start_server()
+        loop = asyncio.get_event_loop()
+
+        sock = await loop.run_in_executor(
+            None,
+            lambda: socket.create_connection(
+                ("127.0.0.1", server.bound_port), timeout=5
+            ),
+        )
+
+        def request():
+            sock.sendall(b"GET /ok HTTP/1.1\r\nHost: x\r\n\r\n")
+            data = b""
+            while b"hello\n" not in data:
+                data += sock.recv(65536)
+            return data
+
+        await loop.run_in_executor(None, request)
+        await server.stop()  # idle keep-alive connection still open
+        data = await loop.run_in_executor(None, lambda: _recv_all(sock))
+        sock.close()
+        return data
+
+    assert run(scenario(), timeout=30) == b""  # EOF promptly, no hang
+
+
+def test_oversized_request_line_gets_400_not_task_crash(run):
+    """A request line overrunning the StreamReader limit (64KB, no
+    newline) raises ValueError inside readline — the client must get
+    a 400 + close, never a silent drop via an unhandled task
+    exception."""
+
+    async def scenario():
+        server = await _start_server()
+        loop = asyncio.get_event_loop()
+
+        def client():
+            sock = socket.create_connection(
+                ("127.0.0.1", server.bound_port), timeout=5
+            )
+            sock.sendall(b"GET /" + b"a" * 70000 + b" HTTP/1.1")
+            data = _recv_all(sock)
+            sock.close()
+            return data
+
+        data = await loop.run_in_executor(None, client)
+        await server.stop()
+        return data
+
+    data = run(scenario(), timeout=30)
+    assert data.startswith(b"HTTP/1.1 400")
+    assert b"Connection: close" in data
