@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all build test test-fast test-workload integration fleet-smoke bench bench-gateway lint lint-baseline clean image
+.PHONY: all build test test-fast test-workload integration fleet-smoke chaos chaos-smoke bench bench-gateway lint lint-baseline clean image
 
 all: build test
 
@@ -14,8 +14,10 @@ bin/cpsup: native/sup.cpp
 	mkdir -p bin
 	cp native/cpsup bin/cpsup
 
+# the tier-1 suite: everything except slow-marked chaos marathons
+# (`make chaos` runs those; the tier-1 wall-time cap stays honest)
 test:
-	$(PYTHON) -m pytest tests/ -q
+	$(PYTHON) -m pytest tests/ -q -m 'not slow'
 
 # supervisor tier only (~2 min): all host-side packages, no JAX compiles
 test-fast:
@@ -33,6 +35,19 @@ integration: build
 # two-replica drain-mid-traffic integration test) on the CPU backend
 fleet-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_fleet.py -q
+
+# trace-driven load + fault injection against a real fleet, scored on
+# SLO-goodput (docs/80-chaos.md). chaos-smoke: the quick seeded
+# scenarios (the same invariants tier-1 gates on) with the JSON
+# goodput report; chaos: the full registry including the slow-marked
+# compound marathons, plus the chaos test module end to end.
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m containerpilot_tpu.chaos \
+		--suite quick --json chaos-report.json
+chaos:
+	JAX_PLATFORMS=cpu $(PYTHON) -m containerpilot_tpu.chaos \
+		--suite full --json chaos-report.json
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_chaos.py -q
 
 bench:
 	$(PYTHON) bench.py

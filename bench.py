@@ -897,6 +897,62 @@ def gateway_overhead_bench(rounds: int = 60) -> dict:
     }
 
 
+def chaos_goodput_bench(seed: int = 0) -> dict:
+    """The robustness trajectory: run the QUICK chaos scenarios (a
+    real multi-replica fleet + gateway replaying a seeded trace while
+    faults fire — replica SIGKILL, wedged health, catalog flap, slow
+    replica) and record each run's SLO-goodput, TTFT/TPOT
+    percentiles, 5xx count, and per-fault counts. Host-side and
+    CPU-sized, so every bench round records real under-fire numbers
+    even TPU-less. ``meets_target`` is every scenario clearing its
+    invariants (zero client-visible 5xx included) — the bar the
+    ROADMAP's autoscaling and multiplexed-transport work will be
+    judged against. See docs/80-chaos.md."""
+    import logging as logging_mod
+    import os
+    import tempfile
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    logging_mod.disable(logging_mod.CRITICAL)
+
+    from containerpilot_tpu.chaos import quick_scenarios, run_scenario
+
+    scenarios: dict = {}
+    all_passed = True
+    for name in quick_scenarios():
+        with tempfile.TemporaryDirectory(prefix="chaos-bench-") as d:
+            report = run_scenario(name, d, seed=seed)
+        score = report["score"]
+        scenarios[name] = {
+            "passed": report["passed"],
+            "requests": score["requests"],
+            "goodput_rps": score["goodput_rps"],
+            "goodput_fraction": score["goodput_fraction"],
+            "ttft_p50_ms": score["ttft_ms"]["p50"],
+            "ttft_p99_ms": score["ttft_ms"]["p99"],
+            "tpot_p95_ms": score["tpot_ms"]["p95"],
+            "count_5xx": score["count_5xx"],
+            "truncated_streams": score["truncated_streams"],
+            "retried": report["gateway"]["retried"],
+            "hedged": report["gateway"]["hedged"],
+            "catalog_flaps_damped": (
+                report["gateway"]["catalog_flaps_damped"]
+            ),
+            "fault_counts": report["fault_counts"],
+        }
+        all_passed = all_passed and report["passed"]
+    return {
+        "backend": jax.default_backend(),
+        "seed": seed,
+        "scenarios": scenarios,
+        # the bar: every quick scenario's invariants hold under fire
+        "meets_target": all_passed,
+    }
+
+
 def _bench_subprocess(fn_name: str, timeout_s: int,
                       env: dict | None = None) -> dict:
     """Run one workload bench in its own interpreter with a hard
@@ -992,6 +1048,12 @@ def workload_benches() -> dict:
     # number too: measure it on every backend
     extras["gateway_overhead"] = _bench_subprocess(
         "gateway_overhead_bench", 600,
+        env=None if backend == "tpu" else {"JAX_PLATFORMS": "cpu"},
+    )
+    # robustness trajectory: quick chaos scenarios' SLO-goodput under
+    # injected faults, recorded every round (BENCH_r06+)
+    extras["chaos_goodput"] = _bench_subprocess(
+        "chaos_goodput_bench", 900,
         env=None if backend == "tpu" else {"JAX_PLATFORMS": "cpu"},
     )
     if backend != "tpu":

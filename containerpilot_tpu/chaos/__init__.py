@@ -1,0 +1,48 @@
+"""Trace-driven load + chaos harness, gated on SLO-goodput.
+
+The fleet's adversarial proving ground: replay realistic multi-tenant
+traffic (trace.py) through a real gateway-fronted replica fleet while
+injecting the faults members actually die of (faults.py) — replica
+SIGKILL, wedged health checks, brownouts, lossy transport, catalog
+flaps — and judge the run by goodput, the fraction of work meeting
+TTFT/TPOT SLOs per wall-second (slo.py), not raw QPS.
+
+``python -m containerpilot_tpu.chaos`` runs scenarios from the
+registry (scenarios.py); ``make chaos-smoke`` runs the quick suite.
+Quick scenarios also run in tier-1 (tests/test_chaos.py), so the
+zero-5xx-under-fire invariants gate every PR the way racecheck gates
+races. See docs/80-chaos.md.
+"""
+from .faults import ChaosProxy, Fault, FlakyBackend
+from .slo import SLO, RequestRecord, ScenarioScore, percentile
+from .scenarios import (
+    SCENARIOS,
+    FleetHarness,
+    ScenarioSpec,
+    full_scenarios,
+    quick_scenarios,
+    run_scenario,
+    run_scenario_async,
+)
+from .trace import TraceConfig, TraceRequest, generate_trace, trace_summary
+
+__all__ = [
+    "SCENARIOS",
+    "SLO",
+    "ChaosProxy",
+    "Fault",
+    "FlakyBackend",
+    "FleetHarness",
+    "RequestRecord",
+    "ScenarioScore",
+    "ScenarioSpec",
+    "TraceConfig",
+    "TraceRequest",
+    "full_scenarios",
+    "generate_trace",
+    "percentile",
+    "quick_scenarios",
+    "run_scenario",
+    "run_scenario_async",
+    "trace_summary",
+]

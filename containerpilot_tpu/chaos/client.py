@@ -1,0 +1,161 @@
+"""Minimal asyncio load-driver client for the chaos harness.
+
+The workload generator needs things stdlib HTTP clients make awkward:
+TTFT measured at the first response byte, SSE event accounting, and
+deliberately hanging up mid-stream (the abandoned-client fault). This
+client speaks just enough HTTP/1.1 for the gateway's two response
+shapes (Content-Length-framed JSON and close-delimited SSE) and
+records a ``RequestRecord`` per call.
+
+One connection per request, by design: each trace request models an
+independent end client, so gateway-side keep-alive pooling (replica
+side) is exercised while the client side stays adversarially churny.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from .slo import RequestRecord
+from .trace import TraceRequest
+
+#: generous cap on any single request; scenario wall time is bounded
+#: by the runner, this just keeps a wedged read from pinning the run
+REQUEST_TIMEOUT_S = 60.0
+
+
+async def _read_head(
+    reader: asyncio.StreamReader,
+) -> Tuple[int, Dict[str, str]]:
+    blob = await reader.readuntil(b"\r\n\r\n")
+    lines = blob.split(b"\r\n")
+    parts = lines[0].decode("latin-1").split(None, 2)
+    status = int(parts[1])
+    headers: Dict[str, str] = {}
+    for line in lines[1:]:
+        if not line:
+            continue
+        key, _, value = line.decode("latin-1").partition(":")
+        headers[key.strip().lower()] = value.strip()
+    return status, headers
+
+
+def _count_tokens(payload: Dict[str, Any]) -> int:
+    rows = payload.get("tokens")
+    if not isinstance(rows, list):
+        return 0
+    return sum(len(r) for r in rows if isinstance(r, list))
+
+
+async def issue_request(
+    port: int,
+    req: TraceRequest,
+    clock_zero: float,
+    host: str = "127.0.0.1",
+    path: str = "/v1/generate",
+) -> RequestRecord:
+    """Issue one trace request against the gateway and record the
+    outcome. Never raises: transport failures land in ``error`` so the
+    scorer can count them (a chaos run WANTS to observe failures)."""
+    record = RequestRecord(
+        index=req.index,
+        session_id=req.session_id,
+        started_s=time.monotonic() - clock_zero,
+        finished_s=0.0,
+        stream=req.stream,
+    )
+    writer: Optional[asyncio.StreamWriter] = None
+    try:
+        record_body = json.dumps(req.payload()).encode()
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), REQUEST_TIMEOUT_S
+        )
+        head = (
+            f"POST {path} HTTP/1.1\r\n"
+            f"Host: {host}:{port}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(record_body)}\r\n"
+            f"Connection: close\r\n\r\n"
+        )
+        writer.write(head.encode() + record_body)
+        await writer.drain()
+        status, headers = await asyncio.wait_for(
+            _read_head(reader), REQUEST_TIMEOUT_S
+        )
+        record.status = status
+        if "text/event-stream" in headers.get("content-type", ""):
+            await _consume_stream(reader, req, record, clock_zero)
+        else:
+            length = int(headers.get("content-length", "0") or "0")
+            body = await asyncio.wait_for(
+                reader.readexactly(length) if length else reader.read(),
+                REQUEST_TIMEOUT_S,
+            )
+            # buffered TTFT: the whole response IS the first token's
+            # arrival (the replica decodes before writing anything)
+            record.ttft_s = (
+                time.monotonic() - clock_zero
+            ) - record.started_s
+            if status == 200:
+                try:
+                    record.tokens_out = _count_tokens(json.loads(body))
+                except ValueError:
+                    record.error = "unparseable 200 body"
+    except (OSError, asyncio.TimeoutError,
+            asyncio.IncompleteReadError, ValueError) as exc:
+        record.error = f"{type(exc).__name__}: {exc}"
+    finally:
+        if writer is not None:
+            writer.close()
+    record.finished_s = time.monotonic() - clock_zero
+    return record
+
+
+async def _consume_stream(
+    reader: asyncio.StreamReader,
+    req: TraceRequest,
+    record: RequestRecord,
+    clock_zero: float,
+) -> None:
+    """Read SSE events, marking TTFT at the first data event, hanging
+    up after ``abandon_after_events`` when the trace says so, and
+    flagging truncation when the stream ends without its terminal
+    ``done`` event."""
+    events = 0
+    saw_done = False
+    buffer = b""
+    while True:
+        chunk = await asyncio.wait_for(
+            reader.read(65536), REQUEST_TIMEOUT_S
+        )
+        if not chunk:
+            break
+        buffer += chunk
+        while b"\n\n" in buffer:
+            raw, buffer = buffer.split(b"\n\n", 1)
+            if not raw.startswith(b"data: "):
+                continue
+            try:
+                event = json.loads(raw[len(b"data: "):])
+            except ValueError:
+                continue
+            events += 1
+            if record.ttft_s is None:
+                record.ttft_s = (
+                    time.monotonic() - clock_zero
+                ) - record.started_s
+            if event.get("done"):
+                saw_done = True
+            else:
+                record.tokens_out += len(event.get("tokens") or [])
+        if saw_done:
+            return
+        if (
+            req.abandon_after_events is not None
+            and events >= req.abandon_after_events
+        ):
+            record.abandoned = True
+            return
+    record.truncated = not saw_done

@@ -1,0 +1,593 @@
+"""Chaos scenarios: a real fleet, a real trace, scheduled faults, and
+an SLO-goodput verdict.
+
+The harness boots the ``make fleet-smoke`` topology for real — N
+in-process ``InferenceServer`` replicas (slot engine enabled, so SSE
+streaming and cancellation work), one ``FleetMember`` each
+heartbeating a ``FileCatalogBackend``, and a ``FleetGateway`` polling
+that catalog (through a ``FlakyBackend`` so catalog flaps can be
+injected). The trace replays through the gateway exactly as an
+external client fleet would: one connection per request, sessions
+sticky, streams abandoned mid-flight when the trace says so.
+
+A scenario is declarative: a trace config, a fault schedule, gateway
+knobs, an SLO, and the invariant thresholds the run must clear
+(``max_5xx`` is 0 for every scenario that models survivable faults —
+the whole point of drains, retries, hedging, and hold-downs is that
+members dying is not the client's problem). ``run_scenario`` returns a
+JSON-able report with the goodput score, per-fault ledger, gateway
+counters, and pass/fail per check; the CLI and the tier-1 tests both
+consume it.
+
+Determinism: the trace, the fault schedule, per-request seeds, and the
+gateway's retry jitter all derive from the scenario seed. Wall-clock
+measurements (TTFT/TPOT) naturally vary run to run; WHICH requests
+arrive, WHAT they ask, and WHEN faults fire do not.
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .client import issue_request
+from .faults import ChaosProxy, Fault, FlakyBackend
+from .slo import SLO, RequestRecord, ScenarioScore
+from .trace import TraceConfig, TraceRequest, generate_trace, trace_summary
+
+SERVICE = "inference"
+
+
+def _counter_total(counter) -> float:
+    """Sum a labeled prometheus counter across its label values."""
+    total = 0.0
+    for metric in counter.collect():
+        for sample in metric.samples:
+            if sample.name.endswith("_total"):
+                total += sample.value
+    return total
+
+
+class FleetHarness:
+    """A live multi-replica fleet the fault verbs operate on."""
+
+    def __init__(
+        self,
+        catalog_dir: str,
+        replicas: int = 2,
+        *,
+        ttl: int = 1,
+        heartbeat_interval: float = 0.1,
+        use_proxies: bool = False,
+        gateway_kwargs: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self.catalog_dir = catalog_dir
+        self.n_replicas = replicas
+        self.ttl = ttl
+        self.heartbeat_interval = heartbeat_interval
+        self.use_proxies = use_proxies
+        self.gateway_kwargs = dict(gateway_kwargs or {})
+        self.servers: List[Any] = []
+        self.members: List[Any] = []
+        self.proxies: List[Optional[ChaosProxy]] = []
+        self.backend = None  # members' (real) catalog view
+        self.flaky: Optional[FlakyBackend] = None  # the gateway's view
+        self.gateway = None
+        self.killed: set = set()
+        self.fault_log: List[Dict[str, Any]] = []
+
+    # -- lifecycle ---------------------------------------------------
+
+    async def start(self) -> None:
+        # JAX imports live here, not at module import: the trace/SLO
+        # halves of the chaos package stay importable (and testable)
+        # without an accelerator stack
+        import jax
+        import jax.numpy as jnp
+
+        from ..discovery import FileCatalogBackend
+        from ..fleet import FleetGateway, FleetMember
+        from ..models.transformer import TransformerConfig, init_params
+        from ..workload.serve import InferenceServer
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+            max_seq_len=64, dtype=jnp.float32,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        self.backend = FileCatalogBackend(self.catalog_dir)
+        for i in range(self.n_replicas):
+            server = InferenceServer(
+                cfg, params, "127.0.0.1", 0, max_len=64,
+                slots=2, slot_chunk=4,
+            )
+            await server.run()
+            proxy: Optional[ChaosProxy] = None
+            advertise = None
+            if self.use_proxies:
+                proxy = ChaosProxy("127.0.0.1", server.port)
+                await proxy.start()
+                advertise = proxy.port
+            member = FleetMember(
+                server, self.backend, SERVICE, ttl=self.ttl,
+                heartbeat_interval=self.heartbeat_interval,
+                instance_id=f"replica-{i}", advertise_port=advertise,
+            )
+            await member.start()
+            self.servers.append(server)
+            self.members.append(member)
+            self.proxies.append(proxy)
+        self.flaky = FlakyBackend(self.backend)
+        kwargs = dict(
+            poll_interval=0.1, retries=3, retry_backoff=0.02,
+            hedge=False,
+        )
+        kwargs.update(self.gateway_kwargs)
+        self.gateway = FleetGateway(
+            self.flaky, SERVICE, "127.0.0.1", 0, **kwargs
+        )
+        await self.gateway.run()
+        for _ in range(200):
+            if self.gateway.replica_count == self.n_replicas:
+                break
+            await asyncio.sleep(0.05)
+        if self.gateway.replica_count != self.n_replicas:
+            raise RuntimeError(
+                f"fleet failed to converge: "
+                f"{self.gateway.replica_count}/{self.n_replicas}"
+            )
+
+    async def stop(self) -> None:
+        if self.gateway is not None:
+            await self.gateway.stop()
+        for i, member in enumerate(self.members):
+            await member.stop(deregister=i not in self.killed)
+        for proxy in self.proxies:
+            if proxy is not None:
+                await proxy.stop()
+        for i, server in enumerate(self.servers):
+            if i not in self.killed:
+                await server.stop()
+
+    # -- fault verbs -------------------------------------------------
+
+    def _log(self, fault: Fault) -> None:
+        self.fault_log.append(
+            {
+                "at_s": fault.at_s, "kind": fault.kind,
+                "replica": fault.replica, "value": fault.value,
+            }
+        )
+
+    async def apply(self, fault: Fault) -> None:
+        self._log(fault)
+        if fault.kind == "kill":
+            await self.kill(fault.replica)
+        elif fault.kind == "wedge":
+            self.servers[fault.replica].ready = False
+        elif fault.kind == "unwedge":
+            self.servers[fault.replica].ready = True
+        elif fault.kind == "slow":
+            self.set_delay(fault.replica, fault.value)
+        elif fault.kind == "lossy":
+            proxy = self.proxies[fault.replica]
+            if proxy is None:
+                raise RuntimeError("lossy fault needs use_proxies=True")
+            proxy.reset_after_bytes = (
+                int(fault.value) if fault.value > 0 else None
+            )
+        elif fault.kind == "flap":
+            assert self.flaky is not None
+            self.flaky.flap(int(fault.value))
+        else:
+            raise ValueError(f"unknown fault kind {fault.kind!r}")
+
+    async def kill(self, i: int) -> None:
+        """SIGKILL semantics: heartbeats stop WITHOUT deregistering
+        (the record decays critical by TTL), then the server aborts —
+        listener and live connections drop with no drain."""
+        self.killed.add(i)
+        await self.members[i].stop(deregister=False)
+        proxy = self.proxies[i]
+        if proxy is not None:
+            await proxy.stop()
+        await self.servers[i].abort()
+
+    def set_delay(self, i: int, delay_s: float) -> None:
+        server = self.servers[i]
+        if delay_s <= 0:
+            server.chaos_hook = None
+            return
+
+        async def hook(endpoint: str) -> None:
+            if endpoint in ("generate", "completions"):
+                await asyncio.sleep(delay_s)
+
+        server.chaos_hook = hook
+
+    async def run_schedule(
+        self, faults: Tuple[Fault, ...], clock_zero: float
+    ) -> None:
+        for fault in sorted(faults, key=lambda f: f.at_s):
+            delay = clock_zero + fault.at_s - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            await self.apply(fault)
+
+
+@dataclass(frozen=True)
+class ScenarioSpec:
+    """One scenario: the workload, the faults, and the bar to clear."""
+
+    name: str
+    description: str
+    trace: TraceConfig
+    faults: Tuple[Fault, ...] = ()
+    replicas: int = 2
+    ttl: int = 1
+    use_proxies: bool = False
+    gateway: Dict[str, Any] = field(default_factory=dict)
+    slo: SLO = field(default_factory=SLO)
+    #: seconds after the last request for TTL expiries / polls to
+    #: converge before end-state checks run
+    settle_s: float = 0.5
+    quick: bool = True
+    # -- invariant thresholds ----------------------------------------
+    max_5xx: int = 0
+    max_transport_errors: int = 0
+    min_goodput_fraction: float = 0.9
+    expect_hedged_min: int = 0
+    expect_flaps_damped_min: int = 0
+    #: replica indices that must have left catalog AND routing table
+    expect_absent: Tuple[int, ...] = ()
+    max_ttft_p99_ms: Optional[float] = None
+    max_truncated_streams: Optional[int] = None
+
+
+async def _warm_fleet(
+    harness: FleetHarness, requests: List[TraceRequest]
+) -> None:
+    """Compile every prompt-length bucket the trace will use BEFORE
+    the clock starts: static-shape serving compiles one prefill
+    program per distinct prompt length, and the jit factories are
+    process-wide (lru-cached per config), so one warm request per
+    bucket against one replica warms the whole in-process fleet.
+    Mid-trace cold compiles would otherwise dominate TTFT on a lab
+    box and score the run on XLA, not on the fleet."""
+    port = harness.servers[0].port
+    for i, length in enumerate(
+        sorted({len(r.tokens) for r in requests})
+    ):
+        warm = TraceRequest(
+            index=-1 - i, at_s=0.0, session_id="warm", tenant=0,
+            tokens=[1] * length, max_new_tokens=2, seed=0,
+        )
+        record = await issue_request(port, warm, time.monotonic())
+        if record.status != 200:
+            raise RuntimeError(
+                f"warm request (prompt len {length}) failed: "
+                f"status={record.status} error={record.error!r}"
+            )
+
+
+async def _drive(
+    requests: List[TraceRequest], port: int, clock_zero: float
+) -> List[RequestRecord]:
+    tasks = []
+    for req in requests:
+        delay = clock_zero + req.at_s - time.monotonic()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        tasks.append(
+            asyncio.ensure_future(issue_request(port, req, clock_zero))
+        )
+    return list(await asyncio.gather(*tasks))
+
+
+async def run_scenario_async(
+    spec: ScenarioSpec, catalog_dir: str, seed: int = 0
+) -> Dict[str, Any]:
+    """Boot the fleet, replay the trace while the fault schedule
+    fires, and score the run. Returns the JSON-able report."""
+    trace_cfg = dataclasses.replace(spec.trace, seed=seed)
+    requests = generate_trace(trace_cfg)
+    harness = FleetHarness(
+        catalog_dir,
+        spec.replicas,
+        ttl=spec.ttl,
+        use_proxies=spec.use_proxies,
+        gateway_kwargs=dict(spec.gateway, jitter_seed=seed),
+    )
+    try:
+        # start() inside the try: a boot that fails half-way (e.g.
+        # convergence timeout on a loaded box) must still tear down
+        # the members/servers it already launched — stop() tolerates
+        # partial state
+        await harness.start()
+        gw = harness.gateway
+        await _warm_fleet(harness, requests)
+        clock_zero = time.monotonic()
+        schedule = asyncio.ensure_future(
+            harness.run_schedule(spec.faults, clock_zero)
+        )
+        records = await _drive(requests, gw.port, clock_zero)
+        await schedule
+        # wall clock for goodput stops when the WORKLOAD ends: the
+        # settle window below is a convergence knob for the end-state
+        # checks, and folding it in would deflate goodput_rps by a
+        # constant idle tax that varies per scenario
+        wall_s = time.monotonic() - clock_zero
+        await asyncio.sleep(spec.settle_s)
+        score = ScenarioScore(records, wall_s, spec.slo).as_dict()
+        catalog_ids = {
+            inst.id for inst in harness.backend.instances(SERVICE)
+        }
+        routing_ids = set(gw._replicas)  # noqa: SLF001
+        gateway_stats = {
+            "replicas_at_end": gw.replica_count,
+            "retried": _counter_total(gw._m_retried),  # noqa: SLF001
+            "hedged": _counter_total(gw._m_hedged),  # noqa: SLF001
+            "drained_away": _counter_total(gw._m_drained),  # noqa: SLF001
+            "catalog_flaps_damped": gw.flaps_damped,
+            "proxy_resets": sum(
+                p.resets_injected
+                for p in harness.proxies if p is not None
+            ),
+        }
+    finally:
+        await harness.stop()
+
+    checks: List[Dict[str, Any]] = []
+
+    def check(name: str, ok: bool, detail: str) -> None:
+        checks.append({"name": name, "ok": bool(ok), "detail": detail})
+
+    check(
+        "5xx", score["count_5xx"] <= spec.max_5xx,
+        f"{score['count_5xx']} client-visible 5xx "
+        f"(allowed {spec.max_5xx})",
+    )
+    check(
+        "transport_errors",
+        score["transport_errors"] <= spec.max_transport_errors,
+        f"{score['transport_errors']} client transport errors "
+        f"(allowed {spec.max_transport_errors})",
+    )
+    check(
+        "goodput",
+        score["goodput_fraction"] is not None
+        and score["goodput_fraction"] >= spec.min_goodput_fraction,
+        f"goodput fraction {score['goodput_fraction']} "
+        f"(floor {spec.min_goodput_fraction})",
+    )
+    if spec.expect_hedged_min > 0:
+        check(
+            "hedged",
+            gateway_stats["hedged"] >= spec.expect_hedged_min,
+            f"{gateway_stats['hedged']:.0f} hedge dispatches "
+            f"(expected >= {spec.expect_hedged_min})",
+        )
+    if spec.expect_flaps_damped_min > 0:
+        check(
+            "flaps_damped",
+            gateway_stats["catalog_flaps_damped"]
+            >= spec.expect_flaps_damped_min,
+            f"{gateway_stats['catalog_flaps_damped']} empty polls "
+            f"damped (expected >= {spec.expect_flaps_damped_min})",
+        )
+    for idx in spec.expect_absent:
+        rid = f"replica-{idx}"
+        check(
+            f"{rid}_absent",
+            rid not in catalog_ids and rid not in routing_ids,
+            f"{rid} at end: in catalog={rid in catalog_ids}, in "
+            f"routing table={rid in routing_ids} "
+            f"(catalog={sorted(catalog_ids)}, "
+            f"routing={sorted(routing_ids)})",
+        )
+    if spec.max_ttft_p99_ms is not None:
+        p99 = score["ttft_ms"]["p99"]
+        check(
+            "ttft_p99",
+            p99 is not None and p99 <= spec.max_ttft_p99_ms,
+            f"TTFT p99 {p99}ms (cap {spec.max_ttft_p99_ms}ms)",
+        )
+    if spec.max_truncated_streams is not None:
+        check(
+            "truncated_streams",
+            score["truncated_streams"] <= spec.max_truncated_streams,
+            f"{score['truncated_streams']} truncated streams "
+            f"(allowed {spec.max_truncated_streams})",
+        )
+
+    fault_counts: Dict[str, int] = {}
+    for entry in harness.fault_log:
+        fault_counts[entry["kind"]] = (
+            fault_counts.get(entry["kind"], 0) + 1
+        )
+    return {
+        "scenario": spec.name,
+        "description": spec.description,
+        "seed": seed,
+        "passed": all(c["ok"] for c in checks),
+        "checks": checks,
+        "trace": trace_summary(requests),
+        "score": score,
+        "gateway": gateway_stats,
+        "faults": harness.fault_log,
+        "fault_counts": fault_counts,
+    }
+
+
+def run_scenario(
+    spec_or_name, catalog_dir: str, seed: int = 0
+) -> Dict[str, Any]:
+    """Synchronous entry point (CLI, bench): fresh event loop."""
+    spec = (
+        SCENARIOS[spec_or_name]
+        if isinstance(spec_or_name, str) else spec_or_name
+    )
+    return asyncio.run(run_scenario_async(spec, catalog_dir, seed))
+
+
+# -- the registry ----------------------------------------------------
+
+def _trace(**overrides: Any) -> TraceConfig:
+    base = dict(
+        duration_s=2.5, mean_rps=10.0, burst_factor=3.0,
+        tenants=3, sessions_per_tenant=3,
+        stream_fraction=0.25, abandon_fraction=0.3,
+    )
+    base.update(overrides)
+    return TraceConfig(**base)
+
+
+SCENARIOS: Dict[str, ScenarioSpec] = {}
+
+
+def _register(spec: ScenarioSpec) -> None:
+    SCENARIOS[spec.name] = spec
+
+
+_register(ScenarioSpec(
+    name="kill_spare",
+    description=(
+        "SIGKILL one of three replicas mid-trace with spare capacity: "
+        "retries absorb the resets, the record TTL-expires, zero "
+        "client-visible 5xx"
+    ),
+    trace=_trace(),
+    faults=(Fault(at_s=0.8, kind="kill", replica=2),),
+    replicas=3,
+    settle_s=1.5,  # ttl=1 expiry + a poll must land before end checks
+    expect_absent=(2,),
+    min_goodput_fraction=0.85,
+))
+
+_register(ScenarioSpec(
+    name="wedged_health",
+    description=(
+        "a replica's health wedges (heartbeats stop, process still "
+        "answers): the record goes catalog-critical by TTL and "
+        "traffic routes around it with zero 5xx — the reference "
+        "ContainerPilot's core failure mode"
+    ),
+    trace=_trace(duration_s=3.0),
+    faults=(Fault(at_s=0.6, kind="wedge", replica=1),),
+    replicas=2,
+    settle_s=1.5,
+    expect_absent=(1,),
+    min_goodput_fraction=0.9,
+))
+
+_register(ScenarioSpec(
+    name="catalog_flap",
+    description=(
+        "the catalog transiently answers empty (torn read): the "
+        "gateway's hold-down keeps the routing table, zero 5xx, "
+        "catalog_flaps_damped > 0"
+    ),
+    trace=_trace(),
+    faults=(
+        Fault(at_s=0.5, kind="flap", value=2),
+        Fault(at_s=1.5, kind="flap", value=2),
+    ),
+    replicas=2,
+    expect_flaps_damped_min=2,
+    min_goodput_fraction=0.95,
+))
+
+_register(ScenarioSpec(
+    name="slow_replica",
+    description=(
+        "one replica browns out (injected per-request latency): tail "
+        "hedging races the slow legs to the healthy replica, keeping "
+        "scenario p99 bounded with zero 5xx"
+    ),
+    trace=_trace(stream_fraction=0.0),  # hedging covers buffered legs
+    faults=(Fault(at_s=0.4, kind="slow", replica=0, value=0.5),),
+    replicas=2,
+    gateway={"hedge": True, "hedge_after_ms": 100.0},
+    expect_hedged_min=1,
+    min_goodput_fraction=0.85,
+    max_ttft_p99_ms=1800.0,
+))
+
+_register(ScenarioSpec(
+    name="lossy_transport",
+    description=(
+        "the gateway->replica transport turns lossy (RST after a "
+        "byte budget, mid-response): buffered requests retry to "
+        "clean replicas with zero 5xx; stream truncations stay "
+        "bounded"
+    ),
+    trace=_trace(duration_s=3.0, stream_fraction=0.15),
+    faults=(
+        Fault(at_s=0.5, kind="lossy", replica=0, value=512),
+        Fault(at_s=2.0, kind="lossy", replica=0, value=0),  # heal
+    ),
+    replicas=2,
+    use_proxies=True,
+    quick=False,
+    min_goodput_fraction=0.75,
+    max_truncated_streams=4,
+))
+
+_register(ScenarioSpec(
+    name="kill_under_burst",
+    description=(
+        "a replica dies at the height of a 5x burst while the "
+        "catalog also flaps: jittered retries + hold-down keep the "
+        "run at zero 5xx"
+    ),
+    trace=_trace(
+        duration_s=5.0, mean_rps=16.0, burst_factor=5.0,
+        burst_dwell_s=0.6,
+    ),
+    faults=(
+        Fault(at_s=1.0, kind="kill", replica=2),
+        Fault(at_s=2.0, kind="flap", value=2),
+    ),
+    replicas=3,
+    settle_s=1.5,
+    quick=False,
+    expect_absent=(2,),
+    expect_flaps_damped_min=1,
+    min_goodput_fraction=0.8,
+))
+
+_register(ScenarioSpec(
+    name="rolling_chaos",
+    description=(
+        "the marathon: brownout, catalog flap, wedged health, "
+        "recovery, and a kill across one long bursty trace — the "
+        "compound-fault bar every future routing change must clear"
+    ),
+    trace=_trace(duration_s=8.0, mean_rps=12.0),
+    faults=(
+        Fault(at_s=0.8, kind="slow", replica=0, value=0.3),
+        Fault(at_s=1.6, kind="flap", value=2),
+        Fault(at_s=2.5, kind="wedge", replica=1),
+        Fault(at_s=4.0, kind="slow", replica=0, value=0.0),  # heal
+        Fault(at_s=4.5, kind="unwedge", replica=1),
+        Fault(at_s=6.0, kind="kill", replica=2),
+    ),
+    replicas=3,
+    ttl=2,
+    settle_s=2.5,
+    gateway={"hedge": True, "hedge_after_ms": 150.0},
+    quick=False,
+    expect_absent=(2,),
+    expect_flaps_damped_min=1,
+    min_goodput_fraction=0.75,
+))
+
+
+def quick_scenarios() -> List[str]:
+    return [s.name for s in SCENARIOS.values() if s.quick]
+
+
+def full_scenarios() -> List[str]:
+    return list(SCENARIOS)

@@ -1,0 +1,152 @@
+"""SLO accounting and the goodput metric for chaos scenarios.
+
+Raw QPS is the wrong yardstick for a fleet under fire: a gateway that
+answers every request with a fast 503 has great QPS and zero value.
+Following the ML-fleet-efficiency framing (PAPERS.md: "ML Productivity
+Goodput"), the harness scores **goodput** — useful work that met its
+SLOs per unit wall time:
+
+- **TTFT** (time to first token): request start to the first response
+  byte (buffered) or first SSE data event (streams).
+- **TPOT** (time per output token): residual stream time divided by
+  the tokens after the first — the decode-rate half of the SLO.
+- A request is **good** when it returned 200, met both SLO bounds,
+  and was not truncated by a transport fault. Abandoned streams are
+  the client's choice, not a failure: they are good if the events
+  delivered before the hangup met TTFT.
+
+``goodput_rps`` = good requests / wall seconds; ``goodput_fraction``
+= good / issued. 5xx counts are tracked separately because several
+invariants pin them to exactly zero.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class SLO:
+    """Per-request latency bounds a scenario scores against. The
+    defaults fit the tiny CPU-lab model the harness boots: its decode
+    is milliseconds per token, so an entire quick scenario clears
+    them unless a fault actually bites."""
+
+    ttft_s: float = 2.0
+    tpot_s: float = 0.5
+
+
+@dataclass
+class RequestRecord:
+    """Outcome of one trace request, as the load driver observed it."""
+
+    index: int
+    session_id: str
+    started_s: float
+    finished_s: float
+    status: int = 0
+    ttft_s: Optional[float] = None
+    tokens_out: int = 0
+    stream: bool = False
+    abandoned: bool = False
+    #: transport-level failure talking to the GATEWAY (connection
+    #: refused/reset): counted as bad, distinctly from a 5xx answer
+    error: str = ""
+    #: a stream that started but ended without its terminal event and
+    #: without the client hanging up (upstream died mid-relay)
+    truncated: bool = False
+
+    def tpot(self) -> Optional[float]:
+        if self.ttft_s is None or self.tokens_out <= 1:
+            return None
+        span = (self.finished_s - self.started_s) - self.ttft_s
+        return max(span, 0.0) / (self.tokens_out - 1)
+
+    def is_good(self, slo: SLO) -> bool:
+        if self.error or self.truncated:
+            return False
+        if self.status != 200:
+            return False
+        if self.ttft_s is None or self.ttft_s > slo.ttft_s:
+            return False
+        if self.abandoned:
+            # the client hung up by choice: judge only TTFT — a TPOT
+            # over the tiny delivered window is noise, not decode rate
+            return True
+        tpot = self.tpot()
+        return tpot is None or tpot <= slo.tpot_s
+
+
+def percentile(values: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile, deterministic and dependency-free."""
+    if not values:
+        return None
+    ordered = sorted(values)
+    idx = min(int(q * len(ordered)), len(ordered) - 1)
+    return ordered[idx]
+
+
+@dataclass
+class ScenarioScore:
+    """Aggregated scenario outcome; ``as_dict`` is the JSON report."""
+
+    records: List[RequestRecord]
+    wall_s: float
+    slo: SLO = field(default_factory=SLO)
+
+    def as_dict(self) -> Dict[str, Any]:
+        records = self.records
+        good = [r for r in records if r.is_good(self.slo)]
+        ttfts = [r.ttft_s for r in records if r.ttft_s is not None]
+        tpots = [t for r in records if (t := r.tpot()) is not None]
+        statuses: Dict[str, int] = {}
+        for r in records:
+            key = str(r.status) if not r.error else "error"
+            statuses[key] = statuses.get(key, 0) + 1
+        wall = max(self.wall_s, 1e-9)
+        return {
+            "requests": len(records),
+            "good": len(good),
+            "goodput_rps": round(len(good) / wall, 3),
+            "goodput_fraction": round(
+                len(good) / len(records), 4
+            ) if records else None,
+            "wall_s": round(self.wall_s, 3),
+            "slo": {"ttft_s": self.slo.ttft_s, "tpot_s": self.slo.tpot_s},
+            "ttft_ms": {
+                "p50": _ms(percentile(ttfts, 0.50)),
+                "p95": _ms(percentile(ttfts, 0.95)),
+                "p99": _ms(percentile(ttfts, 0.99)),
+            },
+            "tpot_ms": {
+                "p50": _ms(percentile(tpots, 0.50)),
+                "p95": _ms(percentile(tpots, 0.95)),
+                "p99": _ms(percentile(tpots, 0.99)),
+            },
+            "statuses": dict(sorted(statuses.items())),
+            "count_5xx": sum(
+                1 for r in records if 500 <= r.status <= 599
+            ),
+            "transport_errors": sum(1 for r in records if r.error),
+            "truncated_streams": sum(1 for r in records if r.truncated),
+            "abandoned_streams": sum(1 for r in records if r.abandoned),
+            "tokens_out": sum(r.tokens_out for r in records),
+            # triage ledger: the first few non-good requests with
+            # enough detail to replay them (trace index + session)
+            "failures": [
+                {
+                    "index": r.index,
+                    "session": r.session_id,
+                    "status": r.status,
+                    "error": r.error,
+                    "ttft_ms": _ms(r.ttft_s),
+                    "truncated": r.truncated,
+                }
+                for r in records
+                if not r.is_good(self.slo) and not r.abandoned
+            ][:8],
+        }
+
+
+def _ms(seconds: Optional[float]) -> Optional[float]:
+    return None if seconds is None else round(seconds * 1e3, 2)

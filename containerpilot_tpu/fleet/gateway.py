@@ -45,6 +45,7 @@ import asyncio
 import hashlib
 import json
 import logging
+import random
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
@@ -254,6 +255,9 @@ class FleetGateway:
         retries: int = 2,
         retry_backoff: float = 0.05,
         retry_backoff_cap: float = 0.5,
+        retry_jitter: float = 0.5,
+        jitter_seed: Optional[int] = None,
+        empty_poll_threshold: int = 3,
         hedge: bool = True,
         hedge_quantile: float = 0.95,
         hedge_min_ms: float = 50.0,
@@ -276,6 +280,23 @@ class FleetGateway:
         self.retries = retries
         self.retry_backoff = retry_backoff
         self.retry_backoff_cap = retry_backoff_cap
+        # jittered backoff: when a replica dies under load, every
+        # in-flight request fails in the same instant — identical
+        # backoffs would re-dispatch them as one synchronized wave
+        # onto the survivors. Seedable so chaos runs are reproducible.
+        if not 0.0 <= retry_jitter <= 1.0:
+            raise ValueError("retry_jitter must be in [0, 1]")
+        self.retry_jitter = retry_jitter
+        self._rng = random.Random(jitter_seed)
+        # catalog-flap hold-down: a previously non-empty routing table
+        # is wiped only after this many CONSECUTIVE empty polls — one
+        # torn/empty catalog read must not turn into client-visible
+        # "no healthy replicas" 503s
+        if empty_poll_threshold < 1:
+            raise ValueError("empty_poll_threshold must be >= 1")
+        self.empty_poll_threshold = empty_poll_threshold
+        self._empty_polls = 0
+        self.flaps_damped = 0  # plain mirror of the counter for /fleet
         self.hedge = hedge
         self.hedge_quantile = hedge_quantile
         self.hedge_min_ms = hedge_min_ms
@@ -345,6 +366,12 @@ class FleetGateway:
         self._g_replicas = Gauge(
             "containerpilot_gateway_healthy_replicas",
             "replicas currently in the healthy routing set",
+            registry=self._registry,
+        )
+        self._m_flaps_damped = Counter(
+            "containerpilot_gateway_catalog_flaps_damped",
+            "empty catalog polls absorbed by the hold-down instead of "
+            "wiping a previously non-empty routing table",
             registry=self._registry,
         )
         self._m_pool_hits = Counter(
@@ -436,6 +463,9 @@ class FleetGateway:
         # set emptied) — steady state costs ONE catalog scan per poll
         if not did_change:
             if healthy and self._replicas:
+                # a healthy steady-state poll closes any hold-down
+                # window: only CONSECUTIVE empty polls may wipe
+                self._empty_polls = 0
                 return
             if not healthy and not self._replicas:
                 return
@@ -452,6 +482,29 @@ class FleetGateway:
                 fresh[inst.id] = known  # keep live outstanding counts
             else:
                 fresh[inst.id] = Replica(inst.id, address, inst.port)
+        if not fresh and self._replicas:
+            # catalog-flap hold-down: an empty healthy set right after
+            # a non-empty one is more often a torn read / flapping
+            # catalog than a simultaneous fleet-wide death. Keep the
+            # current routing table (and its pools) until the emptiness
+            # persists for empty_poll_threshold consecutive polls.
+            self._empty_polls += 1
+            if self._empty_polls < self.empty_poll_threshold:
+                self._m_flaps_damped.inc()
+                self.flaps_damped += 1
+                log.warning(
+                    "gateway: empty catalog poll %d/%d damped "
+                    "(holding %d replicas)",
+                    self._empty_polls, self.empty_poll_threshold,
+                    len(self._replicas),
+                )
+                return
+            log.warning(
+                "gateway: %d consecutive empty polls; dropping all "
+                "replicas", self._empty_polls,
+            )
+        if fresh:
+            self._empty_polls = 0
         if did_change or set(fresh) != set(self._replicas):
             log.info(
                 "gateway: healthy set -> %s",
@@ -571,6 +624,8 @@ class FleetGateway:
             {
                 "service": self.service_name,
                 "poll_interval": self.poll_interval,
+                "empty_poll_threshold": self.empty_poll_threshold,
+                "catalog_flaps_damped": self.flaps_damped,
                 "pool": {
                     "max_idle": self._pool.max_idle,
                     "idle_ttl_s": self._pool.idle_ttl,
@@ -642,8 +697,20 @@ class FleetGateway:
             if retrying:
                 self._m_retried.labels(rid).inc()
         if retrying:
-            await asyncio.sleep(backoff)
+            await asyncio.sleep(self._jittered(backoff))
         return min(backoff * 2, self.retry_backoff_cap)
+
+    def _jittered(self, backoff: float) -> float:
+        """Equal-jitter backoff: a deterministic floor plus a uniform
+        random slice. A replica SIGKILLed under load fails every
+        in-flight request in the same millisecond; without jitter the
+        retries arrive at the surviving replicas as one synchronized
+        storm, re-creating the spike that hedging and least-
+        outstanding routing just absorbed."""
+        if self.retry_jitter <= 0.0:
+            return backoff
+        spread = backoff * self.retry_jitter
+        return backoff - spread + self._rng.random() * spread
 
     @staticmethod
     def _failure_response(exc: Exception) -> Response:
@@ -1043,6 +1110,16 @@ def main() -> int:
     parser.add_argument("--poll-interval", type=float, default=1.0)
     parser.add_argument("--retries", type=int, default=2)
     parser.add_argument(
+        "--retry-jitter", type=float, default=0.5,
+        help="fraction of each retry backoff randomized (0 disables; "
+        "desynchronizes retry storms after a replica dies under load)",
+    )
+    parser.add_argument(
+        "--empty-poll-threshold", type=int, default=3,
+        help="consecutive empty catalog polls before a previously "
+        "non-empty routing table is dropped (flap hold-down)",
+    )
+    parser.add_argument(
         "--affinity", choices=AFFINITY_MODES, default="session"
     )
     parser.add_argument(
@@ -1074,7 +1151,9 @@ def main() -> int:
     gateway = FleetGateway(
         backend, args.service, args.host, args.port,
         tag=args.tag, poll_interval=args.poll_interval,
-        retries=args.retries, affinity=args.affinity,
+        retries=args.retries, retry_jitter=args.retry_jitter,
+        empty_poll_threshold=args.empty_poll_threshold,
+        affinity=args.affinity,
         hedge=not args.no_hedge, hedge_after_ms=args.hedge_after_ms,
         pool_max_idle=0 if args.no_pool else args.pool_max_idle,
         pool_idle_ttl=args.pool_idle_ttl,

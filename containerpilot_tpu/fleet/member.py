@@ -63,6 +63,7 @@ class FleetMember(EventHandler):
         address: str = "127.0.0.1",
         instance_id: str = "",
         tags: Iterable[str] = (),
+        advertise_port: Optional[int] = None,
     ) -> None:
         super().__init__()
         if ttl < 1:
@@ -78,11 +79,17 @@ class FleetMember(EventHandler):
         self.instance_id = (
             instance_id or f"{service_name}-{uuid.uuid4().hex[:8]}"
         )
+        # advertise a different port than the server's bind (NAT'd
+        # deployments; the chaos harness's transport proxies)
+        self.advertise_port = advertise_port
         self.service = ServiceDefinition(
             ServiceRegistration(
                 id=self.instance_id,
                 name=service_name,
-                port=int(getattr(server, "port", 0) or 0),
+                port=int(
+                    advertise_port
+                    or getattr(server, "port", 0) or 0
+                ),
                 ttl=ttl,
                 tags=list(tags),
                 address=address,
@@ -98,7 +105,8 @@ class FleetMember(EventHandler):
         """Start heartbeating. Call after ``server.run()`` so a
         port-0 bind has resolved to the real port."""
         self.service.registration.port = int(
-            getattr(self.server, "port", 0) or 0
+            self.advertise_port
+            or getattr(self.server, "port", 0) or 0
         )
         self._beat_task = asyncio.get_event_loop().create_task(
             self._beat_loop(), name=f"fleet-member:{self.instance_id}"

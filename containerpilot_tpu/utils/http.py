@@ -214,6 +214,24 @@ class HTTPServer:
         # yield once so the force-closed handlers observe EOF and exit
         await asyncio.sleep(0)
 
+    async def abort(self) -> None:
+        """Die like SIGKILL (chaos/testing): drop the listener and RST
+        every live connection with nothing flushed. ``stop()`` closes
+        connections politely (FIN after buffered bytes), which lets a
+        handler racing shutdown still deliver a well-formed error
+        response — a process that was KILLED can't do that, and fault
+        injection must not be gentler than the fault it models."""
+        if self._server is not None:
+            self._server.close()
+        for conn_writer in list(self._conns):
+            transport = conn_writer.transport
+            if transport is not None:
+                transport.abort()
+        if self._server is not None:
+            await self._server.wait_closed()
+            self._server = None
+        await asyncio.sleep(0)
+
     # bound on reading one request (headers+body): a stalled client
     # can't pin a connection open indefinitely. Handler execution is
     # deliberately unbounded (inference warmup can be slow).

@@ -36,7 +36,7 @@ import asyncio
 import json
 import logging
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, List, Optional
+from typing import Any, Awaitable, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -115,6 +115,14 @@ class InferenceServer:
         # control plane's /v3/maintenance endpoints.
         self.draining = False
         self._inflight = 0
+        # test-only fault-injection seam (chaos harness): when set,
+        # awaited before every instrumented API handler. Injects
+        # per-request latency (slow-replica brownouts) or raises to
+        # fail requests, without touching any serving path. Never set
+        # in production; None costs one attribute load per request.
+        self.chaos_hook: Optional[
+            Callable[[str], Awaitable[None]]
+        ] = None
         # context-parallel prefill: single-row prompts at least
         # cp_min_len long ring over the mesh's seq axis
         # (parallel.cp_generate); everything else takes the usual
@@ -309,6 +317,11 @@ class InferenceServer:
             t0 = time_mod.perf_counter()
             self._inflight += 1
             try:
+                # the hook runs inside the inflight window: a request
+                # parked in an injected delay must hold off a drain's
+                # inflight==0 wait exactly like one inside the handler
+                if self.chaos_hook is not None:
+                    await self.chaos_hook(endpoint)
                 resp = await handler(req)
             except Exception:
                 # the HTTP layer turns this into a 500; the failing
@@ -1014,6 +1027,22 @@ class InferenceServer:
                 None, self.slot_engine.stop
             )
         await self._server.stop()
+
+    async def abort(self) -> None:
+        """Test-only (chaos harness): die like SIGKILL. The listener
+        and every live connection drop FIRST — in-flight clients see
+        resets, exactly as if the process vanished — and only then are
+        the decode threads reaped so the test process doesn't leak
+        them. No drain, no deregistration: a FleetMember's catalog
+        record is left to decay critical by TTL expiry, which is the
+        crash signature gateways must route around."""
+        self.ready = False
+        await self._server.abort()
+        await self._batcher.stop()
+        if self.slot_engine is not None:
+            await asyncio.get_event_loop().run_in_executor(
+                None, self.slot_engine.stop
+            )
 
 
 if __name__ == "__main__":

@@ -76,7 +76,7 @@ import pytest
 _WORKLOAD_MODULES = {
     "test_workload", "test_window", "test_data", "test_flops",
     "test_capstone", "test_tuning", "test_slots",
-    "test_serve_dist", "test_fleet",
+    "test_serve_dist", "test_fleet", "test_chaos",
 }
 _WORKLOAD_TESTS = {"test_fuzz_sample_logits_invariants"}
 
@@ -87,6 +87,11 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers", "workload: JAX models/ops/parallel tier (slow)"
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running scenarios excluded from tier-1 "
+        "(`pytest -m 'not slow'`); `make chaos` runs them",
     )
 
 

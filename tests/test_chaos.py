@@ -1,0 +1,529 @@
+"""Chaos harness tests: trace/SLO units, the gateway's catalog-flap
+hold-down and jittered retries, the all-replicas-down path, the fault
+injectors, and the quick chaos scenarios against a REAL fleet (the
+tier-1 under-fire invariants: SIGKILL with spare capacity, wedged
+health check, catalog flap, slow replica + hedging).
+
+Long compound scenarios are ``slow``-marked: tier-1 runs the quick
+ones, ``make chaos`` runs everything.
+"""
+import asyncio
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from containerpilot_tpu.chaos import (
+    SLO,
+    ChaosProxy,
+    FlakyBackend,
+    RequestRecord,
+    ScenarioScore,
+    SCENARIOS,
+    TraceConfig,
+    generate_trace,
+    trace_summary,
+)
+from containerpilot_tpu.discovery import (
+    FileCatalogBackend,
+    NoopBackend,
+    ServiceRegistration,
+)
+from containerpilot_tpu.fleet import FleetGateway, FleetMember
+from containerpilot_tpu.fleet.gateway import Replica
+from containerpilot_tpu.utils.http import HTTPServer, Response
+
+
+def _post(port, path, payload, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode(), dict(exc.headers)
+
+
+def _register(backend, instance_id, port, name="svc"):
+    backend.service_register(
+        ServiceRegistration(
+            id=instance_id, name=name, port=port, ttl=60,
+            address="127.0.0.1",
+        ),
+        status="passing",
+    )
+
+
+# -- trace generator (no JAX, no servers) -------------------------------
+
+
+def test_trace_is_deterministic_under_a_seed():
+    cfg = TraceConfig(seed=11)
+    a = generate_trace(cfg)
+    b = generate_trace(cfg)
+    assert [vars(r) for r in a] == [vars(r) for r in b]
+    c = generate_trace(TraceConfig(seed=12))
+    assert [vars(r) for r in a] != [vars(r) for r in c]
+    # arrivals are ordered and inside the horizon
+    times = [r.at_s for r in a]
+    assert times == sorted(times)
+    assert all(0 <= t < cfg.duration_s for t in times)
+
+
+def test_trace_has_the_advertised_structure():
+    cfg = TraceConfig(seed=3, duration_s=6.0, mean_rps=20.0)
+    requests = generate_trace(cfg)
+    summary = trace_summary(requests)
+    assert summary["requests"] > 50
+    assert summary["streams"] > 0 and summary["abandons"] > 0
+    assert 0 < summary["burst_requests"] < summary["requests"]
+    # multi-tenant sessions share prefixes: two requests of one
+    # session open with identical tokens (tenant + session prefix)
+    by_session = {}
+    for r in requests:
+        by_session.setdefault(r.session_id, []).append(r)
+    multi = [rs for rs in by_session.values() if len(rs) > 1]
+    assert multi, "trace never revisited a session"
+    prefix = cfg.tenant_prefix + cfg.session_prefix
+    for rs in multi:
+        first = rs[0].tokens[:prefix]
+        assert all(r.tokens[:prefix] == first for r in rs)
+    # prompt lengths are quantized (bounded compile set) but still
+    # spread across buckets (the lognormal tail survives)
+    lengths = {len(r.tokens) for r in requests}
+    assert all(length % cfg.prompt_quantum == 0 for length in lengths)
+    assert len(lengths) > 1
+    assert max(len(r.tokens) for r in requests) <= cfg.max_prompt
+    # per-request seeds are unique (retries must be idempotent, but
+    # distinct requests must not share a sampling stream)
+    seeds = [r.seed for r in requests]
+    assert len(set(seeds)) == len(seeds)
+
+
+# -- SLO scorer (pure) --------------------------------------------------
+
+
+def test_slo_scorer_goodput_and_failure_ledger():
+    slo = SLO(ttft_s=0.5, tpot_s=0.1)
+    records = [
+        # good: fast TTFT, fine decode rate
+        RequestRecord(0, "s0", 0.0, 1.0, status=200, ttft_s=0.1,
+                      tokens_out=10),
+        # bad: TTFT blown
+        RequestRecord(1, "s1", 0.0, 2.0, status=200, ttft_s=1.0,
+                      tokens_out=4),
+        # bad: TPOT blown (0.9s residual over 4 tokens -> 0.3/token)
+        RequestRecord(2, "s2", 0.0, 1.0, status=200, ttft_s=0.1,
+                      tokens_out=4),
+        # bad: 5xx
+        RequestRecord(3, "s3", 0.0, 0.1, status=503),
+        # bad: transport error
+        RequestRecord(4, "s4", 0.0, 0.1, error="ConnectionError"),
+        # bad: truncated stream
+        RequestRecord(5, "s5", 0.0, 0.4, status=200, ttft_s=0.1,
+                      tokens_out=3, stream=True, truncated=True),
+        # good: abandoned stream that met TTFT — hanging up is the
+        # client's choice, and a TPOT over the tiny delivered window
+        # (here 0.2/token, over the SLO) is noise, not decode rate
+        RequestRecord(6, "s6", 0.0, 0.3, status=200, ttft_s=0.1,
+                      tokens_out=2, stream=True, abandoned=True),
+    ]
+    score = ScenarioScore(records, wall_s=2.0, slo=slo).as_dict()
+    assert score["requests"] == 7
+    assert score["good"] == 2
+    assert score["goodput_rps"] == 1.0  # 2 good / 2s
+    assert score["count_5xx"] == 1
+    assert score["transport_errors"] == 1
+    assert score["truncated_streams"] == 1
+    assert score["abandoned_streams"] == 1
+    assert score["statuses"]["error"] == 1
+    # the triage ledger names the bad requests, abandons excluded
+    failed_indices = {f["index"] for f in score["failures"]}
+    assert failed_indices == {1, 2, 3, 4, 5}
+    json.dumps(score)  # report must be JSON-able
+
+
+def test_tpot_math():
+    r = RequestRecord(0, "s", 0.0, 1.1, status=200, ttft_s=0.1,
+                      tokens_out=11)
+    assert abs(r.tpot() - 0.1) < 1e-9
+    # one token has no inter-token gap
+    assert RequestRecord(
+        0, "s", 0.0, 1.0, status=200, ttft_s=0.5, tokens_out=1
+    ).tpot() is None
+
+
+# -- gateway hold-down + jitter (no servers) ----------------------------
+
+
+class _EmptyBackend(NoopBackend):
+    """Catalog that always answers empty-but-changed."""
+
+    def check_for_upstream_changes(self, s, tag="", dc=""):
+        return True, False
+
+    def instances(self, s, tag=""):
+        return []
+
+
+def _two_replicas():
+    return {
+        "a": Replica("a", "h", 1),
+        "b": Replica("b", "h", 2),
+    }
+
+
+def test_holddown_damps_transient_empty_polls(run):
+    gw = FleetGateway(
+        _EmptyBackend(), "svc", empty_poll_threshold=3
+    )
+    gw._replicas = _two_replicas()
+
+    async def scenario():
+        await gw._poll_once()
+        assert gw.replica_count == 2 and gw.flaps_damped == 1
+        await gw._poll_once()
+        assert gw.replica_count == 2 and gw.flaps_damped == 2
+        # third CONSECUTIVE empty poll: the emptiness is real
+        await gw._poll_once()
+        assert gw.replica_count == 0 and gw.flaps_damped == 2
+
+    run(scenario(), timeout=30)
+
+
+def test_holddown_window_resets_on_healthy_poll(run):
+    """Two separate two-poll flaps with healthy polls between them
+    must BOTH be damped — the consecutive-empties counter resets on
+    any healthy poll, including the no-change early return."""
+    backend = FlakyBackend(_HealthyStub())
+    gw = FleetGateway(backend, "svc", empty_poll_threshold=3)
+    gw._replicas = _two_replicas()
+
+    async def scenario():
+        backend.flap(2)
+        await gw._poll_once()
+        await gw._poll_once()
+        assert gw.replica_count == 2 and gw.flaps_damped == 2
+        # healthy poll (steady state, no change): window closes
+        await gw._poll_once()
+        assert gw.replica_count == 2
+        backend.flap(2)
+        await gw._poll_once()
+        await gw._poll_once()
+        # regression: these used to accumulate to 4 consecutive and
+        # wipe the table mid-flap
+        assert gw.replica_count == 2 and gw.flaps_damped == 4
+
+    run(scenario(), timeout=30)
+
+
+class _HealthyStub(NoopBackend):
+    """Two healthy instances, steady state (no changes reported)."""
+
+    def check_for_upstream_changes(self, s, tag="", dc=""):
+        return False, True
+
+    def instances(self, s, tag=""):
+        from containerpilot_tpu.discovery import ServiceInstance
+
+        return [
+            ServiceInstance(id="a", name=s, address="h", port=1),
+            ServiceInstance(id="b", name=s, address="h", port=2),
+        ]
+
+
+def test_flaky_backend_budget_is_per_poll_cycle():
+    backend = FlakyBackend(_HealthyStub())
+    backend.flap(2)
+    # one poll cycle = check + re-list; exactly two cycles come up empty
+    assert backend.check_for_upstream_changes("svc") == (True, False)
+    assert backend.instances("svc") == []
+    assert backend.check_for_upstream_changes("svc") == (True, False)
+    assert backend.instances("svc") == []
+    assert backend.check_for_upstream_changes("svc") == (False, True)
+    assert len(backend.instances("svc")) == 2
+    assert backend.flaps_served == 2
+
+
+def test_retry_jitter_bounded_and_seeded():
+    gw = FleetGateway(NoopBackend(), "svc", jitter_seed=42)
+    delays = [gw._jittered(0.2) for _ in range(50)]
+    # equal jitter: [backoff/2, backoff] at the default 0.5 fraction
+    assert all(0.1 <= d <= 0.2 for d in delays)
+    assert len(set(delays)) > 10, "jitter produced no spread"
+    # seeded: two gateways draw identical sequences (reproducible runs)
+    gw2 = FleetGateway(NoopBackend(), "svc", jitter_seed=42)
+    assert [gw2._jittered(0.2) for _ in range(50)] == delays
+    # jitter disabled -> the exact deterministic backoff
+    plain = FleetGateway(NoopBackend(), "svc", retry_jitter=0.0)
+    assert plain._jittered(0.2) == 0.2
+
+
+# -- all replicas down: fast 503, no leak, full recovery ----------------
+
+
+def test_all_replicas_down_fast_503_then_recovery(run, tmp_path):
+    """Every replica dies: after the hold-down expires the gateway
+    answers 503 + Retry-After immediately (no hang, no pooled
+    connection left), and the next poll after replicas return
+    restores routing."""
+    import time
+
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        replicas = []
+        for rid in ("aaa", "bbb"):
+            server = HTTPServer()
+
+            async def handler(_req):
+                return Response(
+                    200, b"{}", content_type="application/json"
+                )
+
+            server.route("POST", "/v1/generate", handler)
+            await server.start_tcp("127.0.0.1", 0)
+            _register(backend, rid, server.bound_port)
+            replicas.append(server)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0,
+            poll_interval=0.05, hedge=False, retry_backoff=0.01,
+            empty_poll_threshold=2,
+        )
+        await gw.run()
+        loop = asyncio.get_event_loop()
+        status, _, _ = await loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+        )
+        assert status == 200
+
+        # all replicas die at once (catalog records removed + servers
+        # gone) — the hold-down damps the first empty poll, then the
+        # table empties for real
+        for rid in ("aaa", "bbb"):
+            backend.service_deregister(rid)
+        for server in replicas:
+            await server.stop()
+        for _ in range(100):
+            if gw.replica_count == 0:
+                break
+            await asyncio.sleep(0.05)
+        assert gw.replica_count == 0
+        assert gw.flaps_damped >= 1
+
+        # fast-fail: 503 + Retry-After with no upstream to hang on
+        t0 = time.perf_counter()
+        status, _, headers = await loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+        )
+        elapsed = time.perf_counter() - t0
+        assert status == 503
+        assert {k.lower(): v for k, v in headers.items()}["retry-after"]
+        assert elapsed < 5.0, f"all-down 503 took {elapsed:.1f}s"
+        # no pooled connections survive the prune
+        assert gw._pool.idle_count("aaa") == 0
+        assert gw._pool.idle_count("bbb") == 0
+
+        # recovery: a replica comes back; the next polls re-route
+        revived = HTTPServer()
+
+        async def handler2(_req):
+            return Response(200, b"{}", content_type="application/json")
+
+        revived.route("POST", "/v1/generate", handler2)
+        await revived.start_tcp("127.0.0.1", 0)
+        _register(backend, "ccc", revived.bound_port)
+        for _ in range(100):
+            if gw.replica_count == 1:
+                break
+            await asyncio.sleep(0.05)
+        assert gw.replica_count == 1
+        status, _, _ = await loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+        )
+        assert status == 200
+
+        await gw.stop()
+        await revived.stop()
+
+    run(scenario(), timeout=120)
+
+
+# -- fault injectors (no JAX) -------------------------------------------
+
+
+def test_chaos_proxy_resets_mid_response(run):
+    """The lossy-transport fault: the proxy forwards the request, then
+    RSTs the response after its byte budget."""
+
+    async def scenario():
+        async def handle(reader, writer):
+            await reader.readuntil(b"\r\n\r\n")
+            writer.write(b"HTTP/1.1 200 OK\r\nContent-Length: 1000\r\n"
+                         b"\r\n" + b"x" * 1000)
+            await writer.drain()
+            writer.close()
+
+        upstream = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = upstream.sockets[0].getsockname()[1]
+        proxy = ChaosProxy("127.0.0.1", port)
+        await proxy.start()
+
+        async def fetch():
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", proxy.port
+            )
+            writer.write(b"GET / HTTP/1.1\r\n\r\n")
+            await writer.drain()
+            body = b""
+            try:
+                while True:
+                    chunk = await reader.read(4096)
+                    if not chunk:
+                        break
+                    body += chunk
+            except ConnectionError:
+                return body, True
+            finally:
+                writer.close()
+            return body, False
+
+        # pass-through first
+        body, _reset = await fetch()
+        assert body.endswith(b"x" * 100) and len(body) > 1000
+        # armed: response cut at the budget
+        proxy.reset_after_bytes = 100
+        body, reset = await fetch()
+        assert len(body) <= 100
+        assert reset or len(body) < 1000  # RST or short read
+        assert proxy.resets_injected == 1
+        await proxy.stop()
+        upstream.close()
+        await upstream.wait_closed()
+
+    run(scenario(), timeout=60)
+
+
+def test_member_advertises_override_port(run, tmp_path):
+    """The proxy seam: a member can advertise a port other than the
+    server's bind (NAT, chaos transport proxies)."""
+    backend = FileCatalogBackend(str(tmp_path))
+
+    class _Stub:
+        ready = True
+        draining = False
+        inflight = 0
+        port = 7777
+
+    async def scenario():
+        member = FleetMember(
+            _Stub(), backend, "svc", ttl=2, heartbeat_interval=0.05,
+            instance_id="r1", advertise_port=8888,
+        )
+        await member.start()
+        for _ in range(100):
+            if backend.instances("svc"):
+                break
+            await asyncio.sleep(0.02)
+        instances = backend.instances("svc")
+        await member.stop()
+        return instances
+
+    instances = run(scenario(), timeout=30)
+    assert [i.port for i in instances] == [8888]
+
+
+# -- the quick scenarios: a real fleet under fire (tier-1) --------------
+
+
+def _run_scenario_checked(name, tmp_path, seed=5):
+    from containerpilot_tpu.chaos import run_scenario
+
+    report = run_scenario(name, str(tmp_path), seed=seed)
+    assert report["passed"], json.dumps(report["checks"], indent=2)
+    assert report["score"]["count_5xx"] == 0
+    assert report["score"]["transport_errors"] == 0
+    json.dumps(report)  # the whole report is JSON-able
+    return report
+
+
+def test_scenario_kill_with_spare_capacity(tmp_path):
+    """SIGKILL one of three replicas mid-trace: zero client-visible
+    5xx, and the corpse TTL-expires out of catalog + routing."""
+    report = _run_scenario_checked("kill_spare", tmp_path)
+    assert report["gateway"]["replicas_at_end"] == 2
+    # the run is the seeded trace, reproducibly
+    spec = SCENARIOS["kill_spare"]
+    expected = trace_summary(
+        generate_trace(dataclasses.replace(spec.trace, seed=5))
+    )
+    assert report["trace"] == expected
+
+
+def test_scenario_wedged_health_check(tmp_path):
+    """A replica stops heartbeating (wedged health): its record goes
+    catalog-critical by TTL and traffic routes around it."""
+    report = _run_scenario_checked("wedged_health", tmp_path)
+    assert report["gateway"]["replicas_at_end"] == 1
+
+
+def test_scenario_catalog_flap_and_cli(tmp_path):
+    """Catalog flaps mid-trace: the hold-down damps them with zero
+    5xx — driven through the CLI so its report plumbing is covered."""
+    from containerpilot_tpu.chaos.__main__ import main
+
+    out = tmp_path / "report.json"
+    rc = main([
+        "--scenario", "catalog_flap", "--seed", "5",
+        "--json", str(out),
+    ])
+    assert rc == 0
+    payload = json.loads(out.read_text())
+    assert payload["passed"] is True
+    report = payload["scenarios"][0]
+    assert report["score"]["count_5xx"] == 0
+    assert report["gateway"]["catalog_flaps_damped"] >= 2
+    assert report["gateway"]["replicas_at_end"] == 2
+    assert {"goodput_rps", "ttft_ms", "tpot_ms"} <= set(
+        report["score"]
+    )
+
+
+def test_scenario_slow_replica_hedging_bounds_p99(tmp_path):
+    """One replica browns out: hedging fires (hedged > 0) and keeps
+    scenario p99 TTFT bounded, goodput above its floor."""
+    report = _run_scenario_checked("slow_replica", tmp_path)
+    assert report["gateway"]["hedged"] >= 1
+    spec = SCENARIOS["slow_replica"]
+    assert (
+        report["score"]["goodput_fraction"]
+        >= spec.min_goodput_fraction
+    )
+    assert report["score"]["ttft_ms"]["p99"] <= spec.max_ttft_p99_ms
+
+
+# -- the compound marathons (make chaos) --------------------------------
+
+
+@pytest.mark.slow
+def test_scenario_lossy_transport(tmp_path):
+    report = _run_scenario_checked("lossy_transport", tmp_path)
+    assert report["gateway"]["proxy_resets"] >= 1
+
+
+@pytest.mark.slow
+def test_scenario_kill_under_burst(tmp_path):
+    report = _run_scenario_checked("kill_under_burst", tmp_path)
+    assert report["gateway"]["replicas_at_end"] == 2
+    assert report["gateway"]["catalog_flaps_damped"] >= 1
+
+
+@pytest.mark.slow
+def test_scenario_rolling_chaos(tmp_path):
+    report = _run_scenario_checked("rolling_chaos", tmp_path)
+    assert report["gateway"]["replicas_at_end"] == 2
