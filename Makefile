@@ -38,9 +38,11 @@ fleet-smoke:
 
 # trace-driven load + fault injection against a real fleet, scored on
 # SLO-goodput (docs/80-chaos.md). chaos-smoke: the quick seeded
-# scenarios (the same invariants tier-1 gates on) with the JSON
-# goodput report; chaos: the full registry including the slow-marked
-# compound marathons, plus the chaos test module end to end.
+# scenarios (the same invariants tier-1 gates on — including the
+# burst suite: burst_10x admission shedding and the autoscaled
+# kill-under-burst) with the JSON goodput report; chaos: the full
+# registry including the slow-marked compound marathons, plus the
+# chaos test module end to end.
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m containerpilot_tpu.chaos \
 		--suite quick --json chaos-report.json

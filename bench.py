@@ -901,13 +901,16 @@ def chaos_goodput_bench(seed: int = 0) -> dict:
     """The robustness trajectory: run the QUICK chaos scenarios (a
     real multi-replica fleet + gateway replaying a seeded trace while
     faults fire — replica SIGKILL, wedged health, catalog flap, slow
-    replica) and record each run's SLO-goodput, TTFT/TPOT
-    percentiles, 5xx count, and per-fault counts. Host-side and
-    CPU-sized, so every bench round records real under-fire numbers
-    even TPU-less. ``meets_target`` is every scenario clearing its
-    invariants (zero client-visible 5xx included) — the bar the
-    ROADMAP's autoscaling and multiplexed-transport work will be
-    judged against. See docs/80-chaos.md."""
+    replica, and the burst suite: a 10x overload shed by admission
+    control, and a kill-under-burst the autoscaler scales through)
+    and record each run's SLO-goodput, TTFT/TPOT percentiles, 5xx
+    count, shed counts, scale events, and per-fault counts. Host-side
+    and CPU-sized, so every bench round records real under-fire (and
+    goodput-under-burst) numbers even TPU-less. ``meets_target`` is
+    every scenario clearing its invariants (zero client-visible 5xx
+    included — sheds are honest 429/504, counted separately) — the
+    bar the ROADMAP's multiplexed-transport work will be judged
+    against. See docs/80-chaos.md."""
     import logging as logging_mod
     import os
     import tempfile
@@ -931,6 +934,13 @@ def chaos_goodput_bench(seed: int = 0) -> dict:
             "requests": score["requests"],
             "goodput_rps": score["goodput_rps"],
             "goodput_fraction": score["goodput_fraction"],
+            "goodput_fraction_admitted": (
+                score["goodput_fraction_admitted"]
+            ),
+            "sheds": score["sheds"],
+            "shed_429": score["shed_429"],
+            "shed_504": score["shed_504"],
+            "client_retries": score["client_retries"],
             "ttft_p50_ms": score["ttft_ms"]["p50"],
             "ttft_p99_ms": score["ttft_ms"]["p99"],
             "tpot_p95_ms": score["tpot_ms"]["p95"],
@@ -940,6 +950,14 @@ def chaos_goodput_bench(seed: int = 0) -> dict:
             "hedged": report["gateway"]["hedged"],
             "catalog_flaps_damped": (
                 report["gateway"]["catalog_flaps_damped"]
+            ),
+            "autoscaler": (
+                {
+                    "scale_ups": report["autoscaler"]["scale_ups"],
+                    "scale_downs": report["autoscaler"]["scale_downs"],
+                    "replicas_at_end": report["autoscaler"]["replicas"],
+                }
+                if report.get("autoscaler") else None
             ),
             "fault_counts": report["fault_counts"],
         }

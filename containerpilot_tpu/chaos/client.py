@@ -10,11 +10,23 @@ records a ``RequestRecord`` per call.
 One connection per request, by design: each trace request models an
 independent end client, so gateway-side keep-alive pooling (replica
 side) is exercised while the client side stays adversarially churny.
+
+Well-behaved clients honor ``Retry-After``: a 429 (admission shed) or
+a maintenance 503 that carries one is retried after that delay times
+an equal-jitter factor seeded per request — thousands of clients shed
+in the same burst instant must NOT re-arrive in the same instant, or
+the retry storm re-creates the spike shedding just absorbed (the
+client-side mirror of the gateway's jittered retry backoff). A final
+429/504 with Retry-After is recorded as a **shed**: honest overload
+refusal the SLO scorer counts apart from failures. A retried 503
+still sets ``saw_5xx`` — politeness must not hide a 5xx from the
+zero-5xx invariants.
 """
 from __future__ import annotations
 
 import asyncio
 import json
+import random
 import time
 from typing import Any, Dict, Optional, Tuple
 
@@ -24,6 +36,15 @@ from .trace import TraceRequest
 #: generous cap on any single request; scenario wall time is bounded
 #: by the runner, this just keeps a wedged read from pinning the run
 REQUEST_TIMEOUT_S = 60.0
+#: Retry-After honor policy: how many times a polite client re-sends
+#: a shed/maintenance answer, and the longest single wait it accepts
+MAX_RETRY_AFTER_RETRIES = 2
+MAX_RETRY_AFTER_WAIT_S = 5.0
+#: statuses worth re-sending when the server quoted a Retry-After:
+#: 429 is an admission shed, 503 a draining/overloaded hop. 504 is
+#: NEVER retried — the request's deadline already passed.
+RETRYABLE_WITH_HINT = frozenset({429, 503})
+SHED_STATUSES = frozenset({429, 504})
 
 
 async def _read_head(
@@ -49,6 +70,17 @@ def _count_tokens(payload: Dict[str, Any]) -> int:
     return sum(len(r) for r in rows if isinstance(r, list))
 
 
+def _retry_after_s(headers: Dict[str, str]) -> Optional[float]:
+    raw = headers.get("retry-after", "")
+    if not raw:
+        return None
+    try:
+        value = float(raw)
+    except ValueError:
+        return None
+    return value if value >= 0 else None
+
+
 async def issue_request(
     port: int,
     req: TraceRequest,
@@ -57,8 +89,11 @@ async def issue_request(
     path: str = "/v1/generate",
 ) -> RequestRecord:
     """Issue one trace request against the gateway and record the
-    outcome. Never raises: transport failures land in ``error`` so the
-    scorer can count them (a chaos run WANTS to observe failures)."""
+    outcome, honoring Retry-After on shed/maintenance answers. Never
+    raises: transport failures land in ``error`` so the scorer can
+    count them (a chaos run WANTS to observe failures). TTFT runs
+    from the FIRST attempt — a retried shed that eventually succeeds
+    is only good if the whole dance met the SLO."""
     record = RequestRecord(
         index=req.index,
         session_id=req.session_id,
@@ -66,17 +101,84 @@ async def issue_request(
         finished_s=0.0,
         stream=req.stream,
     )
+    # per-request jitter stream: seeded so runs replay, distinct per
+    # request so a burst's shed victims desynchronize
+    rng = random.Random(req.seed * 2654435761 % (2**31) ^ 0x5EED)
+    attempts = 0
+    while True:
+        headers = await _attempt(port, req, clock_zero, record, host, path)
+        attempts += 1
+        if (
+            not record.error
+            and 500 <= record.status <= 599
+            and record.status != 504
+        ):
+            # a non-shed 5xx was SEEN, even if a polite retry later
+            # lands a 200 — zero-5xx invariants must still count it
+            record.saw_5xx = True
+        if (
+            record.error
+            or record.status not in RETRYABLE_WITH_HINT
+            or attempts > MAX_RETRY_AFTER_RETRIES
+        ):
+            break
+        hint = _retry_after_s(headers)
+        if hint is None:
+            break
+        record.client_retries += 1
+        # equal jitter: [hint/2, hint] — the mean backs off with the
+        # server's estimate, the spread kills the synchronized wave
+        delay = min(hint, MAX_RETRY_AFTER_WAIT_S)
+        await asyncio.sleep(delay * (0.5 + 0.5 * rng.random()))
+        # a retry is a fresh exchange; only TTFT's zero point persists
+        record.ttft_s = None
+        record.tokens_out = 0
+        record.truncated = False
+    # a transport failure on the LAST attempt leaves the prior
+    # answer's status/header flags behind — an errored exchange is
+    # never an honest shed
+    if (
+        record.status in SHED_STATUSES
+        and record.retry_after_quoted
+        and not record.error
+    ):
+        record.shed = True
+    record.finished_s = time.monotonic() - clock_zero
+    return record
+
+
+async def _attempt(
+    port: int,
+    req: TraceRequest,
+    clock_zero: float,
+    record: RequestRecord,
+    host: str,
+    path: str,
+) -> Dict[str, str]:
+    """One wire exchange; mutates ``record`` and returns the response
+    headers (empty on transport failure)."""
+    # the record reflects the FINAL exchange: a retry that dies on
+    # the wire must not inherit the prior attempt's status/header
+    # flags (saw_5xx, set by the caller, is the cumulative memory)
+    record.status = 0
+    record.retry_after_quoted = False
     writer: Optional[asyncio.StreamWriter] = None
+    headers: Dict[str, str] = {}
     try:
         record_body = json.dumps(req.payload()).encode()
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(host, port), REQUEST_TIMEOUT_S
+        )
+        priority_header = (
+            f"X-Priority: {req.priority}\r\n"
+            if req.priority != "interactive" else ""
         )
         head = (
             f"POST {path} HTTP/1.1\r\n"
             f"Host: {host}:{port}\r\n"
             f"Content-Type: application/json\r\n"
             f"Content-Length: {len(record_body)}\r\n"
+            f"{priority_header}"
             f"Connection: close\r\n\r\n"
         )
         writer.write(head.encode() + record_body)
@@ -85,6 +187,7 @@ async def issue_request(
             _read_head(reader), REQUEST_TIMEOUT_S
         )
         record.status = status
+        record.retry_after_quoted = "retry-after" in headers
         if "text/event-stream" in headers.get("content-type", ""):
             await _consume_stream(reader, req, record, clock_zero)
         else:
@@ -109,8 +212,7 @@ async def issue_request(
     finally:
         if writer is not None:
             writer.close()
-    record.finished_s = time.monotonic() - clock_zero
-    return record
+    return headers
 
 
 async def _consume_stream(

@@ -50,6 +50,45 @@ def _counter_total(counter) -> float:
     return total
 
 
+def _counter_by_label(counter, label: str) -> Dict[str, float]:
+    """Per-label-value totals of a labeled prometheus counter."""
+    out: Dict[str, float] = {}
+    for metric in counter.collect():
+        for sample in metric.samples:
+            if sample.name.endswith("_total"):
+                out[sample.labels.get(label, "")] = sample.value
+    return out
+
+
+class _HarnessLauncher:
+    """The autoscaler's launcher, backed by the harness: launch =
+    spawn an in-process replica + member (a production launcher
+    submits a supervisor job instead — same duck type), retire =
+    PR 3's drain path then stop. ``count``/``ids`` reflect what the
+    harness believes alive — catalog flaps can't shrink it, which is
+    half the no-thrash story."""
+
+    def __init__(self, harness: "FleetHarness") -> None:
+        self.harness = harness
+
+    def ids(self) -> List[str]:
+        h = self.harness
+        return [
+            f"replica-{i}"
+            for i in range(len(h.servers))
+            if i not in h.killed and i not in h.retired
+        ]
+
+    def count(self) -> int:
+        return len(self.ids())
+
+    async def launch(self) -> str:
+        return await self.harness.spawn_replica()
+
+    async def retire(self, replica_id: str) -> None:
+        await self.harness.retire_replica(replica_id)
+
+
 class FleetHarness:
     """A live multi-replica fleet the fault verbs operate on."""
 
@@ -62,6 +101,7 @@ class FleetHarness:
         heartbeat_interval: float = 0.1,
         use_proxies: bool = False,
         gateway_kwargs: Optional[Dict[str, Any]] = None,
+        autoscaler_kwargs: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.catalog_dir = catalog_dir
         self.n_replicas = replicas
@@ -69,16 +109,89 @@ class FleetHarness:
         self.heartbeat_interval = heartbeat_interval
         self.use_proxies = use_proxies
         self.gateway_kwargs = dict(gateway_kwargs or {})
+        self.autoscaler_kwargs = (
+            dict(autoscaler_kwargs)
+            if autoscaler_kwargs is not None else None
+        )
         self.servers: List[Any] = []
         self.members: List[Any] = []
         self.proxies: List[Optional[ChaosProxy]] = []
         self.backend = None  # members' (real) catalog view
         self.flaky: Optional[FlakyBackend] = None  # the gateway's view
         self.gateway = None
+        self.autoscaler = None
         self.killed: set = set()
+        self.retired: set = set()
         self.fault_log: List[Dict[str, Any]] = []
+        self._model = None  # (cfg, params), built once at start
 
     # -- lifecycle ---------------------------------------------------
+
+    async def spawn_replica(self) -> str:
+        """Boot one replica (server + member, proxy when enabled) and
+        register it; the autoscaler's launch verb and the boot loop
+        share this path. The in-process jit factories are lru-cached
+        per config, so a mid-trace launch warms in milliseconds, not
+        compile-seconds."""
+        from ..fleet import FleetMember
+        from ..workload.serve import InferenceServer
+
+        cfg, params = self._model
+        index = len(self.servers)
+        server = InferenceServer(
+            cfg, params, "127.0.0.1", 0, max_len=64,
+            slots=2, slot_chunk=4,
+        )
+        await server.run()
+        proxy: Optional[ChaosProxy] = None
+        advertise = None
+        if self.use_proxies:
+            proxy = ChaosProxy("127.0.0.1", server.port)
+            await proxy.start()
+            advertise = proxy.port
+        member = FleetMember(
+            server, self.backend, SERVICE, ttl=self.ttl,
+            heartbeat_interval=self.heartbeat_interval,
+            instance_id=f"replica-{index}", advertise_port=advertise,
+        )
+        await member.start()
+        self.servers.append(server)
+        self.members.append(member)
+        self.proxies.append(proxy)
+        return f"replica-{index}"
+
+    async def retire_replica(self, replica_id: str) -> None:
+        """Scale-down: the PR 3 drain invariant — deregister, finish
+        in-flight, stop — so retiring capacity is as invisible to
+        clients as replica maintenance."""
+        index = int(replica_id.rsplit("-", 1)[1])
+        if index in self.killed or index in self.retired:
+            return
+        self.retired.add(index)
+        await self.members[index].drain(timeout=10.0)
+        await self.members[index].stop(deregister=True)
+        proxy = self.proxies[index]
+        if proxy is not None:
+            await proxy.stop()
+        await self.servers[index].stop()
+
+    def fleet_load(self):
+        """The autoscaler's signal: admission queue depth + per-
+        replica DISPATCHED load, straight from the gateway's own
+        state. Dispatched only, deliberately: every queued request —
+        sticky-pinned or not — is already in ``queue_depth``, and
+        folding ``Replica.queued`` in as well would double-count
+        pinned waiters and scale up on phantom load."""
+        from ..fleet import FleetLoad
+
+        gw = self.gateway
+        return FleetLoad(
+            queue_depth=gw.admission.depth,
+            per_replica={
+                r.id: float(r.outstanding)
+                for r in gw._replicas.values()  # noqa: SLF001
+            },
+        )
 
     async def start(self) -> None:
         # JAX imports live here, not at module import: the trace/SLO
@@ -88,37 +201,18 @@ class FleetHarness:
         import jax.numpy as jnp
 
         from ..discovery import FileCatalogBackend
-        from ..fleet import FleetGateway, FleetMember
+        from ..fleet import Autoscaler, AutoscalerConfig, FleetGateway
         from ..models.transformer import TransformerConfig, init_params
-        from ..workload.serve import InferenceServer
 
         cfg = TransformerConfig(
             vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
             max_seq_len=64, dtype=jnp.float32,
         )
         params = init_params(jax.random.PRNGKey(0), cfg)
+        self._model = (cfg, params)
         self.backend = FileCatalogBackend(self.catalog_dir)
-        for i in range(self.n_replicas):
-            server = InferenceServer(
-                cfg, params, "127.0.0.1", 0, max_len=64,
-                slots=2, slot_chunk=4,
-            )
-            await server.run()
-            proxy: Optional[ChaosProxy] = None
-            advertise = None
-            if self.use_proxies:
-                proxy = ChaosProxy("127.0.0.1", server.port)
-                await proxy.start()
-                advertise = proxy.port
-            member = FleetMember(
-                server, self.backend, SERVICE, ttl=self.ttl,
-                heartbeat_interval=self.heartbeat_interval,
-                instance_id=f"replica-{i}", advertise_port=advertise,
-            )
-            await member.start()
-            self.servers.append(server)
-            self.members.append(member)
-            self.proxies.append(proxy)
+        for _ in range(self.n_replicas):
+            await self.spawn_replica()
         self.flaky = FlakyBackend(self.backend)
         kwargs = dict(
             poll_interval=0.1, retries=3, retry_backoff=0.02,
@@ -138,17 +232,30 @@ class FleetHarness:
                 f"fleet failed to converge: "
                 f"{self.gateway.replica_count}/{self.n_replicas}"
             )
+        if self.autoscaler_kwargs is not None:
+            self.autoscaler = Autoscaler(
+                _HarnessLauncher(self),
+                self.fleet_load,
+                AutoscalerConfig(**self.autoscaler_kwargs),
+                registry=self.gateway.registry,
+            )
+            self.gateway.attach_autoscaler(self.autoscaler)
+            self.autoscaler.start()
 
     async def stop(self) -> None:
+        if self.autoscaler is not None:
+            await self.autoscaler.stop()
         if self.gateway is not None:
             await self.gateway.stop()
         for i, member in enumerate(self.members):
+            if i in self.retired:
+                continue  # retire_replica already stopped it
             await member.stop(deregister=i not in self.killed)
-        for proxy in self.proxies:
-            if proxy is not None:
+        for i, proxy in enumerate(self.proxies):
+            if proxy is not None and i not in self.retired:
                 await proxy.stop()
         for i, server in enumerate(self.servers):
-            if i not in self.killed:
+            if i not in self.killed and i not in self.retired:
                 await server.stop()
 
     # -- fault verbs -------------------------------------------------
@@ -229,9 +336,12 @@ class ScenarioSpec:
     ttl: int = 1
     use_proxies: bool = False
     gateway: Dict[str, Any] = field(default_factory=dict)
+    #: AutoscalerConfig kwargs; None runs without an autoscaler
+    autoscaler: Optional[Dict[str, Any]] = None
     slo: SLO = field(default_factory=SLO)
     #: seconds after the last request for TTL expiries / polls to
-    #: converge before end-state checks run
+    #: converge before end-state checks run (and, for autoscaled
+    #: scenarios, the sustained-idle window scale-down needs)
     settle_s: float = 0.5
     quick: bool = True
     # -- invariant thresholds ----------------------------------------
@@ -244,6 +354,21 @@ class ScenarioSpec:
     expect_absent: Tuple[int, ...] = ()
     max_ttft_p99_ms: Optional[float] = None
     max_truncated_streams: Optional[int] = None
+    # -- overload / autoscaling invariants ---------------------------
+    #: the burst must actually shed (proves admission bit, and that
+    #: every shed was honest 429/504, since max_5xx still holds)
+    expect_sheds_min: int = 0
+    #: goodput floor over the requests the fleet ADMITTED
+    min_admitted_goodput_fraction: Optional[float] = None
+    expect_scale_up_min: int = 0
+    expect_scale_down_min: int = 0
+    #: thrash bound: scale_ups + scale_downs must stay under this
+    max_scale_events: Optional[int] = None
+    #: a replica launched mid-run (index >= the boot count) must have
+    #: been registered and routed to
+    expect_scaled_replica_routed: bool = False
+    #: replicas the autoscaler manages at the end (back to min)
+    expect_managed_at_end: Optional[int] = None
 
 
 async def _warm_fleet(
@@ -299,6 +424,7 @@ async def run_scenario_async(
         ttl=spec.ttl,
         use_proxies=spec.use_proxies,
         gateway_kwargs=dict(spec.gateway, jitter_seed=seed),
+        autoscaler_kwargs=spec.autoscaler,
     )
     try:
         # start() inside the try: a boot that fails half-way (e.g.
@@ -331,11 +457,19 @@ async def run_scenario_async(
             "hedged": _counter_total(gw._m_hedged),  # noqa: SLF001
             "drained_away": _counter_total(gw._m_drained),  # noqa: SLF001
             "catalog_flaps_damped": gw.flaps_damped,
+            "admission": gw.admission.stats(),
+            "routed": _counter_by_label(
+                gw._m_routed, "replica"  # noqa: SLF001
+            ),
             "proxy_resets": sum(
                 p.resets_injected
                 for p in harness.proxies if p is not None
             ),
         }
+        autoscaler_stats = (
+            dict(harness.autoscaler.stats)
+            if harness.autoscaler is not None else None
+        )
     finally:
         await harness.stop()
 
@@ -401,6 +535,73 @@ async def run_scenario_async(
             f"{score['truncated_streams']} truncated streams "
             f"(allowed {spec.max_truncated_streams})",
         )
+    if spec.expect_sheds_min > 0:
+        check(
+            "sheds",
+            score["sheds"] >= spec.expect_sheds_min,
+            f"{score['sheds']} sheds (429={score['shed_429']}, "
+            f"504={score['shed_504']}; expected >= "
+            f"{spec.expect_sheds_min})",
+        )
+    if spec.min_admitted_goodput_fraction is not None:
+        check(
+            "admitted_goodput",
+            score["goodput_fraction_admitted"] is not None
+            and score["goodput_fraction_admitted"]
+            >= spec.min_admitted_goodput_fraction,
+            f"goodput over admitted requests "
+            f"{score['goodput_fraction_admitted']} "
+            f"(floor {spec.min_admitted_goodput_fraction})",
+        )
+    if spec.expect_scale_up_min > 0:
+        ups = (autoscaler_stats or {}).get("scale_ups", 0)
+        check(
+            "scale_up",
+            ups >= spec.expect_scale_up_min,
+            f"{ups} scale-ups (expected >= {spec.expect_scale_up_min})",
+        )
+    if spec.expect_scale_down_min > 0:
+        downs = (autoscaler_stats or {}).get("scale_downs", 0)
+        check(
+            "scale_down",
+            downs >= spec.expect_scale_down_min,
+            f"{downs} scale-downs "
+            f"(expected >= {spec.expect_scale_down_min})",
+        )
+    if spec.max_scale_events is not None:
+        events = (autoscaler_stats or {}).get("scale_ups", 0) + (
+            autoscaler_stats or {}
+        ).get("scale_downs", 0)
+        check(
+            "scale_thrash",
+            events <= spec.max_scale_events,
+            f"{events} scale events (thrash bound "
+            f"{spec.max_scale_events})",
+        )
+    if spec.expect_scaled_replica_routed:
+        launched = {
+            f"replica-{i}"
+            for i in range(spec.replicas, len(harness.servers))
+        }
+        routed_launched = {
+            rid for rid, n in gateway_stats["routed"].items()
+            if rid in launched and n > 0
+        }
+        check(
+            "scaled_replica_routed",
+            bool(routed_launched),
+            f"launched={sorted(launched)}, routed-to="
+            f"{sorted(routed_launched)} (a scale-up must register "
+            f"AND take traffic)",
+        )
+    if spec.expect_managed_at_end is not None:
+        managed = (autoscaler_stats or {}).get("replicas", -1)
+        check(
+            "managed_at_end",
+            managed == spec.expect_managed_at_end,
+            f"{managed} managed replicas at end "
+            f"(expected {spec.expect_managed_at_end})",
+        )
 
     fault_counts: Dict[str, int] = {}
     for entry in harness.fault_log:
@@ -416,6 +617,7 @@ async def run_scenario_async(
         "trace": trace_summary(requests),
         "score": score,
         "gateway": gateway_stats,
+        "autoscaler": autoscaler_stats,
         "faults": harness.fault_log,
         "fault_counts": fault_counts,
     }
@@ -556,6 +758,115 @@ _register(ScenarioSpec(
     expect_absent=(2,),
     expect_flaps_damped_min=1,
     min_goodput_fraction=0.8,
+))
+
+_register(ScenarioSpec(
+    name="burst_10x",
+    description=(
+        "a 10x arrival-rate burst slams a browned-out two-replica "
+        "fleet: admission control sheds the overflow honestly (429 "
+        "for batch past high-water, 504 at the TTFT deadline, both "
+        "with drain-rate-derived Retry-After the clients honor with "
+        "jitter) — zero client-visible 5xx, and the work the fleet "
+        "DID admit still meets its SLOs"
+    ),
+    # the injected per-request service floor stands in for a
+    # production-sized model's decode time: the lab model answers in
+    # ms, which no burst the 1-core box can generate would saturate
+    trace=_trace(
+        # dwell means favor the burst state so EVERY seed spends
+        # real time at 10x — a seed that never bursts can't prove
+        # shedding
+        duration_s=5.0, mean_rps=6.0, burst_factor=10.0,
+        quiet_dwell_s=0.6, burst_dwell_s=1.2,
+        stream_fraction=0.1, abandon_fraction=0.2,
+        batch_fraction=0.35,
+    ),
+    faults=(
+        Fault(at_s=0.0, kind="slow", replica=0, value=0.15),
+        Fault(at_s=0.0, kind="slow", replica=1, value=0.15),
+    ),
+    replicas=2,
+    gateway={
+        "admission": {
+            "per_replica_inflight": 2,
+            "max_queue_depth": 16,
+            "high_water": 8,
+            "deadline_s": 1.2,
+            "session_rate": 8.0,
+        },
+    },
+    settle_s=1.0,
+    # TTFT is honest — measured from the FIRST attempt, so a shed
+    # that retried after Retry-After (~1-2s) and then served carries
+    # the whole dance. The scenario SLO allows one polite retry
+    # (3s); the fleet-side bar stays sharp via the 1.2s admission
+    # deadline. Floors leave headroom for 1-core-box scheduling
+    # noise: observed run-to-run spread is wide under overload.
+    slo=SLO(ttft_s=3.0, tpot_s=0.5),
+    min_goodput_fraction=0.2,
+    min_admitted_goodput_fraction=0.8,
+    expect_sheds_min=1,
+))
+
+_register(ScenarioSpec(
+    name="kill_under_burst_autoscaled",
+    description=(
+        "a replica is SIGKILLed inside an 8x burst while the catalog "
+        "flaps: the autoscaler relaunches to hold the min, scales "
+        "into the pressure (launched replica registers and takes "
+        "traffic), then drains back to min in the idle tail — no "
+        "scale thrash, zero client-visible 5xx"
+    ),
+    trace=_trace(
+        # burst-favored dwells, like burst_10x: every seed must
+        # spend real time over capacity or the scale-up/-down
+        # choreography has nothing to react to
+        duration_s=6.5, mean_rps=6.0, burst_factor=8.0,
+        quiet_dwell_s=0.6, burst_dwell_s=1.4,
+        stream_fraction=0.1, abandon_fraction=0.2,
+        batch_fraction=0.25,
+    ),
+    faults=(
+        Fault(at_s=0.0, kind="slow", replica=0, value=0.12),
+        Fault(at_s=0.0, kind="slow", replica=1, value=0.12),
+        Fault(at_s=1.2, kind="kill", replica=1),
+        Fault(at_s=2.5, kind="flap", value=2),
+        Fault(at_s=4.0, kind="flap", value=2),
+    ),
+    replicas=2,
+    gateway={
+        "admission": {
+            "per_replica_inflight": 2,
+            "max_queue_depth": 24,
+            "high_water": 12,
+            "deadline_s": 1.5,
+        },
+    },
+    autoscaler={
+        "min_replicas": 2,
+        "max_replicas": 4,
+        "slots_per_replica": 2,
+        "high_water": 0.75,
+        "low_water": 0.2,
+        "up_sustain_s": 0.3,
+        "down_sustain_s": 1.0,
+        "cooldown_s": 0.7,
+        "tick_interval": 0.15,
+    },
+    # scale-down needs sustained idle AFTER the trace: the settle
+    # window is where the fleet shrinks back to min
+    settle_s=5.0,
+    min_goodput_fraction=0.2,
+    min_admitted_goodput_fraction=0.8,
+    expect_flaps_damped_min=1,
+    expect_absent=(1,),
+    expect_scale_up_min=1,
+    expect_scale_down_min=1,
+    max_scale_events=8,
+    expect_scaled_replica_routed=True,
+    expect_managed_at_end=2,
+    slo=SLO(ttft_s=2.5, tpot_s=0.5),
 ))
 
 _register(ScenarioSpec(

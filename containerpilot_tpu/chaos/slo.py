@@ -14,6 +14,17 @@ SLOs per unit wall time:
   and was not truncated by a transport fault. Abandoned streams are
   the client's choice, not a failure: they are good if the events
   delivered before the hangup met TTFT.
+- A request is **shed** when admission control refused it honestly —
+  a final 429 (overload/session shed) or 504 (queued past its TTFT
+  deadline) carrying a Retry-After. Sheds are the overload design
+  WORKING, not the fleet failing: they are never good, but they are
+  counted apart from 5xx failures and excluded from the failure
+  ledger. ``goodput_fraction_admitted`` judges serving quality over
+  the requests the fleet accepted **on first contact** (no shed, no
+  client retry): their latency is bounded by the admission deadline
+  plus service time, so the metric isolates the fleet's serving
+  discipline from the (wall-clock-noisy) shed-retry dance, which is
+  already accounted under ``sheds``/``client_retries``.
 
 ``goodput_rps`` = good requests / wall seconds; ``goodput_fraction``
 = good / issued. 5xx counts are tracked separately because several
@@ -55,6 +66,17 @@ class RequestRecord:
     #: a stream that started but ended without its terminal event and
     #: without the client hanging up (upstream died mid-relay)
     truncated: bool = False
+    #: final answer was an honest overload refusal (429/504 with
+    #: Retry-After): counted apart from failures
+    shed: bool = False
+    #: the last response carried a Retry-After header
+    retry_after_quoted: bool = False
+    #: Retry-After-honoring re-sends the client performed
+    client_retries: int = 0
+    #: ANY attempt answered a non-shed 5xx (e.g. a 503 later retried
+    #: to a 200): still client-VISIBLE, so zero-5xx invariants count
+    #: it — polite client retries must not mask a gateway regression
+    saw_5xx: bool = False
 
     def tpot(self) -> Optional[float]:
         if self.ttft_s is None or self.tokens_out <= 1:
@@ -63,7 +85,7 @@ class RequestRecord:
         return max(span, 0.0) / (self.tokens_out - 1)
 
     def is_good(self, slo: SLO) -> bool:
-        if self.error or self.truncated:
+        if self.error or self.truncated or self.shed:
             return False
         if self.status != 200:
             return False
@@ -97,7 +119,21 @@ class ScenarioScore:
     def as_dict(self) -> Dict[str, Any]:
         records = self.records
         good = [r for r in records if r.is_good(self.slo)]
-        ttfts = [r.ttft_s for r in records if r.ttft_s is not None]
+        sheds = [r for r in records if r.shed]
+        # first-contact admissions: no shed, no Retry-After retry —
+        # the set whose latency the fleet fully controls
+        first_contact = [
+            r for r in records
+            if not r.shed and r.client_retries == 0
+        ]
+        good_first = [r for r in first_contact if r.is_good(self.slo)]
+        # latency percentiles describe SERVING, so a shed's
+        # millisecond-fast refusal must not drag them down
+        ttfts = [
+            r.ttft_s
+            for r in records
+            if r.ttft_s is not None and not r.shed
+        ]
         tpots = [t for r in records if (t := r.tpot()) is not None]
         statuses: Dict[str, int] = {}
         for r in records:
@@ -111,6 +147,16 @@ class ScenarioScore:
             "goodput_fraction": round(
                 len(good) / len(records), 4
             ) if records else None,
+            # serving quality over first-contact admissions: the
+            # number burst invariants gate on while sheds absorb the
+            # overload
+            "goodput_fraction_admitted": round(
+                len(good_first) / len(first_contact), 4
+            ) if first_contact else None,
+            "sheds": len(sheds),
+            "shed_429": sum(1 for r in sheds if r.status == 429),
+            "shed_504": sum(1 for r in sheds if r.status == 504),
+            "client_retries": sum(r.client_retries for r in records),
             "wall_s": round(self.wall_s, 3),
             "slo": {"ttft_s": self.slo.ttft_s, "tpot_s": self.slo.tpot_s},
             "ttft_ms": {
@@ -124,8 +170,14 @@ class ScenarioScore:
                 "p99": _ms(percentile(tpots, 0.99)),
             },
             "statuses": dict(sorted(statuses.items())),
+            # sheds (an honest 504 at the admission deadline) are the
+            # overload defense working; 5xx here means FAILURE — and a
+            # 5xx ANY attempt saw counts even when a polite retry
+            # turned the final answer into a 200
             "count_5xx": sum(
-                1 for r in records if 500 <= r.status <= 599
+                1 for r in records
+                if r.saw_5xx
+                or (500 <= r.status <= 599 and not r.shed)
             ),
             "transport_errors": sum(1 for r in records if r.error),
             "truncated_streams": sum(1 for r in records if r.truncated),
@@ -143,7 +195,9 @@ class ScenarioScore:
                     "truncated": r.truncated,
                 }
                 for r in records
-                if not r.is_good(self.slo) and not r.abandoned
+                if not r.is_good(self.slo)
+                and not r.abandoned
+                and not r.shed
             ][:8],
         }
 

@@ -70,6 +70,10 @@ class TraceConfig:
     stream_fraction: float = 0.25
     #: of the streaming requests, how many hang up mid-stream
     abandon_fraction: float = 0.3
+    #: fraction of requests tagged batch priority (X-Priority: batch)
+    #: — the work admission control sheds FIRST in a burst. 0 draws
+    #: nothing from the rng, so existing traces stay byte-identical.
+    batch_fraction: float = 0.0
     vocab: int = 64
 
 
@@ -90,6 +94,9 @@ class TraceRequest:
     #: read to completion)
     abandon_after_events: Optional[int] = None
     in_burst: bool = False
+    #: admission priority class ("interactive" | "batch"), sent as
+    #: the X-Priority header
+    priority: str = "interactive"
 
     def payload(self) -> Dict[str, Any]:
         body: Dict[str, Any] = {
@@ -164,6 +171,11 @@ def generate_trace(cfg: TraceConfig) -> List[TraceRequest]:
         abandon: Optional[int] = None
         if stream and rng.random() < cfg.abandon_fraction:
             abandon = 1 + rng.randrange(2)
+        # guarded draw: batch_fraction == 0 consumes no randomness,
+        # so pre-existing scenario traces replay byte-identically
+        priority = "interactive"
+        if cfg.batch_fraction > 0 and rng.random() < cfg.batch_fraction:
+            priority = "batch"
         requests.append(
             TraceRequest(
                 index=index,
@@ -176,6 +188,7 @@ def generate_trace(cfg: TraceConfig) -> List[TraceRequest]:
                 stream=stream,
                 abandon_after_events=abandon,
                 in_burst=in_burst,
+                priority=priority,
             )
         )
         index += 1
@@ -186,13 +199,14 @@ def trace_summary(requests: List[TraceRequest]) -> Dict[str, Any]:
     """Shape of a trace for reports and determinism checks."""
     if not requests:
         return {
-            "requests": 0, "streams": 0, "abandons": 0,
+            "requests": 0, "streams": 0, "batch": 0, "abandons": 0,
             "burst_requests": 0, "sessions": 0,
             "max_prompt_len": 0, "max_new_total": 0,
         }
     return {
         "requests": len(requests),
         "streams": sum(1 for r in requests if r.stream),
+        "batch": sum(1 for r in requests if r.priority == "batch"),
         "abandons": sum(
             1 for r in requests if r.abandon_after_events is not None
         ),

@@ -47,6 +47,10 @@ class ServiceInstance:
     name: str
     address: str
     port: int
+    #: last TTL-check output ("ok occ=0.50" from fleet members):
+    #: a coarse, TTL-fresh load hint; empty when the backend doesn't
+    #: surface check output
+    notes: str = ""
 
 
 class Backend(abc.ABC):

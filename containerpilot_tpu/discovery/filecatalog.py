@@ -105,6 +105,9 @@ class FileCatalogBackend(Backend):
                 record = json.load(f)
             record["status"] = "passing" if status == "pass" else status
             record["expires"] = time.time() + float(record.get("ttl") or 0)
+            # the TTL check's output (e.g. "ok occ=0.50" from fleet
+            # members): a coarse load signal readers can surface
+            record["notes"] = output
             tmp = path + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(record, f)
@@ -138,6 +141,7 @@ class FileCatalogBackend(Backend):
                     name=record["name"],
                     address=str(record.get("address") or ""),
                     port=int(record.get("port") or 0),
+                    notes=str(record.get("notes") or ""),
                 )
                 healthy = (
                     record.get("status") == "passing"

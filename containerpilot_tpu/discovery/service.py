@@ -97,14 +97,18 @@ class ServiceDefinition:
 
     # -- operations --------------------------------------------------------
 
-    def send_heartbeat(self) -> Optional[Future]:
+    def send_heartbeat(self, output: str = "ok") -> Optional[Future]:
         """Lazy-register then refresh the TTL check
-        (reference: discovery/service.go:41-51)."""
+        (reference: discovery/service.go:41-51). ``output`` rides the
+        check record (consul's check Output field; the file catalog's
+        ``notes``) — fleet members put slot occupancy there."""
 
         def work() -> None:
             self._register_sync(HEALTH_PASSING)
             try:
-                self.backend.update_ttl(f"service:{self.id}", "ok", "pass")
+                self.backend.update_ttl(
+                    f"service:{self.id}", output, "pass"
+                )
             except DiscoveryError as exc:
                 log.warning("service update TTL failed: %s", exc)
                 # self-heal from catalog state loss (restarted agent,

@@ -18,18 +18,44 @@ this package joins that supervisor half to the serving half
   per-replica keep-alive connection pooling (pool.py), and
   per-replica counters on ``/metrics``.
 
-Every later scale direction (autoscaling, multi-backend, spillover)
-routes through this seam.
+- ``AdmissionController`` (admission.py): overload defense in front
+  of routing — bounded queue, per-request TTFT deadlines, priority
+  classes, per-session token buckets, and load shedding with honest
+  drain-rate-derived Retry-After.
+- ``Autoscaler`` (autoscaler.py): the capacity loop — watches the
+  admission queue + folded per-replica load and launches/retires
+  replicas through a caller-provided launcher, with hysteresis,
+  sustain windows, and a cooldown so bursts (and catalog flaps)
+  don't thrash the fleet size.
+
+Every later scale direction (multi-backend, spillover) routes
+through this seam.
 """
+from .admission import (
+    AdmissionController,
+    AdmissionError,
+    DeadlineExpired,
+    SessionLimited,
+    ShedError,
+)
+from .autoscaler import Autoscaler, AutoscalerConfig, FleetLoad
 from .gateway import FleetGateway, Replica
 from .member import FleetMember
 from .pool import ConnectionPool, StaleConnection, UpstreamError
 
 __all__ = [
+    "AdmissionController",
+    "AdmissionError",
+    "Autoscaler",
+    "AutoscalerConfig",
     "ConnectionPool",
+    "DeadlineExpired",
     "FleetGateway",
+    "FleetLoad",
     "FleetMember",
     "Replica",
+    "SessionLimited",
+    "ShedError",
     "StaleConnection",
     "UpstreamError",
 ]

@@ -61,6 +61,14 @@ from ..utils.http import (
 )
 from ..utils.prom import exposition
 from ..watches import poll_upstream
+from .admission import (
+    AdmissionController,
+    AdmissionError,
+    DeadlineExpired,
+    PRIORITY_NAMES,
+    delta_seconds,
+    parse_priority,
+)
 from .pool import (
     ConnectionPool,
     PooledConnection,
@@ -92,7 +100,17 @@ class Replica:
     address: str
     port: int
     outstanding: int = 0
+    #: admission-queued requests whose sticky key pins here: work this
+    #: replica WILL absorb that hasn't dispatched yet. Folded into the
+    #: routing load signal — counting only dispatched requests made a
+    #: replica absorbing queued work look idle the moment it wedged
+    #: mid-burst, and least-outstanding kept feeding it.
+    queued: int = 0
     first_seen: float = field(default_factory=time.monotonic)
+
+    @property
+    def load(self) -> int:
+        return self.outstanding + self.queued
 
     @property
     def authority(self) -> str:
@@ -268,6 +286,7 @@ class FleetGateway:
         pool_max_idle: int = 8,
         pool_idle_ttl: float = 30.0,
         pool_max_uses: int = 1000,
+        admission: Optional[Dict[str, Any]] = None,
     ) -> None:
         if affinity not in AFFINITY_MODES:
             raise ValueError(f"affinity must be one of {AFFINITY_MODES}")
@@ -314,6 +333,15 @@ class FleetGateway:
             max_uses=pool_max_uses,
             on_event=self._pool_event,
         )
+        # admission control in front of routing: bounded queue,
+        # deadlines, priorities, token buckets, shedding. The default
+        # knobs are pass-through-permissive (huge per-replica inflight,
+        # no deadline), so a gateway that doesn't configure overload
+        # behaves exactly as before while the counters still exist.
+        self._admission = AdmissionController(**(admission or {}))
+        # graceful shutdown: stop admitting, finish queued + in-flight
+        self.draining = False
+        self._autoscaler: Optional[Any] = None
         self._sticky: "OrderedDict[str, str]" = OrderedDict()
         # per-endpoint pools of recent 200-latencies (seconds): the
         # hedge threshold for generate must not be poisoned by
@@ -390,6 +418,39 @@ class FleetGateway:
             "set, failed a request, or the connection went stale)",
             ["replica"], registry=self._registry,
         )
+        self._m_admitted = Counter(
+            "containerpilot_gateway_admitted",
+            "requests granted a dispatch slot, by priority class",
+            ["priority"], registry=self._registry,
+        )
+        self._m_shed = Counter(
+            "containerpilot_gateway_shed",
+            "requests answered 429 by admission control, by reason "
+            "(high_water / queue_full / session)",
+            ["reason"], registry=self._registry,
+        )
+        self._m_expired = Counter(
+            "containerpilot_gateway_deadline_expired",
+            "queued requests 504'd at their TTFT deadline without "
+            "ever dispatching upstream",
+            registry=self._registry,
+        )
+        self._g_admission_depth = Gauge(
+            "containerpilot_gateway_admission_depth",
+            "requests waiting in the admission queue",
+            registry=self._registry,
+        )
+        self._g_admission_depth.set_function(
+            lambda: self._admission.depth
+        )
+        self._g_admission_inflight = Gauge(
+            "containerpilot_gateway_admission_inflight",
+            "requests holding a dispatch slot",
+            registry=self._registry,
+        )
+        self._g_admission_inflight.set_function(
+            lambda: self._admission.inflight
+        )
 
         self._server = HTTPServer()
         self._server.route("GET", "/health", self._health)
@@ -427,6 +488,50 @@ class FleetGateway:
             self._poll_task = None
         self._pool.close_all()
         await self._server.stop()
+
+    async def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful shutdown, the replica drain invariant mirrored at
+        the gateway: stop admitting (new API requests answer 503 +
+        honest Retry-After immediately), let everything already queued
+        or in flight — streams included — finish, then return. True
+        once idle; False when ``timeout`` expired with work still
+        running (the caller stops anyway; the window is a bound, not a
+        promise). Idempotent; ``stop()`` still closes the listener."""
+        if not self.draining:
+            log.info(
+                "gateway: draining (%d in flight, %d queued)",
+                self._admission.inflight, self._admission.depth,
+            )
+        self.draining = True
+        deadline = time.monotonic() + timeout
+        while (
+            self._admission.inflight > 0 or self._admission.depth > 0
+        ):
+            if time.monotonic() >= deadline:
+                log.warning(
+                    "gateway: drain timed out with %d in flight, "
+                    "%d queued",
+                    self._admission.inflight, self._admission.depth,
+                )
+                return False
+            await asyncio.sleep(0.02)
+        log.info("gateway: drained")
+        return True
+
+    @property
+    def admission(self) -> AdmissionController:
+        return self._admission
+
+    @property
+    def registry(self):
+        """The gateway's private prometheus registry, so co-located
+        actors (the autoscaler) can expose counters on this /metrics."""
+        return self._registry
+
+    def attach_autoscaler(self, autoscaler: Any) -> None:
+        """Surface an autoscaler's stats on ``GET /fleet`` (its
+        prometheus side joins via ``registry=gateway.registry``)."""
+        self._autoscaler = autoscaler
 
     def _pool_event(self, event: str, replica_id: str) -> None:
         """Mirror pool bookkeeping into the prometheus registry."""
@@ -512,6 +617,9 @@ class FleetGateway:
             )
         self._replicas = fresh
         self._g_replicas.set(len(fresh))
+        # admission capacity tracks the healthy set; growth grants
+        # queued waiters immediately
+        self._admission.set_capacity(len(fresh))
         # pooled connections to a replica that LEFT the healthy set
         # (drained, deregistered, TTL-expired) are evicted, never
         # reused: a draining replica would answer them 503, a dead one
@@ -521,15 +629,18 @@ class FleetGateway:
     # -- routing --------------------------------------------------------
 
     def _pick(self, exclude: Iterable[str] = ()) -> Optional[Replica]:
-        """Least-outstanding-requests; replica id breaks ties so the
-        choice is deterministic under equal load."""
+        """Least-loaded (dispatched + admission-queue-assigned);
+        replica id breaks ties so the choice is deterministic under
+        equal load. Counting only dispatched requests let a replica
+        whose queued work hadn't landed yet look idle — the exact
+        shape a mid-burst wedge hides behind."""
         excluded = set(exclude)
         candidates = [
             r for r in self._replicas.values() if r.id not in excluded
         ]
         if not candidates:
             return None
-        return min(candidates, key=lambda r: (r.outstanding, r.id))
+        return min(candidates, key=lambda r: (r.load, r.id))
 
     def _affinity_key(
         self, req: Request, body: Dict[str, Any]
@@ -607,11 +718,25 @@ class FleetGateway:
 
     # -- local handlers -------------------------------------------------
 
+    def _retry_after(self) -> str:
+        """Honest Retry-After (delta-seconds) for shed/drain/failure
+        answers: derived from the admission queue's observed drain
+        rate when replicas exist; with none, the catalog poll interval
+        is the soonest anything can change."""
+        if self._replicas:
+            return str(self._admission.retry_after_s())
+        return str(delta_seconds(self.poll_interval))
+
     async def _health(self, _req: Request) -> Response:
+        if self.draining:
+            return Response(
+                503, b"draining\n",
+                headers={"Retry-After": self._retry_after()},
+            )
         if not self._replicas:
             return Response(
                 503, b"no healthy replicas\n",
-                headers={"Retry-After": "1"},
+                headers={"Retry-After": self._retry_after()},
             )
         return Response(200, b"ok\n")
 
@@ -626,6 +751,12 @@ class FleetGateway:
                 "poll_interval": self.poll_interval,
                 "empty_poll_threshold": self.empty_poll_threshold,
                 "catalog_flaps_damped": self.flaps_damped,
+                "draining": self.draining,
+                "admission": self._admission.stats(),
+                "autoscaler": (
+                    self._autoscaler.stats
+                    if self._autoscaler is not None else None
+                ),
                 "pool": {
                     "max_idle": self._pool.max_idle,
                     "idle_ttl_s": self._pool.idle_ttl,
@@ -637,6 +768,7 @@ class FleetGateway:
                         "address": r.address,
                         "port": r.port,
                         "outstanding": r.outstanding,
+                        "queued": r.queued,
                         "age_s": round(
                             time.monotonic() - r.first_seen, 1
                         ),
@@ -666,12 +798,10 @@ class FleetGateway:
             if not isinstance(parsed, dict):
                 parsed = {}
             key = self._affinity_key(req, parsed)
-            if parsed.get("stream"):
-                resp = await self._proxy_stream(endpoint, path, body, key)
-            else:
-                resp = await self._proxy_buffered(
-                    endpoint, "POST", path, body, key
-                )
+            resp = await self._admitted(
+                endpoint, path, body, key, req,
+                stream=bool(parsed.get("stream")),
+            )
             self._m_latency.labels(endpoint).observe(
                 time.perf_counter() - t0
             )
@@ -679,6 +809,110 @@ class FleetGateway:
             return resp
 
         return handler
+
+    async def _admitted(
+        self,
+        endpoint: str,
+        path: str,
+        body: bytes,
+        key: Optional[str],
+        req: Request,
+        *,
+        stream: bool,
+    ) -> Response:
+        """Admission in front of routing: shed/expire before a replica
+        slot is spent, then dispatch holding a ticket. A streaming
+        response carries its ticket until the relay closes."""
+        if self.draining:
+            # graceful shutdown: new work bounces immediately; the
+            # queued + in-flight work drain() is waiting on finishes
+            return Response(
+                503, b"gateway draining\n",
+                headers={"Retry-After": self._retry_after()},
+            )
+        priority = parse_priority(req.headers.get("x-priority", ""))
+        deadline_ms = req.headers.get("x-ttft-slo-ms", "")
+        deadline_s: Optional[float] = None
+        if deadline_ms:
+            try:
+                deadline_s = max(0.001, float(deadline_ms) / 1e3)
+            except ValueError:
+                deadline_s = None  # garbage header: server default
+        # fold the queued request into its pinned replica's load
+        # signal while it waits (see Replica.queued)
+        pinned: Optional[Replica] = None
+        if key is not None:
+            pinned = self._replicas.get(self._sticky.get(key, ""))
+            if pinned is not None:
+                pinned.queued += 1
+        try:
+            ticket = await self._admission.admit(
+                priority, key, deadline_s
+            )
+        except DeadlineExpired as exc:
+            self._m_expired.inc()
+            return Response(
+                504,
+                f"admission deadline expired: {exc}\n".encode(),
+                headers={"Retry-After": self._retry_after()},
+            )
+        except AdmissionError as exc:
+            self._m_shed.labels(exc.label).inc()
+            return Response(
+                429,
+                f"shed: {exc.reason}\n".encode(),
+                headers={
+                    "Retry-After": str(delta_seconds(exc.retry_after_s))
+                },
+            )
+        finally:
+            if pinned is not None:
+                pinned.queued -= 1
+        self._m_admitted.labels(PRIORITY_NAMES[ticket.priority]).inc()
+        released = False
+
+        def release(ok: bool) -> None:
+            nonlocal released
+            if released:
+                return
+            released = True
+            self._admission.release(ticket, completed=ok)
+
+        try:
+            if stream:
+                resp = await self._proxy_stream(
+                    endpoint, path, body, key
+                )
+            else:
+                resp = await self._proxy_buffered(
+                    endpoint, "POST", path, body, key
+                )
+        except BaseException:
+            release(False)
+            raise
+        if isinstance(resp, StreamingResponse):
+            # the dispatch slot stays held while tokens stream; the
+            # relay's close (completion, disconnect, upstream death)
+            # releases it — both close paths are idempotent. A relay
+            # the upstream killed mid-stream is NOT a completion for
+            # the drain-rate window.
+            inner_close = resp.close
+
+            def close_with_release() -> None:
+                try:
+                    if inner_close is not None:
+                        inner_close()
+                finally:
+                    release(
+                        getattr(
+                            resp, "upstream_intact", {}
+                        ).get("ok", True)
+                    )
+
+            resp.close = close_with_release
+        else:
+            release(resp.status < 500)
+        return resp
 
     async def _retry_pause(
         self,
@@ -712,12 +946,11 @@ class FleetGateway:
         spread = backoff * self.retry_jitter
         return backoff - spread + self._rng.random() * spread
 
-    @staticmethod
-    def _failure_response(exc: Exception) -> Response:
+    def _failure_response(self, exc: Exception) -> Response:
         return Response(
             503,
             f"upstream failure: {exc}\n".encode(),
-            headers={"Retry-After": "1"},
+            headers={"Retry-After": self._retry_after()},
         )
 
     def _evict_replica_pool(self, replica_id: str) -> None:
@@ -939,7 +1172,8 @@ class FleetGateway:
                 continue
             return self._relay(status, headers, payload)
         return last or Response(
-            503, b"no healthy replicas\n", headers={"Retry-After": "1"}
+            503, b"no healthy replicas\n",
+            headers={"Retry-After": self._retry_after()},
         )
 
     @staticmethod
@@ -1042,7 +1276,8 @@ class FleetGateway:
                 if held:
                     replica.outstanding -= 1
         return last or Response(
-            503, b"no healthy replicas\n", headers={"Retry-After": "1"}
+            503, b"no healthy replicas\n",
+            headers={"Retry-After": self._retry_after()},
         )
 
     def _relay_stream(
@@ -1056,6 +1291,11 @@ class FleetGateway:
         close-delimited, so the connection never returns to the pool
         — close() discards it."""
         closed = [False]
+        # whether the relay ended on an intact upstream (clean EOF vs
+        # transport death): read by the admission-ticket release so a
+        # fleet whose streams keep dying doesn't feed the drain-rate
+        # window with phantom completions
+        intact = {"ok": True}
 
         def close() -> None:
             # idempotent: generator-finally AND the response's close
@@ -1078,11 +1318,15 @@ class FleetGateway:
                         return
                     yield chunk
             except (OSError, asyncio.TimeoutError):
-                return  # upstream died mid-stream; downstream sees EOF
+                # upstream died mid-stream; downstream sees EOF
+                intact["ok"] = False
+                return
             finally:
                 close()
 
-        return StreamingResponse(chunks(), status=status, close=close)
+        resp = StreamingResponse(chunks(), status=status, close=close)
+        resp.upstream_intact = intact  # type: ignore[attr-defined]
+        return resp
 
 
 def main() -> int:
@@ -1140,6 +1384,39 @@ def main() -> int:
         "--no-pool", action="store_true",
         help="shorthand for --pool-max-idle 0",
     )
+    parser.add_argument(
+        "--admission-queue-depth", type=int, default=256,
+        help="bounded admission queue in front of routing; a full "
+        "queue sheds new work with 429 + Retry-After",
+    )
+    parser.add_argument(
+        "--admission-high-water", type=int, default=None,
+        help="queue depth past which BATCH-priority requests shed "
+        "(default: half the queue)",
+    )
+    parser.add_argument(
+        "--admission-deadline-ms", type=float, default=None,
+        help="TTFT budget for queued work: a request still queued "
+        "this long is 504'd without dispatching (default: none; "
+        "clients can pass X-TTFT-SLO-Ms per request)",
+    )
+    parser.add_argument(
+        "--per-replica-inflight", type=int, default=64,
+        help="dispatch-slot capacity contributed per healthy replica",
+    )
+    parser.add_argument(
+        "--session-rate", type=float, default=0.0,
+        help="per-session token-bucket rate (requests/s; 0 disables)",
+    )
+    parser.add_argument(
+        "--session-burst", type=float, default=None,
+        help="per-session bucket burst (default: 2x rate)",
+    )
+    parser.add_argument(
+        "--drain-window", type=float, default=30.0,
+        help="seconds SIGTERM waits for queued + in-flight requests "
+        "before the gateway exits",
+    )
     args = parser.parse_args()
 
     logging_mod.basicConfig(
@@ -1157,6 +1434,17 @@ def main() -> int:
         hedge=not args.no_hedge, hedge_after_ms=args.hedge_after_ms,
         pool_max_idle=0 if args.no_pool else args.pool_max_idle,
         pool_idle_ttl=args.pool_idle_ttl,
+        admission=dict(
+            max_queue_depth=args.admission_queue_depth,
+            high_water=args.admission_high_water,
+            deadline_s=(
+                args.admission_deadline_ms / 1e3
+                if args.admission_deadline_ms is not None else None
+            ),
+            per_replica_inflight=args.per_replica_inflight,
+            session_rate=args.session_rate,
+            session_burst=args.session_burst,
+        ),
     )
 
     async def serve() -> None:
@@ -1166,6 +1454,9 @@ def main() -> int:
         for sig in (signal_mod.SIGTERM, signal_mod.SIGINT):
             loop.add_signal_handler(sig, stop.set)
         await stop.wait()
+        # graceful: new work bounces with 503 + Retry-After while
+        # queued + in-flight requests finish under the drain window
+        await gateway.drain(args.drain_window)
         await gateway.stop()
 
     asyncio.run(serve())

@@ -141,8 +141,17 @@ class FleetMember(EventHandler):
         if getattr(self.server, "draining", False):
             return  # drained replicas stay out of the catalog
         if getattr(self.server, "ready", False):
-            # lazy-register + TTL refresh; enqueued FIFO off-loop
-            self.service.send_heartbeat()
+            # lazy-register + TTL refresh; enqueued FIFO off-loop.
+            # The beat carries the replica's slot occupancy as the
+            # check output, so the catalog itself is a (coarse,
+            # TTL-fresh) load signal autoscalers and dashboards can
+            # read without touching the replica
+            occupancy = getattr(self.server, "occupancy", None)
+            output = (
+                f"ok occ={occupancy:.2f}"
+                if isinstance(occupancy, (int, float)) else "ok"
+            )
+            self.service.send_heartbeat(output=output)
         # not ready (warming, or wedged enough that ready regressed):
         # no beat — an existing record's TTL expiry flips it critical
 

@@ -151,8 +151,10 @@ _REASONS = {
     405: "Method Not Allowed",
     408: "Request Timeout",
     422: "Unprocessable Entity",
+    429: "Too Many Requests",
     500: "Internal Server Error",
     503: "Service Unavailable",
+    504: "Gateway Timeout",
 }
 
 Handler = Callable[[Request], Awaitable[Response]]

@@ -955,6 +955,20 @@ class InferenceServer:
             n += stats["active"] + stats["queued"]
         return n
 
+    @property
+    def occupancy(self) -> float:
+        """Fraction of decode capacity in use, the autoscaling
+        signal: (active + queued slot-engine rows) / slots, so queued
+        work pushes it past 1.0 — a replica can be *over*-subscribed,
+        and a scaler must see that. Without a slot engine the handler
+        count stands in (each buffered request is one unit)."""
+        if self.slot_engine is not None:
+            stats = self.slot_engine.stats
+            return (stats["active"] + stats["queued"]) / max(
+                1, stats["slots"]
+            )
+        return float(self._inflight)
+
     def enter_maintenance(self) -> None:
         """Start draining: health 503, new generate/completions 503 +
         Retry-After, in-flight work (including running slot-engine

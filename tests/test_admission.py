@@ -1,0 +1,607 @@
+"""Admission control + autoscaler tests: token buckets, bounded-queue
+semantics (fast path, priority grant order, high-water/full sheds),
+the deadline-expiry-means-zero-upstream-dispatch invariant, drain-rate-
+derived Retry-After, the queued-load routing fold, gateway graceful
+shutdown mid-traffic, and the autoscaler's hysteresis/cooldown/repair
+decisions — all host-side, no JAX.
+"""
+import asyncio
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from containerpilot_tpu.discovery import (
+    FileCatalogBackend,
+    NoopBackend,
+    ServiceRegistration,
+)
+from containerpilot_tpu.fleet import (
+    AdmissionController,
+    Autoscaler,
+    AutoscalerConfig,
+    DeadlineExpired,
+    FleetGateway,
+    FleetLoad,
+    SessionLimited,
+    ShedError,
+)
+from containerpilot_tpu.fleet.admission import (
+    PRIORITY_BATCH,
+    PRIORITY_INTERACTIVE,
+    TokenBucket,
+)
+from containerpilot_tpu.fleet.gateway import Replica
+from containerpilot_tpu.utils.http import HTTPServer, Response
+
+
+def _post(port, path, payload, timeout=60, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode(), dict(exc.headers)
+
+
+def _get(port, path, timeout=30):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout
+        ) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode(), dict(exc.headers)
+
+
+def _register(backend, instance_id, port, name="svc"):
+    backend.service_register(
+        ServiceRegistration(
+            id=instance_id, name=name, port=port, ttl=60,
+            address="127.0.0.1",
+        ),
+        status="passing",
+    )
+
+
+# -- token bucket (pure) ------------------------------------------------
+
+
+def test_token_bucket_rate_and_refill():
+    bucket = TokenBucket(rate=2.0, burst=2.0, now=0.0)
+    assert bucket.take(0.0) is None
+    assert bucket.take(0.0) is None
+    wait = bucket.take(0.0)
+    assert wait is not None and abs(wait - 0.5) < 1e-9
+    # half a second refills one token at 2/s
+    assert bucket.take(0.5) is None
+
+
+# -- the controller's queue semantics -----------------------------------
+
+
+def test_admission_fast_path_then_queue_then_grant(run):
+    async def scenario():
+        ctrl = AdmissionController(
+            per_replica_inflight=2, max_queue_depth=4, high_water=2
+        )
+        ctrl.set_capacity(1)  # capacity 2
+        t1 = await ctrl.admit()
+        t2 = await ctrl.admit()
+        assert ctrl.inflight == 2 and not t1.queued and not t2.queued
+        waiter = asyncio.ensure_future(ctrl.admit())
+        await asyncio.sleep(0)
+        assert ctrl.depth == 1 and not waiter.done()
+        ctrl.release(t1)
+        t3 = await waiter
+        assert t3.queued and ctrl.inflight == 2 and ctrl.depth == 0
+        ctrl.release(t2)
+        ctrl.release(t3)
+        assert ctrl.inflight == 0
+        assert ctrl.admitted == 3 and ctrl.queued_total == 1
+
+    run(scenario(), timeout=30)
+
+
+def test_priority_ordering_and_sheds_under_full_queue(run):
+    """At the high-water mark batch sheds while interactive still
+    queues; at the full mark everything sheds; grants drain the
+    interactive class first."""
+
+    async def scenario():
+        ctrl = AdmissionController(
+            per_replica_inflight=1, max_queue_depth=4, high_water=2
+        )
+        ctrl.set_capacity(1)  # capacity 1
+        holder = await ctrl.admit()
+        batch_waiter = asyncio.ensure_future(
+            ctrl.admit(PRIORITY_BATCH)
+        )
+        await asyncio.sleep(0)
+        inter_1 = asyncio.ensure_future(ctrl.admit())
+        await asyncio.sleep(0)
+        assert ctrl.depth == 2  # AT high water now
+        with pytest.raises(ShedError) as shed:
+            await ctrl.admit(PRIORITY_BATCH)
+        assert shed.value.retry_after_s >= 1
+        inter_2 = asyncio.ensure_future(ctrl.admit())
+        await asyncio.sleep(0)
+        inter_3 = asyncio.ensure_future(ctrl.admit())
+        await asyncio.sleep(0)
+        assert ctrl.depth == 4
+        with pytest.raises(ShedError):
+            await ctrl.admit()  # full queue sheds interactive too
+        assert ctrl.shed_overload == 2
+        # grants: all interactive before the batch waiter, FIFO
+        # within a class — each release grants exactly the expected
+        # waiter and no other
+        pending = {
+            "i1": inter_1, "i2": inter_2, "i3": inter_3,
+            "b": batch_waiter,
+        }
+        for expected in ("i1", "i2", "i3", "b"):
+            ctrl.release(holder)
+            await asyncio.sleep(0)
+            await asyncio.sleep(0)
+            granted = [k for k, t in pending.items() if t.done()]
+            assert granted == [expected], granted
+            holder = (await pending.pop(expected))
+        ctrl.release(holder)
+
+    run(scenario(), timeout=30)
+
+
+def test_deadline_expires_queued_request(run):
+    async def scenario():
+        ctrl = AdmissionController(
+            per_replica_inflight=1, deadline_s=0.05
+        )
+        ctrl.set_capacity(1)
+        holder = await ctrl.admit()
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExpired):
+            await ctrl.admit()
+        waited = time.monotonic() - t0
+        assert 0.02 < waited < 2.0
+        assert ctrl.expired == 1 and ctrl.depth == 0
+        # the slot was never granted: releasing the holder leaves a
+        # fully idle controller
+        ctrl.release(holder)
+        assert ctrl.inflight == 0
+
+    run(scenario(), timeout=30)
+
+
+def test_grant_racing_cancellation_leaks_no_slot(run):
+    """A waiter granted in the same event-loop tick its task is
+    cancelled must hand the slot back — otherwise a client hanging up
+    at exactly the wrong moment leaks capacity forever."""
+
+    async def scenario():
+        ctrl = AdmissionController(per_replica_inflight=1)
+        ctrl.set_capacity(1)
+        holder = await ctrl.admit()
+        waiter = asyncio.ensure_future(ctrl.admit())
+        await asyncio.sleep(0)
+        assert ctrl.depth == 1
+        ctrl.release(holder)  # grants the waiter's future...
+        waiter.cancel()  # ...in the same tick the task dies
+        with pytest.raises(asyncio.CancelledError):
+            await waiter
+        assert ctrl.inflight == 0 and ctrl.depth == 0
+        # capacity is genuinely back: a fresh admit is instant
+        ctrl.release(await ctrl.admit())
+
+    run(scenario(), timeout=30)
+
+
+def test_session_bucket_raises_with_refill_hint(run):
+    async def scenario():
+        ctrl = AdmissionController(session_rate=1.0, session_burst=1.0)
+        ctrl.set_capacity(4)
+        t = await ctrl.admit(session="s1")
+        ctrl.release(t)
+        with pytest.raises(SessionLimited) as limited:
+            await ctrl.admit(session="s1")
+        assert limited.value.retry_after_s >= 1.0
+        # other sessions are untouched
+        ctrl.release(await ctrl.admit(session="s2"))
+        assert ctrl.shed_session == 1
+
+    run(scenario(), timeout=30)
+
+
+def test_retry_after_tracks_observed_drain_rate():
+    slow = AdmissionController()
+    fast = AdmissionController()
+    now = time.monotonic()
+    # 3 completions over 4s -> ~0.5/s vs 40 over 4s -> ~10/s
+    slow._completions.extend([now - 4, now - 2, now])  # noqa: SLF001
+    fast._completions.extend(  # noqa: SLF001
+        [now - 4 + i * 0.1 for i in range(41)]
+    )
+    slow.inflight = fast.inflight = 5  # the same backlog, both sides
+    assert slow.retry_after_s() > fast.retry_after_s()
+    assert fast.retry_after_s() >= 1  # floored delta-seconds
+
+
+def test_drain_rate_decays_down_when_wedged_not_merely_idle():
+    """Completions stopped WITH work pending = the fleet is stalling:
+    the estimate must fall (long honest Retry-After), never jump back
+    to capacity-optimism. Completions stopped with nothing pending is
+    just a quiet gateway: the optimistic prior returns."""
+    ctrl = AdmissionController(per_replica_inflight=64)
+    ctrl.set_capacity(2)  # capacity 128
+    now = time.monotonic()
+    # was completing ~2/s, then everything stopped 5s ago
+    stale = [now - 15 + i * 0.5 for i in range(21)]
+    ctrl._completions.extend(stale)  # noqa: SLF001
+    ctrl.inflight = 100  # backlog still out there: a wedge
+    assert ctrl.drain_rate() < 2.0
+    assert ctrl.retry_after_s() == 60  # clamped, not "2s, try again"
+    ctrl.inflight = 0  # same stale window, but nothing pending
+    assert ctrl.drain_rate() >= 128.0
+
+
+def test_depth_one_queue_constructs_and_session_hint_is_capped(run):
+    # max_queue_depth=1 must not crash on its own derived high_water
+    ctrl = AdmissionController(max_queue_depth=1)
+    assert ctrl.high_water == 1
+
+    async def scenario():
+        # a near-zero session rate quotes a capped Retry-After, not
+        # an hour-scale one
+        slow = AdmissionController(session_rate=0.01, session_burst=1.0)
+        slow.set_capacity(4)
+        slow.release(await slow.admit(session="s"))
+        with pytest.raises(SessionLimited) as limited:
+            await slow.admit(session="s")
+        assert 1.0 <= limited.value.retry_after_s <= 60.0
+
+    run(scenario(), timeout=30)
+
+
+# -- routing folds queued load ------------------------------------------
+
+
+def test_pick_counts_admission_queued_work():
+    gw = FleetGateway(NoopBackend(), "svc")
+    busy = Replica("aaa", "h", 1)
+    busy.queued = 3  # sticky-pinned work waiting in the admission queue
+    idle_looking = Replica("bbb", "h", 2)
+    idle_looking.outstanding = 1
+    gw._replicas = {"aaa": busy, "bbb": idle_looking}  # noqa: SLF001
+    # only dispatched counts would pick aaa (0 outstanding); the
+    # folded load signal knows aaa is absorbing queued work
+    assert gw._pick().id == "bbb"  # noqa: SLF001
+
+
+# -- gateway-level: deadline 504 with zero upstream dispatch ------------
+
+
+def test_deadline_504_without_upstream_dispatch(run, tmp_path):
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        release = asyncio.Event()
+        calls = [0]
+        server = HTTPServer()
+
+        async def handler(_req):
+            calls[0] += 1
+            await release.wait()
+            return Response(200, b"{}", content_type="application/json")
+
+        server.route("POST", "/v1/generate", handler)
+        await server.start_tcp("127.0.0.1", 0)
+        _register(backend, "aaa", server.bound_port)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0,
+            poll_interval=0.05, hedge=False, retries=0,
+            admission={"per_replica_inflight": 1, "deadline_s": 0.15},
+        )
+        await gw.run()
+        loop = asyncio.get_event_loop()
+        blocker = loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+        )
+        for _ in range(100):
+            if calls[0] == 1:
+                break
+            await asyncio.sleep(0.01)
+        assert calls[0] == 1
+        # the slot is held: this request queues, then dies at its
+        # deadline WITHOUT the replica ever seeing it
+        status, body, headers = await loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[2]]},
+        )
+        assert status == 504, body
+        assert {k.lower(): v for k, v in headers.items()}["retry-after"]
+        assert calls[0] == 1, "expired request reached the replica"
+        release.set()
+        status, _, _ = await blocker
+        assert status == 200
+        # counters surfaced on /metrics and /fleet
+        _, metrics, _ = await loop.run_in_executor(
+            None, _get, gw.port, "/metrics"
+        )
+        assert "containerpilot_gateway_deadline_expired_total 1.0" in metrics
+        assert "containerpilot_gateway_admission_depth" in metrics
+        _, fleet, _ = await loop.run_in_executor(
+            None, _get, gw.port, "/fleet"
+        )
+        snapshot = json.loads(fleet)
+        assert snapshot["admission"]["deadline_expired"] == 1
+        # the expired request was never admitted — only the blocker
+        assert snapshot["admission"]["admitted"] == 1
+        assert snapshot["draining"] is False
+        await gw.stop()
+        await server.stop()
+
+    run(scenario(), timeout=60)
+
+
+def test_batch_sheds_while_interactive_admitted_under_full_queue(
+    run, tmp_path
+):
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        release = asyncio.Event()
+        server = HTTPServer()
+
+        async def handler(_req):
+            await release.wait()
+            return Response(200, b"{}", content_type="application/json")
+
+        server.route("POST", "/v1/generate", handler)
+        await server.start_tcp("127.0.0.1", 0)
+        _register(backend, "aaa", server.bound_port)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0,
+            poll_interval=0.05, hedge=False, retries=0,
+            admission={
+                "per_replica_inflight": 1,
+                "max_queue_depth": 4,
+                "high_water": 1,
+            },
+        )
+        await gw.run()
+        loop = asyncio.get_event_loop()
+        holder = loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+        )
+        while gw.admission.inflight == 0:
+            await asyncio.sleep(0.01)
+        queued = loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[2]]},
+        )
+        while gw.admission.depth == 0:
+            await asyncio.sleep(0.01)
+        # queue at high water: batch bounces fast with Retry-After,
+        # interactive still gets in line
+        status, body, headers = await loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[3]]},
+            60, {"X-Priority": "batch"},
+        )
+        assert status == 429, body
+        assert {k.lower(): v for k, v in headers.items()}["retry-after"]
+        interactive = loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[4]]},
+        )
+        while gw.admission.depth < 2:
+            await asyncio.sleep(0.01)
+        release.set()
+        for fut in (holder, queued, interactive):
+            status, _, _ = await fut
+            assert status == 200
+        _, metrics, _ = await loop.run_in_executor(
+            None, _get, gw.port, "/metrics"
+        )
+        assert (
+            'containerpilot_gateway_shed_total'
+            '{reason="high_water"} 1.0' in metrics
+        )
+        await gw.stop()
+        await server.stop()
+
+    run(scenario(), timeout=60)
+
+
+# -- graceful shutdown ---------------------------------------------------
+
+
+def test_gateway_graceful_drain_mid_traffic(run, tmp_path):
+    """SIGTERM semantics: new work bounces 503 + Retry-After the
+    moment drain starts, queued + in-flight requests all finish 200,
+    and drain() returns True once idle."""
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        server = HTTPServer()
+
+        async def handler(_req):
+            await asyncio.sleep(0.15)
+            return Response(200, b"{}", content_type="application/json")
+
+        server.route("POST", "/v1/generate", handler)
+        await server.start_tcp("127.0.0.1", 0)
+        _register(backend, "aaa", server.bound_port)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0,
+            poll_interval=0.05, hedge=False, retries=0,
+            admission={"per_replica_inflight": 2},
+        )
+        await gw.run()
+        loop = asyncio.get_event_loop()
+        inflight = [
+            loop.run_in_executor(
+                None, _post, gw.port, "/v1/generate",
+                {"tokens": [[i]]},
+            )
+            for i in range(4)
+        ]
+        while gw.admission.inflight + gw.admission.depth < 4:
+            await asyncio.sleep(0.005)
+        drainer = asyncio.ensure_future(gw.drain(timeout=10.0))
+        await asyncio.sleep(0.01)
+        # the gate is down for NEW work
+        status, body, headers = await loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[9]]},
+        )
+        assert status == 503 and b"draining" in body.encode()
+        assert {k.lower(): v for k, v in headers.items()}["retry-after"]
+        hstatus, _, _ = await loop.run_in_executor(
+            None, _get, gw.port, "/health"
+        )
+        assert hstatus == 503
+        # but everything already accepted lands
+        for fut in inflight:
+            status, _, _ = await fut
+            assert status == 200
+        assert await drainer is True
+        assert gw.admission.inflight == 0 and gw.admission.depth == 0
+        await gw.stop()
+        await server.stop()
+
+    run(scenario(), timeout=60)
+
+
+# -- autoscaler decisions (fake launcher, manual clock) ------------------
+
+
+class _FakeLauncher:
+    def __init__(self, n):
+        self._next = n
+        self._ids = [f"r{i}" for i in range(n)]
+        self.launches = 0
+        self.retired = []
+
+    def ids(self):
+        return list(self._ids)
+
+    def count(self):
+        return len(self._ids)
+
+    async def launch(self):
+        rid = f"r{self._next}"
+        self._next += 1
+        self._ids.append(rid)
+        self.launches += 1
+        return rid
+
+    async def retire(self, rid):
+        self._ids.remove(rid)
+        self.retired.append(rid)
+
+
+def test_autoscaler_scale_up_needs_sustained_pressure_then_cools(run):
+    async def scenario():
+        launcher = _FakeLauncher(1)
+        load = {"value": FleetLoad(queue_depth=6, per_replica={"r0": 2})}
+        scaler = Autoscaler(
+            launcher, lambda: load["value"],
+            AutoscalerConfig(
+                min_replicas=1, max_replicas=3, slots_per_replica=2,
+                up_sustain_s=0.3, cooldown_s=100.0,
+            ),
+        )
+        await scaler.tick(now=0.0)
+        assert launcher.launches == 0  # pressure seen, not sustained
+        await scaler.tick(now=0.1)
+        assert launcher.launches == 0
+        await scaler.tick(now=0.4)
+        assert launcher.launches == 1 and launcher.count() == 2
+        # still hot, but the cooldown holds a second launch
+        await scaler.tick(now=0.8)
+        await scaler.tick(now=1.5)
+        assert launcher.launches == 1
+        assert scaler.scale_ups == 1
+
+    run(scenario(), timeout=30)
+
+
+def test_autoscaler_scales_down_least_loaded_to_min(run):
+    async def scenario():
+        launcher = _FakeLauncher(3)
+        load = {
+            "value": FleetLoad(
+                queue_depth=0,
+                per_replica={"r0": 0.2, "r1": 0.0, "r2": 0.4},
+            )
+        }
+        scaler = Autoscaler(
+            launcher, lambda: load["value"],
+            AutoscalerConfig(
+                min_replicas=1, max_replicas=3, slots_per_replica=2,
+                down_sustain_s=0.5, cooldown_s=0.0,
+            ),
+        )
+        await scaler.tick(now=0.0)
+        assert launcher.retired == []  # idle seen, not yet sustained
+        await scaler.tick(now=0.6)
+        assert launcher.retired == ["r1"]  # the idle one goes first
+        # the sustain window restarts after each event
+        await scaler.tick(now=1.3)
+        assert launcher.retired == ["r1"]
+        await scaler.tick(now=1.9)
+        assert launcher.retired == ["r1", "r0"]
+        # at min: idle forever changes nothing
+        await scaler.tick(now=5.0)
+        await scaler.tick(now=9.0)
+        await scaler.tick(now=9.6)
+        assert launcher.count() == 1 and scaler.scale_downs == 2
+
+    run(scenario(), timeout=30)
+
+
+def test_autoscaler_repairs_below_min_immediately(run):
+    async def scenario():
+        launcher = _FakeLauncher(1)
+        scaler = Autoscaler(
+            launcher,
+            lambda: FleetLoad(queue_depth=0, per_replica={}),
+            AutoscalerConfig(
+                min_replicas=2, max_replicas=4, cooldown_s=0.0
+            ),
+        )
+        # no pressure at all — min is an invariant, not a suggestion
+        await scaler.tick(now=0.0)
+        assert launcher.count() == 2 and scaler.scale_ups == 1
+
+    run(scenario(), timeout=30)
+
+
+def test_autoscaler_flapping_signal_causes_no_thrash(run):
+    """A signal bouncing between hot and mid-band every tick (the
+    shape a flapping catalog or bursty scrape produces) never sustains
+    past the window, so the fleet size never moves."""
+
+    async def scenario():
+        launcher = _FakeLauncher(2)
+        hot = FleetLoad(queue_depth=8, per_replica={"r0": 2, "r1": 2})
+        mid = FleetLoad(queue_depth=0, per_replica={"r0": 1, "r1": 1})
+        flip = {"n": 0}
+
+        def signals():
+            flip["n"] += 1
+            return hot if flip["n"] % 2 else mid
+
+        scaler = Autoscaler(
+            launcher, signals,
+            AutoscalerConfig(
+                min_replicas=1, max_replicas=4, slots_per_replica=2,
+                up_sustain_s=0.5, down_sustain_s=0.5, cooldown_s=0.1,
+            ),
+        )
+        for i in range(20):
+            await scaler.tick(now=i * 0.2)
+        assert launcher.launches == 0 and launcher.retired == []
+
+    run(scenario(), timeout=30)

@@ -438,6 +438,147 @@ def test_member_advertises_override_port(run, tmp_path):
     assert [i.port for i in instances] == [8888]
 
 
+# -- shed accounting + Retry-After honoring -----------------------------
+
+
+def test_slo_scorer_counts_sheds_apart_from_failures():
+    """A 429/504 shed with Retry-After is the overload design working:
+    never good, never a 5xx failure, excluded from the triage ledger,
+    and goodput-over-admitted ignores it."""
+    slo = SLO(ttft_s=0.5, tpot_s=0.1)
+    records = [
+        RequestRecord(0, "s0", 0.0, 0.3, status=200, ttft_s=0.1,
+                      tokens_out=4),
+        RequestRecord(1, "s1", 0.0, 0.1, status=429, shed=True,
+                      retry_after_quoted=True),
+        RequestRecord(2, "s2", 0.0, 0.1, status=504, shed=True,
+                      retry_after_quoted=True, client_retries=1),
+        # a REAL 5xx still counts as failure
+        RequestRecord(3, "s3", 0.0, 0.1, status=503),
+        # a 503 politely retried into a 200 was still SEEN: counted
+        RequestRecord(4, "s4", 0.0, 0.3, status=200, ttft_s=0.1,
+                      tokens_out=4, saw_5xx=True, client_retries=1),
+    ]
+    score = ScenarioScore(records, wall_s=1.0, slo=slo).as_dict()
+    assert score["sheds"] == 2
+    assert score["shed_429"] == 1 and score["shed_504"] == 1
+    # the 503 and the retried-away 503 — never the shed 504
+    assert score["count_5xx"] == 2
+    assert score["goodput_fraction"] == 0.4  # 2 good of 5
+    # first-contact admissions = the clean 200 and the 503 (no shed,
+    # no client retry); the retried record is accounted elsewhere
+    assert score["goodput_fraction_admitted"] == 0.5
+    assert score["client_retries"] == 2
+    assert {f["index"] for f in score["failures"]} == {3}
+    # shed answers' millisecond TTFTs stay out of the percentiles
+    shedded = ScenarioScore(
+        [
+            RequestRecord(0, "s", 0.0, 1.0, status=200, ttft_s=0.5,
+                          tokens_out=2),
+            RequestRecord(1, "s", 0.0, 0.002, status=429, shed=True,
+                          retry_after_quoted=True, ttft_s=0.001),
+        ],
+        wall_s=1.0, slo=slo,
+    ).as_dict()
+    assert shedded["ttft_ms"]["p50"] == 500.0
+    json.dumps(score)
+
+
+def test_client_honors_retry_after_then_succeeds(run):
+    """A shed answer with Retry-After is retried after a jittered
+    fraction of the quoted delay (never immediately: retry storms must
+    desynchronize), and the eventual 200 is recorded with the retry
+    count."""
+    import time as time_mod
+
+    from containerpilot_tpu.chaos.client import issue_request
+    from containerpilot_tpu.chaos.trace import TraceRequest
+
+    async def scenario():
+        hits = []
+        server = HTTPServer()
+
+        async def handler(_req):
+            hits.append(time_mod.monotonic())
+            if len(hits) == 1:
+                return Response(
+                    429, b"shed\n", headers={"Retry-After": "1"}
+                )
+            return Response(
+                200, b'{"tokens": [[1, 2]]}',
+                content_type="application/json",
+            )
+
+        server.route("POST", "/v1/generate", handler)
+        await server.start_tcp("127.0.0.1", 0)
+        req = TraceRequest(
+            index=0, at_s=0.0, session_id="s", tenant=0,
+            tokens=[1, 2], max_new_tokens=2, seed=123,
+        )
+        record = await issue_request(
+            server.bound_port, req, time_mod.monotonic()
+        )
+        await server.stop()
+        assert record.status == 200 and not record.shed
+        assert record.client_retries == 1
+        assert record.tokens_out == 2
+        # equal jitter on a 1s hint: the re-send waits [0.5, 1.0]s
+        assert len(hits) == 2
+        assert 0.4 <= hits[1] - hits[0] <= 1.5
+
+    run(scenario(), timeout=60)
+
+
+def test_client_marks_final_shed_and_never_retries_504(run):
+    """A 504 (deadline already blown) is never re-sent; with
+    Retry-After quoted it lands as a shed, not a failure."""
+    import time as time_mod
+
+    from containerpilot_tpu.chaos.client import issue_request
+    from containerpilot_tpu.chaos.trace import TraceRequest
+
+    async def scenario():
+        hits = [0]
+        server = HTTPServer()
+
+        async def handler(_req):
+            hits[0] += 1
+            return Response(
+                504, b"deadline\n", headers={"Retry-After": "2"}
+            )
+
+        server.route("POST", "/v1/generate", handler)
+        await server.start_tcp("127.0.0.1", 0)
+        req = TraceRequest(
+            index=0, at_s=0.0, session_id="s", tenant=0,
+            tokens=[1], max_new_tokens=1, seed=7,
+        )
+        record = await issue_request(
+            server.bound_port, req, time_mod.monotonic()
+        )
+        await server.stop()
+        assert record.status == 504
+        assert record.shed and record.client_retries == 0
+        assert hits[0] == 1
+
+    run(scenario(), timeout=60)
+
+
+def test_trace_batch_priority_is_seeded_and_optional():
+    cfg = TraceConfig(seed=4, batch_fraction=0.4)
+    requests = generate_trace(cfg)
+    batch = [r for r in requests if r.priority == "batch"]
+    assert 0 < len(batch) < len(requests)
+    assert trace_summary(requests)["batch"] == len(batch)
+    # batch_fraction=0 draws nothing: pre-existing traces replay
+    # byte-identically seed-for-seed
+    plain = generate_trace(TraceConfig(seed=4))
+    assert all(r.priority == "interactive" for r in plain)
+    assert [r.tokens for r in plain] == [
+        r.tokens for r in generate_trace(TraceConfig(seed=4))
+    ]
+
+
 # -- the quick scenarios: a real fleet under fire (tier-1) --------------
 
 
@@ -505,6 +646,44 @@ def test_scenario_slow_replica_hedging_bounds_p99(tmp_path):
         >= spec.min_goodput_fraction
     )
     assert report["score"]["ttft_ms"]["p99"] <= spec.max_ttft_p99_ms
+
+
+def test_scenario_burst_10x_sheds_honestly(tmp_path):
+    """The overload invariant: a 10x burst over a browned-out fleet
+    yields ZERO client-visible 5xx — every refusal is a 429/504 shed
+    carrying a drain-rate-derived Retry-After — and the work the
+    fleet admitted still meets its SLOs."""
+    report = _run_scenario_checked("burst_10x", tmp_path)
+    score = report["score"]
+    assert score["sheds"] >= 1
+    assert score["goodput_fraction_admitted"] >= 0.8
+    admission = report["gateway"]["admission"]
+    assert admission["shed_overload"] + admission["deadline_expired"] >= 1
+    # clients honored Retry-After instead of hammering
+    assert score["client_retries"] >= 1
+
+
+def test_scenario_kill_under_burst_autoscaled(tmp_path):
+    """The capacity loop under fire: a replica dies inside the burst
+    (autoscaler repairs the min), pressure launches a replica that
+    registers AND takes traffic, the idle tail drains back to min,
+    and injected catalog flaps cause no scale thrash."""
+    report = _run_scenario_checked(
+        "kill_under_burst_autoscaled", tmp_path
+    )
+    scaler = report["autoscaler"]
+    assert scaler["scale_ups"] >= 1
+    assert scaler["scale_downs"] >= 1
+    assert scaler["replicas"] == scaler["min_replicas"] == 2
+    assert scaler["scale_ups"] + scaler["scale_downs"] <= 8
+    # a launched replica (index past the boot set) was routed to
+    routed = report["gateway"]["routed"]
+    assert any(
+        count > 0
+        for rid, count in routed.items()
+        if int(rid.rsplit("-", 1)[1]) >= 2
+    )
+    assert report["gateway"]["catalog_flaps_damped"] >= 1
 
 
 # -- the compound marathons (make chaos) --------------------------------
