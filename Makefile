@@ -54,8 +54,9 @@ chaos:
 bench:
 	$(PYTHON) bench.py
 
-# the gateway hop's pooled-vs-per-dial cost on this box (host-side
-# number; the CPU backend is representative)
+# the gateway hop's mux-vs-pooled-vs-per-dial cost on this box, plus
+# the concurrency-per-socket probe (host-side number; the CPU backend
+# is representative)
 bench-gateway:
 	JAX_PLATFORMS=cpu $(PYTHON) -c "import json, bench; \
 		print(json.dumps(bench.gateway_overhead_bench(), indent=2))"

@@ -364,6 +364,12 @@ class ScenarioSpec:
     expect_scale_down_min: int = 0
     #: thrash bound: scale_ups + scale_downs must stay under this
     max_scale_events: Optional[int] = None
+    # -- mux transport invariants ------------------------------------
+    #: abandoned/cancelled streams must become CANCEL frames (stream
+    #: id freed, shared connection kept), not connection teardowns
+    expect_mux_cancels_min: int = 0
+    #: closes where the HTTP/1.1 path would have burned a connection
+    expect_conns_saved_min: int = 0
     #: a replica launched mid-run (index >= the boot count) must have
     #: been registered and routed to
     expect_scaled_replica_routed: bool = False
@@ -460,6 +466,11 @@ async def run_scenario_async(
             "admission": gw.admission.stats(),
             "routed": _counter_by_label(
                 gw._m_routed, "replica"  # noqa: SLF001
+            ),
+            "mux_streams": _counter_total(gw._m_mux_streams),  # noqa: SLF001
+            "mux_cancels": _counter_total(gw._m_mux_cancels),  # noqa: SLF001
+            "conns_saved_by_mux": _counter_total(
+                gw._m_conns_saved  # noqa: SLF001
             ),
             "proxy_resets": sum(
                 p.resets_injected
@@ -577,6 +588,23 @@ async def run_scenario_async(
             events <= spec.max_scale_events,
             f"{events} scale events (thrash bound "
             f"{spec.max_scale_events})",
+        )
+    if spec.expect_mux_cancels_min > 0:
+        check(
+            "mux_cancels",
+            gateway_stats["mux_cancels"] >= spec.expect_mux_cancels_min,
+            f"{gateway_stats['mux_cancels']:.0f} CANCEL frames "
+            f"(expected >= {spec.expect_mux_cancels_min}; an abandoned "
+            f"stream must free its stream id, not its connection)",
+        )
+    if spec.expect_conns_saved_min > 0:
+        check(
+            "conns_saved_by_mux",
+            gateway_stats["conns_saved_by_mux"]
+            >= spec.expect_conns_saved_min,
+            f"{gateway_stats['conns_saved_by_mux']:.0f} connection "
+            f"teardowns avoided (expected >= "
+            f"{spec.expect_conns_saved_min})",
         )
     if spec.expect_scaled_replica_routed:
         launched = {
@@ -718,6 +746,29 @@ _register(ScenarioSpec(
 ))
 
 _register(ScenarioSpec(
+    name="abandoned_streams_mux",
+    description=(
+        "SSE-heavy trace where most clients hang up mid-stream, all "
+        "over the mux transport: every abandon becomes a CANCEL "
+        "frame that frees its stream id while the replicas' shared "
+        "connections keep serving the co-resident streams — zero "
+        "client-visible 5xx, no connection teardowns"
+    ),
+    trace=_trace(
+        duration_s=2.5, mean_rps=12.0,
+        stream_fraction=0.7, abandon_fraction=0.6,
+        # long outputs so streams span many decode rounds: an abandon
+        # after 1-2 SSE events must land MID-stream (a stream that
+        # already ended has nothing to CANCEL), warm caches included
+        output_median=24, output_sigma=0.3, max_output=32,
+    ),
+    replicas=2,
+    min_goodput_fraction=0.85,
+    expect_mux_cancels_min=1,
+    expect_conns_saved_min=3,
+))
+
+_register(ScenarioSpec(
     name="lossy_transport",
     description=(
         "the gateway->replica transport turns lossy (RST after a "
@@ -768,7 +819,9 @@ _register(ScenarioSpec(
         "for batch past high-water, 504 at the TTFT deadline, both "
         "with drain-rate-derived Retry-After the clients honor with "
         "jitter) — zero client-visible 5xx, and the work the fleet "
-        "DID admit still meets its SLOs"
+        "DID admit still meets its SLOs — and since PR 8 the whole "
+        "burst rides the mux transport (interleaved streams on one "
+        "warm connection per replica)"
     ),
     # the injected per-request service floor stands in for a
     # production-sized model's decode time: the lab model answers in

@@ -24,10 +24,20 @@ supervisor Watch actors use) and proxies the inference API over them:
 - **Streaming**: SSE responses (``"stream": true``) relay chunk-by-
   chunk; retries apply only BEFORE the first upstream byte, never
   mid-stream.
-- **Connection pooling**: every buffered hop reuses a bounded LIFO
-  pool of keep-alive connections per replica (pool.py) instead of
-  dialing per request; pooled connections are evicted when a replica
-  leaves the healthy set or fails a request, a stale pooled
+- **Multiplexed transport**: with ``mux=True`` (default) each
+  replica's traffic — buffered and SSE alike — rides interleaved
+  cp-mux/1 streams on ONE warm upgraded connection (pool.py's
+  MuxConnection over utils/http's frame codec), so in-flight
+  concurrency per replica stops being bounded by socket count, a
+  hedge loser or abandoned client costs a CANCEL frame instead of a
+  connection teardown (``mux_cancels`` / ``conns_saved_by_mux``
+  counters), and a slow SSE consumer stalls only its own stream's
+  window. Replicas that decline the upgrade fall back per-replica to
+  the classic pooled path below, negotiated transparently.
+- **Connection pooling**: buffered hops to non-mux replicas reuse a
+  bounded LIFO pool of keep-alive connections per replica (pool.py)
+  instead of dialing per request; pooled connections are evicted when
+  a replica leaves the healthy set or fails a request, a stale pooled
   connection gets ONE transparent redial, and hedged/retried legs
   always take distinct connections.
 - **Metrics**: per-replica counters (routed, retried, hedged,
@@ -71,8 +81,11 @@ from .admission import (
 )
 from .pool import (
     ConnectionPool,
+    MuxStream,
+    MuxStreamError,
     PooledConnection,
     StaleConnection,
+    StaleMuxConnection,
     UpstreamError,
 )
 
@@ -286,6 +299,7 @@ class FleetGateway:
         pool_max_idle: int = 8,
         pool_idle_ttl: float = 30.0,
         pool_max_uses: int = 1000,
+        mux: bool = True,
         admission: Optional[Dict[str, Any]] = None,
     ) -> None:
         if affinity not in AFFINITY_MODES:
@@ -326,12 +340,14 @@ class FleetGateway:
         self.connect_timeout = connect_timeout
         self.request_timeout = request_timeout
 
+        self.mux = mux
         self._replicas: Dict[str, Replica] = {}
         self._pool = ConnectionPool(
             max_idle=pool_max_idle,
             idle_ttl=pool_idle_ttl,
             max_uses=pool_max_uses,
             on_event=self._pool_event,
+            mux=mux,
         )
         # admission control in front of routing: bounded queue,
         # deadlines, priorities, token buckets, shedding. The default
@@ -416,6 +432,26 @@ class FleetGateway:
             "containerpilot_gateway_pool_evicted",
             "pooled connections dropped (replica left the healthy "
             "set, failed a request, or the connection went stale)",
+            ["replica"], registry=self._registry,
+        )
+        self._m_mux_streams = Counter(
+            "containerpilot_gateway_mux_streams",
+            "proxied requests carried as cp-mux streams on a shared "
+            "upgraded connection",
+            ["replica"], registry=self._registry,
+        )
+        self._m_mux_cancels = Counter(
+            "containerpilot_gateway_mux_cancels",
+            "streams aborted with a CANCEL frame (hedge losers, "
+            "abandoned clients, per-stream deadlines) with the shared "
+            "connection left in service",
+            ["replica"], registry=self._registry,
+        )
+        self._m_conns_saved = Counter(
+            "containerpilot_gateway_conns_saved_by_mux",
+            "upstream connections kept alive where the HTTP/1.1 path "
+            "would have discarded one (cancelled legs, completed "
+            "close-delimited streams)",
             ["replica"], registry=self._registry,
         )
         self._m_admitted = Counter(
@@ -761,6 +797,7 @@ class FleetGateway:
                     "max_idle": self._pool.max_idle,
                     "idle_ttl_s": self._pool.idle_ttl,
                     "max_uses": self._pool.max_uses,
+                    "mux": self._pool.mux,
                 },
                 "replicas": [
                     {
@@ -773,6 +810,7 @@ class FleetGateway:
                             time.monotonic() - r.first_seen, 1
                         ),
                         "pool": self._pool.stats(r.id),
+                        "mux": self._pool.mux_stats(r.id),
                     }
                     for r in sorted(
                         self._replicas.values(), key=lambda r: r.id
@@ -999,6 +1037,120 @@ class FleetGateway:
                 raise
             return conn, status, headers
 
+    async def _mux_request(
+        self, replica: Replica, method: str, path: str, body: bytes
+    ) -> Optional[MuxStream]:
+        """Open one cp-mux stream to ``replica``; None means the
+        replica doesn't speak mux (or mux is off) and the caller
+        takes the classic pooled path. A warm shared connection that
+        died between the acquire and this stream's send is redialed
+        ONCE, mirroring the classic stale-conn discipline; the loop
+        is bounded because a freshly dialed connection never raises
+        StaleMuxConnection."""
+        while True:
+            try:
+                mux = await self._pool.acquire_mux(
+                    replica, self.connect_timeout
+                )
+            except UpstreamError:
+                self._evict_replica_pool(replica.id)
+                raise
+            if mux is None:
+                return None
+            try:
+                stream = await mux.open_stream(method, path, body)
+            except StaleMuxConnection as exc:
+                log.debug(
+                    "gateway: redialing stale mux connection: %s", exc
+                )
+                continue
+            except UpstreamError:
+                self._evict_replica_pool(replica.id)
+                raise
+            self._m_mux_streams.labels(replica.id).inc()
+            return stream
+
+    def _cancel_stream(self, replica: Replica, stream: MuxStream) -> None:
+        """Abort one stream with a CANCEL frame — the mux replacement
+        for discarding a connection mid-request (hedge losers,
+        abandoned clients, per-stream deadlines)."""
+        if stream.cancel():
+            self._m_mux_cancels.labels(replica.id).inc()
+            self._m_conns_saved.labels(replica.id).inc()
+
+    async def _mux_open_with_head(
+        self, replica: Replica, method: str, path: str, body: bytes
+    ) -> Optional[Tuple[MuxStream, int, Dict[str, str]]]:
+        """Open a mux stream and await its response head, absorbing
+        ONE stale-connection redial: a warm shared connection the
+        replica reaped while idle fails the stream with zero response
+        bytes (StaleMuxConnection), and resending on a fresh
+        connection is as safe as the classic pooled redial — no
+        routing retry is consumed. Error semantics otherwise follow
+        the stream/connection split: a per-stream failure
+        (MuxStreamError) CANCELs only this stream; a connection-level
+        failure already failed every in-flight stream exactly once,
+        so the eviction here is idempotent bookkeeping. None means
+        the replica doesn't speak mux."""
+        stream = await self._mux_request(replica, method, path, body)
+        if stream is None:
+            return None
+        for retry in (True, False):
+            try:
+                status, headers = await stream.response_head(
+                    self.request_timeout
+                )
+                return stream, status, headers
+            except StaleMuxConnection as exc:
+                self._evict_replica_pool(replica.id)
+                if not retry:
+                    raise
+                log.debug(
+                    "gateway: redialing stale mux connection: %s", exc
+                )
+                stream = await self._mux_request(
+                    replica, method, path, body
+                )
+                if stream is None:
+                    raise UpstreamError(str(exc)) from None
+            except MuxStreamError:
+                self._cancel_stream(replica, stream)
+                raise
+            except UpstreamError:
+                self._evict_replica_pool(replica.id)
+                raise
+            except BaseException:
+                # CancelledError (a losing hedge leg / teardown): the
+                # CANCEL frame replaces the old connection discard
+                self._cancel_stream(replica, stream)
+                raise
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    async def _mux_fetch_buffered(
+        self, replica: Replica, method: str, path: str, body: bytes
+    ) -> Optional[Tuple[int, Dict[str, str], bytes]]:
+        """One buffered exchange over a mux stream (None: no mux)."""
+        opened = await self._mux_open_with_head(
+            replica, method, path, body
+        )
+        if opened is None:
+            return None
+        stream, status, headers = opened
+        try:
+            payload = await stream.read_body(
+                self.request_timeout, MAX_UPSTREAM_BODY
+            )
+        except MuxStreamError:
+            self._cancel_stream(replica, stream)
+            raise
+        except UpstreamError:
+            self._evict_replica_pool(replica.id)
+            raise
+        except BaseException:
+            self._cancel_stream(replica, stream)
+            raise
+        return status, headers, payload
+
     async def _fetch_from(
         self,
         endpoint: str,
@@ -1008,33 +1160,41 @@ class FleetGateway:
         body: bytes,
     ) -> Tuple[int, Dict[str, str], bytes]:
         """One buffered round trip to one replica, with routing
-        accounting. Raises UpstreamError on transport failure. The
-        connection returns to the pool only after the body was fully
-        read on an intact, length-framed exchange."""
+        accounting. Raises UpstreamError on transport failure.
+        Prefers a mux stream on the replica's shared connection; on
+        the classic path the connection returns to the pool only
+        after the body was fully read on an intact, length-framed
+        exchange."""
         self._m_routed.labels(replica.id).inc()
         replica.outstanding += 1
         t0 = time.perf_counter()
         try:
-            conn, status, headers = await self._upstream_request(
+            fetched = await self._mux_fetch_buffered(
                 replica, method, path, body
             )
-            try:
-                payload = await _read_body(
-                    conn.reader, headers, self.request_timeout
-                )
-            except UpstreamError:
-                self._pool.discard(conn)
-                self._evict_replica_pool(replica.id)
-                raise
-            except BaseException:
-                # a cancelled leg may leave unread response bytes —
-                # that connection must never serve another request
-                self._pool.discard(conn)
-                raise
-            if _reusable(headers):
-                self._pool.release(conn)
+            if fetched is not None:
+                status, headers, payload = fetched
             else:
-                self._pool.discard(conn)
+                conn, status, headers = await self._upstream_request(
+                    replica, method, path, body
+                )
+                try:
+                    payload = await _read_body(
+                        conn.reader, headers, self.request_timeout
+                    )
+                except UpstreamError:
+                    self._pool.discard(conn)
+                    self._evict_replica_pool(replica.id)
+                    raise
+                except BaseException:
+                    # a cancelled leg may leave unread response bytes —
+                    # that connection must never serve another request
+                    self._pool.discard(conn)
+                    raise
+                if _reusable(headers):
+                    self._pool.release(conn)
+                else:
+                    self._pool.discard(conn)
         finally:
             replica.outstanding -= 1
         if status == 200:
@@ -1219,6 +1379,63 @@ class FleetGateway:
             held = True
             try:
                 try:
+                    opened = await self._mux_open_with_head(
+                        replica, "POST", path, body
+                    )
+                except UpstreamError as exc:
+                    log.warning(
+                        "gateway: %s stream failed: %s", endpoint, exc
+                    )
+                    last = self._failure_response(exc)
+                    backoff = await self._retry_pause(
+                        tried, {replica.id}, attempt, backoff
+                    )
+                    continue
+                if opened is not None:
+                    # mux: this SSE relay is one stream among many on
+                    # the replica's shared connection — it no longer
+                    # pins a socket for its lifetime, and a client
+                    # that hangs up costs a CANCEL frame
+                    stream, status, headers = opened
+                    if "text/event-stream" not in headers.get(
+                        "content-type", ""
+                    ):
+                        # not a stream: an error body — buffer, relay,
+                        # retry the retryable statuses
+                        try:
+                            payload = await stream.read_body(
+                                self.request_timeout, MAX_UPSTREAM_BODY
+                            )
+                        except UpstreamError as exc:
+                            if isinstance(exc, MuxStreamError):
+                                self._cancel_stream(replica, stream)
+                            else:
+                                self._evict_replica_pool(replica.id)
+                            log.warning(
+                                "gateway: %s body read failed: %s",
+                                endpoint, exc,
+                            )
+                            last = self._failure_response(exc)
+                            backoff = await self._retry_pause(
+                                tried, {replica.id}, attempt, backoff
+                            )
+                            continue
+                        except BaseException:
+                            self._cancel_stream(replica, stream)
+                            raise
+                        if (
+                            status in RETRYABLE_STATUSES
+                            and attempt < self.retries
+                        ):
+                            last = self._relay(status, headers, payload)
+                            backoff = await self._retry_pause(
+                                tried, {replica.id}, attempt, backoff
+                            )
+                            continue
+                        return self._relay(status, headers, payload)
+                    held = False  # ownership moves to the relay
+                    return self._relay_mux_stream(replica, stream, status)
+                try:
                     conn, status, headers = await self._upstream_request(
                         replica, "POST", path, body
                     )
@@ -1328,6 +1545,63 @@ class FleetGateway:
         resp.upstream_intact = intact  # type: ignore[attr-defined]
         return resp
 
+    def _relay_mux_stream(
+        self,
+        replica: Replica,
+        stream: MuxStream,
+        status: int,
+    ) -> StreamingResponse:
+        """Relay an upstream SSE stream carried as a mux stream. The
+        caller's outstanding count transfers here and is released by
+        close(). Where the HTTP/1.1 relay discarded its (close-
+        delimited) connection on every close, this one frees only the
+        stream: an abandoned client turns into a CANCEL frame and the
+        shared connection keeps serving its co-resident streams —
+        both paths count into conns_saved_by_mux."""
+        closed = [False]
+        intact = {"ok": True}
+
+        def close() -> None:
+            # idempotent: generator-finally AND the response's close
+            # callback both fire on some paths
+            if closed[0]:
+                return
+            closed[0] = True
+            replica.outstanding -= 1
+            if stream.cancel():
+                # the downstream client abandoned mid-stream: CANCEL
+                # frees the stream id upstream, nothing is torn down
+                self._m_mux_cancels.labels(replica.id).inc()
+                self._m_conns_saved.labels(replica.id).inc()
+            elif intact["ok"]:
+                # completed cleanly: the close-delimited HTTP/1.1
+                # relay would have burned this connection instead
+                self._m_conns_saved.labels(replica.id).inc()
+
+        async def chunks():
+            try:
+                while True:
+                    chunk = await stream.read_chunk(self.request_timeout)
+                    if not chunk:
+                        return
+                    yield chunk
+            except MuxStreamError:
+                # this stream died (deadline, server-side abort); the
+                # connection is fine — downstream sees EOF
+                intact["ok"] = False
+                return
+            except UpstreamError:
+                # the shared connection died mid-relay
+                intact["ok"] = False
+                self._evict_replica_pool(replica.id)
+                return
+            finally:
+                close()
+
+        resp = StreamingResponse(chunks(), status=status, close=close)
+        resp.upstream_intact = intact  # type: ignore[attr-defined]
+        return resp
+
 
 def main() -> int:
     """Run a standalone gateway:
@@ -1385,6 +1659,13 @@ def main() -> int:
         help="shorthand for --pool-max-idle 0",
     )
     parser.add_argument(
+        "--mux", default=True, action=argparse.BooleanOptionalAction,
+        help="carry replica traffic as interleaved cp-mux/1 streams "
+        "on one warm connection per replica (--no-mux forces the "
+        "classic one-request-per-connection pooled path; replicas "
+        "that decline the upgrade fall back per-replica either way)",
+    )
+    parser.add_argument(
         "--admission-queue-depth", type=int, default=256,
         help="bounded admission queue in front of routing; a full "
         "queue sheds new work with 429 + Retry-After",
@@ -1434,6 +1715,7 @@ def main() -> int:
         hedge=not args.no_hedge, hedge_after_ms=args.hedge_after_ms,
         pool_max_idle=0 if args.no_pool else args.pool_max_idle,
         pool_idle_ttl=args.pool_idle_ttl,
+        mux=args.mux,
         admission=dict(
             max_queue_depth=args.admission_queue_depth,
             high_water=args.admission_high_water,

@@ -21,17 +21,97 @@ Connection contract:
   ``Connection: close`` and no Content-Length, the closing connection
   ends the stream.
 - No chunked encoding; bodies need Content-Length.
+- **cp-mux/1 multiplexing is negotiated, never assumed**: a client
+  that sends ``Connection: Upgrade`` + ``Upgrade: cp-mux/1`` on a
+  request switches the connection to the framed, multiplexed protocol
+  below (many concurrent requests — streams included — interleaved on
+  one socket). A client that never sends the upgrade gets the exact
+  HTTP/1.1 byte stream it always got, and a server with
+  ``mux_enabled=False`` answers the upgrade request through the
+  normal route table (404), leaving the connection usable as plain
+  keep-alive — which is precisely the client's fallback signal.
+
+cp-mux/1 wire format (one frame)::
+
+    u32 payload_length | u8 type | u32 stream_id | payload
+
+Types: HEADERS (1, JSON request/response head), DATA (2, body
+bytes), END (3, closes that direction of the stream), CANCEL (4,
+abort the stream, either side), PING (5) / PONG (6, liveness, stream
+id echoed), WINDOW (7, u32 flow-control credit). Response DATA is
+window-gated per stream (``MUX_INITIAL_WINDOW`` bytes of credit,
+refilled by WINDOW frames as the consumer drains), so one slow SSE
+consumer stalls only its own stream while co-resident streams keep
+interleaving. Request bodies are small and bounded by ``MAX_BODY``
+instead of windowed. Framing violations (unknown type, oversized
+frame, HEADERS for a live stream id, malformed HEADERS JSON) close
+the whole connection: its framing can no longer be trusted, exactly
+like a 400 on the HTTP/1.1 path.
 """
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
-from typing import Awaitable, Callable, Dict, Optional, Set, Tuple
+import struct
+from typing import Awaitable, Callable, Dict, List, Optional, Set, Tuple
 from urllib.parse import parse_qs, urlsplit
 
 log = logging.getLogger("containerpilot.http")
 
 MAX_BODY = 4 * 1024 * 1024
+
+# -- cp-mux/1 framed multiplexing ------------------------------------
+
+MUX_PROTOCOL = "cp-mux/1"
+#: path the client's upgrade request targets; unroutable on purpose,
+#: so a mux-less server answers it 404 (the fallback signal) without
+#: ever colliding with a real route
+MUX_UPGRADE_PATH = "/_mux"
+
+FRAME_HEADERS = 1
+FRAME_DATA = 2
+FRAME_END = 3
+FRAME_CANCEL = 4
+FRAME_PING = 5
+FRAME_PONG = 6
+FRAME_WINDOW = 7
+FRAME_TYPES = frozenset((
+    FRAME_HEADERS, FRAME_DATA, FRAME_END, FRAME_CANCEL,
+    FRAME_PING, FRAME_PONG, FRAME_WINDOW,
+))
+
+FRAME_HEAD = struct.Struct(">IBI")  # payload length, type, stream id
+MUX_MAX_FRAME = 1 << 20
+#: per-stream response-DATA credit a receiver starts with
+MUX_INITIAL_WINDOW = 64 * 1024
+#: largest single DATA frame a sender emits (interleaving granularity)
+MUX_CHUNK = 32 * 1024
+#: concurrent streams one connection may carry; the 513th is refused
+#: with a per-stream 503, never a connection error
+MUX_MAX_STREAMS = 512
+
+
+class MuxProtocolError(Exception):
+    """The peer violated cp-mux/1 framing; the connection is dead."""
+
+
+def encode_frame(ftype: int, stream_id: int, payload: bytes = b"") -> bytes:
+    return FRAME_HEAD.pack(len(payload), ftype, stream_id) + payload
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Tuple[int, int, bytes]:
+    """One frame off the wire; raises MuxProtocolError on framing
+    violations and IncompleteReadError on EOF."""
+    length, ftype, stream_id = FRAME_HEAD.unpack(
+        await reader.readexactly(FRAME_HEAD.size)
+    )
+    if ftype not in FRAME_TYPES:
+        raise MuxProtocolError(f"unknown frame type {ftype}")
+    if length > MUX_MAX_FRAME:
+        raise MuxProtocolError(f"{length}-byte frame exceeds cap")
+    payload = await reader.readexactly(length) if length else b""
+    return ftype, stream_id, payload
 
 
 async def timed_read(reader: asyncio.StreamReader, coro, timeout: float):
@@ -144,6 +224,67 @@ class StreamingResponse:
         self.close = close
 
 
+class _MuxServerStream:
+    """Server-side state for one cp-mux stream: the decoded HEADERS,
+    the accumulating request body, the handler task once END arrives,
+    and the response-DATA flow-control window."""
+
+    __slots__ = (
+        "sid", "head", "body", "body_len", "task", "window", "credit",
+    )
+
+    def __init__(self, sid: int, head: Dict) -> None:
+        self.sid = sid
+        self.head = head
+        self.body: List[bytes] = []
+        self.body_len = 0
+        self.task: Optional["asyncio.Task[None]"] = None
+        self.window = MUX_INITIAL_WINDOW
+        self.credit = asyncio.Event()
+
+    def to_request(self):
+        """Build the Request this stream carries, or a Response for
+        content-level errors (bad head shape earns a per-stream 400,
+        not a connection teardown — the framing itself was fine)."""
+        method = self.head.get("method")
+        path = self.head.get("path")
+        if not isinstance(method, str) or not isinstance(path, str):
+            return Response(400, b"malformed mux request head\n")
+        raw_headers = self.head.get("headers")
+        headers: Dict[str, str] = {}
+        if isinstance(raw_headers, dict):
+            headers = {
+                str(k).lower(): str(v) for k, v in raw_headers.items()
+            }
+        parts = urlsplit(path)
+        return Request(
+            method.upper(), parts.path, parse_qs(parts.query), headers,
+            b"".join(self.body),
+        )
+
+
+def _mux_response_head(response) -> bytes:
+    """The JSON HEADERS payload for a Response/StreamingResponse."""
+    headers = {"content-type": response.content_type}
+    for key, value in response.headers.items():
+        headers[key.lower()] = value
+    return json.dumps(
+        {"status": response.status, "headers": headers}
+    ).encode()
+
+
+def _mux_refusal_head() -> bytes:
+    return json.dumps(
+        {
+            "status": 503,
+            "headers": {
+                "content-type": "text/plain; charset=utf-8",
+                "retry-after": "1",
+            },
+        }
+    ).encode()
+
+
 _REASONS = {
     200: "OK",
     400: "Bad Request",
@@ -181,6 +322,12 @@ class HTTPServer:
         # reuse ratio of requests/connections >> 1 means pooling works
         self.connections_accepted = 0
         self.requests_served = 0
+        # cp-mux/1: whether this server accepts the upgrade, and how
+        # many connections/streams took it (mux requests also count
+        # into requests_served — they ARE requests)
+        self.mux_enabled = True
+        self.mux_connections = 0
+        self.mux_streams_served = 0
 
     def route(self, method: str, path: str, handler: Handler) -> None:
         self.routes[(method.upper(), path)] = handler
@@ -244,6 +391,9 @@ class HTTPServer:
     # misbehaving clients)
     KEEPALIVE_IDLE_TIMEOUT = 75.0
     KEEPALIVE_MAX_REQUESTS = 1000
+    # concurrent cp-mux streams one connection may carry; an excess
+    # stream is refused with a per-stream 503, never a conn error
+    MUX_MAX_STREAMS = MUX_MAX_STREAMS
 
     async def _handle(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
@@ -328,6 +478,31 @@ class HTTPServer:
                 return
             served += 1
             self.requests_served += 1
+            if (
+                self.mux_enabled
+                and request.headers.get("upgrade", "").lower()
+                == MUX_PROTOCOL
+                and "upgrade"
+                in request.headers.get("connection", "").lower()
+            ):
+                # negotiated switch to framed multiplexing: everything
+                # after the 101 is cp-mux/1 frames, both directions.
+                # With mux_enabled=False the request instead falls
+                # through to the route table (MUX_UPGRADE_PATH is
+                # unroutable -> 404 keep-alive), which is the
+                # client's signal to stay on plain HTTP/1.1.
+                try:
+                    writer.write(
+                        b"HTTP/1.1 101 Switching Protocols\r\n"
+                        b"Upgrade: " + MUX_PROTOCOL.encode() + b"\r\n"
+                        b"Connection: Upgrade\r\n\r\n"
+                    )
+                    await writer.drain()
+                except (ConnectionError, BrokenPipeError, OSError):
+                    return  # client reset before/under the 101
+                self.mux_connections += 1
+                await self._serve_mux(reader, writer)
+                return
             keep = (
                 request.wants_keepalive()
                 and served < self.KEEPALIVE_MAX_REQUESTS
@@ -347,6 +522,236 @@ class HTTPServer:
                 return  # client went away mid-write
             if not keep:
                 return
+
+    # -- cp-mux/1 accept path -------------------------------------------
+
+    async def _serve_mux(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        """The multiplexed sibling of the keep-alive loop: one read
+        loop demultiplexes frames into per-stream state, each
+        completed request dispatches as its own task, and response
+        writes interleave on the shared socket. Frames are enqueued
+        whole under a writer lock, so concurrent stream tasks can
+        never tear each other's frames; per-stream WINDOW credit gates
+        response DATA, so a stream whose consumer stalls parks only
+        its own task while the others keep writing."""
+        streams: Dict[int, _MuxServerStream] = {}
+        tasks: Set["asyncio.Task[None]"] = set()
+        frames_seen = 0
+
+        # frame writes need no lock: each frame is emitted by ONE
+        # synchronous writer.write() call (built fully before the
+        # write, no await in between), so concurrent stream tasks
+        # interleave at frame granularity by construction — and the
+        # drain afterwards is pure flow control, safe to share. This
+        # also keeps the writer publishing outside any lock
+        # (CP-LOCKPUB's shape: never await subscribers mid-critical-
+        # section).
+        async def send(ftype: int, sid: int, payload: bytes = b"") -> None:
+            writer.write(encode_frame(ftype, sid, payload))
+            await writer.drain()
+
+        async def send_data(stream: "_MuxServerStream", data: bytes) -> None:
+            view = memoryview(data)
+            while view:
+                while stream.window <= 0:
+                    stream.credit.clear()
+                    await stream.credit.wait()
+                n = min(len(view), stream.window, MUX_CHUNK)
+                stream.window -= n
+                await send(FRAME_DATA, stream.sid, bytes(view[:n]))
+                view = view[n:]
+
+        async def send_streaming(
+            stream: "_MuxServerStream", response: StreamingResponse
+        ) -> None:
+            """Relay an async-iterator body as interleaved DATA
+            frames. Mirrors _write_stream's cleanup contract: the
+            generator is aclose()d and the close callback fires
+            however the stream ends (completion, CANCEL, connection
+            death) — a handler's finally still frees what the request
+            holds. A handler that dies mid-iteration CANCELs the
+            stream (the client's error signal), never leaves it
+            dangling without an END."""
+            agen = response.chunks
+            ended = False
+            try:
+                await send(
+                    FRAME_HEADERS, stream.sid,
+                    _mux_response_head(response),
+                )
+                async for chunk in agen:
+                    await send_data(stream, chunk)
+                await send(FRAME_END, stream.sid)
+                ended = True
+            except (ConnectionError, BrokenPipeError, OSError):
+                ended = True  # connection is gone; nothing to CANCEL
+            except Exception:
+                log.exception("mux stream write failed")
+            finally:
+                if not ended:
+                    try:
+                        await send(FRAME_CANCEL, stream.sid)
+                    except (ConnectionError, BrokenPipeError, OSError):
+                        log.debug("mux: CANCEL after failed stream "
+                                  "write found the connection gone")
+                try:
+                    await agen.aclose()
+                except Exception:
+                    log.exception("mux stream close failed")
+                if response.close is not None:
+                    try:
+                        response.close()
+                    except Exception:
+                        log.exception("mux stream close callback failed")
+
+        async def run_stream(stream: "_MuxServerStream") -> None:
+            try:
+                request = stream.to_request()
+                if isinstance(request, Response):
+                    response: Response = request
+                else:
+                    self.requests_served += 1
+                    self.mux_streams_served += 1
+                    try:
+                        response = await self._dispatch(request)
+                    except Exception:
+                        log.exception("mux request handling failed")
+                        response = Response(
+                            500, b"internal server error\n"
+                        )
+                if isinstance(response, StreamingResponse):
+                    await send_streaming(stream, response)
+                    return
+                try:
+                    head = _mux_response_head(response)
+                    body = response.body
+                    if len(body) <= stream.window:
+                        # common case: the whole response fits the
+                        # client's current window — HEADERS+DATA+END
+                        # as ONE write and ONE drain (three separate
+                        # frame sends cost two extra drain cycles on
+                        # the hot path)
+                        stream.window -= len(body)
+                        frames = encode_frame(
+                            FRAME_HEADERS, stream.sid, head
+                        )
+                        if body:
+                            frames += encode_frame(
+                                FRAME_DATA, stream.sid, body
+                            )
+                        frames += encode_frame(FRAME_END, stream.sid)
+                        writer.write(frames)
+                        await writer.drain()
+                    else:
+                        await send(FRAME_HEADERS, stream.sid, head)
+                        await send_data(stream, body)
+                        await send(FRAME_END, stream.sid)
+                except (ConnectionError, BrokenPipeError, OSError):
+                    return  # peer is gone; reader loop unwinds the rest
+            finally:
+                streams.pop(stream.sid, None)
+
+        async def watchdog() -> None:
+            # the mux analog of the keep-alive idle reap: a connection
+            # with no live streams and no frames for a full idle
+            # window is retired; one with in-flight streams is never
+            # reaped, however slow its handlers (handler execution is
+            # deliberately unbounded, as on the HTTP/1.1 path)
+            seen = -1
+            while True:
+                await asyncio.sleep(self.KEEPALIVE_IDLE_TIMEOUT)
+                if not streams and frames_seen == seen:
+                    writer.close()
+                    return
+                seen = frames_seen
+
+        reaper = asyncio.ensure_future(watchdog())
+        try:
+            while True:
+                try:
+                    ftype, sid, payload = await read_frame(reader)
+                except (
+                    asyncio.IncompleteReadError, ConnectionError, OSError,
+                ):
+                    return  # peer went away; tasks unwind in finally
+                except MuxProtocolError as exc:
+                    log.warning("mux: protocol error: %s", exc)
+                    return
+                frames_seen += 1
+                if ftype == FRAME_PING:
+                    await send(FRAME_PONG, sid, payload)
+                elif ftype == FRAME_HEADERS:
+                    if sid == 0 or sid in streams:
+                        log.warning(
+                            "mux: HEADERS for invalid/live stream %d", sid
+                        )
+                        return
+                    try:
+                        head = json.loads(payload.decode())
+                        if not isinstance(head, dict):
+                            raise ValueError("head is not an object")
+                    except (ValueError, UnicodeDecodeError) as exc:
+                        log.warning("mux: malformed HEADERS: %s", exc)
+                        return
+                    if len(streams) >= self.MUX_MAX_STREAMS:
+                        # refuse THIS stream, keep the connection: the
+                        # client sees a retryable 503, its co-resident
+                        # streams see nothing at all
+                        await send(
+                            FRAME_HEADERS, sid,
+                            _mux_refusal_head(),
+                        )
+                        await send(FRAME_END, sid)
+                        continue
+                    streams[sid] = _MuxServerStream(sid, head)
+                elif ftype == FRAME_DATA:
+                    stream = streams.get(sid)
+                    if stream is None or stream.task is not None:
+                        continue  # cancelled/raced: late frames are noise
+                    stream.body_len += len(payload)
+                    if stream.body_len > MAX_BODY:
+                        log.warning("mux: stream %d body exceeds cap", sid)
+                        return
+                    stream.body.append(payload)
+                elif ftype == FRAME_END:
+                    stream = streams.get(sid)
+                    if stream is None or stream.task is not None:
+                        continue
+                    stream.task = asyncio.ensure_future(
+                        run_stream(stream)
+                    )
+                    tasks.add(stream.task)
+                    stream.task.add_done_callback(tasks.discard)
+                elif ftype == FRAME_CANCEL:
+                    stream = streams.pop(sid, None)
+                    if stream is not None and stream.task is not None:
+                        # the handler task's finally (and a streaming
+                        # response's aclose/close) runs its cleanup;
+                        # the stream id is free for reuse immediately
+                        stream.task.cancel()
+                elif ftype == FRAME_WINDOW:
+                    stream = streams.get(sid)
+                    if stream is not None and len(payload) == 4:
+                        stream.window += int.from_bytes(payload, "big")
+                        stream.credit.set()
+                # FRAME_PONG from a client is valid but meaningless here
+        except (ConnectionError, BrokenPipeError, OSError):
+            # a read-loop send (PONG, stream-cap refusal) bounced off
+            # a peer that just reset: same quiet exit as read-side EOF
+            return
+        finally:
+            reaper.cancel()
+            for task in list(tasks):
+                task.cancel()
+            for task in list(tasks):
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                except Exception:
+                    log.exception("mux stream task failed during close")
 
     async def _write_response(
         self,

@@ -100,6 +100,7 @@ class InferenceServer:
         slot_chunk: int = 8,
         cp_mesh: Any = None,
         cp_min_len: int = 0,
+        mux: bool = True,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -249,6 +250,10 @@ class InferenceServer:
             registry=self._metrics_registry,
         )
         self._server = HTTPServer()
+        # cp-mux/1 accept path (the fleet gateway's multiplexed
+        # transport); --no-mux keeps this replica plain HTTP/1.1 and
+        # the gateway falls back per-replica
+        self._server.mux_enabled = mux
         self._server.route("GET", "/health", self._health)
         self._server.route("GET", "/metrics", self._metrics)
         route = self._instrumented

@@ -27,6 +27,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument(
+        "--mux", default=True, action=argparse.BooleanOptionalAction,
+        help="accept cp-mux/1 upgrades (the fleet gateway's "
+        "multiplexed transport); --no-mux keeps this replica plain "
+        "HTTP/1.1 and gateways fall back per-replica",
+    )
     parser.add_argument("--max-len", type=int, default=512)
     parser.add_argument("--d-model", type=int, default=256)
     parser.add_argument("--n-layers", type=int, default=2)
@@ -303,6 +309,7 @@ def main() -> int:
         slots=args.slots, slot_chunk=args.slot_chunk,
         text=args.text,
         cp_mesh=cp_mesh, cp_min_len=getattr(args, "cp_min_len", 0),
+        mux=args.mux,
     )
     member = None
     if getattr(args, "fleet_catalog", ""):

@@ -648,6 +648,21 @@ def test_scenario_slow_replica_hedging_bounds_p99(tmp_path):
     assert report["score"]["ttft_ms"]["p99"] <= spec.max_ttft_p99_ms
 
 
+def test_scenario_abandoned_streams_mux(tmp_path):
+    """Abandoned SSE clients under the mux transport: every abandon
+    becomes a CANCEL frame (stream id freed, shared connection kept),
+    co-resident streams see zero 5xx, and the run records connection
+    teardowns avoided."""
+    report = _run_scenario_checked("abandoned_streams_mux", tmp_path)
+    gw = report["gateway"]
+    assert gw["mux_streams"] >= 1  # the trace actually rode mux
+    assert gw["mux_cancels"] >= 1  # abandons became CANCEL frames
+    assert gw["conns_saved_by_mux"] >= 3
+    assert report["score"]["abandoned_streams"] >= 1
+    # abandons retried nothing: a CANCEL is not a failure
+    assert report["score"]["count_5xx"] == 0
+
+
 def test_scenario_burst_10x_sheds_honestly(tmp_path):
     """The overload invariant: a 10x burst over a browned-out fleet
     yields ZERO client-visible 5xx — every refusal is a 429/504 shed

@@ -9,6 +9,7 @@ FleetGateway on the CPU backend.
 """
 import asyncio
 import json
+import socket
 import time
 import urllib.error
 import urllib.request
@@ -22,7 +23,11 @@ from containerpilot_tpu.discovery import (
 )
 from containerpilot_tpu.fleet import FleetGateway, FleetMember
 from containerpilot_tpu.fleet.gateway import Replica
-from containerpilot_tpu.utils.http import HTTPServer, Response
+from containerpilot_tpu.utils.http import (
+    HTTPServer,
+    Response,
+    StreamingResponse,
+)
 
 
 def _counter(metric, label: str) -> float:
@@ -308,9 +313,12 @@ def test_gateway_pool_reuses_connections_across_requests(run, tmp_path):
         replica.route("POST", "/v1/generate", handler)
         await replica.start_tcp("127.0.0.1", 0)
         _register(backend, "aaa", replica.bound_port)
+        # mux=False: this suite pins the CLASSIC pooled discipline,
+        # which stays the fallback for replicas that decline the
+        # cp-mux upgrade (the mux paths have their own suite)
         gw = FleetGateway(
             backend, "svc", "127.0.0.1", 0, poll_interval=5.0,
-            hedge=False,
+            hedge=False, mux=False,
         )
         await gw.run()
         loop = asyncio.get_event_loop()
@@ -370,7 +378,7 @@ def test_gateway_pool_evicts_on_deregister(run, tmp_path):
         _register(backend, "aaa", replica.bound_port)
         gw = FleetGateway(
             backend, "svc", "127.0.0.1", 0, poll_interval=0.1,
-            hedge=False,
+            hedge=False, mux=False,
         )
         await gw.run()
         loop = asyncio.get_event_loop()
@@ -413,7 +421,7 @@ def test_gateway_pool_redials_stale_connection_transparently(
         _register(backend, "aaa", replica.bound_port)
         gw = FleetGateway(
             backend, "svc", "127.0.0.1", 0, poll_interval=5.0,
-            hedge=False,
+            hedge=False, mux=False,
         )
         await gw.run()
         loop = asyncio.get_event_loop()
@@ -465,6 +473,7 @@ def test_hedge_legs_take_distinct_connections(run, tmp_path):
         gw = FleetGateway(
             backend, "svc", "127.0.0.1", 0,
             poll_interval=5.0, retries=0, hedge_after_ms=80.0,
+            mux=False,
         )
         await gw.run()
         status, text, _ = await asyncio.get_event_loop().run_in_executor(
@@ -554,6 +563,7 @@ def test_replica_dying_after_status_line_is_retried(run, tmp_path):
         gw = FleetGateway(
             backend, "svc", "127.0.0.1", 0,
             poll_interval=5.0, hedge=False, retry_backoff=0.01,
+            mux=False,  # pins the HTTP/1.1 response-parsing path
         )
         await gw.run()
         status, text, _ = await asyncio.get_event_loop().run_in_executor(
@@ -595,6 +605,7 @@ def test_malformed_content_length_is_retried(run, tmp_path):
         gw = FleetGateway(
             backend, "svc", "127.0.0.1", 0,
             poll_interval=5.0, hedge=False, retry_backoff=0.01,
+            mux=False,  # pins the HTTP/1.1 response-parsing path
         )
         await gw.run()
         status, text, _ = await asyncio.get_event_loop().run_in_executor(
@@ -972,6 +983,294 @@ def test_fleet_gateway_drain_mid_traffic_zero_5xx(run, tmp_path):
         'containerpilot_gateway_requests_total'
         '{code="200",endpoint="generate"}'
     ) in metrics[1]
+
+
+# -- mux transport through the gateway (stub replicas, no JAX) ----------
+
+
+def test_mux_hedge_loser_cancelled_not_torn_down(run, tmp_path):
+    """PR 8's headline cancel semantics: the losing hedge leg becomes
+    a CANCEL frame — counter-pinned — and the slow replica's shared
+    connection stays in service for the next request instead of being
+    discarded (pre-mux, every hedge loss burned a pooled conn)."""
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        slow, fast = HTTPServer(), HTTPServer()
+
+        async def handler_slow(_req):
+            await asyncio.sleep(1.0)
+            return Response(200, b'{"who": "slow"}',
+                            content_type="application/json")
+
+        async def handler_fast(_req):
+            return Response(200, b'{"who": "fast"}',
+                            content_type="application/json")
+
+        slow.route("POST", "/v1/generate", handler_slow)
+        fast.route("POST", "/v1/generate", handler_fast)
+        await slow.start_tcp("127.0.0.1", 0)
+        await fast.start_tcp("127.0.0.1", 0)
+        _register(backend, "aaa", slow.bound_port)  # tie -> slow first
+        _register(backend, "bbb", fast.bound_port)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0,
+            poll_interval=5.0, retries=0, hedge_after_ms=80.0,
+        )
+        await gw.run()
+        loop = asyncio.get_event_loop()
+        status, text, _ = await loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+        )
+        cancels = _counter(gw._m_mux_cancels, "aaa")  # noqa: SLF001
+        saved = _counter(gw._m_conns_saved, "aaa")  # noqa: SLF001
+        conns_after_race = slow.connections_accepted
+        # the cancelled leg's connection went BACK to service: a
+        # follow-up request to the slow replica rides the same socket
+        gw._sticky.clear()  # noqa: SLF001
+        backend.service_deregister("bbb")
+        await gw._poll_once()  # noqa: SLF001
+        status2, _text2, _ = await loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+        )
+        conns_after_reuse = slow.connections_accepted
+        await gw.stop()
+        await slow.stop()
+        await fast.stop()
+        return (
+            status, text, cancels, saved,
+            conns_after_race, status2, conns_after_reuse,
+        )
+
+    (status, text, cancels, saved, conns_race, status2, conns_reuse) = (
+        run(scenario(), timeout=60)
+    )
+    assert status == 200 and json.loads(text)["who"] == "fast"
+    assert cancels == 1 and saved == 1  # the loss was a CANCEL frame
+    assert conns_race == 1  # one mux conn carried the losing leg
+    assert status2 == 200
+    assert conns_reuse == 1  # ...and SURVIVED to carry the next request
+
+
+def test_dead_mux_conn_fails_streams_once_each_arming_retry(run, tmp_path):
+    """A mux connection dying with streams in flight fails each
+    exactly once: every request retries to the healthy replica and
+    the dead replica saw each body exactly once — no double-dispatch
+    of a request the server might have started."""
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        doomed, healthy = HTTPServer(), HTTPServer()
+        gate = asyncio.Event()
+        hits = {"doomed": 0, "healthy": 0}
+
+        async def handler_doomed(_req):
+            hits["doomed"] += 1
+            await gate.wait()  # never answers
+            return Response(200, b"{}")
+
+        async def handler_healthy(_req):
+            hits["healthy"] += 1
+            return Response(200, b'{"tokens": [[9]]}',
+                            content_type="application/json")
+
+        doomed.route("POST", "/v1/generate", handler_doomed)
+        healthy.route("POST", "/v1/generate", handler_healthy)
+        await doomed.start_tcp("127.0.0.1", 0)
+        await healthy.start_tcp("127.0.0.1", 0)
+        _register(backend, "aaa", doomed.bound_port)  # tie -> doomed
+        _register(backend, "bbb", healthy.bound_port)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0, poll_interval=5.0,
+            hedge=False, retry_backoff=0.01, affinity="none",
+        )
+        await gw.run()
+        loop = asyncio.get_event_loop()
+        posts = [
+            loop.run_in_executor(
+                None, _post, gw.port, "/v1/generate",
+                {"tokens": [[1]], "i": i},
+            )
+            for i in range(2)
+        ]
+        # both streams in flight on the doomed replica's ONE conn
+        for _ in range(200):
+            if hits["doomed"] == 2:
+                break
+            await asyncio.sleep(0.01)
+        await doomed.abort()  # SIGKILL semantics: RST, flush nothing
+        results = await asyncio.gather(*posts)
+        retried = _counter(gw._m_retried, "aaa")  # noqa: SLF001
+        await gw.stop()
+        await healthy.stop()
+        return results, dict(hits), retried
+
+    results, hits, retried = run(scenario(), timeout=60)
+    assert [status for status, _t, _h in results] == [200, 200]
+    # each stream failed ONCE and was dispatched exactly once to each
+    # side: no silent redispatch onto the dead conn, no double-serve
+    assert hits == {"doomed": 2, "healthy": 2}
+    assert retried == 2
+
+
+def test_mux_cold_burst_shares_one_dial(run, tmp_path):
+    """N concurrent requests against a COLD gateway share one
+    upgrade dial: the replica sees a single connection, not a
+    stampede of N sockets racing to become the shared conn."""
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        replica = HTTPServer()
+
+        async def handler(_req):
+            await asyncio.sleep(0.05)  # keep the burst overlapping
+            return Response(200, b"{}", content_type="application/json")
+
+        replica.route("POST", "/v1/generate", handler)
+        await replica.start_tcp("127.0.0.1", 0)
+        _register(backend, "aaa", replica.bound_port)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0, poll_interval=5.0,
+            hedge=False,
+        )
+        await gw.run()
+        loop = asyncio.get_event_loop()
+        results = await asyncio.gather(*[
+            loop.run_in_executor(
+                None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+            )
+            for _ in range(8)
+        ])
+        conns = replica.connections_accepted
+        streams = replica.mux_streams_served
+        await gw.stop()
+        await replica.stop()
+        return [s for s, _t, _h in results], conns, streams
+
+    statuses, conns, streams = run(scenario(), timeout=60)
+    assert statuses == [200] * 8
+    assert conns == 1  # one shared dial, no cold-start stampede
+    assert streams == 8
+
+
+def test_mux_stale_connection_redialed_transparently(run, tmp_path):
+    """A mux connection the replica reaped while idle is replaced
+    without the client seeing a failure and WITHOUT consuming a
+    routing retry — the mux mirror of the classic pooled
+    stale-redial discipline."""
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        replica = HTTPServer()
+        replica.KEEPALIVE_IDLE_TIMEOUT = 0.15
+
+        async def handler(_req):
+            return Response(200, b"{}", content_type="application/json")
+
+        replica.route("POST", "/v1/generate", handler)
+        await replica.start_tcp("127.0.0.1", 0)
+        _register(backend, "aaa", replica.bound_port)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0, poll_interval=5.0,
+            hedge=False,
+        )
+        await gw.run()
+        loop = asyncio.get_event_loop()
+        first, _, _ = await loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+        )
+        await asyncio.sleep(0.6)  # idle-reap the warm mux conn
+        second, _, _ = await loop.run_in_executor(
+            None, _post, gw.port, "/v1/generate", {"tokens": [[1]]},
+        )
+        retried = _counter(gw._m_retried, "aaa")  # noqa: SLF001
+        mux_conns = replica.mux_connections
+        await gw.stop()
+        await replica.stop()
+        return first, second, retried, mux_conns
+
+    first, second, retried, mux_conns = run(scenario(), timeout=60)
+    assert first == 200 and second == 200
+    assert retried == 0  # transparent: no routing-level retry consumed
+    assert mux_conns == 2  # the reaped conn was replaced by a redial
+
+
+def test_mux_sse_abandon_cancels_stream_keeps_connection(run, tmp_path):
+    """A downstream client abandoning an SSE relay becomes an
+    upstream CANCEL frame: the replica's generator cleanup runs, the
+    stream id is freed, and the SAME connection serves the next
+    request (pre-mux, a stream always burned its close-delimited
+    connection)."""
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        replica = HTTPServer()
+        cleaned = asyncio.Event()
+
+        async def sse(_req):
+            async def gen():
+                try:
+                    while True:
+                        yield b"data: {\"tick\": 1}\n\n"
+                        await asyncio.sleep(0.01)
+                finally:
+                    cleaned.set()
+
+            return StreamingResponse(gen())
+
+        async def buffered(_req):
+            return Response(200, b"{}", content_type="application/json")
+
+        replica.route("POST", "/v1/generate", sse)
+        replica.route("POST", "/v1/score", buffered)
+        await replica.start_tcp("127.0.0.1", 0)
+        _register(backend, "aaa", replica.bound_port)
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0, poll_interval=5.0,
+            hedge=False,
+        )
+        await gw.run()
+        loop = asyncio.get_event_loop()
+
+        def abandoning_client():
+            sock = socket.create_connection(
+                ("127.0.0.1", gw.port), timeout=10
+            )
+            body = b'{"tokens": [[1]], "stream": true}'
+            sock.sendall(
+                b"POST /v1/generate HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: " + str(len(body)).encode()
+                + b"\r\n\r\n" + body
+            )
+            got = b""
+            while b"tick" not in got:
+                got += sock.recv(65536)
+            sock.close()  # hang up mid-stream
+            return got
+
+        got = await loop.run_in_executor(None, abandoning_client)
+        await asyncio.wait_for(cleaned.wait(), 10)
+        for _ in range(200):  # relay close runs after the disconnect
+            if _counter(gw._m_mux_cancels, "aaa") > 0:  # noqa: SLF001
+                break
+            await asyncio.sleep(0.01)
+        cancels = _counter(gw._m_mux_cancels, "aaa")  # noqa: SLF001
+        # the shared conn survived the abandon: a buffered request
+        # rides the same socket
+        status, _t, _h = await loop.run_in_executor(
+            None, _post, gw.port, "/v1/score", {"tokens": [[1]]},
+        )
+        conns = replica.connections_accepted
+        await gw.stop()
+        await replica.stop()
+        return got, cancels, status, conns
+
+    got, cancels, status, conns = run(scenario(), timeout=60)
+    assert b"tick" in got
+    assert cancels == 1  # the abandon became a CANCEL frame
+    assert status == 200
+    assert conns == 1  # one connection through stream AND next request
 
 
 def test_member_drain_cycle_racecheck_clean(run, tmp_path):
