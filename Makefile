@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all build test test-fast test-workload integration fleet-smoke chaos chaos-smoke bench bench-gateway lint lint-baseline clean image
+.PHONY: all build test test-fast test-workload integration fleet-smoke trace-smoke chaos chaos-smoke bench bench-gateway lint lint-baseline clean image
 
 all: build test
 
@@ -35,6 +35,13 @@ integration: build
 # two-replica drain-mid-traffic integration test) on the CPU backend
 fleet-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_fleet.py -q
+
+# cross-hop tracing proof on a live 2-replica fleet: a buffered and
+# an SSE request over cp-mux/1, each stitched (gateway + replica
+# spans under one trace id) with non-overlapping stage accounting
+# (docs/90-observability.md)
+trace-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/trace_smoke.py
 
 # trace-driven load + fault injection against a real fleet, scored on
 # SLO-goodput (docs/80-chaos.md). chaos-smoke: the quick seeded

@@ -30,6 +30,7 @@ import random
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from ..telemetry.tracing import stage_totals
 from .slo import RequestRecord
 from .trace import TraceRequest
 
@@ -105,6 +106,7 @@ async def issue_request(
     # request so a burst's shed victims desynchronize
     rng = random.Random(req.seed * 2654435761 % (2**31) ^ 0x5EED)
     attempts = 0
+    retry_wait_s = 0.0
     while True:
         headers = await _attempt(port, req, clock_zero, record, host, path)
         attempts += 1
@@ -129,7 +131,9 @@ async def issue_request(
         # equal jitter: [hint/2, hint] — the mean backs off with the
         # server's estimate, the spread kills the synchronized wave
         delay = min(hint, MAX_RETRY_AFTER_WAIT_S)
-        await asyncio.sleep(delay * (0.5 + 0.5 * rng.random()))
+        jittered = delay * (0.5 + 0.5 * rng.random())
+        retry_wait_s += jittered
+        await asyncio.sleep(jittered)
         # a retry is a fresh exchange; only TTFT's zero point persists
         record.ttft_s = None
         record.tokens_out = 0
@@ -143,6 +147,16 @@ async def issue_request(
         and not record.error
     ):
         record.shed = True
+    if retry_wait_s > 0.0:
+        # Retry-After parking is admission-imposed wait exactly like
+        # gateway queue time — the client was told to stand off
+        # because no dispatch capacity existed. Folding it into the
+        # same stage keeps TTFT attribution honest: a request whose
+        # SLO died in the shed-retry dance blames admission, not the
+        # replica that eventually served it in milliseconds.
+        record.stages["admission_queue_wait"] = (
+            record.stages.get("admission_queue_wait", 0.0) + retry_wait_s
+        )
     record.finished_s = time.monotonic() - clock_zero
     return record
 
@@ -162,6 +176,8 @@ async def _attempt(
     # flags (saw_5xx, set by the caller, is the cumulative memory)
     record.status = 0
     record.retry_after_quoted = False
+    record.trace_id = ""
+    record.stages = {}
     writer: Optional[asyncio.StreamWriter] = None
     headers: Dict[str, str] = {}
     try:
@@ -188,6 +204,13 @@ async def _attempt(
         )
         record.status = status
         record.retry_after_quoted = "retry-after" in headers
+        # request identity + stage breakdown: every gateway answer —
+        # sheds and 504s included — carries its trace id, and most
+        # carry the span digest the triage ledger decomposes TTFT by
+        record.trace_id = headers.get("x-cp-trace", "")
+        record.stages = stage_totals(
+            headers.get("x-cp-span-digest", "")
+        )
         if "text/event-stream" in headers.get("content-type", ""):
             await _consume_stream(reader, req, record, clock_zero)
         else:
@@ -250,6 +273,17 @@ async def _consume_stream(
                 ) - record.started_s
             if event.get("done"):
                 saw_done = True
+                spans = event.get("spans")
+                if isinstance(spans, str):
+                    # the stream's digest channel: the replica ships
+                    # its spans in the terminal frame (headers are
+                    # long gone); merge them under the same prefix
+                    # the gateway's stitcher uses
+                    for stage, dur in stage_totals(spans).items():
+                        key = "replica." + stage
+                        record.stages[key] = (
+                            record.stages.get(key, 0.0) + dur
+                        )
             else:
                 record.tokens_out += len(event.get("tokens") or [])
         if saw_done:

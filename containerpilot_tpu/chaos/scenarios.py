@@ -375,6 +375,14 @@ class ScenarioSpec:
     expect_scaled_replica_routed: bool = False
     #: replicas the autoscaler manages at the end (back to min)
     expect_managed_at_end: Optional[int] = None
+    # -- latency-attribution invariants --------------------------------
+    #: violation class -> the stage that must dominate it in the
+    #: report's stage_attribution (e.g. {"ttft":
+    #: "admission_queue_wait"}: a burst's TTFT misses must be queue
+    #: wait, not replica compute). Vacuously true when the class has
+    #: no violations — the invariant constrains the blame, not the
+    #: failure count (goodput floors do that).
+    expect_dominant_stage: Dict[str, str] = field(default_factory=dict)
 
 
 async def _warm_fleet(
@@ -630,6 +638,31 @@ async def run_scenario_async(
             f"{managed} managed replicas at end "
             f"(expected {spec.expect_managed_at_end})",
         )
+    for cls, want in sorted(spec.expect_dominant_stage.items()):
+        attributed = score["stage_attribution"].get(cls)
+        if attributed is None:
+            check(
+                f"dominant_{cls}", True,
+                f"no {cls} violations to attribute (vacuous pass)",
+            )
+        elif attributed["with_stage_data"] == 0:
+            # violations happened but NONE carried a stage breakdown:
+            # that is a tracing regression (digest dropped or parse
+            # broken), not a vacuous pass — failing here keeps the
+            # attribution invariant honest
+            check(
+                f"dominant_{cls}", False,
+                f"{attributed['count']} {cls} violations but none "
+                f"carried stage data — trace propagation broken?",
+            )
+        else:
+            check(
+                f"dominant_{cls}",
+                attributed["dominant"] == want,
+                f"{attributed['count']} {cls} violations dominated by "
+                f"{attributed['dominant']!r} (expected {want!r}; "
+                f"stage totals {attributed['stages_ms']})",
+            )
 
     fault_counts: Dict[str, int] = {}
     for entry in harness.fault_log:
@@ -860,6 +893,12 @@ _register(ScenarioSpec(
     min_goodput_fraction=0.2,
     min_admitted_goodput_fraction=0.8,
     expect_sheds_min=1,
+    # the PR 9 attribution invariant: a burst's TTFT misses are QUEUE
+    # time (gateway admission wait + Retry-After parking, which the
+    # client folds into the same stage), never replica compute — an
+    # overloaded-but-honest fleet pages the operator at admission,
+    # not at the replicas
+    expect_dominant_stage={"ttft": "admission_queue_wait"},
 ))
 
 _register(ScenarioSpec(

@@ -35,6 +35,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from ..telemetry.tracing import dominant_stage
+
 
 @dataclass
 class SLO:
@@ -77,6 +79,15 @@ class RequestRecord:
     #: to a 200): still client-VISIBLE, so zero-5xx invariants count
     #: it — polite client retries must not mask a gateway regression
     saw_5xx: bool = False
+    #: trace id the gateway stamped on the final answer (X-CP-Trace):
+    #: the handle that finds this request in /v1/traces and in
+    #: trace-id-correlated logs, refusals included
+    trace_id: str = ""
+    #: per-stage seconds from the final answer's span digest
+    #: (admission_queue_wait, upstream_ttfb, replica.decode, ...) —
+    #: Retry-After parking is folded into admission_queue_wait by the
+    #: client, since both are admission-imposed wait
+    stages: Dict[str, float] = field(default_factory=dict)
 
     def tpot(self) -> Optional[float]:
         if self.ttft_s is None or self.tokens_out <= 1:
@@ -97,6 +108,23 @@ class RequestRecord:
             return True
         tpot = self.tpot()
         return tpot is None or tpot <= slo.tpot_s
+
+    def violation_class(self, slo: SLO) -> Optional[str]:
+        """Which SLO this request violated — the triage ledger's
+        grouping key — or None for good requests and honest sheds.
+        One class per record, checked in failure-severity order (a
+        transport error that ALSO missed TTFT is a transport error)."""
+        if self.shed or self.is_good(slo):
+            return None
+        if self.error:
+            return "transport"
+        if self.truncated:
+            return "truncated"
+        if self.status != 200:
+            return "5xx" if 500 <= self.status <= 599 else "bad_status"
+        if self.ttft_s is None or self.ttft_s > slo.ttft_s:
+            return "ttft"
+        return "tpot"
 
 
 def percentile(values: List[float], q: float) -> Optional[float]:
@@ -185,6 +213,10 @@ class ScenarioScore:
             "tokens_out": sum(r.tokens_out for r in records),
             # triage ledger: the first few non-good requests with
             # enough detail to replay them (trace index + session)
+            # AND to blame them — the gateway trace id, the per-stage
+            # latency breakdown off the span digest, and the stage
+            # that dominated ("goodput dropped" becomes "goodput
+            # dropped HERE")
             "failures": [
                 {
                     "index": r.index,
@@ -193,13 +225,55 @@ class ScenarioScore:
                     "error": r.error,
                     "ttft_ms": _ms(r.ttft_s),
                     "truncated": r.truncated,
+                    "class": r.violation_class(self.slo),
+                    "trace": r.trace_id,
+                    "stages_ms": {
+                        stage: _ms(dur)
+                        for stage, dur in sorted(r.stages.items())
+                    },
+                    "dominant_stage": dominant_stage(r.stages),
                 }
                 for r in records
                 if not r.is_good(self.slo)
                 and not r.abandoned
                 and not r.shed
             ][:8],
+            # the aggregate face of the same blame: per violation
+            # class, the stage that ate the violated requests' time
+            "stage_attribution": self._stage_attribution(),
         }
+
+    def _stage_attribution(self) -> Dict[str, Dict[str, Any]]:
+        """Per violation class: how many requests, the summed
+        per-stage seconds across them, and the DOMINANT stage (the
+        refinement discipline lives in tracing.dominant_stage: nested
+        ``replica.*`` spans refine their upstream window rather than
+        double-count it). The scenario report names this stage, and
+        scenario specs can pin it (``expect_dominant_stage``)."""
+        buckets: Dict[str, List[RequestRecord]] = {}
+        for record in self.records:
+            cls = record.violation_class(self.slo)
+            if cls is not None:
+                buckets.setdefault(cls, []).append(record)
+        out: Dict[str, Dict[str, Any]] = {}
+        for cls, violated in sorted(buckets.items()):
+            totals: Dict[str, float] = {}
+            traced = 0
+            for record in violated:
+                if record.stages:
+                    traced += 1
+                for stage, dur in record.stages.items():
+                    totals[stage] = totals.get(stage, 0.0) + dur
+            out[cls] = {
+                "count": len(violated),
+                "with_stage_data": traced,
+                "dominant": dominant_stage(totals),
+                "stages_ms": {
+                    stage: _ms(dur)
+                    for stage, dur in sorted(totals.items())
+                },
+            }
+        return out
 
 
 def _ms(seconds: Optional[float]) -> Optional[float]:

@@ -29,6 +29,32 @@ class LogConfigError(ValueError):
     pass
 
 
+def _trace_fields() -> Dict[str, Any]:
+    """trace_id/stream_id from the tracing contextvars, when a
+    request is active on the logging task's context — the glue that
+    lets replica logs and gateway logs grep together by trace id.
+    Lazy import (cached on first success) keeps config.logger free of
+    a package-level dependency on telemetry."""
+    global _tracing
+    if _tracing is None:
+        try:
+            from ..telemetry import tracing as _tracing_mod
+        except ImportError:  # partial install; logging must not die
+            return {}
+        _tracing = _tracing_mod
+    fields: Dict[str, Any] = {}
+    trace_id = _tracing.current_trace_id()
+    if trace_id:
+        fields["trace_id"] = trace_id
+    stream_id = _tracing.current_stream_id()
+    if stream_id:
+        fields["stream_id"] = stream_id
+    return fields
+
+
+_tracing = None
+
+
 class _DefaultFormatter(logging.Formatter):
     """The reference's custom default formatter prints time, level, and
     any job/pid/check fields before the message
@@ -45,6 +71,13 @@ class _DefaultFormatter(logging.Formatter):
 
 
 class _JSONFormatter(logging.Formatter):
+    """The opt-in structured formatter (``"format": "json"``). Every
+    record emitted while a traced request is active additionally
+    carries ``trace_id`` (and ``stream_id`` for cp-mux streams) from
+    the tracing contextvars, so one ``grep <trace_id>`` correlates a
+    request's replica and gateway log lines with its /v1/traces
+    timeline."""
+
     def format(self, record: logging.LogRecord) -> str:
         entry: Dict[str, Any] = {
             "time": self.formatTime(record, "%Y-%m-%dT%H:%M:%S%z"),
@@ -55,6 +88,7 @@ class _JSONFormatter(logging.Formatter):
             val = record.__dict__.get(key)
             if val is not None:
                 entry[key] = val
+        entry.update(_trace_fields())
         return json.dumps(entry)
 
 

@@ -62,6 +62,7 @@ from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, Iterable, List, Optional, Set, Tuple
 
 from ..discovery import Backend
+from ..telemetry import tracing
 from ..utils.http import (
     HTTPServer,
     Request,
@@ -69,7 +70,7 @@ from ..utils.http import (
     StreamingResponse,
     timed_read,
 )
-from ..utils.prom import exposition
+from ..utils.prom import ensure_build_info, exposition
 from ..watches import poll_upstream
 from .admission import (
     AdmissionController,
@@ -149,11 +150,18 @@ async def _send_on(
     so resending on a fresh dial cannot double-apply the request."""
     reader, writer = conn.reader, conn.writer
     try:
+        # cross-hop trace propagation: the replica records its spans
+        # under the SAME id and hands back a digest (tracing.py)
+        trace_id = tracing.current_trace_id()
+        trace_line = (
+            f"{tracing.TRACE_HEADER}: {trace_id}\r\n" if trace_id else ""
+        )
         head = (
             f"{method} {path} HTTP/1.1\r\n"
             f"Host: {conn.authority}\r\n"
             f"Content-Type: application/json\r\n"
             f"Content-Length: {len(body)}\r\n"
+            f"{trace_line}"
             f"Connection: keep-alive\r\n\r\n"
         )
         writer.write(head.encode() + body)
@@ -263,6 +271,38 @@ async def _read_body(
         chunks.append(chunk)
 
 
+#: bytes of relayed SSE kept for the final ``done`` frame's span
+#: digest; events are small, so this comfortably holds the last one
+_TAIL_KEEP = 4096
+
+
+def _keep_tail(tail: bytearray, chunk: bytes) -> None:
+    """Retain the last ``_TAIL_KEEP`` bytes of a relayed stream —
+    enough to recover the terminal SSE event after EOF without ever
+    buffering the stream itself."""
+    tail += chunk
+    if len(tail) > _TAIL_KEEP:
+        del tail[:len(tail) - _TAIL_KEEP]
+
+
+def _tail_digest(tail: bytes) -> str:
+    """The replica span digest off a relayed stream's final ``done``
+    event, or "" when the stream ended without one (abandon,
+    truncation) — telemetry extraction must never fail a relay."""
+    idx = tail.rfind(b"data: ")
+    if idx < 0:
+        return ""
+    raw = tail[idx + len(b"data: "):].split(b"\n\n", 1)[0]
+    try:
+        event = json.loads(raw)
+    except ValueError:
+        return ""
+    if not isinstance(event, dict) or not event.get("done"):
+        return ""
+    digest = event.get("spans")
+    return digest if isinstance(digest, str) else ""
+
+
 def _reusable(headers: Dict[str, str]) -> bool:
     """A connection goes back to the pool only when the response was
     Content-Length-framed (so the body had a definite end) and the
@@ -300,6 +340,7 @@ class FleetGateway:
         pool_idle_ttl: float = 30.0,
         pool_max_uses: int = 1000,
         mux: bool = True,
+        trace: bool = True,
         admission: Optional[Dict[str, Any]] = None,
     ) -> None:
         if affinity not in AFFINITY_MODES:
@@ -341,6 +382,15 @@ class FleetGateway:
         self.request_timeout = request_timeout
 
         self.mux = mux
+        # request tracing: on by default (the bench pins its cost at
+        # effectively-free); --no-trace is the bench's A/B control,
+        # not an operational recommendation
+        self.trace = trace
+        self._tracer = tracing.TraceRecorder("gateway")
+        # staleness signal for flap triage: monotonic stamp of the
+        # last catalog poll that RETURNED (empty or not); None until
+        # the first one lands
+        self._last_poll: Optional[float] = None
         self._replicas: Dict[str, Replica] = {}
         self._pool = ConnectionPool(
             max_idle=pool_max_idle,
@@ -487,11 +537,24 @@ class FleetGateway:
         self._g_admission_inflight.set_function(
             lambda: self._admission.inflight
         )
+        # per-stage latency decomposition: one histogram row per
+        # tracing stage (admission_queue_wait, upstream_ttfb,
+        # replica.prefill, ...) — the aggregate face of /v1/traces
+        self._m_stage = Histogram(
+            "cp_request_stage_seconds",
+            "per-stage request latency decomposition "
+            "(docs/90-observability.md has the stage glossary)",
+            ["stage"], registry=self._registry,
+            buckets=(.001, .005, .02, .05, .1, .25, .5, 1, 2.5, 5,
+                     10, 30, 60),
+        )
+        ensure_build_info(self._registry, "gateway")
 
         self._server = HTTPServer()
         self._server.route("GET", "/health", self._health)
         self._server.route("GET", "/metrics", self._metrics)
         self._server.route("GET", "/fleet", self._fleet_status)
+        self._server.route("GET", "/v1/traces", self._traces)
         self._server.route("GET", "/v1/model", self._model_info)
         for path, endpoint in (
             ("/v1/generate", "generate"),
@@ -598,6 +661,10 @@ class FleetGateway:
         did_change, healthy = await poll_upstream(
             self.backend, self.service_name, self.tag
         )
+        # the poll RETURNED (it may still be empty): the staleness
+        # clock on /fleet resets here, so a wedged/flapping catalog
+        # shows up as a growing catalog_poll_age_s
+        self._last_poll = time.monotonic()
         # change detection already scanned the catalog; re-list only
         # when membership moved (or when this gateway holds nothing a
         # freshly-shared backend considers unchanged, or the healthy
@@ -780,6 +847,15 @@ class FleetGateway:
         body, content_type = exposition(self._registry)
         return Response(200, body, content_type=content_type)
 
+    async def _traces(self, req: Request) -> Response:
+        """Per-process trace surface: slowest-N + most-recent-N
+        stitched timelines, JSON. ``?n=`` bounds either list."""
+        return Response(
+            200,
+            self._tracer.snapshot_json(req.query),
+            content_type="application/json",
+        )
+
     async def _fleet_status(self, _req: Request) -> Response:
         body = json.dumps(
             {
@@ -787,6 +863,18 @@ class FleetGateway:
                 "poll_interval": self.poll_interval,
                 "empty_poll_threshold": self.empty_poll_threshold,
                 "catalog_flaps_damped": self.flaps_damped,
+                # staleness: how old the routing table's information
+                # is — THE missing signal when diagnosing a flap
+                # hold-down (a growing age means the catalog stopped
+                # answering, not that replicas died)
+                "catalog_poll_age_s": (
+                    round(time.monotonic() - self._last_poll, 3)
+                    if self._last_poll is not None else None
+                ),
+                "traces": (
+                    self._tracer.fleet_summary()
+                    if self.trace else None
+                ),
                 "draining": self.draining,
                 "admission": self._admission.stats(),
                 "autoscaler": (
@@ -836,10 +924,65 @@ class FleetGateway:
             if not isinstance(parsed, dict):
                 parsed = {}
             key = self._affinity_key(req, parsed)
-            resp = await self._admitted(
-                endpoint, path, body, key, req,
-                stream=bool(parsed.get("stream")),
-            )
+            # mint (or adopt the client's) trace id and bind it for
+            # the whole routing lifetime: spans recorded anywhere
+            # downstream — admission, hedge legs, relays — attach to
+            # this request without threading a handle through
+            trace: Optional[tracing.Trace] = None
+            token = None
+            if self.trace:
+                # adopt the client's id only when it is splice-safe
+                # (tracing.safe_id): a hostile header must not ride
+                # into the mux head template or echoed answers
+                trace = self._tracer.start(
+                    tracing.safe_id(req.headers.get("x-cp-trace")),
+                    endpoint,
+                )
+                token = tracing.activate(trace)
+            try:
+                resp = await self._admitted(
+                    endpoint, path, body, key, req,
+                    stream=bool(parsed.get("stream")),
+                )
+            except asyncio.CancelledError:
+                # client abandon: the server cancels the handler task
+                # on disconnect. Not a gateway failure — file the
+                # trace (still findable by id) with status 0, the
+                # "no server verdict" convention, not a bogus 500
+                if trace is not None:
+                    trace.finish(0)
+                    self._observe_trace(trace)
+                raise
+            except BaseException:
+                if trace is not None:
+                    trace.finish(500)
+                    self._observe_trace(trace)
+                raise
+            finally:
+                if token is not None:
+                    tracing.deactivate(token)
+            if trace is not None:
+                # every answer — 200s, sheds, 504s, failures — carries
+                # its trace id, so a client-reported failure is
+                # findable in /v1/traces even when nothing dispatched
+                resp.headers.setdefault(
+                    tracing.TRACE_HEADER, trace.trace_id
+                )
+                if isinstance(resp, StreamingResponse):
+                    # the relay owns the trace's tail: it adds the
+                    # relay span, splices the replica digest off the
+                    # final SSE frame, and finishes the trace at
+                    # close. The head ships the breakdown known so
+                    # far (TTFT is fully decided by this point).
+                    resp.headers.setdefault(
+                        tracing.DIGEST_HEADER, trace.digest()
+                    )
+                else:
+                    trace.finish(resp.status)
+                    self._observe_trace(trace)
+                    resp.headers.setdefault(
+                        tracing.DIGEST_HEADER, trace.digest()
+                    )
             self._m_latency.labels(endpoint).observe(
                 time.perf_counter() - t0
             )
@@ -883,12 +1026,20 @@ class FleetGateway:
             pinned = self._replicas.get(self._sticky.get(key, ""))
             if pinned is not None:
                 pinned.queued += 1
+        trace = tracing.current_trace()
         try:
             ticket = await self._admission.admit(
                 priority, key, deadline_s
             )
         except DeadlineExpired as exc:
             self._m_expired.inc()
+            if trace is not None:
+                # the request died IN the queue: its whole life was
+                # queue wait, and the ledger must be able to say so
+                end = tracing.now()
+                trace.add_span(
+                    "admission_queue_wait", end - exc.waited_s, end
+                )
             return Response(
                 504,
                 f"admission deadline expired: {exc}\n".encode(),
@@ -906,6 +1057,14 @@ class FleetGateway:
         finally:
             if pinned is not None:
                 pinned.queued -= 1
+        if trace is not None:
+            # enqueued_at/granted_at are time.monotonic() stamps —
+            # the same clock tracing runs on, so this span subtracts
+            # cleanly against the upstream spans that follow
+            trace.add_span(
+                "admission_queue_wait",
+                ticket.enqueued_at, ticket.granted_at,
+            )
         self._m_admitted.labels(PRIORITY_NAMES[ticket.priority]).inc()
         released = False
 
@@ -1012,16 +1171,18 @@ class FleetGateway:
         release/discard it after the body."""
         while True:
             try:
-                conn = await self._pool.acquire(
-                    replica, self.connect_timeout
-                )
+                with tracing.span("upstream_connect"):
+                    conn = await self._pool.acquire(
+                        replica, self.connect_timeout
+                    )
             except UpstreamError:
                 self._evict_replica_pool(replica.id)
                 raise
             try:
-                status, headers = await _send_on(
-                    conn, method, path, body, self.request_timeout
-                )
+                with tracing.span("upstream_ttfb"):
+                    status, headers = await _send_on(
+                        conn, method, path, body, self.request_timeout
+                    )
             except StaleConnection as exc:
                 self._pool.discard_stale(conn)
                 log.debug("gateway: redialing stale connection: %s", exc)
@@ -1049,16 +1210,22 @@ class FleetGateway:
         StaleMuxConnection."""
         while True:
             try:
-                mux = await self._pool.acquire_mux(
-                    replica, self.connect_timeout
-                )
+                with tracing.span("upstream_connect"):
+                    mux = await self._pool.acquire_mux(
+                        replica, self.connect_timeout
+                    )
             except UpstreamError:
                 self._evict_replica_pool(replica.id)
                 raise
             if mux is None:
                 return None
             try:
-                stream = await mux.open_stream(method, path, body)
+                # trace id rides the stream's HEADERS frame (pool.py
+                # splices it into the cached head template)
+                stream = await mux.open_stream(
+                    method, path, body,
+                    trace_id=tracing.current_trace_id() or None,
+                )
             except StaleMuxConnection as exc:
                 log.debug(
                     "gateway: redialing stale mux connection: %s", exc
@@ -1097,9 +1264,10 @@ class FleetGateway:
             return None
         for retry in (True, False):
             try:
-                status, headers = await stream.response_head(
-                    self.request_timeout
-                )
+                with tracing.span("upstream_ttfb"):
+                    status, headers = await stream.response_head(
+                        self.request_timeout
+                    )
                 return stream, status, headers
             except StaleMuxConnection as exc:
                 self._evict_replica_pool(replica.id)
@@ -1137,9 +1305,10 @@ class FleetGateway:
             return None
         stream, status, headers = opened
         try:
-            payload = await stream.read_body(
-                self.request_timeout, MAX_UPSTREAM_BODY
-            )
+            with tracing.span("upstream_body"):
+                payload = await stream.read_body(
+                    self.request_timeout, MAX_UPSTREAM_BODY
+                )
         except MuxStreamError:
             self._cancel_stream(replica, stream)
             raise
@@ -1179,9 +1348,10 @@ class FleetGateway:
                     replica, method, path, body
                 )
                 try:
-                    payload = await _read_body(
-                        conn.reader, headers, self.request_timeout
-                    )
+                    with tracing.span("upstream_body"):
+                        payload = await _read_body(
+                            conn.reader, headers, self.request_timeout
+                        )
                 except UpstreamError:
                     self._pool.discard(conn)
                     self._evict_replica_pool(replica.id)
@@ -1330,11 +1500,34 @@ class FleetGateway:
                     tried, {served_by.id}, attempt, backoff
                 )
                 continue
+            self._stitch_upstream(headers)
             return self._relay(status, headers, payload)
         return last or Response(
             503, b"no healthy replicas\n",
             headers={"Retry-After": self._retry_after()},
         )
+
+    def _stitch_upstream(self, headers: Dict[str, str]) -> None:
+        """Splice the replica's span digest (if the response carried
+        one) into the current trace as ``replica.*`` children, aligned
+        at the moment this gateway dispatched upstream — the stitched
+        timeline without a second RPC."""
+        trace = tracing.current_trace()
+        if trace is None:
+            return
+        digest = headers.get("x-cp-span-digest", "")
+        if not digest:
+            return
+        base = trace.last_span_start("upstream_ttfb")
+        trace.add_child_digest(
+            digest, base if base is not None else trace.started
+        )
+
+    def _observe_trace(self, trace: "tracing.Trace") -> None:
+        """Mirror a finished trace's spans into the per-stage
+        histogram — the aggregate face of the same decomposition."""
+        for stage, start, end, _meta in trace.spans:
+            self._m_stage.labels(stage).observe(max(end - start, 0.0))
 
     @staticmethod
     def _relay(
@@ -1497,6 +1690,30 @@ class FleetGateway:
             headers={"Retry-After": self._retry_after()},
         )
 
+    def _finish_stream_trace(
+        self,
+        trace: Optional["tracing.Trace"],
+        relay_t0: float,
+        tail: bytearray,
+        status: int,
+        intact: bool,
+    ) -> None:
+        """Shared relay-close tail for both stream transports: record
+        the relay span, splice the replica digest off the final SSE
+        ``done`` frame (the stream's version of the digest header),
+        finish the trace, feed the stage histogram."""
+        if trace is None:
+            return
+        trace.add_span("relay", relay_t0, tracing.now())
+        digest = _tail_digest(bytes(tail))
+        if digest:
+            base = trace.last_span_start("upstream_ttfb")
+            trace.add_child_digest(
+                digest, base if base is not None else trace.started
+            )
+        trace.finish(status if intact else 0)
+        self._observe_trace(trace)
+
     def _relay_stream(
         self,
         replica: Replica,
@@ -1513,6 +1730,9 @@ class FleetGateway:
         # fleet whose streams keep dying doesn't feed the drain-rate
         # window with phantom completions
         intact = {"ok": True}
+        trace = tracing.current_trace()
+        relay_t0 = tracing.now()
+        tail = bytearray()
 
         def close() -> None:
             # idempotent: generator-finally AND the response's close
@@ -1522,6 +1742,9 @@ class FleetGateway:
             closed[0] = True
             replica.outstanding -= 1
             self._pool.discard(conn)
+            self._finish_stream_trace(
+                trace, relay_t0, tail, status, intact["ok"]
+            )
 
         async def chunks():
             try:
@@ -1533,6 +1756,8 @@ class FleetGateway:
                     )
                     if not chunk:
                         return
+                    if trace is not None:
+                        _keep_tail(tail, chunk)
                     yield chunk
             except (OSError, asyncio.TimeoutError):
                 # upstream died mid-stream; downstream sees EOF
@@ -1560,6 +1785,9 @@ class FleetGateway:
         both paths count into conns_saved_by_mux."""
         closed = [False]
         intact = {"ok": True}
+        trace = tracing.current_trace()
+        relay_t0 = tracing.now()
+        tail = bytearray()
 
         def close() -> None:
             # idempotent: generator-finally AND the response's close
@@ -1577,6 +1805,9 @@ class FleetGateway:
                 # completed cleanly: the close-delimited HTTP/1.1
                 # relay would have burned this connection instead
                 self._m_conns_saved.labels(replica.id).inc()
+            self._finish_stream_trace(
+                trace, relay_t0, tail, status, intact["ok"]
+            )
 
         async def chunks():
             try:
@@ -1584,6 +1815,8 @@ class FleetGateway:
                     chunk = await stream.read_chunk(self.request_timeout)
                     if not chunk:
                         return
+                    if trace is not None:
+                        _keep_tail(tail, chunk)
                     yield chunk
             except MuxStreamError:
                 # this stream died (deadline, server-side abort); the
@@ -1666,6 +1899,13 @@ def main() -> int:
         "that decline the upgrade fall back per-replica either way)",
     )
     parser.add_argument(
+        "--trace", default=True, action=argparse.BooleanOptionalAction,
+        help="per-request cross-hop tracing (X-CP-Trace propagation, "
+        "/v1/traces, cp_request_stage_seconds): on by default and "
+        "effectively free (bench-pinned); --no-trace is the bench's "
+        "A/B control",
+    )
+    parser.add_argument(
         "--admission-queue-depth", type=int, default=256,
         help="bounded admission queue in front of routing; a full "
         "queue sheds new work with 429 + Retry-After",
@@ -1716,6 +1956,7 @@ def main() -> int:
         pool_max_idle=0 if args.no_pool else args.pool_max_idle,
         pool_idle_ttl=args.pool_idle_ttl,
         mux=args.mux,
+        trace=args.trace,
         admission=dict(
             max_queue_depth=args.admission_queue_depth,
             high_water=args.admission_high_water,

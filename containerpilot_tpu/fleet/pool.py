@@ -57,6 +57,7 @@ import time
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 
+from ..telemetry import tracing
 from ..utils.http import (
     FRAME_CANCEL,
     FRAME_DATA,
@@ -340,7 +341,9 @@ class MuxConnection:
         self._transport = None
         self._protocol: Optional[_MuxClientProtocol] = None
         self._pongs: Dict[bytes, asyncio.Event] = {}
-        self._head_cache: Dict[Tuple[str, str], bytes] = {}
+        #: (method, path) -> encoded head; (method, path, True) ->
+        #: (prefix, suffix) template the trace id splices between
+        self._head_cache: Dict[Tuple, object] = {}
 
     @property
     def active_streams(self) -> int:
@@ -377,12 +380,17 @@ class MuxConnection:
         path: str,
         body: bytes = b"",
         headers: Optional[Dict[str, str]] = None,
+        trace_id: Optional[str] = None,
     ) -> MuxStream:
         """Send HEADERS(+DATA)+END for a new stream in one write and
-        return its handle. A send that bounces off a dead connection
-        raises StaleMuxConnection when the connection came warm from
-        the pool (redial-safe: the server answered nothing for this
-        stream) and plain UpstreamError for a fresh dial."""
+        return its handle. ``trace_id`` rides the HEADERS frame as
+        ``x-cp-trace`` — the mux half of cross-hop trace propagation
+        — spliced into a cached head template so the (every-request)
+        traced path pays no per-request JSON encode. A send that
+        bounces off a dead connection raises StaleMuxConnection when
+        the connection came warm from the pool (redial-safe: the
+        server answered nothing for this stream) and plain
+        UpstreamError for a fresh dial."""
         if self.dead:
             raise self._send_failure("connection already dead")
         sid = self._next_id
@@ -390,24 +398,73 @@ class MuxConnection:
         if self._next_id >= 1 << 32:
             self._next_id = 1
         if headers:
+            merged = {"content-type": "application/json", **headers}
+            if trace_id:
+                merged.setdefault("x-cp-trace", trace_id)
             head = json.dumps({
                 "method": method,
                 "path": path,
-                "headers": {
-                    "content-type": "application/json", **headers
-                },
+                "headers": merged,
             }).encode()
         else:
             # the hot path sends the same few heads over and over
-            # (generate/completions/score); cache their encoding
-            head = self._head_cache.get((method, path))
-            if head is None:
+            # (generate/completions/score); cache their encoding. The
+            # traced variant caches a (prefix, suffix) template the
+            # splice-safe trace id splices between — minted ids are
+            # hex by construction and adopted ids pass
+            # tracing.safe_id at the gateway, but re-check here: an
+            # unsafe id through the template is a JSON injection
+            # into the upstream HEADERS frame
+            if trace_id and tracing.safe_id(trace_id) is None:
                 head = json.dumps({
                     "method": method,
                     "path": path,
-                    "headers": {"content-type": "application/json"},
+                    "headers": {
+                        "content-type": "application/json",
+                        "x-cp-trace": trace_id,
+                    },
                 }).encode()
-                self._head_cache[(method, path)] = head
+            elif trace_id:
+                parts = self._head_cache.get((method, path, True))
+                if parts is None:
+                    template = json.dumps({
+                        "method": method,
+                        "path": path,
+                        "headers": {
+                            "content-type": "application/json",
+                            "x-cp-trace": "@TRACE-ID@",
+                        },
+                    }).encode().split(b'"@TRACE-ID@"')
+                    # a method/path containing the placeholder would
+                    # tear the template; no API path does, but fall
+                    # back to a plain encode rather than mis-splice
+                    parts = (
+                        (template[0] + b'"', b'"' + template[1])
+                        if len(template) == 2 else None
+                    )
+                    self._head_cache[(method, path, True)] = parts
+                if parts is not None:
+                    head = parts[0] + trace_id.encode() + parts[1]
+                else:
+                    head = json.dumps({
+                        "method": method,
+                        "path": path,
+                        "headers": {
+                            "content-type": "application/json",
+                            "x-cp-trace": trace_id,
+                        },
+                    }).encode()
+            else:
+                head = self._head_cache.get((method, path))
+                if head is None:
+                    head = json.dumps({
+                        "method": method,
+                        "path": path,
+                        "headers": {
+                            "content-type": "application/json"
+                        },
+                    }).encode()
+                    self._head_cache[(method, path)] = head
         frames = encode_frame(FRAME_HEADERS, sid, head)
         if body:
             frames += encode_frame(FRAME_DATA, sid, body)

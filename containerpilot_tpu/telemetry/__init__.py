@@ -1,8 +1,11 @@
 """Telemetry: Prometheus metrics + status server
-(reference: telemetry/ package)."""
+(reference: telemetry/ package), plus cross-hop request tracing
+(tracing.py — not the reference's; see docs/90-observability.md)."""
+from . import tracing
 from .config import MetricConfig, TelemetryConfig, TelemetryConfigError
 from .metrics import Metric
 from .telemetry import Telemetry
+from .tracing import Trace, TraceRecorder
 
 __all__ = [
     "Metric",
@@ -10,4 +13,7 @@ __all__ = [
     "Telemetry",
     "TelemetryConfig",
     "TelemetryConfigError",
+    "Trace",
+    "TraceRecorder",
+    "tracing",
 ]

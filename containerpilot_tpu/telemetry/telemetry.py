@@ -23,7 +23,7 @@ from typing import TYPE_CHECKING, Any, Dict, List, Optional
 from prometheus_client import REGISTRY
 
 from ..utils.http import HTTPServer, Request, Response
-from ..utils.prom import exposition
+from ..utils.prom import ensure_build_info, exposition
 from ..version import VERSION
 from .config import TelemetryConfig
 from .metrics import Metric
@@ -42,6 +42,8 @@ class Telemetry:
     def __init__(self, cfg: TelemetryConfig) -> None:
         self.cfg = cfg
         self.metrics: List[Metric] = [Metric(m) for m in cfg.metrics]
+        # the shared identity gauge every /metrics surface exports
+        ensure_build_info(REGISTRY, "supervisor")
         self._server = HTTPServer()
         self._server.route("GET", "/metrics", self._handle_metrics)
         self._server.route("GET", "/status", self._handle_status)

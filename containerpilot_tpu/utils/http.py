@@ -61,6 +61,20 @@ log = logging.getLogger("containerpilot.http")
 
 MAX_BODY = 4 * 1024 * 1024
 
+_tracing = None
+
+
+def _get_tracing():
+    """Lazy tracing accessor: utils.http is imported by nearly every
+    package, so the telemetry dependency stays off the module import
+    path and is resolved once, on the first mux stream served."""
+    global _tracing
+    if _tracing is None:
+        from ..telemetry import tracing as _tracing_mod
+
+        _tracing = _tracing_mod
+    return _tracing
+
 # -- cp-mux/1 framed multiplexing ------------------------------------
 
 MUX_PROTOCOL = "cp-mux/1"
@@ -607,6 +621,11 @@ class HTTPServer:
                         log.exception("mux stream close callback failed")
 
         async def run_stream(stream: "_MuxServerStream") -> None:
+            # each stream runs as its own task, so binding the stream
+            # id here scopes it to exactly this request's handler —
+            # log records emitted under it carry stream_id (and the
+            # handler's trace carries it for /v1/traces)
+            _get_tracing().set_stream_id(stream.sid)
             try:
                 request = stream.to_request()
                 if isinstance(request, Response):

@@ -31,6 +31,22 @@ from __future__ import annotations
 import http.client
 from typing import Callable, Dict, Optional, Tuple
 
+_tracing = None
+
+
+def _trace_id() -> str:
+    """The active trace id, lazily bound: a control-plane or catalog
+    call made while serving a traced request carries the request's
+    X-CP-Trace, so cross-service log/trace greps pick it up too."""
+    global _tracing
+    if _tracing is None:
+        try:
+            from ..telemetry import tracing as _tracing_mod
+        except ImportError:
+            return ""
+        _tracing = _tracing_mod
+    return _tracing.current_trace_id()
+
 
 def keepalive_request(
     take_conn: Callable[[], Optional[http.client.HTTPConnection]],
@@ -48,13 +64,17 @@ def keepalive_request(
     exhausted — at most one redial happens, since the redialed
     connection is fresh. See the module docstring for the resend
     heuristic's (narrow) double-apply window."""
+    send_headers = dict(headers or {})
+    trace_id = _trace_id()
+    if trace_id and "X-CP-Trace" not in send_headers:
+        send_headers["X-CP-Trace"] = trace_id
     while True:
         conn = take_conn()
         reused = conn is not None
         if conn is None:
             conn = new_conn()
         try:
-            conn.request(method, path, body=body, headers=headers or {})
+            conn.request(method, path, body=body, headers=send_headers)
         except (OSError, http.client.HTTPException) as exc:
             conn.close()
             if reused and isinstance(exc, ConnectionError):

@@ -18,3 +18,29 @@ def exposition(registry) -> Tuple[bytes, str]:
     from prometheus_client import generate_latest
 
     return generate_latest(registry), PROM_CONTENT_TYPE
+
+
+def ensure_build_info(registry, role: str) -> None:
+    """Register the ONE shared identity gauge every /metrics surface
+    in the tree exports: ``cp_build_info{version,role} 1``. The first
+    question on any triage call — "which build is this, and what is
+    it?" — must be answerable from the metrics alone; a constant-1
+    info gauge is the standard Prometheus idiom for it. Idempotent
+    per registry (re-registration — config reloads, test fixtures
+    sharing the global registry — is a no-op, never a crash)."""
+    from prometheus_client import Gauge
+
+    from ..version import VERSION
+
+    try:
+        gauge = Gauge(
+            "cp_build_info",
+            "build identity: constant 1, labeled by version and the "
+            "process role (supervisor/replica/pod/gateway)",
+            ["version", "role"],
+            registry=registry,
+        )
+    except ValueError:
+        # already registered in this registry (reload/fixture reuse)
+        return
+    gauge.labels(VERSION, role).set(1)

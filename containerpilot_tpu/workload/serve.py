@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.transformer import TransformerConfig
+from ..telemetry import tracing
 from ..utils.http import HTTPServer, Request, Response, StreamingResponse
 from . import serve_strategies
 from .serve_batcher import Batcher, GenJob
@@ -249,6 +250,17 @@ class InferenceServer:
             "tokens returned by generate/completions (post-trim)",
             registry=self._metrics_registry,
         )
+        from ..utils.prom import ensure_build_info
+
+        ensure_build_info(self._metrics_registry, "replica")
+        # replica-side request tracing: spans recorded under the
+        # gateway's trace id (X-CP-Trace / the mux HEADERS field) —
+        # or a freshly minted one for direct clients — retained in a
+        # per-server ring on GET /v1/traces, and handed back to the
+        # caller as a compact digest (header / final SSE frame) so
+        # the gateway stitches a cross-hop timeline without a second
+        # RPC. See telemetry/tracing.py.
+        self._tracer = tracing.TraceRecorder("replica")
         self._server = HTTPServer()
         # cp-mux/1 accept path (the fleet gateway's multiplexed
         # transport); --no-mux keeps this replica plain HTTP/1.1 and
@@ -256,6 +268,7 @@ class InferenceServer:
         self._server.mux_enabled = mux
         self._server.route("GET", "/health", self._health)
         self._server.route("GET", "/metrics", self._metrics)
+        self._server.route("GET", "/v1/traces", self._traces)
         route = self._instrumented
         self._server.route("GET", "/v1/model", route(
             "model", self._model_info
@@ -304,21 +317,41 @@ class InferenceServer:
         body, content_type = exposition(self._metrics_registry)
         return Response(200, body, content_type=content_type)
 
+    async def _traces(self, req: Request) -> Response:
+        """Per-process trace ring: slowest-N + most-recent-N, JSON."""
+        return Response(
+            200,
+            self._tracer.snapshot_json(req.query),
+            content_type="application/json",
+        )
+
     def _instrumented(self, endpoint: str, handler):
-        """Count + time every API request; token accounting happens in
-        the handlers themselves (they know the post-trim lengths)."""
+        """Count + time every API request, under a per-request trace
+        (adopting the caller's X-CP-Trace id when present); token
+        accounting happens in the handlers themselves (they know the
+        post-trim lengths)."""
         import time as time_mod
 
         async def wrapped(req: Request) -> Response:
+            # splice-safe ids only (tracing.safe_id): this id is
+            # echoed in answer headers and digests verbatim
+            inbound_id = tracing.safe_id(
+                req.headers.get("x-cp-trace")
+            ) or ""
             if self.draining and endpoint in ("generate", "completions"):
                 # drain rejects NEW decode work only; reads (model,
                 # score) stay up for the last consumers of this
                 # replica, and everything already admitted runs to
-                # completion
+                # completion. The refusal still echoes the caller's
+                # trace id — an answered-503 must be findable too.
                 self._m_requests.labels(endpoint, "503").inc()
-                return Response(
-                    503, b"draining\n", headers={"Retry-After": "1"}
-                )
+                headers = {"Retry-After": "1"}
+                if inbound_id:
+                    headers[tracing.TRACE_HEADER] = inbound_id
+                return Response(503, b"draining\n", headers=headers)
+            trace = self._tracer.start(inbound_id or None, endpoint)
+            trace.stream_id = tracing.current_stream_id()
+            token = tracing.activate(trace)
             t0 = time_mod.perf_counter()
             self._inflight += 1
             try:
@@ -332,6 +365,7 @@ class InferenceServer:
                 # the HTTP layer turns this into a 500; the failing
                 # (often slowest) requests are exactly what the
                 # metrics exist to surface
+                trace.finish(500)
                 self._m_latency.labels(endpoint).observe(
                     time_mod.perf_counter() - t0
                 )
@@ -339,6 +373,18 @@ class InferenceServer:
                 raise
             finally:
                 self._inflight -= 1
+                tracing.deactivate(token)
+            resp.headers.setdefault(
+                tracing.TRACE_HEADER, trace.trace_id
+            )
+            if not isinstance(resp, StreamingResponse):
+                trace.finish(resp.status)
+                resp.headers.setdefault(
+                    tracing.DIGEST_HEADER, trace.digest()
+                )
+            # else: the stream plumbing owns the trace's tail — it
+            # adds the relay span and ships the digest in the final
+            # SSE frame (response headers are already gone by then)
             self._m_latency.labels(endpoint).observe(
                 time_mod.perf_counter() - t0
             )
@@ -525,6 +571,19 @@ class InferenceServer:
         )
         return p
 
+    @staticmethod
+    async def _timed_compute(trace, awaitable):
+        """Record one coarse ``compute`` span around a non-slot decode
+        path — the slot engine's requests get the finer
+        slot_queue_wait/prefill/decode breakdown instead."""
+        if trace is None:
+            return await awaitable
+        t0 = tracing.now()
+        try:
+            return await awaitable
+        finally:
+            trace.add_span("compute", t0, tracing.now())
+
     async def _dispatch_generate(
         self, tokens: List[List[int]], prompt_len: int, p: Dict[str, Any]
     ) -> List[List[int]]:
@@ -532,12 +591,14 @@ class InferenceServer:
         strategy and return the (untrimmed) generated rows."""
         loop = asyncio.get_event_loop()
         in_exec = loop.run_in_executor
+        trace = tracing.current_trace()
+        timed = self._timed_compute
         if p["beam_width"]:
-            return await in_exec(
+            return await timed(trace, in_exec(
                 self._executor, serve_strategies.run_beam, self, tokens,
                 p["max_new_requested"], p["beam_width"], p["eos_id"],
                 p["length_penalty"],
-            )
+            ))
         if (
             self.draft_params is not None
             and p["temperature"] <= 0.0
@@ -549,14 +610,19 @@ class InferenceServer:
             # greedy single-sequence: draft-and-verify, identical
             # output. The eos trim afterwards applies the same
             # truncation the padded greedy path would get.
-            return await in_exec(
+            return await timed(trace, in_exec(
                 self._executor, serve_strategies.run_speculative, self,
                 tokens, p["max_new"], p["eos_id"],
-            )
+            ))
         if self.slot_engine is not None and len(tokens) == 1:
             # joins the running chunk loop at the next boundary; output
             # is already pad-trimmed at eos (the _trim downstream is
-            # idempotent on it)
+            # idempotent on it). The engine stamps request-boundary
+            # timings the trace converts to slot_queue_wait/prefill/
+            # decode spans — batched, nothing recorded per token.
+            timings: Optional[Dict[str, float]] = (
+                {} if trace is not None else None
+            )
             fut = self.slot_engine.submit(
                 tokens[0], p["max_new_requested"],
                 temperature=p["temperature"], top_k=p["top_k"],
@@ -565,8 +631,12 @@ class InferenceServer:
                 presence_penalty=p["presence"],
                 frequency_penalty=p["frequency"],
                 logit_bias=p["logit_bias"],
+                timings=timings,
             )
-            return [await asyncio.wrap_future(fut)]
+            rows = [await asyncio.wrap_future(fut)]
+            if trace is not None:
+                tracing.add_engine_spans(trace, timings)
+            return rows
         if (
             self.cp_mesh is not None
             and len(tokens) == 1
@@ -574,10 +644,10 @@ class InferenceServer:
         ):
             # long prompt: the prefill — the quadratic part — rings
             # over the seq axis; decode runs the normal scan
-            return await in_exec(
+            return await timed(trace, in_exec(
                 self._executor, serve_strategies.run_cp, self,
                 tokens, p,
-            )
+            ))
         if (
             self.prefix_cache is not None
             and len(tokens) == 1
@@ -590,24 +660,24 @@ class InferenceServer:
             # nothing is queued (otherwise continuous batching would
             # have coalesced this request — don't trade batching
             # throughput for a cold-path seed)
-            return await in_exec(
+            return await timed(trace, in_exec(
                 self._executor, generate_with_prefix, self, tokens[0],
                 p["max_new"], p["temperature"], p["top_k"], p["top_p"],
                 p["eos_id"], p["seed"], p["min_new"], p["presence"],
                 p["frequency"], p["logit_bias"],
-            )
+            ))
         if (
             self.prefill_chunk > 0
             and len(tokens) == 1
             and prompt_len > self.prefill_chunk
         ):
-            return await in_exec(
+            return await timed(trace, in_exec(
                 self._executor, serve_strategies.run_chunked, self,
                 tokens, prompt_len, p["max_new"], p["temperature"],
                 p["top_k"], p["top_p"], p["eos_id"], p["seed"],
                 p["min_new"], p["presence"], p["frequency"],
                 p["logit_bias"],
-            )
+            ))
         job = GenJob(
             rows=tokens, prompt_len=prompt_len, max_new=p["max_new"],
             temperature=p["temperature"], top_k=p["top_k"],
@@ -616,7 +686,7 @@ class InferenceServer:
             frequency=p["frequency"], logit_bias=p["logit_bias"],
             future=loop.create_future(),
         )
-        return await self._batcher.submit(job)
+        return await timed(trace, self._batcher.submit(job))
 
     @staticmethod
     def _trim(
@@ -751,6 +821,13 @@ class InferenceServer:
         def on_tokens(delta: List[int]) -> None:  # worker thread
             loop.call_soon_threadsafe(deltas.put_nowait, delta)
 
+        # the trace outlives the handler's contextvar window (the
+        # relay runs after the handler returned), so the stream
+        # plumbing holds the object directly
+        trace = tracing.current_trace()
+        timings: Optional[Dict[str, float]] = (
+            {} if trace is not None else None
+        )
         fut = self.slot_engine.submit(
             row, p["max_new_requested"],
             temperature=p["temperature"], top_k=p["top_k"],
@@ -760,6 +837,7 @@ class InferenceServer:
             frequency_penalty=p["frequency"],
             logit_bias=p["logit_bias"],
             on_tokens=on_tokens, cancel=cancel,
+            timings=timings,
         )
         fut.add_done_callback(
             lambda _f: loop.call_soon_threadsafe(deltas.put_nowait, _DONE)
@@ -767,6 +845,7 @@ class InferenceServer:
 
         sent = [0]
         finished = [False]
+        first_delta_at = [0.0]
 
         def finish() -> None:
             # runs on ANY stream end — completion, mid-stream
@@ -778,6 +857,21 @@ class InferenceServer:
             finished[0] = True
             cancel.set()  # the engine stops decoding this row
             self._m_tokens.inc(sent[0])
+            if trace is not None:
+                _finish_stream_trace()
+
+        def _finish_stream_trace() -> None:
+            # span conversion happens ONCE, here: engine boundary
+            # stamps plus the relay window, then the trace files into
+            # the ring (status 200 — an abandoned stream delivered
+            # what it delivered; transport failure has no status)
+            tracing.add_engine_spans(trace, timings)
+            if first_delta_at[0]:
+                trace.add_span(
+                    "stream_relay", first_delta_at[0], tracing.now(),
+                    events=sent[0],
+                )
+            trace.finish(200)
 
         def sse(payload: Dict[str, Any]) -> bytes:
             return b"data: " + json.dumps(payload).encode() + b"\n\n"
@@ -788,11 +882,21 @@ class InferenceServer:
                     delta = await deltas.get()
                     if delta is _DONE:
                         break
+                    if trace is not None and not first_delta_at[0]:
+                        first_delta_at[0] = tracing.now()
                     sent[0] += len(delta)
                     yield sse(delta_event(delta))
                 for extra in tail_events():
                     yield sse(extra)
-                yield sse({"done": True, "count": sent[0]})
+                done: Dict[str, Any] = {"done": True, "count": sent[0]}
+                if trace is not None:
+                    # the final frame is the stream's digest channel
+                    # (response headers are long gone): the gateway
+                    # splices these spans into its own timeline
+                    finish()
+                    done["trace"] = trace.trace_id
+                    done["spans"] = trace.digest()
+                yield sse(done)
             finally:
                 finish()
 

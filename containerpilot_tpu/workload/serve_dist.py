@@ -683,12 +683,27 @@ class _Frontend:
             "tokens returned by the pod frontend (post-trim)",
             registry=self._registry,
         )
+        from ..telemetry import tracing
+        from ..utils.prom import ensure_build_info
+
+        ensure_build_info(self._registry, "pod")
+        # request tracing, the single-host server's discipline
+        # pod-shaped: adopt/mint a trace id per request, span the
+        # queue->pod-loop dispatch, echo id + digest back (see
+        # telemetry/tracing.py and docs/90-observability.md)
+        self._tracing = tracing
+        self._tracer = tracing.TraceRecorder("pod")
         self._server = HTTPServer()
         self._server.route("GET", "/health", self._health)
         self._server.route("GET", "/metrics", self._metrics)
+        self._server.route("GET", "/v1/traces", self._traces)
         self._server.route("GET", "/v1/model", self._model)
-        self._server.route("POST", "/v1/generate", self._generate)
-        self._server.route("POST", "/v1/score", self._score)
+        self._server.route(
+            "POST", "/v1/generate", self._traced("generate", self._generate)
+        )
+        self._server.route(
+            "POST", "/v1/score", self._traced("score", self._score)
+        )
         # text surface: byte-level tokenizer, zero external assets —
         # the single-host server's --text, pod-shaped
         self.tokenizer = None
@@ -697,7 +712,8 @@ class _Frontend:
 
             self.tokenizer = ByteTokenizer(vocab)
             self._server.route(
-                "POST", "/v1/completions", self._completions
+                "POST", "/v1/completions",
+                self._traced("completions", self._completions),
             )
         self._host, self._port = host, port
         self._Response = Response
@@ -708,6 +724,50 @@ class _Frontend:
     def port(self) -> int:
         return self._server.bound_port or self._port
 
+    def _traced(self, endpoint: str, handler):
+        """Per-request trace around one API handler: adopt the
+        caller's X-CP-Trace id (or mint one), echo it on EVERY
+        answer — 422s included — and hand buffered responses the
+        span digest header. Streams carry only the id (the pod's
+        lockstep rounds are accounted by the ``pod_dispatch`` span
+        the buffered path records; per-chunk stream spans are the
+        single-host server's refinement)."""
+        tracing = self._tracing
+
+        async def wrapped(req):
+            trace = self._tracer.start(
+                tracing.safe_id(req.headers.get("x-cp-trace")),
+                endpoint,
+            )
+            token = tracing.activate(trace)
+            try:
+                resp = await handler(req)
+            except Exception:
+                trace.finish(500)
+                raise
+            finally:
+                tracing.deactivate(token)
+            resp.headers.setdefault(
+                tracing.TRACE_HEADER, trace.trace_id
+            )
+            if not hasattr(resp, "chunks"):  # buffered Response
+                trace.finish(resp.status)
+                resp.headers.setdefault(
+                    tracing.DIGEST_HEADER, trace.digest()
+                )
+            else:
+                trace.finish(resp.status)
+            return resp
+
+        return wrapped
+
+    async def _traces(self, req):
+        return self._Response(
+            200,
+            self._tracer.snapshot_json(req.query),
+            content_type="application/json",
+        )
+
     async def _dispatch(self, endpoint: str, work: Dict[str, Any]):
         """queue → pod loop → result, with the latency/500 accounting
         every endpoint shares. Returns (result, None) on success or
@@ -717,9 +777,10 @@ class _Frontend:
         t0 = time.perf_counter()
         done: "queue.Queue" = queue.Queue()
         self.requests.put((work, done))
-        result = await asyncio.get_event_loop().run_in_executor(
-            None, done.get
-        )
+        with self._tracing.span("pod_dispatch"):
+            result = await asyncio.get_event_loop().run_in_executor(
+                None, done.get
+            )
         self._m_latency.observe(time.perf_counter() - t0)
         if isinstance(result, Exception):
             self._m_requests.labels(endpoint, "500").inc()

@@ -79,6 +79,14 @@ class _Request:
     # cooperative cancel (client disconnect): the worker frees the
     # slot at the next chunk boundary instead of decoding to the end
     cancel: Optional[threading.Event] = None
+    # tracing (telemetry/tracing.py): a caller-owned dict the engine
+    # stamps at REQUEST boundaries only — enqueued/admitted/
+    # prefill_done/done (time.monotonic, tracing's clock) plus a
+    # rounds count. Nothing is recorded per token or per round beyond
+    # one int increment, so the hotpath decode loop stays
+    # allocation-free; the caller converts the stamps to spans once,
+    # after the future resolves (tracing.add_engine_spans).
+    timings: Optional[dict] = None
     future: Future = field(default_factory=Future)
 
 
@@ -87,6 +95,7 @@ class _Slot:
     req: _Request
     emitted: List[int] = field(default_factory=list)
     finished: bool = False  # eos seen (pads follow) or max_new reached
+    rounds: int = 0  # decode rounds this row rode (tracing metadata)
 
 
 class SlotEngine:
@@ -212,6 +221,7 @@ class SlotEngine:
         logit_bias=None,
         on_tokens: Optional[callable] = None,
         cancel: Optional[threading.Event] = None,
+        timings: Optional[dict] = None,
     ) -> Future:
         """Queue one sequence; resolves to its generated ids.
 
@@ -221,7 +231,8 @@ class SlotEngine:
         emitted delta; ``cancel`` (a threading.Event the caller sets,
         e.g. on client disconnect) frees the slot at the next chunk
         boundary — the future then resolves with whatever was
-        emitted."""
+        emitted. ``timings`` (tracing) is stamped at request
+        boundaries only — see _Request.timings."""
         if max_new < 1:
             raise ValueError("max_new must be >= 1")
         if not 0 <= min_new <= max_new:
@@ -247,8 +258,10 @@ class SlotEngine:
             presence=float(presence_penalty),
             frequency=float(frequency_penalty),
             bias_idx=bias_idx, bias_val=bias_val,
-            on_tokens=on_tokens, cancel=cancel,
+            on_tokens=on_tokens, cancel=cancel, timings=timings,
         )
+        if timings is not None:
+            timings["enqueued"] = time.monotonic()
         # atomic with stop()'s drain: either this put lands before the
         # drain (and gets cancelled there) or the stopped check raises
         with self._submit_lock:
@@ -303,6 +316,8 @@ class SlotEngine:
     def _admit(self, slot_id: int, req: _Request) -> None:
         """Prefill the prompt into the slot and sample token 0 with
         generate's exact key schedule."""
+        if req.timings is not None:
+            req.timings["admitted"] = time.monotonic()
         cfg = self.cfg
         logits = row_cache = None
         pc = self.prefix_cache
@@ -386,6 +401,10 @@ class SlotEngine:
             bias_val=req.bias_val, done=state.finished,
         )
         self._active[slot_id] = state
+        if req.timings is not None:
+            # prefill stage ends here: prompt prefilled, token 0
+            # sampled, row inserted — everything after is decode
+            req.timings["prefill_done"] = time.monotonic()
         self._notify(req, [first_host])
 
     def _harvest(self, slot_id: int) -> None:
@@ -396,6 +415,9 @@ class SlotEngine:
             # keep the eos, pad-trim what follows (generate's contract
             # after its own trim step)
             out = out[: out.index(req.eos_id) + 1]
+        if req.timings is not None:
+            req.timings["done"] = time.monotonic()
+            req.timings["rounds"] = state.rounds
         self._active[slot_id] = None
         self._state = retire_slot(self._state, slot_id)
         if not req.future.done():
@@ -425,6 +447,9 @@ class SlotEngine:
                 and s.req.cancel is not None
                 and s.req.cancel.is_set()
             ):
+                if s.req.timings is not None:
+                    s.req.timings["done"] = time.monotonic()
+                    s.req.timings["rounds"] = s.rounds
                 self._active[i] = None
                 self._state = retire_slot(self._state, i)
                 if not s.req.future.done():
@@ -555,6 +580,11 @@ class SlotEngine:
             for i, state in enumerate(self._active):
                 if state is None:
                     continue
+                # per-round tracing cost is ONE int bump per live
+                # slot; the stamps themselves land only at admission/
+                # harvest boundaries (batched per request, never per
+                # token)
+                state.rounds += 1
                 req = state.req
                 before = len(state.emitted)
                 ended = append_chunk(

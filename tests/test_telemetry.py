@@ -9,7 +9,10 @@ import pytest
 from containerpilot_tpu.events import Event, EventBus, EventCode
 from containerpilot_tpu.jobs import Job, JobConfig
 from containerpilot_tpu.telemetry import Metric, Telemetry, TelemetryConfig
-from containerpilot_tpu.telemetry.config import TelemetryConfigError
+from containerpilot_tpu.telemetry.config import (
+    MetricConfig,
+    TelemetryConfigError,
+)
 
 
 def test_telemetry_config_defaults():
@@ -29,6 +32,124 @@ def test_metric_config_validation():
                 "metrics": [{"name": "x", "type": "bogus"}],
             }
         )
+
+
+# -- config validation (the seed package's near-untested paths) --------
+
+
+def test_telemetry_config_rejects_non_mapping_and_unknown_keys():
+    with pytest.raises(TelemetryConfigError):
+        TelemetryConfig(["not", "a", "mapping"])
+    with pytest.raises(TelemetryConfigError) as exc:
+        TelemetryConfig(
+            {"interfaces": ["static:127.0.0.1"], "prot": "tcp"}
+        )
+    assert "unknown keys" in str(exc.value)
+
+
+def test_metric_config_rejects_unknown_keys_and_missing_name():
+    with pytest.raises(TelemetryConfigError) as exc:
+        MetricConfig({"name": "x", "type": "counter", "bogus": 1})
+    assert "unknown keys" in str(exc.value)
+    with pytest.raises(TelemetryConfigError):
+        MetricConfig({"type": "counter"})  # no name
+
+
+def test_telemetry_config_bad_interface_is_config_error():
+    """get_ip failures surface as TelemetryConfigError (the config
+    layer's contract), not a bare ValueError from the IP helper."""
+    with pytest.raises(TelemetryConfigError):
+        TelemetryConfig({"interfaces": ["static:"]})
+
+
+def test_telemetry_config_string_interface_coerced():
+    cfg = TelemetryConfig({"interfaces": "static:127.0.0.1"})
+    assert cfg.address == "127.0.0.1"
+    # the raw (uncoerced) value round-trips into the self-ad job
+    assert cfg.to_job_config_raw()["interfaces"] == "static:127.0.0.1"
+
+
+def test_to_job_config_raw_tags_and_version():
+    from containerpilot_tpu.version import VERSION
+
+    cfg = TelemetryConfig(
+        {"interfaces": ["static:127.0.0.1"], "tags": ["az1"]}
+    )
+    raw = cfg.to_job_config_raw()
+    assert raw["tags"] == ["az1", VERSION]
+    assert "interfaces" not in TelemetryConfig(
+        {}
+    ).to_job_config_raw()  # unset stays unset
+
+
+def test_metric_config_reload_reregisters_without_collision():
+    """Config reloads re-create the same metric; the prometheus
+    registry treats a duplicate register as fatal, so MetricConfig
+    must unregister-then-register (reference: metrics_config.go)."""
+    spec = {"name": "zz_reload_gauge", "type": "gauge", "help": "g"}
+    first = MetricConfig(dict(spec))
+    first.collector.set(7)
+    second = MetricConfig(dict(spec))  # same full name: no raise
+    assert second.collector is not first.collector
+    assert second.full_name == "zz_reload_gauge"
+
+
+def test_metric_config_full_name_joins_nonempty_parts():
+    cfg = MetricConfig(
+        {"namespace": "zz", "name": "depth", "type": "gauge"}
+    )
+    assert cfg.full_name == "zz_depth"  # empty subsystem dropped
+    assert cfg.help == "depth"  # help defaults to the name
+
+
+# -- metric record paths ----------------------------------------------
+
+
+def _metric(name, mtype):
+    return Metric(MetricConfig({"name": name, "type": mtype}))
+
+
+def test_counter_adds_and_gauge_sets():
+    counter = _metric("zz_rec_counter", "counter")
+    counter.record("2")
+    counter.record("3.5")
+    assert counter.collector._value.get() == 5.5  # noqa: SLF001
+    gauge = _metric("zz_rec_gauge", "gauge")
+    gauge.record("9")
+    gauge.record("4")  # set, not add
+    assert gauge.collector._value.get() == 4.0  # noqa: SLF001
+
+
+def test_histogram_and_summary_observe():
+    histogram = _metric("zz_rec_histogram", "histogram")
+    histogram.record("0.25")
+    histogram.record("0.75")
+    assert histogram.collector._sum.get() == 1.0  # noqa: SLF001
+    summary = _metric("zz_rec_summary", "summary")
+    summary.record("2")
+    assert summary.collector._count.get() == 1  # noqa: SLF001
+    assert summary.collector._sum.get() == 2.0  # noqa: SLF001
+
+
+def test_record_non_numeric_value_is_dropped_not_fatal():
+    counter = _metric("zz_rec_bad_value", "counter")
+    counter.record("not-a-number")
+    assert counter.collector._value.get() == 0.0  # noqa: SLF001
+
+
+def test_process_metric_matches_by_full_name_only():
+    metric = Metric(
+        MetricConfig(
+            {"namespace": "zz", "subsystem": "app",
+             "name": "hits", "type": "counter"}
+        )
+    )
+    metric.process_metric("zz_app_hits|1")
+    metric.process_metric("zz_app_misses|5")  # someone else's
+    metric.process_metric("zz_app_hits")  # no value: logged, dropped
+    # value with extra pipes: fields beyond the second are ignored
+    metric.process_metric("zz_app_hits|2|junk")
+    assert metric.collector._value.get() == 3.0  # noqa: SLF001
 
 
 def test_metric_actor_records(run):

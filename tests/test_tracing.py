@@ -1,0 +1,383 @@
+"""Cross-hop tracing units (telemetry/tracing.py): ring retention,
+contextvar isolation, the digest wire format, dominant-stage
+attribution, logger correlation, and the build-info gauge."""
+import asyncio
+import http.client
+import json
+import logging
+
+import pytest
+
+from containerpilot_tpu.config.logger import LogConfig
+from containerpilot_tpu.telemetry import tracing
+from containerpilot_tpu.utils.http import HTTPServer, Request, Response
+from containerpilot_tpu.utils.httpclient import keepalive_request
+from containerpilot_tpu.utils.prom import ensure_build_info
+
+
+# -- recorder retention -------------------------------------------------
+
+
+def test_recent_ring_evicts_oldest():
+    rec = tracing.TraceRecorder("t", recent=3, slowest=2)
+    ids = []
+    for _ in range(5):
+        trace = rec.start(endpoint="e")
+        ids.append(trace.trace_id)
+        trace.finish(200)
+    assert rec.recorded == 5
+    kept = [t.trace_id for t in rec.recent()]
+    # newest first, capped at 3, the two oldest evicted
+    assert kept == ids[-1:-4:-1]
+
+
+def test_slowest_board_keeps_the_slow_ones():
+    rec = tracing.TraceRecorder("t", recent=2, slowest=2)
+    durations = {}
+    for ms in (5, 50, 1, 20):
+        trace = rec.start(endpoint="e")
+        # synthetic duration: rewind the start stamp
+        trace.started -= ms / 1e3
+        trace.finish(200)
+        durations[trace.trace_id] = ms
+    slow = [durations[t.trace_id] for t in rec.slowest()]
+    assert slow == [50, 20]  # slowest first; 5 and 1 fell off
+    # the ring, meanwhile, is purely most-recent
+    assert [durations[t.trace_id] for t in rec.recent()] == [20, 1]
+
+
+def test_finish_is_idempotent_and_records_once():
+    rec = tracing.TraceRecorder("t")
+    trace = rec.start(endpoint="e")
+    trace.finish(429)
+    trace.finish(200)
+    assert rec.recorded == 1
+    assert rec.recent()[0].status == 429  # first finish wins
+    assert rec.find(trace.trace_id)
+
+
+def test_refused_trace_is_findable_with_zero_spans():
+    """A shed (429/504) dispatched nothing — its trace still lands in
+    the ring so a client-reported failure is findable by id."""
+    rec = tracing.TraceRecorder("gateway")
+    trace = rec.start(trace_id="cafe0123cafe0123", endpoint="generate")
+    trace.finish(429)
+    found = rec.find("cafe0123cafe0123")
+    assert found and found[0].spans == []
+
+
+# -- spans + context ----------------------------------------------------
+
+
+def test_span_cap_bounds_memory():
+    rec = tracing.TraceRecorder("t")
+    trace = rec.start(endpoint="e")
+    for i in range(tracing.MAX_SPANS * 2):
+        trace.add_span("s", 0.0, 1.0)
+    assert len(trace.spans) == tracing.MAX_SPANS
+
+
+def test_contextvar_isolation_across_concurrent_tasks(run):
+    """Two concurrent tasks, two traces: spans recorded through the
+    module-level ``span()`` land on each task's own trace — task
+    creation snapshots the context, so there is no bleed."""
+    rec = tracing.TraceRecorder("t")
+
+    async def worker(name: str, trace: tracing.Trace):
+        token = tracing.activate(trace)
+        try:
+            assert tracing.current_trace_id() == trace.trace_id
+            with tracing.span(f"stage_{name}"):
+                await asyncio.sleep(0.01)
+            with tracing.span(f"stage_{name}_2"):
+                await asyncio.sleep(0.005)
+        finally:
+            tracing.deactivate(token)
+
+    async def scenario():
+        t_a, t_b = rec.start(endpoint="a"), rec.start(endpoint="b")
+
+        async def spawn(name, trace):
+            # ensure_future copies the ambient context; activation
+            # happens INSIDE the task so each binds only its own
+            return asyncio.ensure_future(worker(name, trace))
+
+        await asyncio.gather(
+            await spawn("a", t_a), await spawn("b", t_b)
+        )
+        return t_a, t_b
+
+    t_a, t_b = run(scenario())
+    assert {s[0] for s in t_a.spans} == {"stage_a", "stage_a_2"}
+    assert {s[0] for s in t_b.spans} == {"stage_b", "stage_b_2"}
+
+
+def test_module_span_is_noop_without_active_trace():
+    with tracing.span("anything"):
+        pass  # must not raise, must not record anywhere
+
+
+def test_cancelled_span_records_nothing(run):
+    """A hedge's losing leg (or an abandoned client's task) exits its
+    upstream spans via CancelledError: recording those would misalign
+    the digest-stitch anchor and double-count the stage in dominance,
+    so a cancelled span must vanish. A span exiting via a REAL
+    failure still records — time spent failing is signal."""
+    rec = tracing.TraceRecorder("t")
+    trace = rec.start(endpoint="e")
+
+    async def loser():
+        with tracing.span("upstream_ttfb"):
+            await asyncio.sleep(30)
+
+    async def scenario():
+        token = tracing.activate(trace)
+        try:
+            task = asyncio.ensure_future(loser())
+            await asyncio.sleep(0.01)
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        finally:
+            tracing.deactivate(token)
+
+    run(scenario())
+    assert trace.spans == []
+    with pytest.raises(RuntimeError):
+        with trace.span("upstream_ttfb"):
+            raise RuntimeError("upstream died")
+    assert [s[0] for s in trace.spans] == ["upstream_ttfb"]
+
+
+def test_safe_id_rejects_splice_hostile_ids():
+    """Peer-supplied trace ids ride unescaped into the cached mux
+    HEADERS template and echoed answer headers — adoption points must
+    filter through safe_id."""
+    assert tracing.safe_id("cafe0123cafe0123") == "cafe0123cafe0123"
+    assert tracing.safe_id("client-Req_42") == "client-Req_42"
+    for hostile in (
+        None, "", "a" * (tracing.MAX_ID_LEN + 1),
+        'a"},"path":"/v1/score', "id with spaces", "id\r\nInjected: 1",
+        "id;semi", "id~tilde",
+    ):
+        assert tracing.safe_id(hostile) is None
+
+
+def test_snapshot_json_shared_handler_body():
+    rec = tracing.TraceRecorder("t")
+    for _ in range(3):
+        rec.start(endpoint="e").finish(200)
+    body = json.loads(rec.snapshot_json({}))
+    assert len(body["recent"]) == 3
+    bounded = json.loads(rec.snapshot_json({"n": ["1"]}))
+    assert len(bounded["recent"]) == 1
+    ignored = json.loads(rec.snapshot_json({"n": ["-5x"]}))
+    assert len(ignored["recent"]) == 3  # non-numeric ?n= ignored
+
+
+# -- digest wire format -------------------------------------------------
+
+
+def test_digest_roundtrip():
+    rec = tracing.TraceRecorder("replica")
+    trace = rec.start(endpoint="generate")
+    base = trace.started
+    trace.add_span("prefill", base + 0.001, base + 0.004)
+    trace.add_span("decode", base + 0.004, base + 0.050, rounds=7)
+    digest = trace.digest()
+    parsed = tracing.parse_digest(digest)
+    assert [p[0] for p in parsed] == ["prefill", "decode"]
+    assert abs(parsed[0][1] - 0.001) < 1e-4  # offset survives
+    assert abs(parsed[1][2] - 0.046) < 1e-4  # duration survives
+
+
+def test_parse_digest_tolerates_garbage():
+    assert tracing.parse_digest("") == []
+    assert tracing.parse_digest("no-tildes-here") == []
+    assert tracing.parse_digest("a~x~y;b~1.0~2.0;~3~4") == [
+        ("b", 0.001, 0.002)
+    ]
+    # a hostile peer cannot balloon memory through the digest
+    flood = ";".join("s~1~1" for _ in range(10_000))
+    assert len(tracing.parse_digest(flood)) == tracing.MAX_DIGEST_SPANS
+
+
+def test_child_digest_is_spliced_with_prefix_and_alignment():
+    rec = tracing.TraceRecorder("gateway")
+    trace = rec.start(endpoint="generate")
+    dispatch_at = trace.started + 0.010
+    trace.add_span("upstream_ttfb", dispatch_at, dispatch_at + 0.100)
+    trace.add_child_digest("prefill~2.000~5.000", base=dispatch_at)
+    stage, start, end, _meta = trace.spans[-1]
+    assert stage == "replica.prefill"
+    assert abs(start - (dispatch_at + 0.002)) < 1e-6
+    assert abs((end - start) - 0.005) < 1e-6
+
+
+# -- dominance ---------------------------------------------------------
+
+
+def test_dominant_stage_top_level():
+    assert tracing.dominant_stage(
+        {"admission_queue_wait": 1.2, "upstream_connect": 0.01,
+         "upstream_ttfb": 0.3}
+    ) == "admission_queue_wait"
+
+
+def test_dominant_stage_descends_into_replica_refinement():
+    """When the upstream span wins, the replica breakdown nested
+    inside it names the true culprit instead of 'the upstream'."""
+    assert tracing.dominant_stage(
+        {"admission_queue_wait": 0.1, "upstream_ttfb": 2.0,
+         "replica.prefill": 0.2, "replica.decode": 1.7}
+    ) == "replica.decode"
+
+
+def test_dominant_stage_replica_only_and_empty():
+    assert tracing.dominant_stage(
+        {"slot_queue_wait": 0.5, "decode": 0.1}
+    ) == "slot_queue_wait"
+    assert tracing.dominant_stage({}) is None
+    assert tracing.dominant_stage({"x": 0.0}) is None
+
+
+# -- engine-timings bridge ---------------------------------------------
+
+
+def test_add_engine_spans_is_bounded_and_batched():
+    """However long the decode ran (rounds, tokens), the engine hands
+    over FOUR floats and one int — the span conversion emits at most
+    three spans. This is the no-per-token-record contract."""
+    rec = tracing.TraceRecorder("replica")
+    trace = rec.start(endpoint="generate")
+    timings = {
+        "enqueued": 100.0, "admitted": 100.2,
+        "prefill_done": 100.5, "done": 190.0, "rounds": 100_000,
+    }
+    tracing.add_engine_spans(trace, timings)
+    assert [s[0] for s in trace.spans] == [
+        "slot_queue_wait", "prefill", "decode"
+    ]
+    assert trace.spans[-1][3] == {"rounds": 100_000}
+    # partial stamps (request failed before admission) emit less,
+    # never raise
+    t2 = rec.start(endpoint="generate")
+    tracing.add_engine_spans(t2, {"enqueued": 1.0})
+    assert t2.spans == []
+
+
+def test_add_engine_spans_abandoned_mid_decode_accounts_to_now():
+    """A stream abandoned mid-decode converts its timings before the
+    engine's cancel-retire path stamps ``done``/``rounds`` — the
+    decode stage must still be accounted (prefill_done -> now), not
+    dropped, or dominance misattributes seconds of decode."""
+    rec = tracing.TraceRecorder("replica")
+    trace = rec.start(endpoint="generate")
+    start = tracing.now()
+    timings = {
+        "enqueued": start - 0.5, "admitted": start - 0.45,
+        "prefill_done": start - 0.4,  # no done, no rounds yet
+    }
+    tracing.add_engine_spans(trace, timings)
+    stages = {s[0]: s for s in trace.spans}
+    assert set(stages) == {"slot_queue_wait", "prefill", "decode"}
+    _, d_start, d_end, _ = stages["decode"]
+    assert d_start == start - 0.4
+    # decode end is "the abandon instant": at/after prefill_done,
+    # at/before the clock right after conversion
+    assert d_start <= d_end <= tracing.now()
+
+
+# -- log correlation ----------------------------------------------------
+
+
+def test_json_logger_injects_trace_and_stream_id(tmp_path):
+    log_file = tmp_path / "cp.json.log"
+    LogConfig(
+        {"level": "INFO", "format": "json", "output": str(log_file)}
+    ).init()
+    logger = logging.getLogger("containerpilot.test")
+    rec = tracing.TraceRecorder("replica")
+    trace = rec.start(trace_id="beef0000beef0000", endpoint="generate")
+    token = tracing.activate(trace)
+    stream_token = tracing.set_stream_id(7)
+    try:
+        logger.info("inside the request")
+    finally:
+        tracing.deactivate(token)
+        tracing._stream.reset(stream_token)  # noqa: SLF001
+    logger.info("outside the request")
+    for handler in logging.getLogger("containerpilot").handlers:
+        handler.flush()
+    lines = [
+        json.loads(line)
+        for line in log_file.read_text().strip().splitlines()
+    ]
+    assert lines[0]["trace_id"] == "beef0000beef0000"
+    assert lines[0]["stream_id"] == 7
+    assert "trace_id" not in lines[1] and "stream_id" not in lines[1]
+
+
+# -- build info ---------------------------------------------------------
+
+
+def test_build_info_gauge_registered_once_per_registry():
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    registry = CollectorRegistry()
+    ensure_build_info(registry, "replica")
+    ensure_build_info(registry, "replica")  # reload: no crash
+    body = generate_latest(registry).decode()
+    assert 'cp_build_info{' in body
+    assert 'role="replica"' in body and "version=" in body
+
+
+# -- client-side propagation (httpclient) -------------------------------
+
+
+def test_keepalive_request_carries_active_trace_header(run):
+    """A control/catalog call made while a traced request is active
+    carries its X-CP-Trace — callers propagate by running the sync
+    client under a copied context."""
+    import contextvars
+
+    seen = {}
+
+    async def scenario():
+        server = HTTPServer()
+
+        async def handler(req: Request) -> Response:
+            seen.update(req.headers)
+            return Response(200, b"ok\n")
+
+        server.route("GET", "/probe", handler)
+        await server.start_tcp("127.0.0.1", 0)
+        port = server.bound_port
+        rec = tracing.TraceRecorder("test")
+        trace = rec.start(trace_id="feed0123feed0123")
+        token = tracing.activate(trace)
+
+        def call():
+            conns = []
+            return keepalive_request(
+                lambda: None,
+                conns.append,
+                lambda: http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=10
+                ),
+                "GET", "/probe",
+            )
+
+        ctx = contextvars.copy_context()
+        try:
+            status, _body = await asyncio.get_event_loop(
+            ).run_in_executor(None, ctx.run, call)
+        finally:
+            tracing.deactivate(token)
+        await server.stop()
+        return status
+
+    assert run(scenario()) == 200
+    assert seen.get("x-cp-trace") == "feed0123feed0123"
