@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all build test test-fast test-workload integration fleet-smoke trace-smoke chaos chaos-smoke bench bench-gateway lint lint-baseline clean image
+.PHONY: all build test test-fast test-workload integration fleet-smoke trace-smoke chaos chaos-smoke bench bench-gateway bench-reuse lint lint-baseline clean image
 
 all: build test
 
@@ -67,6 +67,13 @@ bench:
 bench-gateway:
 	JAX_PLATFORMS=cpu $(PYTHON) -c "import json, bench; \
 		print(json.dumps(bench.gateway_overhead_bench(), indent=2))"
+
+# fleet-wide KV reuse vs the session-sticky baseline on the same
+# multi-turn chat trace: tokens_reused/prompt token + shed-free TTFT
+# p50 per arm; meets_target pins reuse strictly above baseline
+bench-reuse:
+	JAX_PLATFORMS=cpu $(PYTHON) -c "import json, bench; \
+		print(json.dumps(bench.prefix_reuse_bench(), indent=2))"
 
 # cpcheck (AST invariant rules vs analysis/baseline.json) + compileall;
 # see docs/70-static-analysis.md. Non-zero on any non-baselined finding.

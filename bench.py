@@ -1098,6 +1098,136 @@ def chaos_goodput_bench(seed: int = 0) -> dict:
     }
 
 
+def prefix_reuse_bench(seeds: tuple = (0, 1, 2)) -> dict:
+    """Fleet-wide KV reuse vs. the session-sticky baseline: replay
+    the SAME multi-turn chat trace (growing shared-prefix
+    conversations + a replica draining mid-conversation, from
+    chaos/trace.py) through two fleets that differ only in routing —
+    ``multiturn_rebalance`` (cache-contents-aware ``_pick`` + the
+    host-RAM KV spill tier earning readmissions) and
+    ``multiturn_sticky_baseline`` (cache_routing off: re-pins land by
+    load, blind to where the KV lives). Records fleet-wide
+    tokens_reused per prompt token (the ML-goodput yardstick for
+    reuse) and shed-free TTFT p50 for both arms, POOLED over the
+    seeds (each seed is a different conversation schedule; pooling
+    keeps one lucky tie-break concentration from deciding the
+    verdict). Every scenario runs in its OWN interpreter — exactly
+    the ``python -m containerpilot_tpu.chaos --scenario`` regime the
+    tier-1 tests gate on: a shared warm process would amortize every
+    jit compile, collapse request latencies to the point where
+    conversations never overlap, and hand the blind baseline an
+    idle-fleet concentration the policies are not separable under.
+    ``meets_target`` = the aware arm clears its strict invariants at
+    every seed (zero 5xx, drain absorbed, hint hits, spill
+    readmissions) AND reuses STRICTLY more prefix tokens per prompt
+    token than the baseline — cache-aware routing must pay for
+    itself on the workload it exists for. Host-side and CPU-sized;
+    see docs/80-chaos.md."""
+    import logging as logging_mod
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    logging_mod.disable(logging_mod.CRITICAL)
+
+    def run_cold(name: str, seed: int) -> dict:
+        with tempfile.TemporaryDirectory(prefix="reuse-bench-") as d:
+            out = os.path.join(d, "report.json")
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m", "containerpilot_tpu.chaos",
+                    "--scenario", name, "--seed", str(seed),
+                    "--json", out,
+                ],
+                capture_output=True, text=True, timeout=240,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            )
+            try:
+                with open(out, encoding="utf-8") as f:
+                    return json.load(f)["scenarios"][0]
+            except (OSError, ValueError, KeyError, IndexError):
+                raise RuntimeError(
+                    f"{name} seed {seed} produced no report "
+                    f"(exit {proc.returncode}): {proc.stderr[-300:]!r}"
+                ) from None
+
+    arms: dict = {}
+    for arm, name in (
+        ("cache_aware", "multiturn_rebalance"),
+        ("session_sticky", "multiturn_sticky_baseline"),
+    ):
+        runs = []
+        for seed in seeds:
+            report = run_cold(name, seed)
+            score = report["score"]
+            kv = report["kv"]
+            runs.append({
+                "seed": seed,
+                "passed": report["passed"],
+                "requests": score["requests"],
+                "goodput_fraction": score["goodput_fraction"],
+                # sheds carry no TTFT sample, so these are shed-free
+                "ttft_p50_ms": score["ttft_ms"]["p50"],
+                "ttft_p99_ms": score["ttft_ms"]["p99"],
+                "count_5xx": score["count_5xx"],
+                "tokens_reused": kv["tokens_reused"],
+                "prompt_tokens": kv["prompt_tokens"],
+                "tokens_reused_per_prompt_token": (
+                    kv["tokens_reused_per_prompt_token"]
+                ),
+                "cache_hint_hits": kv["cache_hint_hits"],
+                "cache_hint_misses": kv["cache_hint_misses"],
+                "spilled": kv["spilled"],
+                "readmitted": kv["readmitted"],
+                "sticky_evicted": (
+                    report["gateway"]["sticky"]["evicted"]
+                ),
+            })
+        reused = sum(r["tokens_reused"] for r in runs)
+        prompts = sum(r["prompt_tokens"] for r in runs)
+        arms[arm] = {
+            "scenario": name,
+            "passed": all(r["passed"] for r in runs),
+            "tokens_reused": reused,
+            "prompt_tokens": prompts,
+            "tokens_reused_per_prompt_token": round(
+                reused / max(1, prompts), 4
+            ),
+            "ttft_p50_ms": round(
+                sum(r["ttft_p50_ms"] for r in runs) / len(runs), 2
+            ),
+            "runs": runs,
+        }
+    aware = arms["cache_aware"]
+    base = arms["session_sticky"]
+    return {
+        "backend": jax.default_backend(),
+        "seeds": list(seeds),
+        "arms": arms,
+        "reuse_advantage_per_prompt_token": round(
+            aware["tokens_reused_per_prompt_token"]
+            - base["tokens_reused_per_prompt_token"], 4
+        ),
+        "ttft_p50_delta_ms": round(
+            aware["ttft_p50_ms"] - base["ttft_p50_ms"], 2
+        ),
+        # the bar: the aware arm holds its invariants at every seed
+        # AND reuses strictly more than blind session-sticky on the
+        # same pooled traces
+        "meets_target": bool(
+            aware["passed"]
+            and aware["tokens_reused_per_prompt_token"]
+            > base["tokens_reused_per_prompt_token"]
+        ),
+    }
+
+
 def _bench_subprocess(fn_name: str, timeout_s: int,
                       env: dict | None = None) -> dict:
     """Run one workload bench in its own interpreter with a hard
@@ -1199,6 +1329,13 @@ def workload_benches() -> dict:
     # injected faults, recorded every round (BENCH_r06+)
     extras["chaos_goodput"] = _bench_subprocess(
         "chaos_goodput_bench", 900,
+        env=None if backend == "tpu" else {"JAX_PLATFORMS": "cpu"},
+    )
+    # KV-reuse trajectory: cache-aware routing + host-RAM spill tier
+    # vs the session-sticky baseline on the multi-turn chat trace
+    # (6 cold scenario subprocesses: 2 arms x 3 seeds)
+    extras["prefix_reuse"] = _bench_subprocess(
+        "prefix_reuse_bench", 900,
         env=None if backend == "tpu" else {"JAX_PLATFORMS": "cpu"},
     )
     if backend != "tpu":

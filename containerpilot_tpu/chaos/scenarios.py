@@ -102,6 +102,7 @@ class FleetHarness:
         use_proxies: bool = False,
         gateway_kwargs: Optional[Dict[str, Any]] = None,
         autoscaler_kwargs: Optional[Dict[str, Any]] = None,
+        server_kwargs: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.catalog_dir = catalog_dir
         self.n_replicas = replicas
@@ -109,6 +110,9 @@ class FleetHarness:
         self.heartbeat_interval = heartbeat_interval
         self.use_proxies = use_proxies
         self.gateway_kwargs = dict(gateway_kwargs or {})
+        # extra InferenceServer knobs (e.g. prefix_cache_entries +
+        # kv_spill_bytes for the KV-reuse scenarios)
+        self.server_kwargs = dict(server_kwargs or {})
         self.autoscaler_kwargs = (
             dict(autoscaler_kwargs)
             if autoscaler_kwargs is not None else None
@@ -140,7 +144,7 @@ class FleetHarness:
         index = len(self.servers)
         server = InferenceServer(
             cfg, params, "127.0.0.1", 0, max_len=64,
-            slots=2, slot_chunk=4,
+            slots=2, slot_chunk=4, **self.server_kwargs,
         )
         await server.run()
         proxy: Optional[ChaosProxy] = None
@@ -268,10 +272,31 @@ class FleetHarness:
             }
         )
 
+    def kv_stats(self) -> Dict[str, int]:
+        """Summed prefix-cache/spill counters across every replica
+        ever booted (killed and retired included — their in-process
+        stats remain readable): the fleet-wide reuse ledger."""
+        totals: Dict[str, int] = {
+            "hits": 0, "misses": 0, "tokens_reused": 0,
+            "spilled": 0, "readmitted": 0,
+        }
+        for server in self.servers:
+            pc = getattr(server, "prefix_cache", None)
+            if pc is None:
+                continue
+            for key in totals:
+                totals[key] += pc.stats.get(key, 0)
+        return totals
+
     async def apply(self, fault: Fault) -> None:
         self._log(fault)
         if fault.kind == "kill":
             await self.kill(fault.replica)
+        elif fault.kind == "drain":
+            # graceful scale-away mid-conversation: the PR 3 drain
+            # invariant (deregister, finish in-flight, stop) — the
+            # rebalance event cache-aware routing must absorb warmly
+            await self.retire_replica(f"replica-{fault.replica}")
         elif fault.kind == "wedge":
             self.servers[fault.replica].ready = False
         elif fault.kind == "unwedge":
@@ -336,6 +361,9 @@ class ScenarioSpec:
     ttl: int = 1
     use_proxies: bool = False
     gateway: Dict[str, Any] = field(default_factory=dict)
+    #: extra InferenceServer knobs per replica (e.g.
+    #: prefix_cache_entries + kv_spill_bytes for KV-reuse scenarios)
+    server: Dict[str, Any] = field(default_factory=dict)
     #: AutoscalerConfig kwargs; None runs without an autoscaler
     autoscaler: Optional[Dict[str, Any]] = None
     slo: SLO = field(default_factory=SLO)
@@ -375,6 +403,17 @@ class ScenarioSpec:
     expect_scaled_replica_routed: bool = False
     #: replicas the autoscaler manages at the end (back to min)
     expect_managed_at_end: Optional[int] = None
+    # -- KV reuse invariants -------------------------------------------
+    #: routing picks that must land on a digest-warm replica (a
+    #: drained session re-pinning onto the replica that absorbed its
+    #: retried turns is the canonical hit)
+    expect_cache_hint_hits_min: int = 0
+    #: fleet-wide prefix tokens reused during the trace (warmup
+    #: excluded) — proves the reuse machinery ran, not just routed
+    expect_tokens_reused_min: int = 0
+    #: spill-tier readmissions (device LRU eviction -> host RAM ->
+    #: device again) that must have happened
+    expect_readmitted_min: int = 0
     # -- latency-attribution invariants --------------------------------
     #: violation class -> the stage that must dominate it in the
     #: report's stage_attribution (e.g. {"ttft":
@@ -396,19 +435,53 @@ async def _warm_fleet(
     Mid-trace cold compiles would otherwise dominate TTFT on a lab
     box and score the run on XLA, not on the fleet."""
     port = harness.servers[0].port
-    for i, length in enumerate(
-        sorted({len(r.tokens) for r in requests})
-    ):
+    index = 0
+
+    async def warm_one(tokens: List[int]) -> None:
+        nonlocal index
+        index += 1
         warm = TraceRequest(
-            index=-1 - i, at_s=0.0, session_id="warm", tenant=0,
-            tokens=[1] * length, max_new_tokens=2, seed=0,
+            index=-index, at_s=0.0, session_id="warm", tenant=0,
+            tokens=tokens, max_new_tokens=2, seed=0,
         )
         record = await issue_request(port, warm, time.monotonic())
         if record.status != 200:
             raise RuntimeError(
-                f"warm request (prompt len {length}) failed: "
+                f"warm request (prompt len {len(tokens)}) failed: "
                 f"status={record.status} error={record.error!r}"
             )
+
+    lengths = sorted({len(r.tokens) for r in requests})
+    for length in lengths:
+        await warm_one([1] * length)
+    if getattr(harness.servers[0], "prefix_cache", None) is None:
+        return
+    # with a prefix cache on, the REUSE path has its own compile set:
+    # one (1, bucket)-shaped extend program per suffix bucket a hit
+    # can rewind+extend. The chained [1]*L warms above only ever
+    # produce the smallest bucket (each length extends its
+    # predecessor), so larger jumps — turn k of one session matching
+    # only a short prefix of a longer prompt — would compile mid-trace
+    # and bill XLA to that request's TTFT. Warm each bucket with a
+    # fresh token family: store a MIN_REUSE base, then jump by the
+    # bucket so the hit extends exactly that shape.
+    from ..workload.serve_prefix import BUCKET, MIN_REUSE
+
+    max_len = lengths[-1] if lengths else 0
+    targets = set()
+    jump = MIN_REUSE + BUCKET
+    while jump <= max_len:
+        targets.add(jump)
+        jump += BUCKET
+    if max_len > MIN_REUSE:
+        # the ragged largest jump (max_len - MIN_REUSE may not be a
+        # bucket multiple, and its rounded-up bucket is a shape no
+        # aligned jump produces)
+        targets.add(max_len)
+    for i, length in enumerate(sorted(targets)):
+        family = 2 + i  # distinct ids, never the [1]* family above
+        await warm_one([family] * MIN_REUSE)
+        await warm_one([family] * length)
 
 
 async def _drive(
@@ -439,6 +512,7 @@ async def run_scenario_async(
         use_proxies=spec.use_proxies,
         gateway_kwargs=dict(spec.gateway, jitter_seed=seed),
         autoscaler_kwargs=spec.autoscaler,
+        server_kwargs=spec.server,
     )
     try:
         # start() inside the try: a boot that fails half-way (e.g.
@@ -448,6 +522,10 @@ async def run_scenario_async(
         await harness.start()
         gw = harness.gateway
         await _warm_fleet(harness, requests)
+        # reuse accounting starts AFTER warmup: the warm requests
+        # seed replica-0's prefix cache with [1]*L prompts whose
+        # chained matches must not inflate the trace's reuse numbers
+        kv_before = harness.kv_stats()
         clock_zero = time.monotonic()
         schedule = asyncio.ensure_future(
             harness.run_schedule(spec.faults, clock_zero)
@@ -484,7 +562,27 @@ async def run_scenario_async(
                 p.resets_injected
                 for p in harness.proxies if p is not None
             ),
+            "sticky": {
+                "size": len(gw._sticky),  # noqa: SLF001
+                "capacity": gw.sticky_capacity,
+                "evicted": gw.sticky_evicted,
+            },
         }
+        kv_after = harness.kv_stats()
+        prompt_tokens = sum(len(r.tokens) for r in requests)
+        kv_stats = {
+            key: kv_after[key] - kv_before[key] for key in kv_after
+        }
+        kv_stats.update(
+            cache_hint_hits=gw.hint_hits,
+            cache_hint_misses=gw.hint_misses,
+            prompt_tokens=prompt_tokens,
+            # the ML-goodput yardstick: prefix tokens the fleet did
+            # NOT recompute, per prompt token it was sent
+            tokens_reused_per_prompt_token=round(
+                kv_stats["tokens_reused"] / max(1, prompt_tokens), 4
+            ),
+        )
         autoscaler_stats = (
             dict(harness.autoscaler.stats)
             if harness.autoscaler is not None else None
@@ -638,6 +736,32 @@ async def run_scenario_async(
             f"{managed} managed replicas at end "
             f"(expected {spec.expect_managed_at_end})",
         )
+    if spec.expect_cache_hint_hits_min > 0:
+        check(
+            "cache_hint_hits",
+            kv_stats["cache_hint_hits"]
+            >= spec.expect_cache_hint_hits_min,
+            f"{kv_stats['cache_hint_hits']} cache-hint routing hits "
+            f"(expected >= {spec.expect_cache_hint_hits_min}; a "
+            f"re-pinned session must land on the warmest survivor)",
+        )
+    if spec.expect_tokens_reused_min > 0:
+        check(
+            "tokens_reused",
+            kv_stats["tokens_reused"] >= spec.expect_tokens_reused_min,
+            f"{kv_stats['tokens_reused']} prefix tokens reused "
+            f"fleet-wide ({kv_stats['tokens_reused_per_prompt_token']}"
+            f"/prompt token; expected >= "
+            f"{spec.expect_tokens_reused_min})",
+        )
+    if spec.expect_readmitted_min > 0:
+        check(
+            "spill_readmitted",
+            kv_stats["readmitted"] >= spec.expect_readmitted_min,
+            f"{kv_stats['readmitted']} spill-tier readmissions "
+            f"(expected >= {spec.expect_readmitted_min}; evicted KV "
+            f"must come back from host RAM, not re-prefill)",
+        )
     for cls, want in sorted(spec.expect_dominant_stage.items()):
         attributed = score["stage_attribution"].get(cls)
         if attributed is None:
@@ -678,6 +802,7 @@ async def run_scenario_async(
         "trace": trace_summary(requests),
         "score": score,
         "gateway": gateway_stats,
+        "kv": kv_stats,
         "autoscaler": autoscaler_stats,
         "faults": harness.fault_log,
         "fault_counts": fault_counts,
@@ -959,6 +1084,114 @@ _register(ScenarioSpec(
     expect_scaled_replica_routed=True,
     expect_managed_at_end=2,
     slo=SLO(ttft_s=2.5, tpot_s=0.5),
+))
+
+#: the KV-reuse fleet: a TINY device LRU (2 entries) so a session's
+#: newest key is routinely evicted between its turns — forcing the
+#: host-RAM spill tier to earn its readmissions — with a budget
+#: comfortably holding the lab model's ~16KB entries
+_REUSE_SERVER = {
+    "prefix_cache_entries": 2,
+    "kv_spill_bytes": 512 * 1024,
+}
+
+#: sticky pins bounded WELL below the session count: pins churn out
+#: of the LRU between most turns (the satellite bound doing its job),
+#: and each re-pin is exactly the decision cache-aware routing
+#: upgrades — digest-warm replica vs. wherever least-loaded points.
+#: cache_slack 2 = one 2-slot replica's worth of queue: warmth may
+#: absorb that much extra load (a readmit is far cheaper than a
+#: re-prefill) but never out-shouts a real hotspot; retries carry one
+#: extra attempt because a drain racing a contention spike can bounce
+#: a request off more than one replica
+_REUSE_GATEWAY = {"sticky_capacity": 2, "cache_slack": 2, "retries": 3}
+
+#: the multi-turn conversation workload both reuse scenarios replay:
+#: growing chat histories whose successive turns share an
+#: ever-longer prefix (prompts stop at 48 so prompt + max_new fits
+#: the lab model's max_len=64)
+#: enough CONCURRENT sessions that one replica cannot hold the whole
+#: working set: with sparse arrivals the blind tie-break concentrates
+#: every no-pin pick on the lowest-id replica, which is accidentally
+#: cache-optimal — routing policies only separate under overlap, the
+#: regime a fleet exists for. The think floor keeps turn k+1 from
+#: arriving before turn k even completes (real users read the answer)
+_REUSE_TRACE = _trace(
+    multiturn=True, duration_s=1.2,
+    think_time_s=0.5, think_floor_s=0.4,
+    tenants=3, sessions_per_tenant=4, turns_per_session=5,
+    max_prompt=56, max_output=6, output_median=4,
+    stream_fraction=0.15, abandon_fraction=0.3,
+)
+
+_REUSE_FAULTS = (Fault(at_s=0.9, kind="drain", replica=0),)
+
+_register(ScenarioSpec(
+    name="multiturn_rebalance",
+    description=(
+        "multi-turn chat sessions (growing shared-prefix histories) "
+        "against a bounded sticky table while a replica DRAINS "
+        "mid-conversation: evicted/drained pins re-route, and "
+        "cache-aware routing lands each re-pinned session on the "
+        "replica that actually holds its KV (cache_hint_hits > 0) "
+        "with zero client-visible 5xx — the host-RAM spill tier "
+        "readmitting what the tiny device LRU evicted between turns "
+        "instead of re-prefilling it"
+    ),
+    trace=_REUSE_TRACE,
+    faults=_REUSE_FAULTS,
+    replicas=4,
+    # ttl 2 (not the default 1): four replicas + gateway + client in
+    # ONE lab-box process means a contention spike can starve a
+    # heartbeat thread past a 1s TTL and flap a healthy replica out
+    # of the routing table mid-drain
+    ttl=2,
+    server=dict(_REUSE_SERVER),
+    gateway=dict(_REUSE_GATEWAY),
+    settle_s=1.0,
+    # 2 slots/replica on the 1-core lab box: bursts of co-resident
+    # turns queue on slots, so the TTFT bar carries headroom the way
+    # burst_10x's does — the floor still bites on real regressions
+    slo=SLO(ttft_s=4.0, tpot_s=0.5),
+    expect_absent=(0,),
+    min_goodput_fraction=0.8,
+    expect_cache_hint_hits_min=1,
+    expect_tokens_reused_min=100,
+    expect_readmitted_min=1,
+))
+
+_register(ScenarioSpec(
+    name="multiturn_sticky_baseline",
+    description=(
+        "the SAME multi-turn drain workload with cache-aware routing "
+        "OFF (pure session-sticky + least-outstanding): the baseline "
+        "prefix_reuse_bench compares fleet tokens_reused/token "
+        "against — re-pins after an eviction or the drain land by "
+        "load, blind to where the KV lives"
+    ),
+    trace=_REUSE_TRACE,
+    faults=_REUSE_FAULTS,
+    replicas=4,
+    # ttl 2 (not the default 1): four replicas + gateway + client in
+    # ONE lab-box process means a contention spike can starve a
+    # heartbeat thread past a 1s TTL and flap a healthy replica out
+    # of the routing table mid-drain
+    ttl=2,
+    server=dict(_REUSE_SERVER),
+    gateway=dict(_REUSE_GATEWAY, cache_routing=False),
+    settle_s=1.0,
+    quick=False,  # the bench drives it explicitly, by name
+    slo=SLO(ttft_s=4.0, tpot_s=0.5),
+    expect_absent=(0,),
+    # this arm is the COMPARISON BASELINE, not a robustness gate: its
+    # blind tie-break concentrates no-pin picks onto one replica, and
+    # on the shared-core lab box that hot spot can starve heartbeats
+    # into transient no-healthy-replica 503s and TTFT spikes — the
+    # degradation prefix_reuse_bench exists to measure, not a reason
+    # to fail the measurement. The aware arm keeps the strict bars.
+    max_5xx=30,
+    min_goodput_fraction=0.0,
+    expect_tokens_reused_min=1,
 ))
 
 _register(ScenarioSpec(

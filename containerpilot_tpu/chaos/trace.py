@@ -75,6 +75,27 @@ class TraceConfig:
     #: nothing from the rng, so existing traces stay byte-identical.
     batch_fraction: float = 0.0
     vocab: int = 64
+    #: multi-turn chat mode: each session is a CONVERSATION — turn
+    #: k+1's prompt is turn k's prompt plus a simulated assistant
+    #: reply plus fresh user tokens, so successive turns share an
+    #: ever-growing prefix (what the KV spill tier and cache-aware
+    #: routing exist for). False draws nothing from the rng, so
+    #: pre-existing traces replay byte-identically.
+    multiturn: bool = False
+    turns_per_session: int = 4
+    #: mean exponential think time between a turn's arrival and the
+    #: next turn of the same session
+    think_time_s: float = 0.35
+    #: hard floor under every think gap (the exponential puts heavy
+    #: mass near zero, where turn k+1 would arrive before turn k even
+    #: completes — real users read the answer first)
+    think_floor_s: float = 0.0
+    #: first-turn prompt floor: at least this many ids, so the shared
+    #: prefix clears the reuse threshold (serve_prefix.MIN_REUSE)
+    #: from turn 2 on
+    first_turn_min: int = 16
+    #: simulated assistant-reply ids appended to the history per turn
+    reply_median: int = 4
 
 
 @dataclass
@@ -134,6 +155,9 @@ def generate_trace(cfg: TraceConfig) -> List[TraceRequest]:
                 tenant
             ] + [rng.randrange(1, cfg.vocab) for _ in range(cfg.session_prefix)]
 
+    if cfg.multiturn:
+        return _generate_multiturn(cfg, rng, session_prefixes)
+
     requests: List[TraceRequest] = []
     now = 0.0
     in_burst = False
@@ -192,6 +216,97 @@ def generate_trace(cfg: TraceConfig) -> List[TraceRequest]:
             )
         )
         index += 1
+    return requests
+
+
+def _generate_multiturn(
+    cfg: TraceConfig,
+    rng: random.Random,
+    session_prefixes: Dict[str, List[int]],
+) -> List[TraceRequest]:
+    """Multi-turn chat sessions: each turn re-sends the whole
+    conversation so far (prior prompt + a simulated assistant reply)
+    plus fresh user tokens, the regime where prefix KV reuse pays.
+    The simulated reply STANDS IN for the model's actual output — the
+    replica never checks that history matches what it generated, and
+    the trace must be a pure function of the seed. Session starts
+    spread over the first ``duration_s``; turns follow their
+    predecessor by an exponential think time. Prompt growth stops at
+    ``max_prompt`` (the conversation is truncated, like a real
+    context-window limit); quantization pads with EXTRA user ids so
+    the prefix-of-its-successor property always holds."""
+    requests: List[TraceRequest] = []
+    index = 0
+    for session in sorted(session_prefixes):
+        tenant = int(session[1:].split("-", 1)[0])
+        history = list(session_prefixes[session])
+        # first turn: pad with user ids up to the reuse floor, then
+        # quantize UP (appending keeps every prefix shared)
+        first = max(
+            cfg.first_turn_min,
+            len(history) + 1,
+        )
+        if cfg.prompt_quantum > 0:
+            q = cfg.prompt_quantum
+            first = min(-(-first // q) * q, cfg.max_prompt)
+        while len(history) < first:
+            history.append(rng.randrange(1, cfg.vocab))
+        at_s = rng.uniform(0.0, cfg.duration_s)
+        for _turn in range(cfg.turns_per_session):
+            max_new = _lognormal_len(
+                rng, cfg.output_median, cfg.output_sigma,
+                1, cfg.max_output,
+            )
+            stream = rng.random() < cfg.stream_fraction
+            abandon: Optional[int] = None
+            if stream and rng.random() < cfg.abandon_fraction:
+                abandon = 1 + rng.randrange(2)
+            priority = "interactive"
+            if (
+                cfg.batch_fraction > 0
+                and rng.random() < cfg.batch_fraction
+            ):
+                priority = "batch"
+            requests.append(
+                TraceRequest(
+                    index=index,
+                    at_s=round(at_s, 6),
+                    session_id=session,
+                    tenant=tenant,
+                    tokens=list(history),
+                    max_new_tokens=max_new,
+                    seed=cfg.seed * 100003 + index,
+                    stream=stream,
+                    abandon_after_events=abandon,
+                    priority=priority,
+                )
+            )
+            index += 1
+            # grow the conversation: simulated reply + next user turn
+            reply = _lognormal_len(
+                rng, cfg.reply_median, cfg.output_sigma, 1,
+                cfg.max_output,
+            )
+            user = _lognormal_len(
+                rng, cfg.prompt_median, cfg.prompt_sigma, 1,
+                cfg.max_prompt,
+            )
+            total = len(history) + reply + user
+            if cfg.prompt_quantum > 0:
+                q = cfg.prompt_quantum
+                total = -(-total // q) * q
+            if total > cfg.max_prompt:
+                break  # context window full: the conversation ends
+            while len(history) < total:
+                history.append(rng.randrange(1, cfg.vocab))
+            at_s += cfg.think_floor_s + rng.expovariate(
+                1.0 / cfg.think_time_s
+            )
+    requests.sort(key=lambda r: (r.at_s, r.index))
+    # re-index in arrival order so index stays the replay handle;
+    # per-request seeds were already assigned deterministically
+    for i, req in enumerate(requests):
+        req.index = i
     return requests
 
 

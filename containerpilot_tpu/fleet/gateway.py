@@ -11,6 +11,15 @@ supervisor Watch actors use) and proxies the inference API over them:
   prompt prefix) stick to one replica so its prefix KV cache keeps
   hitting. A sticky key whose replica drained away is re-routed and
   counted (``drained_away``).
+- **Cache-contents-aware routing**: replicas advertise a versioned
+  fingerprint digest of their warm prompt prefixes (kvtier/digest.py)
+  through heartbeat notes, the same channel occupancy travels. When a
+  request has no live sticky pin — a fresh session, a re-pin after a
+  drain, a retry exclusion — ``_pick`` prefers a replica whose digest
+  contains the request's prefix fingerprint, bounded by a load slack
+  (``cache_slack``) so a wedged-but-warm replica is never chosen over
+  a healthy cold one. ``cache_hint_hits``/``cache_hint_misses`` and a
+  fleet-wide ``tokens_reused`` gauge land on ``/metrics`` + ``/fleet``.
 - **Retries**: generation requests are idempotent under a fixed seed,
   so a transport failure or a 503 (a draining or warming replica)
   retries on a DIFFERENT replica with capped exponential backoff —
@@ -62,6 +71,13 @@ from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, Iterable, List, Optional, Set, Tuple
 
 from ..discovery import Backend
+from ..kvtier import (
+    FP_TOKENS,
+    parse_digest,
+    parse_kv_counters,
+    parse_kv_note,
+    prefix_fingerprint,
+)
 from ..telemetry import tracing
 from ..utils.http import (
     HTTPServer,
@@ -121,6 +137,14 @@ class Replica:
     #: mid-burst, and least-outstanding kept feeding it.
     queued: int = 0
     first_seen: float = field(default_factory=time.monotonic)
+    #: prefix fingerprints this replica advertised as warm (its
+    #: heartbeat's ``pd=`` digest) — what cache-aware routing scores
+    digest: frozenset = frozenset()
+    digest_version: int = -1
+    #: monotonic stamp of the last digest update (staleness signal)
+    digest_at: float = 0.0
+    #: last-seen reuse counters from the ``kv=`` note field
+    kv: Dict[str, int] = field(default_factory=dict)
 
     @property
     def load(self) -> int:
@@ -334,6 +358,9 @@ class FleetGateway:
         hedge_min_ms: float = 50.0,
         hedge_after_ms: Optional[float] = None,
         affinity: str = "session",
+        cache_routing: bool = True,
+        cache_slack: int = 2,
+        sticky_capacity: int = STICKY_CAPACITY,
         connect_timeout: float = 5.0,
         request_timeout: float = 600.0,
         pool_max_idle: int = 8,
@@ -378,6 +405,27 @@ class FleetGateway:
         # None = learn the tail from observed latencies
         self.hedge_after_ms = hedge_after_ms
         self.affinity = affinity
+        # cache-contents-aware routing: when a request has no live
+        # sticky pin, prefer a replica advertising the request's
+        # prefix fingerprint — but only within ``cache_slack`` extra
+        # load of the least-loaded candidate, so warmth never
+        # overrides a wedged/overloaded replica's load signal
+        self.cache_routing = cache_routing
+        if cache_slack < 0:
+            raise ValueError("cache_slack must be >= 0")
+        self.cache_slack = cache_slack
+        if sticky_capacity < 1:
+            raise ValueError("sticky_capacity must be >= 1")
+        self.sticky_capacity = sticky_capacity
+        self.sticky_evicted = 0  # plain mirror for /fleet
+        self.hint_hits = 0       # plain mirrors of the hint counters
+        self.hint_misses = 0
+        #: final tokens_reused advertised by replicas that have LEFT
+        #: the fleet, keyed by id — the fleet-wide gauge must not
+        #: forget a drained replica's contribution, and keying by id
+        #: lets a flapped-then-rejoined replica reclaim its own entry
+        #: instead of being double-counted
+        self._reuse_departed: Dict[str, int] = {}
         self.connect_timeout = connect_timeout
         self.request_timeout = request_timeout
 
@@ -537,6 +585,31 @@ class FleetGateway:
         self._g_admission_inflight.set_function(
             lambda: self._admission.inflight
         )
+        self._m_hint_hits = Counter(
+            "containerpilot_gateway_cache_hint_hits",
+            "routing picks that landed on a replica advertising the "
+            "request's prefix fingerprint (cache-aware routing)",
+            registry=self._registry,
+        )
+        self._m_hint_misses = Counter(
+            "containerpilot_gateway_cache_hint_misses",
+            "fingerprinted requests routed cold: no digest-advertising "
+            "replica was warm (or the warm ones exceeded cache_slack)",
+            registry=self._registry,
+        )
+        self._m_sticky_evicted = Counter(
+            "containerpilot_gateway_sticky_evicted",
+            "sticky-affinity pins evicted by the LRU capacity bound",
+            registry=self._registry,
+        )
+        self._g_fleet_reused = Gauge(
+            "containerpilot_gateway_fleet_tokens_reused",
+            "fleet-wide prefix-cache tokens_reused: live replicas' "
+            "last-advertised counters plus departed replicas' final "
+            "ones (the SLO-goodput yardstick for KV reuse)",
+            registry=self._registry,
+        )
+        self._g_fleet_reused.set_function(self._fleet_tokens_reused)
         # per-stage latency decomposition: one histogram row per
         # tracing stage (admission_queue_wait, upstream_ttfb,
         # replica.prefill, ...) — the aggregate face of /v1/traces
@@ -690,6 +763,10 @@ class FleetGateway:
                 fresh[inst.id] = known  # keep live outstanding counts
             else:
                 fresh[inst.id] = Replica(inst.id, address, inst.port)
+            # refresh the KV-reuse advertisement (digest + counters)
+            # off the catalog notes — notes changes flip did_change,
+            # so a replica whose cache contents moved re-lists here
+            self._apply_notes(fresh[inst.id], inst.notes)
         if not fresh and self._replicas:
             # catalog-flap hold-down: an empty healthy set right after
             # a non-empty one is more often a torn read / flapping
@@ -718,6 +795,20 @@ class FleetGateway:
                 "gateway: healthy set -> %s",
                 sorted(f"{r.id}@{r.authority}" for r in fresh.values()),
             )
+        for rid, gone in self._replicas.items():
+            if rid not in fresh and gone.kv.get("tokens_reused", 0):
+                # keep a departed replica's reuse contribution in the
+                # fleet-wide gauge (its counter dies with its record);
+                # zero contributions aren't parked — a long-lived
+                # gateway over an autoscaled no-reuse fleet must not
+                # grow an entry per departed id forever
+                self._reuse_departed[rid] = gone.kv["tokens_reused"]
+        for rid in fresh:
+            # a replica that FLAPPED out and rejoined (wedge heal,
+            # TTL-starved heartbeat, catalog flap) advertises the same
+            # cumulative counter again — drop the parked copy or the
+            # gauge double-counts it on every flap
+            self._reuse_departed.pop(rid, None)
         self._replicas = fresh
         self._g_replicas.set(len(fresh))
         # admission capacity tracks the healthy set; growth grants
@@ -729,21 +820,105 @@ class FleetGateway:
         # not at all
         self._pool.prune(set(fresh))
 
+    def _apply_notes(self, replica: Replica, notes: str) -> None:
+        """Decode a replica's heartbeat check output (``ok occ=0.50
+        kv=... pd=v3:...``) into its routing state. Tolerant: a torn
+        or digest-free note leaves the previous advertisement in
+        place rather than blanking a warm replica."""
+        fields = parse_kv_note(notes)
+        if "kv" in fields:
+            parsed = parse_kv_counters(fields["kv"])
+            # the counters are CUMULATIVE: a torn note's zero-filled
+            # tail (or a truncated digit) must not regress them — a
+            # regressed tokens_reused parked by a departure would
+            # permanently drop the replica's contribution from the
+            # fleet-wide gauge. Elementwise max keeps the best-known
+            # cumulative value per field.
+            replica.kv = {
+                name: max(value, replica.kv.get(name, 0))
+                for name, value in parsed.items()
+            }
+        if "pd" in fields:
+            version, fps = parse_digest(fields["pd"])
+            if version is not None and version != replica.digest_version:
+                replica.digest = fps
+                replica.digest_version = version
+                replica.digest_at = time.monotonic()
+
+    def _fleet_tokens_reused(self) -> int:
+        """Fleet-wide tokens_reused: live replicas' last-advertised
+        counters plus what departed replicas took with them."""
+        return sum(self._reuse_departed.values()) + sum(
+            r.kv.get("tokens_reused", 0)
+            for r in self._replicas.values()
+        )
+
+    def _request_fingerprint(
+        self, body: Dict[str, Any]
+    ) -> Optional[int]:
+        """The prefix fingerprint cache-aware routing scores against:
+        computed from a single token row exactly the way replicas
+        fingerprint their cached keys (kvtier/digest.py). Text
+        prompts return None — the gateway has no tokenizer, so those
+        requests keep plain sticky/least-loaded routing."""
+        if not self.cache_routing:
+            return None
+        tokens = body.get("tokens")
+        if (
+            isinstance(tokens, list) and len(tokens) == 1
+            and isinstance(tokens[0], list)
+            and all(
+                isinstance(t, int) for t in tokens[0][:FP_TOKENS]
+            )
+        ):
+            try:
+                return prefix_fingerprint(tokens[0])
+            except (TypeError, ValueError, OverflowError):
+                return None
+        return None
+
     # -- routing --------------------------------------------------------
 
-    def _pick(self, exclude: Iterable[str] = ()) -> Optional[Replica]:
+    def _pick(
+        self,
+        exclude: Iterable[str] = (),
+        fp: Optional[int] = None,
+    ) -> Optional[Replica]:
         """Least-loaded (dispatched + admission-queue-assigned);
         replica id breaks ties so the choice is deterministic under
         equal load. Counting only dispatched requests let a replica
         whose queued work hadn't landed yet look idle — the exact
-        shape a mid-burst wedge hides behind."""
+        shape a mid-burst wedge hides behind.
+
+        With a prefix fingerprint, a replica advertising it as warm
+        is preferred — but only within ``cache_slack`` of the least
+        load, so a warm-but-wedged replica never beats a healthy cold
+        one; among warm candidates least-loaded still decides."""
         excluded = set(exclude)
         candidates = [
             r for r in self._replicas.values() if r.id not in excluded
         ]
         if not candidates:
             return None
-        return min(candidates, key=lambda r: (r.load, r.id))
+        coldest = min(candidates, key=lambda r: (r.load, r.id))
+        if fp is None:
+            return coldest
+        warm = [
+            r for r in candidates
+            if fp in r.digest
+            and r.load <= coldest.load + self.cache_slack
+        ]
+        if warm:
+            self._m_hint_hits.inc()
+            self.hint_hits += 1
+            return min(warm, key=lambda r: (r.load, r.id))
+        if any(r.digest for r in candidates):
+            # the hint existed and nobody (eligible) was warm — count
+            # it only when digests are in play at all, so fleets that
+            # never publish them don't log a miss per request
+            self._m_hint_misses.inc()
+            self.hint_misses += 1
+        return coldest
 
     def _affinity_key(
         self, req: Request, body: Dict[str, Any]
@@ -773,14 +948,21 @@ class FleetGateway:
         return None
 
     def _route(
-        self, key: Optional[str], exclude: Iterable[str] = ()
+        self,
+        key: Optional[str],
+        exclude: Iterable[str] = (),
+        fp: Optional[int] = None,
     ) -> Optional[Replica]:
-        """Sticky affinity first, least-outstanding otherwise. A
-        sticky target that LEFT the fleet (drained/crashed) re-pins
-        and counts as drained_away; one that is merely excluded by
-        this request's retry re-routes this request only — the pin
-        (and the replica's warm prefix cache) survives a transient
-        failure."""
+        """Sticky affinity first, cache-overlap-blended least-
+        outstanding otherwise. A sticky target that LEFT the fleet
+        (drained/crashed) re-pins and counts as drained_away; one
+        that is merely excluded by this request's retry re-routes
+        this request only — the pin (and the replica's warm prefix
+        cache) survives a transient failure. A re-pin (or a fresh
+        pick, or a retry's re-route) consults the request's prefix
+        fingerprint, so a session whose replica drained lands on the
+        warmest surviving replica instead of wherever least-loaded
+        points."""
         excluded = set(exclude)
         repin = True
         if key is not None:
@@ -795,12 +977,14 @@ class FleetGateway:
                     return replica
                 else:
                     repin = False  # transient exclusion: keep the pin
-        replica = self._pick(excluded)
+        replica = self._pick(excluded, fp)
         if replica is not None and key is not None and repin:
             self._sticky[key] = replica.id
             self._sticky.move_to_end(key)
-            while len(self._sticky) > STICKY_CAPACITY:
+            while len(self._sticky) > self.sticky_capacity:
                 self._sticky.popitem(last=False)
+                self._m_sticky_evicted.inc()
+                self.sticky_evicted += 1
         return replica
 
     def _hedge_threshold(self, endpoint: str) -> Optional[float]:
@@ -876,6 +1060,20 @@ class FleetGateway:
                     if self.trace else None
                 ),
                 "draining": self.draining,
+                # fleet-wide KV reuse: the goodput yardstick plus the
+                # routing hint counters (docs/60 has the runbook rows)
+                "kv": {
+                    "cache_routing": self.cache_routing,
+                    "cache_slack": self.cache_slack,
+                    "tokens_reused": self._fleet_tokens_reused(),
+                    "hint_hits": self.hint_hits,
+                    "hint_misses": self.hint_misses,
+                },
+                "sticky": {
+                    "size": len(self._sticky),
+                    "capacity": self.sticky_capacity,
+                    "evicted": self.sticky_evicted,
+                },
                 "admission": self._admission.stats(),
                 "autoscaler": (
                     self._autoscaler.stats
@@ -896,6 +1094,18 @@ class FleetGateway:
                         "queued": r.queued,
                         "age_s": round(
                             time.monotonic() - r.first_seen, 1
+                        ),
+                        # digest size/staleness: how much of the
+                        # replica's cache the gateway knows about,
+                        # and how old that knowledge is
+                        "kv": dict(r.kv),
+                        "digest_fps": len(r.digest),
+                        "digest_version": r.digest_version,
+                        "digest_age_s": (
+                            round(
+                                time.monotonic() - r.digest_at, 3
+                            )
+                            if r.digest_at else None
                         ),
                         "pool": self._pool.stats(r.id),
                         "mux": self._pool.mux_stats(r.id),
@@ -924,6 +1134,7 @@ class FleetGateway:
             if not isinstance(parsed, dict):
                 parsed = {}
             key = self._affinity_key(req, parsed)
+            fp = self._request_fingerprint(parsed)
             # mint (or adopt the client's) trace id and bind it for
             # the whole routing lifetime: spans recorded anywhere
             # downstream — admission, hedge legs, relays — attach to
@@ -943,6 +1154,7 @@ class FleetGateway:
                 resp = await self._admitted(
                     endpoint, path, body, key, req,
                     stream=bool(parsed.get("stream")),
+                    fp=fp,
                 )
             except asyncio.CancelledError:
                 # client abandon: the server cancels the handler task
@@ -1000,6 +1212,7 @@ class FleetGateway:
         req: Request,
         *,
         stream: bool,
+        fp: Optional[int] = None,
     ) -> Response:
         """Admission in front of routing: shed/expire before a replica
         slot is spent, then dispatch holding a ticket. A streaming
@@ -1078,11 +1291,11 @@ class FleetGateway:
         try:
             if stream:
                 resp = await self._proxy_stream(
-                    endpoint, path, body, key
+                    endpoint, path, body, key, fp
                 )
             else:
                 resp = await self._proxy_buffered(
-                    endpoint, "POST", path, body, key
+                    endpoint, "POST", path, body, key, fp
                 )
         except BaseException:
             release(False)
@@ -1381,6 +1594,7 @@ class FleetGateway:
         path: str,
         body: bytes,
         tried: Set[str],
+        fp: Optional[int] = None,
     ) -> Tuple[int, Dict[str, str], bytes, Replica]:
         """Dispatch to ``replica``; if the response is still not back
         at the hedge threshold, race a second replica. First success
@@ -1399,7 +1613,7 @@ class FleetGateway:
         done, _ = await asyncio.wait({primary}, timeout=threshold)
         if done:
             return (*primary.result(), replica)
-        hedge_replica = self._pick(tried | {replica.id})
+        hedge_replica = self._pick(tried | {replica.id}, fp)
         if hedge_replica is None:
             status, headers, payload = await primary
             return status, headers, payload, replica
@@ -1469,18 +1683,20 @@ class FleetGateway:
         path: str,
         body: bytes,
         key: Optional[str],
+        fp: Optional[int] = None,
     ) -> Response:
         tried: Set[str] = set()
         backoff = self.retry_backoff
         last: Optional[Response] = None
         for attempt in range(self.retries + 1):
-            replica = self._route(key, tried)
+            replica = self._route(key, tried, fp)
             if replica is None:
                 break
             try:
                 status, headers, payload, served_by = (
                     await self._fetch_with_hedge(
-                        endpoint, replica, method, path, body, tried
+                        endpoint, replica, method, path, body, tried,
+                        fp,
                     )
                 )
             except UpstreamError as exc:
@@ -1551,6 +1767,7 @@ class FleetGateway:
         path: str,
         body: bytes,
         key: Optional[str],
+        fp: Optional[int] = None,
     ) -> Response:
         """SSE relay. Retries/re-routing apply only while nothing has
         been sent downstream; once the upstream stream starts, the
@@ -1561,7 +1778,7 @@ class FleetGateway:
         backoff = self.retry_backoff
         last: Optional[Response] = None
         for attempt in range(self.retries + 1):
-            replica = self._route(key, tried)
+            replica = self._route(key, tried, fp)
             if replica is None:
                 break
             self._m_routed.labels(replica.id).inc()
@@ -1874,6 +2091,25 @@ def main() -> int:
         "--affinity", choices=AFFINITY_MODES, default="session"
     )
     parser.add_argument(
+        "--cache-routing", default=True,
+        action=argparse.BooleanOptionalAction,
+        help="cache-contents-aware routing: when a request has no "
+        "live sticky pin, prefer a replica whose advertised prefix "
+        "digest contains the request's fingerprint (--no-cache-"
+        "routing keeps pure sticky + least-outstanding)",
+    )
+    parser.add_argument(
+        "--cache-slack", type=int, default=2,
+        help="extra load a cache-warm replica may carry over the "
+        "least-loaded candidate and still win the pick (0 = warmth "
+        "only ever breaks exact load ties)",
+    )
+    parser.add_argument(
+        "--sticky-capacity", type=int, default=STICKY_CAPACITY,
+        help="LRU bound on sticky-affinity pins; evictions count on "
+        "/metrics (sticky_evicted)",
+    )
+    parser.add_argument(
         "--hedge-after-ms", type=float, default=None,
         help="fixed hedge deadline; default learns the tail quantile",
     )
@@ -1952,6 +2188,9 @@ def main() -> int:
         retries=args.retries, retry_jitter=args.retry_jitter,
         empty_poll_threshold=args.empty_poll_threshold,
         affinity=args.affinity,
+        cache_routing=args.cache_routing,
+        cache_slack=args.cache_slack,
+        sticky_capacity=args.sticky_capacity,
         hedge=not args.no_hedge, hedge_after_ms=args.hedge_after_ms,
         pool_max_idle=0 if args.no_pool else args.pool_max_idle,
         pool_idle_ttl=args.pool_idle_ttl,

@@ -151,6 +151,16 @@ class FleetMember(EventHandler):
                 f"ok occ={occupancy:.2f}"
                 if isinstance(occupancy, (int, float)) else "ok"
             )
+            # KV-reuse advertisement (optional, duck-typed like the
+            # rest of the server surface): reuse counters + the
+            # prefix fingerprint digest ride the same check-output
+            # channel occupancy does, so cache-aware gateways learn
+            # what's warm from the catalog poll they already pay for
+            kv_note = getattr(self.server, "kv_note", None)
+            if callable(kv_note):
+                extra = kv_note()
+                if extra:
+                    output += " " + extra
             self.service.send_heartbeat(output=output)
         # not ready (warming, or wedged enough that ready regressed):
         # no beat — an existing record's TTL expiry flips it critical

@@ -1,0 +1,42 @@
+"""Fleet-wide KV reuse: the host-RAM spill tier and the prefix
+digest the cache-aware gateway routes on.
+
+Two coupled halves of one idea — stop recomputing prefixes anywhere
+in the fleet:
+
+- :mod:`.spill` keeps the KV rows a replica's ``PrefixCache`` LRU
+  would have dropped, in a byte-budgeted host-RAM store. A later
+  match readmits them through the existing ``reuse_admission``
+  protocol (a ``jax.device_put`` roundtrip is far cheaper than
+  re-prefilling the prefix).
+- :mod:`.digest` is the wire format replicas use to advertise WHAT
+  they have cached: a compact, versioned fingerprint set of cached
+  prompt prefixes, published through heartbeat notes and
+  ``/v1/model``, which the gateway blends into its routing pick.
+
+The package is import-light by design (no JAX at import time): the
+gateway imports the digest codec without pulling an accelerator
+stack, and the spill tier defers its ``jax`` imports to the first
+transfer.
+"""
+from .digest import (
+    DIGEST_MAX_BYTES,
+    FP_TOKENS,
+    encode_fingerprints,
+    parse_digest,
+    parse_kv_counters,
+    parse_kv_note,
+    prefix_fingerprint,
+)
+from .spill import HostSpillTier
+
+__all__ = [
+    "DIGEST_MAX_BYTES",
+    "FP_TOKENS",
+    "HostSpillTier",
+    "encode_fingerprints",
+    "parse_digest",
+    "parse_kv_counters",
+    "parse_kv_note",
+    "prefix_fingerprint",
+]

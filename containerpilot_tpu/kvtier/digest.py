@@ -1,0 +1,128 @@
+"""Prefix digests: how a replica tells the fleet what it has cached.
+
+The gateway's cache-aware routing needs to know, per replica, WHICH
+prompt prefixes are warm — without shipping token tuples around. The
+answer is a fingerprint set:
+
+- ``prefix_fingerprint(tokens)`` hashes the first ``FP_TOKENS`` ids
+  of a prompt to a stable 32-bit value. ``FP_TOKENS`` equals the
+  prefix cache's ``MIN_REUSE``: anything shorter can never be reused,
+  so it never needs advertising. The hash is blake2b, NOT Python's
+  ``hash()`` — it must agree across processes and runs.
+- ``encode_fingerprints(version, fps)`` packs a set of fingerprints
+  into ``v<version>:<8-hex each, sorted>``, truncated to
+  ``DIGEST_MAX_BYTES`` so a huge cache can't balloon heartbeat notes
+  or ``/v1/model`` responses. The version lets readers tell a fresh
+  digest from a stale re-read.
+- ``parse_digest(raw)`` is the tolerant reader: any malformed input
+  (hostile peer, torn note) decodes to ``(None, frozenset())``, never
+  an exception on the routing path.
+
+Digests travel the way occupancy already does — as ``key=value``
+fields in the TTL heartbeat's check output (``ok occ=0.50
+kv=... pd=v3:...``), parsed with ``parse_kv_note`` — and verbatim in
+``/v1/model``'s ``prefix_digest`` field.
+
+A fingerprint match is a HINT, not a promise: the entry may have been
+evicted (even from the spill tier) by the time the request lands, or
+two distinct prefixes may collide in 32 bits (~1 in 4e9). Both cost
+one wasted preference, never a wrong answer — the replica simply
+prefills cold, exactly as an unhinted request would.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, FrozenSet, Iterable, Optional, Sequence, Tuple
+
+#: prompt ids hashed into one fingerprint; equals serve_prefix's
+#: MIN_REUSE (shorter prefixes are never reusable, so never
+#: advertised) — keep the two in lockstep
+FP_TOKENS = 16
+
+#: byte bound on one encoded digest: it rides every TTL heartbeat's
+#: check output, so it must stay note-sized (~128 fingerprints)
+DIGEST_MAX_BYTES = 1024
+
+_HEADER = "v"
+
+
+def prefix_fingerprint(tokens: Sequence[int]) -> Optional[int]:
+    """Stable 32-bit fingerprint of a prompt's first ``FP_TOKENS``
+    ids, or None when the prompt is too short to ever be reused."""
+    if len(tokens) < FP_TOKENS:
+        return None
+    raw = b"".join(
+        int(t).to_bytes(4, "little", signed=True)
+        for t in tokens[:FP_TOKENS]
+    )
+    return int.from_bytes(
+        hashlib.blake2b(raw, digest_size=4).digest(), "big"
+    )
+
+
+def encode_fingerprints(
+    version: int,
+    fps: Iterable[int],
+    max_bytes: int = DIGEST_MAX_BYTES,
+) -> str:
+    """``v<version>:<hex8 hex8 ...>`` (no separators), size-bounded.
+    Sorted so equal sets encode identically; truncation keeps the
+    lexicographically-smallest fingerprints, which is arbitrary but
+    deterministic — a bounded digest is a sample, not a census."""
+    header = f"{_HEADER}{int(version)}:"
+    budget = max(0, max_bytes - len(header))
+    body = "".join(
+        f"{fp & 0xFFFFFFFF:08x}" for fp in sorted(set(fps))
+    )[: (budget // 8) * 8]
+    return header + body
+
+
+def parse_digest(raw: object) -> Tuple[Optional[int], FrozenSet[int]]:
+    """Tolerant inverse of :func:`encode_fingerprints`. Garbage — a
+    hostile note, a torn read, the wrong field — parses to
+    ``(None, frozenset())``; the routing path never throws on it."""
+    if not isinstance(raw, str) or not raw.startswith(_HEADER):
+        return None, frozenset()
+    head, sep, body = raw[len(_HEADER):].partition(":")
+    if not sep or not head.isascii() or not head.isdigit():
+        return None, frozenset()
+    if len(body) % 8 != 0 or len(body) > DIGEST_MAX_BYTES:
+        return None, frozenset()
+    try:
+        fps = frozenset(
+            int(body[i:i + 8], 16) for i in range(0, len(body), 8)
+        )
+    except ValueError:
+        return None, frozenset()
+    return int(head), fps
+
+
+def parse_kv_note(notes: object) -> Dict[str, str]:
+    """Split a heartbeat check output (``ok occ=0.50 kv=1,2,3
+    pd=v4:...``) into its ``key=value`` fields. Bare words (the
+    leading ``ok``) are dropped; duplicate keys keep the last."""
+    out: Dict[str, str] = {}
+    if not isinstance(notes, str):
+        return out
+    for token in notes.split():
+        key, sep, value = token.partition("=")
+        if sep and key:
+            out[key] = value
+    return out
+
+
+def parse_kv_counters(raw: object) -> Dict[str, int]:
+    """Decode the ``kv=`` note field: five comma-separated ints
+    (hits, misses, tokens_reused, spilled, readmitted). Short or
+    malformed values yield the fields that did parse, zero-filled —
+    a half-written note must not zero a replica's routing state."""
+    names = ("hits", "misses", "tokens_reused", "spilled", "readmitted")
+    out = {name: 0 for name in names}
+    if not isinstance(raw, str) or not raw:
+        return out
+    for name, part in zip(names, raw.split(",")):
+        try:
+            out[name] = max(0, int(part))
+        except ValueError:
+            break
+    return out

@@ -52,6 +52,8 @@ stage                       meaning
 ``upstream_body``           gateway: response head -> body read
 ``relay``                   gateway: SSE head -> relay close
 ``replica.slot_queue_wait`` replica: engine submit -> slot admission
+``replica.kv``              replica: spill-tier readmit (host->device
+                            KV copy) ahead of the suffix extend
 ``replica.prefill``         replica: prefill + first-token sample
 ``replica.decode``          replica: decode rounds to completion
 ``replica.stream_relay``    replica: first SSE delta -> done event
@@ -440,7 +442,16 @@ def add_engine_spans(trace: Trace, timings: Mapping[str, float]) -> None:
     if enq is not None and adm is not None:
         trace.add_span("slot_queue_wait", enq, adm)
     if adm is not None and pf is not None:
-        trace.add_span("prefill", adm, pf)
+        kv = timings.get("kv")
+        if kv is not None and kv > 0.0:
+            # spill-tier readmit (host->device KV copy) carved out of
+            # the admission window so the stages stay non-overlapping:
+            # kv + prefill together still span admitted -> prefill_done
+            kv_end = min(adm + kv, pf)
+            trace.add_span("kv", adm, kv_end)
+            trace.add_span("prefill", kv_end, pf)
+        else:
+            trace.add_span("prefill", adm, pf)
     if pf is not None and done is not None:
         rounds = timings.get("rounds")
         if rounds is not None:

@@ -95,6 +95,7 @@ class InferenceServer:
         speculate: int = 4,
         max_batch_rows: int = 16,
         prefix_cache_entries: int = 0,
+        kv_spill_bytes: int = 0,
         prefill_chunk: int = 0,
         text: bool = False,
         slots: int = 0,
@@ -175,8 +176,20 @@ class InferenceServer:
                 "ring cache's stale rows are live window context, so "
                 "a shorter-prefix rewind cannot reuse them)"
             )
+        if kv_spill_bytes > 0 and prefix_cache_entries <= 0:
+            raise ValueError(
+                "--kv-spill requires --prefix-cache (the spill tier "
+                "catches the prefix cache's evictions)"
+            )
+        spill = None
+        if kv_spill_bytes > 0:
+            # host-RAM floor under the device LRU: evictions spill,
+            # later matches readmit via device_put (kvtier/spill.py)
+            from ..kvtier import HostSpillTier
+
+            spill = HostSpillTier(kv_spill_bytes)
         self.prefix_cache: Optional[PrefixCache] = (
-            PrefixCache(prefix_cache_entries)
+            PrefixCache(prefix_cache_entries, spill=spill)
             if prefix_cache_entries > 0 else None
         )
         # continuous decode admission: single-row requests join a
@@ -437,6 +450,20 @@ class InferenceServer:
                         **self.prefix_cache.stats,
                     }
                     if self.prefix_cache is not None
+                    else None
+                ),
+                # cache-aware routing surface: the versioned prefix
+                # fingerprint digest (kvtier/digest.py) and the spill
+                # tier's accounting; both None when disabled, so the
+                # schema is stable across configurations
+                "prefix_digest": (
+                    self.prefix_cache.digest()
+                    if self.prefix_cache is not None else None
+                ),
+                "kv_spill": (
+                    self.prefix_cache.spill.snapshot()
+                    if self.prefix_cache is not None
+                    and self.prefix_cache.spill is not None
                     else None
                 ),
                 "slot_engine": (
@@ -1077,6 +1104,26 @@ class InferenceServer:
                 1, stats["slots"]
             )
         return float(self._inflight)
+
+    def kv_note(self) -> str:
+        """The KV-reuse fields a FleetMember appends to its TTL
+        heartbeat output (the same channel occupancy travels):
+        ``kv=hits,misses,tokens_reused,spilled,readmitted`` plus the
+        ``pd=``-prefixed fingerprint digest the gateway's cache-aware
+        routing scores against. Empty without a prefix cache, so
+        fleets that don't reuse pay zero note bytes."""
+        pc = self.prefix_cache
+        if pc is None:
+            return ""
+        s = pc.stats
+        note = (
+            f"kv={s['hits']},{s['misses']},{s['tokens_reused']},"
+            f"{s['spilled']},{s['readmitted']}"
+        )
+        digest = pc.digest()
+        if digest:
+            note += f" pd={digest}"
+        return note
 
     def enter_maintenance(self) -> None:
         """Start draining: health 503, new generate/completions 503 +

@@ -104,6 +104,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "requests sharing a prefix (the chat/agent regime); 0 = off",
     )
     parser.add_argument(
+        "--kv-spill-mb", type=float, default=0.0,
+        help="host-RAM KV spill tier budget in MiB: prefix-cache LRU "
+        "evictions spill to host memory and readmit on a later match "
+        "(device_put roundtrip instead of re-prefill); requires "
+        "--prefix-cache; 0 = off",
+    )
+    parser.add_argument(
         "--text", action="store_true",
         help="enable the text surface: POST /v1/completions encodes "
         "prompts with the built-in byte-level tokenizer (requires "
@@ -305,6 +312,7 @@ def main() -> int:
         draft_layers=args.draft_layers, speculate=args.speculate,
         max_batch_rows=args.max_batch_rows,
         prefix_cache_entries=args.prefix_cache,
+        kv_spill_bytes=int(args.kv_spill_mb * 1024 * 1024),
         prefill_chunk=args.prefill_chunk,
         slots=args.slots, slot_chunk=args.slot_chunk,
         text=args.text,

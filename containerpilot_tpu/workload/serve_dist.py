@@ -807,10 +807,14 @@ class _Frontend:
             # live stats, same shape as the single-host /v1/model
             info["prefix_cache"] = {"entries": pc.entries, **pc.stats}
         elif self.prefix_entries > 0:
-            # boot window: same schema, zeroed counts
+            # boot window: same schema, zeroed counts (spill fields
+            # included — the pod runs without a spill tier, so they
+            # stay zero after warm too, mirroring the single-host
+            # server's tier-disabled shape)
             info["prefix_cache"] = {
                 "entries": self.prefix_entries,
                 "hits": 0, "misses": 0, "tokens_reused": 0,
+                "spilled": 0, "readmitted": 0, "spill_bytes": 0,
             }
         return self._Response(
             200, json.dumps(info).encode(),

@@ -10,23 +10,56 @@ Thread safety: ``match_len`` runs on the asyncio event-loop thread
 on the inference executor thread, so every OrderedDict access holds
 ``_lock`` (round-2 review: a concurrent request could previously hit
 "OrderedDict mutated during iteration" and surface as a 500).
+
+With a **spill tier** attached (``kvtier.HostSpillTier``), LRU
+eviction moves the entry's KV to byte-budgeted host RAM instead of
+dropping it, and a later match readmits it through the SAME
+``get``/``reuse_admission`` path — the slot engines and the rewind+
+extend protocol never see the difference, only the stats do
+(``spilled``/``readmitted``/``spill_bytes``, zeroed when the tier is
+disabled so the ``/v1/model`` schema stays stable either way).
 """
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, List, Optional, Tuple
 
-MIN_REUSE = 16   # shorter matches aren't worth a device call
+# import-light by design (no jax): just the fingerprint/codec helpers
+from ..kvtier import digest as kvdigest
+
+#: shorter matches aren't worth a device call. Tied to the digest's
+#: FP_TOKENS BY CONSTRUCTION: the spill tier indexes keys by their
+#: first-FP_TOKENS fingerprint, and that bucket lookup finds every
+#: >= MIN_REUSE match only while FP_TOKENS <= MIN_REUSE — tune the
+#: floor in kvtier/digest.py, not by breaking the tie here
+MIN_REUSE = kvdigest.FP_TOKENS
 BUCKET = 16      # suffix lengths compile in these steps
 
 
 class PrefixCache:
-    def __init__(self, entries: int) -> None:
+    def __init__(self, entries: int, spill: Optional[Any] = None) -> None:
         self.entries = entries
+        #: optional kvtier.HostSpillTier catching LRU evictions
+        self.spill = spill
         self._cache: "OrderedDict[Tuple[int, ...], Any]" = OrderedDict()
         self._lock = threading.Lock()
-        self.stats = {"hits": 0, "misses": 0, "tokens_reused": 0}
+        self.stats = {
+            "hits": 0, "misses": 0, "tokens_reused": 0,
+            # spill-tier accounting; stays zeroed when no tier is
+            # attached so the /v1/model schema is identical either way
+            "spilled": 0, "readmitted": 0, "spill_bytes": 0,
+        }
+        #: seconds the LAST admission spent readmitting from spill —
+        #: reset/read by the slot engines around reuse_admission to
+        #: stamp the trace's ``kv`` stage (single inference thread per
+        #: engine, so a plain float is race-free in practice)
+        self.readmit_seconds = 0.0
+        #: bumped on any contents change; versions the published
+        #: digest so readers can tell fresh from stale
+        self.version = 0
+        self._digest_memo: Tuple[int, str] = (-1, "")
 
     def __len__(self) -> int:
         with self._lock:
@@ -40,32 +73,98 @@ class PrefixCache:
     def best_match(
         self, row: List[int]
     ) -> Tuple[int, Optional[Tuple[int, ...]]]:
-        best_len, best_key = 0, None
+        """Longest common prefix over device-resident AND spilled
+        keys. Device keys scan first, so on equal match length the
+        cheaper (no-readmit) base wins. The spill tier is consulted
+        by fingerprint bucket, not scanned: a usable (>= MIN_REUSE)
+        match shares the row's first-MIN_REUSE ids, so only
+        same-fingerprint keys can qualify — the scan stays O(device
+        LRU) however large the host budget grows."""
         with self._lock:
-            for stored in self._cache:
-                n = min(len(stored), len(row))
-                i = 0
-                while i < n and stored[i] == row[i]:
-                    i += 1
-                if i > best_len:
-                    best_len, best_key = i, stored
+            keys: List[Tuple[int, ...]] = list(self._cache)
+        if self.spill is not None:
+            keys.extend(
+                self.spill.candidates(
+                    kvdigest.prefix_fingerprint(row)
+                )
+            )
+        best_len, best_key = 0, None
+        for stored in keys:
+            n = min(len(stored), len(row))
+            i = 0
+            while i < n and stored[i] == row[i]:
+                i += 1
+            if i > best_len:
+                best_len, best_key = i, stored
         return best_len, best_key
 
     def get(self, key: Tuple[int, ...]) -> Optional[Any]:
-        """Fetch a stored cache and mark it most-recently-used. Returns
-        None if it was evicted between match and fetch."""
+        """Fetch a stored cache and mark it most-recently-used,
+        readmitting from the spill tier when the device LRU evicted
+        it. Returns None if it is gone from both tiers (evicted
+        between match and fetch)."""
         with self._lock:
             cache = self._cache.get(key)
             if cache is not None:
                 self._cache.move_to_end(key)
-            return cache
+                return cache
+        if self.spill is None:
+            return None
+        t0 = time.monotonic()
+        cache = self.spill.take(key)
+        if cache is None:
+            return None
+        self.stats["readmitted"] += 1
+        self.readmit_seconds += time.monotonic() - t0
+        # back into the device LRU as MRU (which may spill another
+        # entry in turn); the caller sees a plain device-tier hit
+        self.store(key, cache)
+        return cache
 
     def store(self, key: Tuple[int, ...], cache: Any) -> None:
+        evicted: List[Tuple[Tuple[int, ...], Any]] = []
         with self._lock:
             self._cache[key] = cache
             self._cache.move_to_end(key)
             while len(self._cache) > self.entries:
-                self._cache.popitem(last=False)
+                evicted.append(self._cache.popitem(last=False))
+            self.version += 1
+        if self.spill is None:
+            return
+        for k, c in evicted:
+            if len(k) < MIN_REUSE:
+                # below the reuse floor it can never match again —
+                # not worth the host RAM or the transfer
+                continue
+            # device->host happens inside put(), outside our lock
+            if self.spill.put(k, c):
+                self.stats["spilled"] += 1
+        if evicted:
+            self.version += 1
+        self.stats["spill_bytes"] = self.spill.bytes_used
+
+    def digest(self, max_bytes: Optional[int] = None) -> str:
+        """Versioned fingerprint digest of every reusable prefix this
+        cache holds (device + spill tiers), for gateway routing —
+        memoized per version, so steady state costs a tuple compare."""
+        version = self.version
+        memo_version, memo = self._digest_memo
+        if memo_version == version:
+            return memo
+        with self._lock:
+            keys = list(self._cache)
+        if self.spill is not None:
+            keys.extend(self.spill.keys())
+        fps = []
+        for key in keys:
+            fp = kvdigest.prefix_fingerprint(key)
+            if fp is not None:
+                fps.append(fp)
+        encoded = kvdigest.encode_fingerprints(
+            version, fps, max_bytes or kvdigest.DIGEST_MAX_BYTES
+        )
+        self._digest_memo = (version, encoded)
+        return encoded
 
 
 def plan_reuse(pc: "PrefixCache", row: List[int]):

@@ -330,12 +330,18 @@ class SlotEngine:
         if use_pc:
             from .serve_prefix import reuse_admission
 
+            pc.readmit_seconds = 0.0
             hit = reuse_admission(
                 pc, req.tokens, cfg, self.params,
                 chunk_len=self.prefill_chunk,
             )
             if hit is not None:
                 logits, row_cache = hit
+            if req.timings is not None and pc.readmit_seconds > 0.0:
+                # time spent readmitting a spilled base from host RAM
+                # (device_put roundtrip) — surfaces as the trace's
+                # ``kv`` stage, carved out of the prefill window
+                req.timings["kv"] = pc.readmit_seconds
         if row_cache is None:
             if (
                 self.cp_mesh is not None

@@ -76,7 +76,7 @@ import pytest
 _WORKLOAD_MODULES = {
     "test_workload", "test_window", "test_data", "test_flops",
     "test_capstone", "test_tuning", "test_slots",
-    "test_serve_dist", "test_fleet", "test_chaos",
+    "test_serve_dist", "test_fleet", "test_chaos", "test_kvtier",
 }
 _WORKLOAD_TESTS = {"test_fuzz_sample_logits_invariants"}
 

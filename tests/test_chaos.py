@@ -579,6 +579,42 @@ def test_trace_batch_priority_is_seeded_and_optional():
     ]
 
 
+def test_multiturn_trace_grows_shared_prefixes():
+    """Multi-turn mode: each session is a conversation whose turn
+    k prompt is a STRICT prefix of turn k+1's (the prefix-reuse
+    regime), deterministic under a seed, bounded by the context
+    window, with think gaps over the floor."""
+    cfg = TraceConfig(
+        seed=9, multiturn=True, duration_s=2.0,
+        turns_per_session=5, think_time_s=0.3, think_floor_s=0.25,
+        max_prompt=56, first_turn_min=16,
+    )
+    a = generate_trace(cfg)
+    assert [vars(r) for r in a] == [
+        vars(r) for r in generate_trace(cfg)
+    ]
+    assert [r.index for r in a] == list(range(len(a)))
+    assert [r.at_s for r in a] == sorted(r.at_s for r in a)
+    by_session = {}
+    for r in a:
+        by_session.setdefault(r.session_id, []).append(r)
+    multi = [rs for rs in by_session.values() if len(rs) > 1]
+    assert multi, "no session got a second turn"
+    for rs in multi:
+        for prev, cur in zip(rs, rs[1:]):
+            assert len(prev.tokens) < len(cur.tokens) <= cfg.max_prompt
+            assert cur.tokens[: len(prev.tokens)] == prev.tokens
+            assert cur.at_s - prev.at_s >= cfg.think_floor_s
+        assert len(rs[0].tokens) >= cfg.first_turn_min
+    # turns count toward the cap but stop at the context window
+    assert all(len(rs) <= cfg.turns_per_session for rs in by_session.values())
+    # multiturn=False draws nothing new from the rng: pre-existing
+    # traces replay byte-identically
+    assert [r.tokens for r in generate_trace(TraceConfig(seed=4))] == [
+        r.tokens for r in generate_trace(TraceConfig(seed=4))
+    ]
+
+
 # -- the quick scenarios: a real fleet under fire (tier-1) --------------
 
 
@@ -699,6 +735,28 @@ def test_scenario_kill_under_burst_autoscaled(tmp_path):
         if int(rid.rsplit("-", 1)[1]) >= 2
     )
     assert report["gateway"]["catalog_flaps_damped"] >= 1
+
+
+def test_scenario_multiturn_rebalance(tmp_path):
+    """The KV-reuse proof: multi-turn conversations against a bounded
+    sticky table while a replica drains mid-conversation. Cache-aware
+    routing lands re-pinned sessions on digest-warm survivors (hint
+    hits), the host-RAM spill tier readmits what the 2-entry device
+    LRU evicted between turns, and the fleet reuses prefix tokens —
+    all with zero client-visible 5xx."""
+    report = _run_scenario_checked("multiturn_rebalance", tmp_path)
+    kv = report["kv"]
+    assert kv["cache_hint_hits"] >= 1
+    assert kv["readmitted"] >= 1
+    assert kv["spilled"] >= 1
+    assert kv["tokens_reused"] >= 100
+    assert kv["tokens_reused_per_prompt_token"] > 0
+    # the sticky bound did its job under 9 sessions / capacity 2
+    sticky = report["gateway"]["sticky"]
+    assert sticky["capacity"] == 2 and sticky["size"] <= 2
+    assert sticky["evicted"] >= 1
+    # the drained replica's absence from catalog + routing and the
+    # zero-5xx bar are covered by the spec checks (report["passed"])
 
 
 # -- the compound marathons (make chaos) --------------------------------

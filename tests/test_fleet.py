@@ -139,6 +139,173 @@ def test_affinity_key_extraction_modes():
     assert session_gw._affinity_key(req(), {"tokens": [[1, 2, 3]]}) is None
 
 
+def test_cache_aware_pick_prefers_warm_within_slack():
+    """A replica advertising the request's prefix fingerprint wins
+    the pick — but only within cache_slack of the least load, so a
+    warm-but-loaded replica never beats a healthy cold one."""
+    gw = FleetGateway(NoopBackend(), "svc", cache_slack=2)
+    fp = 0xBEEF
+    gw._replicas = {
+        "a": Replica("a", "h", 1, outstanding=0),
+        "b": Replica("b", "h", 2, outstanding=2, digest=frozenset({fp})),
+        "c": Replica("c", "h", 3, outstanding=1, digest=frozenset({fp})),
+    }
+    # no fingerprint: plain least-outstanding
+    assert gw._pick().id == "a"
+    # warm within slack: least-loaded WARM candidate wins
+    assert gw._pick(fp=fp).id == "c"
+    assert gw.hint_hits == 1
+    # every warm candidate beyond slack: cold pick, counted as a miss
+    gw._replicas["b"].outstanding = 3
+    gw._replicas["c"].outstanding = 3
+    assert gw._pick(fp=fp).id == "a"
+    assert gw.hint_misses == 1
+    # slack 0 still lets warmth break exact load ties
+    tie = FleetGateway(NoopBackend(), "svc", cache_slack=0)
+    tie._replicas = {
+        "a": Replica("a", "h", 1, outstanding=1),
+        "b": Replica("b", "h", 2, outstanding=1, digest=frozenset({fp})),
+    }
+    assert tie._pick(fp=fp).id == "b"
+    # an unknown fingerprint in a digest-publishing fleet is a miss;
+    # in a fleet with NO digests at all it is not counted (nothing
+    # was in play)
+    assert tie._pick(fp=0x1234).id == "a"
+    assert tie.hint_misses == 1
+    bare = FleetGateway(NoopBackend(), "svc")
+    bare._replicas = {"a": Replica("a", "h", 1)}
+    assert bare._pick(fp=fp).id == "a"
+    assert bare.hint_misses == 0
+
+
+def test_request_fingerprint_token_rows_only():
+    """The gateway fingerprints single token-row bodies exactly the
+    way replicas fingerprint cached keys; text prompts and malformed
+    bodies keep plain routing (None)."""
+    from containerpilot_tpu.kvtier import FP_TOKENS, prefix_fingerprint
+
+    gw = FleetGateway(NoopBackend(), "svc")
+    row = list(range(5, 5 + FP_TOKENS + 4))
+    assert gw._request_fingerprint(
+        {"tokens": [row]}
+    ) == prefix_fingerprint(row)
+    assert gw._request_fingerprint({"prompt": "text"}) is None
+    assert gw._request_fingerprint({"tokens": row}) is None  # flat
+    assert gw._request_fingerprint({"tokens": [row, row]}) is None
+    assert gw._request_fingerprint({"tokens": [["a"] * 20]}) is None
+    assert gw._request_fingerprint(
+        {"tokens": [row[: FP_TOKENS - 1]]}
+    ) is None
+    off = FleetGateway(NoopBackend(), "svc", cache_routing=False)
+    assert off._request_fingerprint({"tokens": [row]}) is None
+
+
+def test_sticky_lru_bound_and_eviction_counter():
+    """The sticky table is CAPPED: the oldest pin falls out when a
+    new session pins past capacity (it used to grow one entry per
+    session forever), and evictions are counted."""
+    gw = FleetGateway(NoopBackend(), "svc", sticky_capacity=2)
+    gw._replicas = {
+        "a": Replica("a", "h", 1),
+        "b": Replica("b", "h", 2),
+    }
+    for n in range(4):
+        gw._route(f"s:u{n}")
+    assert len(gw._sticky) == 2
+    assert gw.sticky_evicted == 2
+    assert gw._m_sticky_evicted._value.get() == 2  # noqa: SLF001
+    # the survivors are the two newest pins
+    assert set(gw._sticky) == {"s:u2", "s:u3"}
+    # routing an evicted key simply re-pins (possibly elsewhere);
+    # no crash, no drained_away accounting
+    assert gw._route("s:u0") is not None
+    assert len(gw._sticky) == 2
+    with pytest.raises(ValueError):
+        FleetGateway(NoopBackend(), "svc", sticky_capacity=0)
+
+
+def test_apply_notes_updates_kv_state_tolerantly():
+    """Heartbeat notes feed routing state: kv= counters and the pd=
+    digest parse tolerantly, same-version digests don't churn, and a
+    torn note never blanks a warm advertisement."""
+    from containerpilot_tpu.kvtier import encode_fingerprints
+
+    gw = FleetGateway(NoopBackend(), "svc")
+    r = Replica("a", "h", 1)
+    digest = encode_fingerprints(3, {0xAA, 0xBB})
+    gw._apply_notes(r, f"ok occ=0.50 kv=4,2,96,1,1 pd={digest}")
+    assert r.kv["tokens_reused"] == 96 and r.kv["hits"] == 4
+    assert r.digest == frozenset({0xAA, 0xBB})
+    assert r.digest_version == 3 and r.digest_at > 0
+    stamp = r.digest_at
+    # same version: no re-parse churn, stamp untouched
+    gw._apply_notes(r, f"ok kv=5,2,97,1,1 pd={digest}")
+    assert r.digest_at == stamp and r.kv["hits"] == 5
+    # a digest-free or garbage note keeps the previous advertisement,
+    # and a torn/malformed kv= must NOT regress the cumulative
+    # counters (a zeroed tokens_reused parked by a departure would
+    # permanently drop the replica from the fleet-wide gauge)
+    gw._apply_notes(r, "ok occ=0.75")
+    gw._apply_notes(r, "ok pd=garbage kv=nonsense")
+    gw._apply_notes(r, "ok kv=5,2,")      # torn mid-value
+    gw._apply_notes(r, "ok kv=5,2,9,1,1")  # truncated digit: 97 -> 9
+    assert r.digest == frozenset({0xAA, 0xBB})
+    assert r.kv == {
+        "hits": 5, "misses": 2, "tokens_reused": 97,
+        "spilled": 1, "readmitted": 1,
+    }
+    # a new version replaces the set
+    gw._apply_notes(r, f"ok pd={encode_fingerprints(4, {0xCC})}")
+    assert r.digest == frozenset({0xCC}) and r.digest_version == 4
+
+
+def test_fleet_tokens_reused_survives_replica_departure(run, tmp_path):
+    """The fleet-wide tokens_reused gauge folds a departed replica's
+    final advertised counter into _reuse_departed instead of
+    forgetting it when the record leaves the catalog."""
+    backend = FileCatalogBackend(str(tmp_path))
+
+    async def scenario():
+        gw = FleetGateway(
+            backend, "svc", poll_interval=0.05, empty_poll_threshold=1
+        )
+        for rid, port in (("r1", 1001), ("r2", 1002)):
+            backend.service_register(
+                ServiceRegistration(
+                    id=rid, name="svc", port=port, ttl=60,
+                    address="127.0.0.1",
+                ),
+                status="passing",
+            )
+            backend.update_ttl(rid, "ok occ=0.10 kv=1,0,50,0,0", "pass")
+        await gw._poll_once()
+        assert gw._fleet_tokens_reused() == 100
+        assert gw._replicas["r1"].kv["tokens_reused"] == 50
+        # r1 leaves the fleet (drain/crash): its contribution stays
+        backend.service_deregister("r1")
+        backend.update_ttl("r2", "ok occ=0.10 kv=2,0,75,0,0", "pass")
+        await gw._poll_once()
+        assert set(gw._replicas) == {"r2"}
+        assert gw._fleet_tokens_reused() == 50 + 75
+        # r1 FLAPS BACK (wedge heal / TTL-starved heartbeat) with its
+        # cumulative counter intact: the parked departed copy must be
+        # reclaimed, not double-counted
+        backend.service_register(
+            ServiceRegistration(
+                id="r1", name="svc", port=1001, ttl=60,
+                address="127.0.0.1",
+            ),
+            status="passing",
+        )
+        backend.update_ttl("r1", "ok occ=0.10 kv=1,0,50,0,0", "pass")
+        await gw._poll_once()
+        assert set(gw._replicas) == {"r1", "r2"}
+        assert gw._fleet_tokens_reused() == 50 + 75
+        return True
+
+    assert run(scenario())
+
+
 def test_hedge_threshold_is_learned_per_endpoint():
     """Millisecond /v1/score samples must not set the hedge deadline
     for second-long /v1/generate requests (and vice versa)."""

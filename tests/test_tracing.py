@@ -261,6 +261,26 @@ def test_add_engine_spans_is_bounded_and_batched():
         "slot_queue_wait", "prefill", "decode"
     ]
     assert trace.spans[-1][3] == {"rounds": 100_000}
+    # a spill-tier readmit carves a kv span OUT of the admission
+    # window: kv + prefill together still span admitted ->
+    # prefill_done, non-overlapping
+    t_kv = rec.start(endpoint="generate")
+    tracing.add_engine_spans(t_kv, dict(timings, kv=0.1))
+    stages = {s[0]: s for s in t_kv.spans}
+    assert set(stages) == {
+        "slot_queue_wait", "kv", "prefill", "decode"
+    }
+    assert stages["kv"][1] == 100.2
+    assert stages["kv"][2] == pytest.approx(100.3)
+    assert stages["prefill"][1] == stages["kv"][2]
+    assert stages["prefill"][2] == 100.5
+    # a kv time exceeding the whole window clamps (never a negative
+    # prefill span)
+    t_clamp = rec.start(endpoint="generate")
+    tracing.add_engine_spans(t_clamp, dict(timings, kv=99.0))
+    stages = {s[0]: s for s in t_clamp.spans}
+    assert stages["kv"][2] == 100.5
+    assert stages["prefill"][1] == stages["prefill"][2] == 100.5
     # partial stamps (request failed before admission) emit less,
     # never raise
     t2 = rec.start(endpoint="generate")
