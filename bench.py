@@ -1073,6 +1073,12 @@ def chaos_goodput_bench(seed: int = 0) -> dict:
             "tpot_p95_ms": score["tpot_ms"]["p95"],
             "count_5xx": score["count_5xx"],
             "truncated_streams": score["truncated_streams"],
+            # event-loop health (analysis/loopcheck.py): the named
+            # form of "the loop hiccuped", tracked release-over-release
+            "loop_lag_max_ms": report["loop_lag_max_ms"],
+            "loop_task_exceptions": len(
+                report["loop"]["task_exceptions"]
+            ),
             "retried": report["gateway"]["retried"],
             "hedged": report["gateway"]["hedged"],
             "catalog_flaps_damped": (

@@ -8,7 +8,10 @@ enumerates pre-existing, justified debt instead of hiding it.
 
 See ``docs/70-static-analysis.md`` for the rule catalog, the pragma
 escape hatches, and the baseline workflow; ``racecheck.py`` is the
-opt-in runtime lock-order/publish-discipline harness tests use.
+opt-in runtime lock-order/publish-discipline harness tests use, and
+``loopcheck.py`` is its event-loop sibling (scheduling-lag probe +
+leaked-task watchdog) that the gateway, replicas, and the chaos
+harness run in production paths.
 """
 from .cpcheck import (
     ALL_RULES,
@@ -23,9 +26,12 @@ from .cpcheck import (
     scan_source,
     write_baseline,
 )
+from .loopcheck import LoopLagProbe, TaskWatchdog
 from .racecheck import CheckedLock, RaceCheck, Violation
 
 __all__ = [
+    "LoopLagProbe",
+    "TaskWatchdog",
     "ALL_RULES",
     "RULES_BY_ID",
     "Finding",

@@ -29,6 +29,39 @@ invariants earlier PRs paid real debugging time to establish:
   (``EventCode.X`` / the well-known ``GLOBAL_*`` constants), never
   inline string literals.
 
+PRs 5-10 grew a second concurrency regime — the asyncio event loop
+under the gateway, admission, autoscaler, mux transport, and every
+replica HTTP surface — and these rules keep THAT half honest the same
+way the thread-and-JAX rules above keep the first:
+
+- **CP-ASYNCBLOCK** — no blocking call (``time.sleep``, sync
+  socket/file I/O, ``subprocess.run``, ``future.result()`` /
+  ``thread.join()``, ``jax.device_get``/``device_put``/
+  ``block_until_ready``) lexically inside an ``async def`` body:
+  one blocking call on the gateway loop stalls every multiplexed
+  stream on the box. Wrapping the work in ``run_in_executor`` /
+  ``asyncio.to_thread`` heals it.
+- **CP-TASKLEAK** — ``asyncio.create_task(...)`` /
+  ``ensure_future(...)`` whose return value is discarded: an
+  unreferenced task is garbage-collectable mid-flight and its
+  exception vanishes with it. Storing the task, awaiting it, or
+  chaining a done-callback heals it (``utils/tasks.spawn`` does all
+  three).
+- **CP-AWAITHOLD** — ``await`` lexically inside a held
+  ``threading.Lock``/``RLock`` ``with``-block: the task parks with
+  the lock held, and any other task (or executor thread) that wants
+  it wedges the whole loop. ``asyncio.Lock`` (``async with``) is
+  exempt — that is the primitive to use here.
+- **CP-RETRACE** — a locally-jitted callable invoked in a
+  ``# cpcheck: hotpath`` region with arguments derived from
+  Python-varying values (``len(...)``, f-strings, dynamic
+  subscripts): every distinct value is a silent recompile, and a
+  recompile storm is a stall no profiler names.
+
+The runtime analog of these rules is ``analysis/loopcheck.py`` (an
+event-loop lag probe + leaked-task watchdog), the way ``racecheck.py``
+is the runtime analog of CP-LOCKPUB.
+
 Each rule is a small visitor class with a ``rule_id`` and a docstring;
 ``scan_source``/``scan_file``/``scan_package`` drive them and return
 ``Finding`` records. Findings are fingerprinted by (rule, file, scope,
@@ -699,6 +732,328 @@ class TopicRule(Rule):
         return findings
 
 
+class AsyncBlockRule(Rule):
+    """CP-ASYNCBLOCK: a blocking call lexically inside an ``async
+    def`` body.
+
+    The event loop is cooperative: one ``time.sleep``, sync
+    socket/file I/O, ``subprocess.run``, ``future.result()`` /
+    ``thread.join()``, or host-synchronizing JAX transfer
+    (``device_get``/``device_put``/``block_until_ready``) on the
+    gateway loop stalls every co-resident request, stream, heartbeat
+    and poll on the box — the exact failure the supervisor exists to
+    prevent. Nested ``def``/``lambda`` bodies are skipped (they run
+    later, usually on an executor thread), and a call lexically
+    wrapped in ``loop.run_in_executor(...)`` / ``asyncio.to_thread(...)``
+    arguments is healed: that is the sanctioned escape, and the fix
+    this rule is pushing toward.
+
+    ``.result()``/``.join()`` are matched by dataflow, not name alone
+    (``"".join(...)`` and an awaited asyncio future are innocent):
+    only receivers bound from ``executor.submit(...)`` /
+    ``threading.Thread(...)`` in the same function — or chained
+    directly off them — are flagged.
+    """
+
+    rule_id = "CP-ASYNCBLOCK"
+
+    BLOCKED_NAMES = {
+        "time.sleep",
+        "subprocess.run", "subprocess.call", "subprocess.check_call",
+        "subprocess.check_output", "subprocess.getoutput",
+        "os.system", "os.waitpid",
+        "socket.create_connection", "urllib.request.urlopen",
+        "open", "input",
+        "jax.device_get", "jax.device_put", "jax.block_until_ready",
+    }
+    BLOCKED_TAILS = {"block_until_ready", "device_get", "device_put"}
+    #: calls whose argument subtrees are the sanctioned escape hatch
+    EXECUTOR_TAILS = {"run_in_executor", "to_thread"}
+    #: receivers born from these tails make .result()/.join() blocking
+    FUTURE_SOURCES = {"submit"}
+    THREAD_SOURCES = {"Thread"}
+
+    def _scan_async_fn(
+        self, ctx: ModuleContext, fn: ast.AsyncFunctionDef
+    ) -> List[Finding]:
+        findings: List[Finding] = []
+        # names bound from executor.submit(...) / threading.Thread(...)
+        future_names: Set[str] = set()
+        thread_names: Set[str] = set()
+
+        def source_kind(call: ast.Call) -> Optional[str]:
+            tail = dotted_name(call.func).rpartition(".")[2]
+            if tail in self.FUTURE_SOURCES:
+                return "future"
+            if tail in self.THREAD_SOURCES:
+                return "thread"
+            return None
+
+        for node in _body_nodes(fn.body, skip_defs=True):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                kind = source_kind(node.value)
+                if kind:
+                    for target in node.targets:
+                        path = _expr_path(target)
+                        if path:
+                            (future_names if kind == "future"
+                             else thread_names).add(path)
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                 ast.Lambda),
+            ):
+                return  # runs later, not on this loop iteration
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                tail = name.rpartition(".")[2]
+                if tail in self.EXECUTOR_TAILS:
+                    # run_in_executor/to_thread arguments are the
+                    # escape hatch; don't descend into them
+                    visit(node.func)
+                    return
+                hit = (
+                    name in self.BLOCKED_NAMES
+                    or tail in self.BLOCKED_TAILS
+                )
+                why = f"blocking `{name or tail}`"
+                if not hit and tail in ("result", "join"):
+                    recv = node.func.value if isinstance(
+                        node.func, ast.Attribute
+                    ) else None
+                    recv_path = _expr_path(recv) if recv is not None else None
+                    if recv_path in future_names or (
+                        isinstance(recv, ast.Call)
+                        and source_kind(recv) == "future"
+                    ):
+                        hit, why = True, f"`{recv_path or '...'}.result()` blocks on a concurrent future"
+                    elif recv_path in thread_names or (
+                        isinstance(recv, ast.Call)
+                        and source_kind(recv) == "thread"
+                    ):
+                        hit, why = True, f"`{recv_path or '...'}.join()` blocks on a thread"
+                if hit:
+                    f = self.finding(
+                        ctx, node,
+                        f"{why} in async def `{fn.name}` stalls the "
+                        "event loop: move it to run_in_executor / "
+                        "asyncio.to_thread",
+                    )
+                    if f:
+                        findings.append(f)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in fn.body:
+            visit(stmt)
+        return findings
+
+    def run(self, ctx: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                findings.extend(self._scan_async_fn(ctx, node))
+        return findings
+
+
+class TaskLeakRule(Rule):
+    """CP-TASKLEAK: ``asyncio.create_task(...)`` (or
+    ``ensure_future``) whose return value is discarded.
+
+    The event loop holds only a weak reference to running tasks: a
+    task nobody stores can be garbage-collected mid-flight, and an
+    exception it raises is silently dropped on the floor — the
+    asyncio face of CP-SWALLOW, with the added insult that the
+    watchdog/relay the task implemented just stops existing. Storing
+    the task (``self._task = ...``, a pending set), awaiting it, or
+    chaining ``.add_done_callback(...)`` heals the finding;
+    ``utils/tasks.spawn`` packages the full discipline (reference +
+    logging done-callback) in one call.
+    """
+
+    rule_id = "CP-TASKLEAK"
+
+    SPAWN_TAILS = {"create_task", "ensure_future"}
+
+    def run(self, ctx: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Expr):
+                continue
+            call = node.value
+            if not isinstance(call, ast.Call):
+                continue
+            name = dotted_name(call.func)
+            if name.rpartition(".")[2] not in self.SPAWN_TAILS:
+                continue
+            f = self.finding(
+                ctx, call,
+                f"`{name}` result discarded: an unreferenced task is "
+                "GC-cancellable and swallows its exception — store "
+                "it (utils/tasks.spawn), await it, or chain "
+                "add_done_callback",
+            )
+            if f:
+                findings.append(f)
+        return findings
+
+
+class AwaitHoldRule(Rule):
+    """CP-AWAITHOLD: ``await`` lexically inside a held
+    ``threading.Lock``/``RLock`` ``with``-block.
+
+    A coroutine that awaits while holding a *thread* lock parks with
+    the lock held. Any other task that wants the lock then blocks the
+    whole event loop when it tries to acquire (thread locks don't
+    yield), and an executor thread contending for it can deadlock
+    against the loop outright — a loop-wide stall with no stack trace
+    pointing at the cause. ``async for`` and ``async with`` suspend
+    the same way (at ``__anext__``/``__aenter__``) and are flagged
+    too. ``asyncio.Lock`` is exempt by shape: the *outer* lock being
+    held must be a sync ``with`` (an ``AsyncWith`` there is exactly
+    the primitive to use around awaits). Nested ``def`` bodies are
+    skipped (they run later, not under the lock).
+    """
+
+    rule_id = "CP-AWAITHOLD"
+
+    #: nodes that suspend the coroutine: an explicit await, or the
+    #: implicit ones inside `async for` / `async with`
+    SUSPENDS = (ast.Await, ast.AsyncFor, ast.AsyncWith)
+
+    def run(self, ctx: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            # sync `with` only: `async with asyncio.Lock()` is the fix
+            if not isinstance(node, ast.With):
+                continue
+            if not any(
+                LockPubRule._is_lockish(item.context_expr)
+                for item in node.items
+            ):
+                continue
+            for sub in _body_nodes(node.body, skip_defs=True):
+                if isinstance(sub, self.SUSPENDS):
+                    f = self.finding(
+                        ctx, sub,
+                        "await while holding a thread lock: the task "
+                        "parks mid-critical-section and wedges the "
+                        "loop — narrow the lock or use asyncio.Lock",
+                    )
+                    if f:
+                        findings.append(f)
+        return findings
+
+
+class RetraceRule(Rule):
+    """CP-RETRACE: a jitted callable invoked in a hot path with
+    Python-varying arguments — the static face of a recompile storm.
+
+    ``jax.jit`` specializes on argument shapes and static values:
+    passing ``len(batch)``, an f-string, or a dict lookup keyed on
+    request state means every distinct value silently compiles a new
+    executable, billing seconds of XLA time to a request that
+    expected milliseconds (the exact trap the chaos warmup had to
+    pre-compile its way around). Inside ``# cpcheck: hotpath``
+    regions, calls to locally-bound ``jax.jit``/``pjit`` objects —
+    and direct ``lax.scan`` calls — are checked: any argument whose
+    expression tree contains ``len(...)``, an f-string
+    (``JoinedStr``), or a subscript with a non-constant key is
+    flagged. Pad/bucket the value (the warmup's bucket set exists for
+    this) or hoist it out of the hot region.
+    """
+
+    rule_id = "CP-RETRACE"
+
+    JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+    SCAN_NAMES = {"lax.scan", "jax.lax.scan"}
+    VARYING_CALLS = {"len"}
+
+    def _jit_bound(self, ctx: ModuleContext) -> Set[str]:
+        bound: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            if dotted_name(node.value.func) not in self.JIT_NAMES:
+                continue
+            for target in node.targets:
+                path = _expr_path(target)
+                if path:
+                    bound.add(path)
+        return bound
+
+    @staticmethod
+    def _static_index(node: ast.AST) -> bool:
+        """True when a subscript's index is a compile-time constant:
+        ``b[0]``, ``b[-1]``, ``shapes[1, 0]`` — literal_eval folds
+        them all; anything it can't fold varies at runtime."""
+        try:
+            ast.literal_eval(node)
+        except (ValueError, TypeError, SyntaxError, MemoryError):
+            return False
+        return True
+
+    def _varying(self, arg: ast.AST) -> Optional[str]:
+        """The first Python-varying subexpression in ``arg``, as a
+        human-readable reason, or None when the argument is stable."""
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Call) and dotted_name(
+                node.func
+            ) in self.VARYING_CALLS:
+                return "len(...)"
+            if isinstance(node, ast.JoinedStr):
+                return "an f-string"
+            if isinstance(node, ast.Subscript) and not self._static_index(
+                node.slice
+            ):
+                return "a dynamic subscript"
+        return None
+
+    def run(self, ctx: ModuleContext) -> List[Finding]:
+        bound = self._jit_bound(ctx)
+        findings: List[Finding] = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if not _is_hotpath(fn, ctx):
+                continue
+            for sub in _body_nodes(fn.body, skip_defs=False):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = dotted_name(sub.func)
+                jitted = (
+                    name in bound
+                    or name.rpartition(".")[2] in bound
+                    or name in self.SCAN_NAMES
+                )
+                if not jitted:
+                    continue
+                for arg in list(sub.args) + [
+                    kw.value for kw in sub.keywords
+                ]:
+                    reason = self._varying(arg)
+                    if reason is None:
+                        continue
+                    f = self.finding(
+                        ctx, sub,
+                        f"jitted `{name}` called with {reason} in a "
+                        "hot path: every distinct value is a silent "
+                        "recompile — pad/bucket it or hoist it out",
+                    )
+                    if f:
+                        findings.append(f)
+                    break  # one report per call site
+        return findings
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     HotSyncRule(),
     DonateRule(),
@@ -706,6 +1061,10 @@ ALL_RULES: Tuple[Rule, ...] = (
     SwallowRule(),
     ThreadRule(),
     TopicRule(),
+    AsyncBlockRule(),
+    TaskLeakRule(),
+    AwaitHoldRule(),
+    RetraceRule(),
 )
 
 RULES_BY_ID: Dict[str, Rule] = {r.rule_id: r for r in ALL_RULES}

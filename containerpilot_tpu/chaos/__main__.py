@@ -83,7 +83,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"{report['score']['goodput_fraction']} "
             f"({report['score']['goodput_rps']} rps), "
             f"5xx={report['score']['count_5xx']}, "
-            f"requests={report['score']['requests']}",
+            f"requests={report['score']['requests']}, "
+            f"loop_lag_max={report['loop_lag_max_ms']}ms",
             file=sys.stderr,
         )
         for check in report["checks"]:

@@ -32,6 +32,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..analysis.loopcheck import LoopLagProbe, TaskWatchdog
 from .client import issue_request
 from .faults import ChaosProxy, Fault, FlakyBackend
 from .slo import SLO, RequestRecord, ScenarioScore
@@ -422,6 +423,17 @@ class ScenarioSpec:
     #: no violations — the invariant constrains the blame, not the
     #: failure count (goodput floors do that).
     expect_dominant_stage: Dict[str, str] = field(default_factory=dict)
+    # -- event-loop health invariant ------------------------------------
+    #: loopcheck bound: the harness loop (which carries the gateway,
+    #: every replica, the members, AND the chaos client) must never
+    #: stall longer than this during the driven window. The stated
+    #: default leaves generous room for GIL contention with the
+    #: decode/compile executor threads on a loaded CPU box while
+    #: still catching the CP-ASYNCBLOCK failure shape (a sync sleep,
+    #: file read, or device fetch on the loop shows up as its own
+    #: duration). Scenarios with harsher compute may raise it —
+    #: stating the bound is the point.
+    max_loop_lag_ms: float = 1500.0
 
 
 async def _warm_fleet(
@@ -505,6 +517,13 @@ async def run_scenario_async(
     fires, and score the run. Returns the JSON-able report."""
     trace_cfg = dataclasses.replace(spec.trace, seed=seed)
     requests = generate_trace(trace_cfg)
+    # event-loop sentinel (analysis/loopcheck.py): the watchdog wraps
+    # the task factory BEFORE the fleet boots so every task the run
+    # creates is covered; the lag probe starts with the traffic clock
+    # (boot/warmup deliberately compile XLA programs — that stall is
+    # paid before the SLO window opens and must not pollute the bound)
+    probe = LoopLagProbe()
+    watchdog = TaskWatchdog().install()
     harness = FleetHarness(
         catalog_dir,
         spec.replicas,
@@ -526,6 +545,7 @@ async def run_scenario_async(
         # seed replica-0's prefix cache with [1]*L prompts whose
         # chained matches must not inflate the trace's reuse numbers
         kv_before = harness.kv_stats()
+        probe.start()
         clock_zero = time.monotonic()
         schedule = asyncio.ensure_future(
             harness.run_schedule(spec.faults, clock_zero)
@@ -538,6 +558,11 @@ async def run_scenario_async(
         # constant idle tax that varies per scenario
         wall_s = time.monotonic() - clock_zero
         await asyncio.sleep(spec.settle_s)
+        # the settle window stays inside the measured span: autoscaler
+        # drain/retire and late TTL expiries run on the same loop and
+        # a stall there is just as real to the next request
+        probe.stop()
+        loop_stats = probe.snapshot()
         score = ScenarioScore(records, wall_s, spec.slo).as_dict()
         catalog_ids = {
             inst.id for inst in harness.backend.instances(SERVICE)
@@ -588,7 +613,18 @@ async def run_scenario_async(
             if harness.autoscaler is not None else None
         )
     finally:
+        probe.stop()
         await harness.stop()
+        watchdog.uninstall()
+        # the leak ledger is read AFTER teardown and one drained grace
+        # window: a task that died during harness.stop() (or in the
+        # final grace_s of the run) must not slip past the
+        # task_exceptions == [] gate because its deferred _check
+        # hadn't fired when the snapshot was taken
+        await asyncio.sleep(watchdog.grace_s * 2)
+
+    loop_stats["task_exceptions"] = watchdog.snapshot()
+    loop_stats["tasks_created"] = watchdog.tasks_created
 
     checks: List[Dict[str, Any]] = []
 
@@ -599,6 +635,15 @@ async def run_scenario_async(
         "5xx", score["count_5xx"] <= spec.max_5xx,
         f"{score['count_5xx']} client-visible 5xx "
         f"(allowed {spec.max_5xx})",
+    )
+    check(
+        "loop_lag",
+        loop_stats["lag_max_ms"] <= spec.max_loop_lag_ms,
+        f"event-loop lag max {loop_stats['lag_max_ms']}ms over "
+        f"{loop_stats['heartbeats']} heartbeats "
+        f"(bound {spec.max_loop_lag_ms}ms; p99 "
+        f"{loop_stats['lag_p99_ms']}ms — a blocking call on the loop "
+        f"shows up here as its own duration)",
     )
     check(
         "transport_errors",
@@ -801,6 +846,10 @@ async def run_scenario_async(
         "checks": checks,
         "trace": trace_summary(requests),
         "score": score,
+        # event-loop health (analysis/loopcheck.py): the gated max is
+        # also surfaced top-level as the report's schema-stable name
+        "loop_lag_max_ms": loop_stats["lag_max_ms"],
+        "loop": loop_stats,
         "gateway": gateway_stats,
         "kv": kv_stats,
         "autoscaler": autoscaler_stats,
@@ -1074,6 +1123,11 @@ _register(ScenarioSpec(
     # scale-down needs sustained idle AFTER the trace: the settle
     # window is where the fleet shrinks back to min
     settle_s=5.0,
+    # mid-run scale-ups compile a fresh replica's XLA warmup on an
+    # executor thread; the GIL bursts bleed into loop scheduling
+    # (~0.9s observed on the CPU lab box) — a raised, stated bound,
+    # not an exemption
+    max_loop_lag_ms=3000.0,
     min_goodput_fraction=0.2,
     min_admitted_goodput_fraction=0.8,
     expect_flaps_damped_min=1,
@@ -1149,6 +1203,10 @@ _register(ScenarioSpec(
     server=dict(_REUSE_SERVER),
     gateway=dict(_REUSE_GATEWAY),
     settle_s=1.0,
+    # spill readmits (device_put) and mid-trace extend-bucket jit
+    # compiles burst the GIL from the executor threads (~0.35-0.65s
+    # lag observed on the CPU lab box) — a raised, stated bound
+    max_loop_lag_ms=2500.0,
     # 2 slots/replica on the 1-core lab box: bursts of co-resident
     # turns queue on slots, so the TTFT bar carries headroom the way
     # burst_10x's does — the floor still bites on real regressions

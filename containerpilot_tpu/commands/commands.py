@@ -32,6 +32,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..events import Event, EventBus, EventCode
+from ..utils.tasks import spawn
 from .args import parse_args
 
 log = logging.getLogger("containerpilot.commands")
@@ -123,9 +124,7 @@ class Command:
         """
         self._spawn_pending = True
         self._pending_signal = None  # nothing queued from before this run
-        return asyncio.get_event_loop().create_task(
-            self._run(bus), name=f"exec:{self.name}"
-        )
+        return spawn(self._run(bus), name=f"exec:{self.name}")
 
     async def _run(self, bus: EventBus) -> Optional[int]:
         # Exit events are collected while the run lock is held and

@@ -34,6 +34,7 @@ from ..control import ControlServer
 from ..events import Event, EventBus, EventCode, GLOBAL_STARTUP
 from ..jobs import Job, from_configs as jobs_from_configs
 from ..telemetry import Metric, Telemetry
+from ..utils.tasks import spawn
 from ..watches import Watch, from_configs as watches_from_configs
 
 log = logging.getLogger("containerpilot.core")
@@ -146,8 +147,8 @@ class App:
             if stop_task is not None:
                 return
             if all(j.is_complete for j in self.jobs):
-                stop_task = asyncio.get_event_loop().create_task(
-                    self._stop_generation()
+                stop_task = spawn(
+                    self._stop_generation(), name="stop-generation"
                 )
 
         await self.control_server.run(bus)
@@ -216,7 +217,10 @@ class App:
                     )
                     job.kill()
 
-        asyncio.get_event_loop().create_task(_kill_stragglers())
+        # fire-and-forget by design, but never unreferenced: spawn's
+        # module-level pending set keeps the killer alive across the
+        # generation swap, and its done-callback logs a death
+        spawn(_kill_stragglers(), name="reload-kill-stragglers")
         self.cfg = new_app.cfg
         self.jobs = new_app.jobs
         self.watches = new_app.watches

@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional
 
 from ..config.timing import DurationError, parse_duration
 from ..utils.http import HTTPServer, Request, Response
+from ..utils.tasks import spawn
 
 log = logging.getLogger("containerpilot.catalog")
 
@@ -93,7 +94,7 @@ class CatalogServer:
         if self.snapshot_path:
             self._load_snapshot()
         await self._server.start_tcp(self.host, self.port)
-        self._reaper = asyncio.get_event_loop().create_task(self._reap_loop())
+        self._reaper = spawn(self._reap_loop(), name="catalog-reaper")
         log.info("catalog: serving Consul-compatible API on %s:%d",
                  self.host, self.port)
 

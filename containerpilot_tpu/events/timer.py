@@ -24,6 +24,7 @@ from __future__ import annotations
 import asyncio
 from typing import Any, Callable, Optional
 
+from ..utils.tasks import spawn
 from .events import Event, EventCode
 
 EmitFn = Callable[[Event], None]
@@ -67,7 +68,7 @@ def event_timeout(sink: Any, delay: float, name: str) -> "asyncio.Task[None]":
         except asyncio.CancelledError:
             pass
 
-    return asyncio.get_event_loop().create_task(_fire(), name=f"timeout:{name}")
+    return spawn(_fire(), name=f"timeout:{name}")
 
 
 def event_timer(
@@ -90,7 +91,7 @@ def event_timer(
         except asyncio.CancelledError:
             pass
 
-    return asyncio.get_event_loop().create_task(_tick(), name=f"timer:{name}")
+    return spawn(_tick(), name=f"timer:{name}")
 
 
 def cancel_timer(task: Optional["asyncio.Task[None]"]) -> None:

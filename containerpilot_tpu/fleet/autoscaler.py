@@ -44,6 +44,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, NamedTuple, Optional
 
 from ..events import Event, EventBus, EventCode
+from ..utils.tasks import spawn
 
 log = logging.getLogger("containerpilot.fleet")
 
@@ -135,9 +136,7 @@ class Autoscaler:
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> "asyncio.Task[None]":
-        self._task = asyncio.get_event_loop().create_task(
-            self._loop(), name="fleet-autoscaler"
-        )
+        self._task = spawn(self._loop(), name="fleet-autoscaler")
         return self._task
 
     async def stop(self) -> None:

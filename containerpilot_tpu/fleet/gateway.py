@@ -78,6 +78,7 @@ from ..kvtier import (
     parse_kv_note,
     prefix_fingerprint,
 )
+from ..analysis.loopcheck import LoopLagProbe
 from ..telemetry import tracing
 from ..utils.http import (
     HTTPServer,
@@ -86,7 +87,12 @@ from ..utils.http import (
     StreamingResponse,
     timed_read,
 )
-from ..utils.prom import ensure_build_info, exposition
+from ..utils.prom import (
+    ensure_build_info,
+    ensure_loop_lag_gauge,
+    exposition,
+)
+from ..utils.tasks import spawn
 from ..watches import poll_upstream
 from .admission import (
     AdmissionController,
@@ -622,6 +628,13 @@ class FleetGateway:
                      10, 30, 60),
         )
         ensure_build_info(self._registry, "gateway")
+        # event-loop health sentinel (analysis/loopcheck.py): the
+        # gateway loop carries every mux stream, admission timer, and
+        # catalog poll on the box — one blocking call stalls them all
+        # at once, and cp_loop_lag_ms is how that stall gets a name
+        # instead of surfacing as unattributed TTFT jitter
+        self._loop_probe = LoopLagProbe()
+        ensure_loop_lag_gauge(self._registry, self._loop_probe)
 
         self._server = HTTPServer()
         self._server.route("GET", "/health", self._health)
@@ -641,8 +654,9 @@ class FleetGateway:
     async def run(self) -> None:
         await self._server.start_tcp(self.host, self.port)
         self.port = self._server.bound_port or self.port
+        self._loop_probe.start()
         await self._poll_once()  # first routing set before traffic
-        self._poll_task = asyncio.get_event_loop().create_task(
+        self._poll_task = spawn(
             self._poll_loop(), name=f"fleet-gateway:{self.service_name}"
         )
         log.info(
@@ -651,6 +665,7 @@ class FleetGateway:
         )
 
     async def stop(self) -> None:
+        self._loop_probe.stop()
         if self._poll_task is not None and not self._poll_task.done():
             self._poll_task.cancel()
             try:
@@ -1060,6 +1075,12 @@ class FleetGateway:
                     if self.trace else None
                 ),
                 "draining": self.draining,
+                # event-loop health: the same numbers as the
+                # cp_loop_lag_ms gauge, for triage without a scrape
+                "loop_lag_ms": {
+                    "max": round(self._loop_probe.max_ms(), 2),
+                    "p99": round(self._loop_probe.p99_ms(), 2),
+                },
                 # fleet-wide KV reuse: the goodput yardstick plus the
                 # routing hint counters (docs/60 has the runbook rows)
                 "kv": {

@@ -47,6 +47,7 @@ from ..events import (
     GLOBAL_SHUTDOWN,
     QUIT_BY_TEST,
 )
+from ..utils.tasks import spawn
 
 log = logging.getLogger("containerpilot.fleet")
 
@@ -108,7 +109,7 @@ class FleetMember(EventHandler):
             self.advertise_port
             or getattr(self.server, "port", 0) or 0
         )
-        self._beat_task = asyncio.get_event_loop().create_task(
+        self._beat_task = spawn(
             self._beat_loop(), name=f"fleet-member:{self.instance_id}"
         )
 
@@ -212,7 +213,7 @@ class FleetMember(EventHandler):
         maintenance verbs drain/resume this replica."""
         self.subscribe(bus)
         self.register(bus)
-        self._bus_task = asyncio.get_event_loop().create_task(
+        self._bus_task = spawn(
             self._bus_loop(), name=f"fleet-member-bus:{self.instance_id}"
         )
         return self._bus_task

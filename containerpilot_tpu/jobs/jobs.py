@@ -41,6 +41,7 @@ from ..events import (
     event_timeout,
     event_timer,
 )
+from ..utils.tasks import spawn
 from .config import UNLIMITED, JobConfig
 from .status import JobStatus
 
@@ -124,7 +125,7 @@ class Job(EventHandler):
             self.start_timeout_event = Event(EventCode.TIMER_EXPIRED, timeout_name)
         else:
             self.start_timeout_event = NON_EVENT
-        self._task = asyncio.get_event_loop().create_task(
+        self._task = spawn(
             self._loop(on_complete), name=f"job:{self.name}"
         )
         return self._task

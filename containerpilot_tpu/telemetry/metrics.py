@@ -21,6 +21,7 @@ from ..events import (
     GLOBAL_SHUTDOWN,
     QUIT_BY_TEST,
 )
+from ..utils.tasks import spawn
 from .config import MetricConfig
 
 log = logging.getLogger("containerpilot.telemetry")
@@ -37,9 +38,7 @@ class Metric(EventHandler):
     def run(self, bus: EventBus) -> "asyncio.Task[None]":
         self.subscribe(bus)
         self.register(bus)
-        self._task = asyncio.get_event_loop().create_task(
-            self._loop(), name=f"metric:{self.name}"
-        )
+        self._task = spawn(self._loop(), name=f"metric:{self.name}")
         return self._task
 
     def stop(self) -> None:

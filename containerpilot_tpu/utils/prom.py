@@ -44,3 +44,25 @@ def ensure_build_info(registry, role: str) -> None:
         # already registered in this registry (reload/fixture reuse)
         return
     gauge.labels(VERSION, role).set(1)
+
+
+def ensure_loop_lag_gauge(registry, probe) -> None:
+    """Register the shared event-loop health gauge
+    ``cp_loop_lag_ms{stat="max"|"p99"}`` over a
+    ``analysis/loopcheck.LoopLagProbe`` — one definition, so the
+    gateway and replica surfaces cannot drift. Idempotent per
+    registry, like ``ensure_build_info``."""
+    from prometheus_client import Gauge
+
+    try:
+        gauge = Gauge(
+            "cp_loop_lag_ms",
+            "event-loop scheduling delay over the probe ring, ms "
+            "(docs/70-static-analysis.md has the loopcheck runbook)",
+            ["stat"],
+            registry=registry,
+        )
+    except ValueError:
+        return
+    gauge.labels("max").set_function(probe.max_ms)
+    gauge.labels("p99").set_function(probe.p99_ms)

@@ -1,0 +1,73 @@
+"""Owned-task discipline for fire-and-forget asyncio tasks.
+
+The event loop holds only a weak reference to a running task: a task
+whose return value is discarded can be garbage-collected mid-flight,
+and an exception it raises evaporates with it — the relay/watchdog the
+task implemented just stops existing while /health stays green. That
+is CP-TASKLEAK's hazard (analysis/cpcheck.py), and ``spawn`` is the
+one-call fix every background task in the tree uses:
+
+- a **live reference**: the task joins ``owner`` (an owner-object
+  field's set, or the module-level ``_BACKGROUND`` pending set when no
+  owner is given) and leaves it on completion;
+- a **done-callback** that logs any exception that is not a
+  ``CancelledError`` — a supervisor loop that dies must say so, loudly,
+  the moment it dies, not when someone notices heartbeats stopped.
+
+Callers that also keep their own handle (``self._task = spawn(...)``)
+lose nothing: the set membership is belt-and-braces against the field
+being dropped, and the logging callback runs either way. The runtime
+backstop for tasks created OUTSIDE this helper is
+``analysis/loopcheck.TaskWatchdog``.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Coroutine, Optional, Set
+
+log = logging.getLogger("containerpilot.tasks")
+
+#: module-level pending set: the reference of last resort for spawns
+#: with no owner object (e.g. a reload's straggler-killer that must
+#: outlive the generation that scheduled it)
+_BACKGROUND: Set["asyncio.Task"] = set()
+
+
+def _log_done(task: "asyncio.Task") -> None:
+    """Done-callback: surface non-CancelledError deaths immediately."""
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        log.error(
+            "background task %r died: %r", task.get_name(), exc,
+            exc_info=exc,
+        )
+
+
+def spawn(
+    coro: Coroutine,
+    *,
+    name: Optional[str] = None,
+    owner: Optional[Set["asyncio.Task"]] = None,
+) -> "asyncio.Task":
+    """``create_task`` plus the two things a fire-and-forget task must
+    have: a live reference and an exception-logging done-callback.
+
+    ``owner`` is a set the task should live in (an owner object's
+    field); default is the module-level pending set. The task removes
+    itself on completion either way.
+    """
+    task = asyncio.get_event_loop().create_task(coro, name=name)
+    holder = _BACKGROUND if owner is None else owner
+    holder.add(task)
+    task.add_done_callback(holder.discard)
+    task.add_done_callback(_log_done)
+    return task
+
+
+def pending_count() -> int:
+    """How many ownerless background tasks are still in flight
+    (observability + tests)."""
+    return len(_BACKGROUND)

@@ -31,6 +31,7 @@ from ..events import (
     cancel_timer,
     event_timer,
 )
+from ..utils.tasks import spawn
 
 log = logging.getLogger("containerpilot.watches")
 
@@ -143,7 +144,7 @@ class Watch(EventHandler):
         self._timer = event_timer(
             self.receive, self.poll, timer_source, immediate=True
         )
-        self._task = asyncio.get_event_loop().create_task(
+        self._task = spawn(
             self._loop(timer_source), name=f"watch:{self.name}"
         )
         return self._task

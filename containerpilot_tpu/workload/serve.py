@@ -263,9 +263,17 @@ class InferenceServer:
             "tokens returned by generate/completions (post-trim)",
             registry=self._metrics_registry,
         )
-        from ..utils.prom import ensure_build_info
+        from ..utils.prom import ensure_build_info, ensure_loop_lag_gauge
 
         ensure_build_info(self._metrics_registry, "replica")
+        # event-loop health sentinel (analysis/loopcheck.py): one
+        # blocking call on this loop stalls every stream, heartbeat,
+        # and health check the replica serves — cp_loop_lag_ms is the
+        # named form of that stall, gated in the chaos quick suite
+        from ..analysis.loopcheck import LoopLagProbe
+
+        self._loop_probe = LoopLagProbe()
+        ensure_loop_lag_gauge(self._metrics_registry, self._loop_probe)
         # replica-side request tracing: spans recorded under the
         # gateway's trace id (X-CP-Trace / the mux HEADERS field) —
         # or a freshly minted one for direct clients — retained in a
@@ -1185,10 +1193,12 @@ class InferenceServer:
         await self._server.start_tcp(self.host, self.port)
         self.port = self._server.bound_port or self.port
         self._batcher.start()
+        self._loop_probe.start()
         log.info("serve: listening on %s:%d", self.host, self.port)
         await self.warmup()
 
     async def stop(self) -> None:
+        self._loop_probe.stop()
         await self._batcher.stop()
         if self.slot_engine is not None:
             # joins the worker thread; run off-loop so in-flight
@@ -1207,6 +1217,7 @@ class InferenceServer:
         record is left to decay critical by TTL expiry, which is the
         crash signature gateways must route around."""
         self.ready = False
+        self._loop_probe.stop()
         await self._server.abort()
         await self._batcher.stop()
         if self.slot_engine is not None:

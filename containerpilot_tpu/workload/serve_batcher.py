@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.decode import generate
+from ..utils.tasks import spawn
 
 
 @dataclass
@@ -63,7 +64,7 @@ class Batcher:
         return await job.future
 
     def start(self) -> None:
-        self._task = asyncio.get_event_loop().create_task(self._loop())
+        self._task = spawn(self._loop(), name="serve-batcher")
 
     async def stop(self) -> None:
         if self._task is not None:

@@ -1,7 +1,10 @@
 #!/usr/bin/env bash
 # cpcheck over ONLY the .py files your working tree changed — the fast
 # precommit-style loop (the full gate is `make lint`; CI runs it via
-# the tier-1 test_lint_gate test).
+# the tier-1 test_lint_gate test). The rule set is whatever
+# `python -m containerpilot_tpu.analysis --list-rules` prints —
+# thread/JAX rules (CP-HOTSYNC..CP-TOPIC) and the asyncio-era rules
+# (CP-ASYNCBLOCK, CP-TASKLEAK, CP-AWAITHOLD, CP-RETRACE) alike.
 #
 # Usage:
 #   scripts/cpcheck_diff.sh            # changed vs HEAD (staged + unstaged + untracked)

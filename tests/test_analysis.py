@@ -7,6 +7,7 @@ These are the analyzer's own unit tests; the rules' value against the
 REAL codebase is enforced by test_baseline_matches_fresh_scan and
 test_lint_gate below.
 """
+import asyncio
 import json
 import os
 import subprocess
@@ -45,6 +46,7 @@ def test_rule_catalog_complete():
     assert ids == {
         "CP-HOTSYNC", "CP-DONATE", "CP-LOCKPUB",
         "CP-SWALLOW", "CP-THREAD", "CP-TOPIC",
+        "CP-ASYNCBLOCK", "CP-TASKLEAK", "CP-AWAITHOLD", "CP-RETRACE",
     }
     for rule in ALL_RULES:
         assert rule.__doc__, f"{rule.rule_id} must document itself"
@@ -282,6 +284,175 @@ def test_disable_pragma_suppresses_named_rule_only():
     assert len(findings_for(src, "CP-SWALLOW")) == 1
 
 
+# ------------------------------------------- asyncio-era rules (PR 11)
+
+def test_asyncblock_fires_on_blocking_calls():
+    src = """
+    async def handler(self, req):
+        time.sleep(0.1)
+        data = open(self.path).read()
+        arr = jax.device_get(self.toks)
+        jax.device_put(arr)
+        out = subprocess.run(["ls"])
+        return arr
+    """
+    found = findings_for(src, "CP-ASYNCBLOCK")
+    assert len(found) == 5
+    assert all(f.scope == "handler" for f in found)
+
+
+def test_asyncblock_result_join_by_dataflow():
+    """`.result()`/`.join()` fire only on receivers born from
+    executor.submit / threading.Thread — `"".join(...)` and a done
+    asyncio task's `.result()` are innocent."""
+    src = """
+    async def handler(self, ex):
+        fut = ex.submit(work)
+        y = fut.result()
+        t = threading.Thread(target=work, daemon=True)
+        t.join()
+        s = ",".join(str(i) for i in y)
+        done, _ = await asyncio.wait({task})
+        return task.result()
+    """
+    found = findings_for(src, "CP-ASYNCBLOCK")
+    assert len(found) == 2
+    assert {f.line for f in found} == {4, 6}
+
+
+def test_asyncblock_clean_sync_def_and_executor_heal():
+    """Sync defs aren't the loop's problem; nested defs run on the
+    executor; run_in_executor/to_thread arguments are the sanctioned
+    escape and heal the finding."""
+    src = """
+    def sync_helper(path):
+        time.sleep(0.1)
+        return open(path).read()
+
+    async def handler(self, loop, path):
+        def work():
+            return jax.device_get(self.toks)
+        healed = await loop.run_in_executor(None, work)
+        also = await asyncio.to_thread(sync_helper, path)
+        return healed, also
+    """
+    assert findings_for(src, "CP-ASYNCBLOCK") == []
+
+
+def test_asyncblock_inline_disable_pragma():
+    src = """
+    async def handler(self):
+        time.sleep(0.001)  # cpcheck: disable=CP-ASYNCBLOCK sub-ms jitter by design, measured
+        return 1
+    """
+    assert findings_for(src, "CP-ASYNCBLOCK") == []
+
+
+def test_taskleak_fires_on_discarded_task():
+    src = """
+    def start(self):
+        asyncio.create_task(self._loop())
+        asyncio.get_event_loop().create_task(self._beat())
+        asyncio.ensure_future(self._poll())
+    """
+    found = findings_for(src, "CP-TASKLEAK")
+    assert len(found) == 3
+
+
+def test_taskleak_heals_when_stored_awaited_or_chained():
+    src = """
+    def start(self):
+        self._task = asyncio.create_task(self._loop())
+        asyncio.create_task(self._beat()).add_done_callback(done)
+        tasks.append(asyncio.ensure_future(self._poll()))
+
+    async def once(self):
+        await asyncio.create_task(self._once())
+    """
+    assert findings_for(src, "CP-TASKLEAK") == []
+
+
+def test_awaithold_fires_under_thread_lock():
+    src = """
+    async def flush(self):
+        with self._lock:
+            await self._drain()
+    """
+    found = findings_for(src, "CP-AWAITHOLD")
+    assert len(found) == 1 and found[0].scope == "flush"
+
+
+def test_awaithold_fires_on_async_for_and_async_with():
+    """`async for`/`async with` suspend at __anext__/__aenter__ with
+    the thread lock held — same hazard, different node."""
+    src = """
+    async def relay(self):
+        with self._lock:
+            async for chunk in self._stream:
+                self._buf.append(chunk)
+
+    async def enter(self):
+        with self._lock:
+            async with self._session:
+                pass
+    """
+    found = findings_for(src, "CP-AWAITHOLD")
+    assert {f.scope for f in found} == {"relay", "enter"}
+
+
+def test_awaithold_clean_asyncio_lock_and_nested_def():
+    """`async with` IS the fix (asyncio.Lock is exempt by shape), a
+    nested def's await runs later, and awaiting after release is the
+    discipline the rule pushes toward."""
+    src = """
+    async def flush(self):
+        async with self._alock:
+            await self._drain()
+        with self._lock:
+            def later():
+                return self._drain()
+            snapshot = list(self._pending)
+        await self._deliver(snapshot)
+    """
+    assert findings_for(src, "CP-AWAITHOLD") == []
+
+
+def test_retrace_fires_on_varying_args_in_hotpath():
+    src = """
+    step = jax.jit(_step)
+
+    # cpcheck: hotpath
+    def round(self, batch, key):
+        a = step(batch, len(batch))
+        b = step(batch, f"bucket-{key}")
+        c = step(batch, self.cache[key])
+        d = lax.scan(body, carry, xs[key])
+        return a, b, c, d
+    """
+    found = findings_for(src, "CP-RETRACE")
+    assert len(found) == 4
+    assert "recompile" in found[0].message
+
+
+def test_retrace_clean_on_stable_args_or_cold_path():
+    """Stable operands in the hot path are fine; a warmup path may
+    shape-probe all it wants; constant subscripts are static."""
+    src = """
+    step = jax.jit(_step)
+
+    # cpcheck: hotpath
+    def round(self, batch, params, cfg):
+        out = step(batch, params, cfg)
+        out = step(out, self.buckets[0])
+        out = step(out, self.buckets[-1])
+        return step(out, self.shapes[1, 0])
+
+    def warmup(self, batch):
+        return step(batch, len(batch))
+    """
+    assert findings_for(src, "CP-RETRACE") == []
+
+
 # ------------------------------------------------------------- baseline
 
 def test_baseline_matches_fresh_scan():
@@ -379,6 +550,28 @@ def test_lint_gate_fails_on_seeded_lockpub(tmp_path):
     proc = _run_cli("--files", str(bad))
     assert proc.returncode == 1
     assert "CP-LOCKPUB" in proc.stdout
+
+
+def test_lint_gate_fails_on_seeded_asyncblock(tmp_path):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(
+        "async def handler(req):\n"
+        "    time.sleep(1)\n"
+    )
+    proc = _run_cli("--files", str(bad))
+    assert proc.returncode == 1
+    assert "CP-ASYNCBLOCK" in proc.stdout
+
+
+def test_lint_gate_fails_on_seeded_taskleak(tmp_path):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(
+        "def start(self):\n"
+        "    asyncio.create_task(self._loop())\n"
+    )
+    proc = _run_cli("--files", str(bad))
+    assert proc.returncode == 1
+    assert "CP-TASKLEAK" in proc.stdout
 
 
 def test_cli_rejects_partial_baseline_write(tmp_path):
@@ -493,3 +686,152 @@ def test_racecheck_publish_outside_lock_is_clean(run):
         # context-manager exit ran assert_clean and unwrap
 
     run(scenario())
+
+
+# ------------------------------------------------------------ loopcheck
+
+def test_loopcheck_records_injected_stall(run):
+    """A blocking call on the loop (the CP-ASYNCBLOCK failure shape)
+    shows up in the lag ring as roughly its own duration."""
+    import time
+
+    from containerpilot_tpu.analysis import LoopLagProbe
+
+    async def scenario():
+        probe = LoopLagProbe(interval_s=0.01)
+        probe.start()
+        await asyncio.sleep(0.05)
+        time.sleep(0.25)  # the injected stall, on the loop thread
+        await asyncio.sleep(0.05)
+        probe.stop()
+        return probe
+
+    probe = run(scenario())
+    assert probe.max_ms() >= 150.0
+    snap = probe.snapshot()
+    assert snap["lag_max_ms"] == round(probe.max_ms(), 2)
+    assert snap["heartbeats"] == probe.beats > 0
+
+
+def test_loopcheck_clean_loop_reports_near_zero(run):
+    """A loop doing nothing but sleeping schedules its heartbeats on
+    time: p99 stays far under one stall's worth of lag."""
+    from containerpilot_tpu.analysis import LoopLagProbe
+
+    async def scenario():
+        probe = LoopLagProbe(interval_s=0.01)
+        probe.start()
+        await asyncio.sleep(0.3)
+        probe.stop()
+        return probe
+
+    probe = run(scenario())
+    assert probe.beats >= 10
+    assert probe.p99_ms() < 100.0  # ~0 in practice; CI-noise headroom
+
+
+def test_loopcheck_probe_stop_is_idempotent(run):
+    from containerpilot_tpu.analysis import LoopLagProbe
+
+    async def scenario():
+        probe = LoopLagProbe(interval_s=0.01)
+        probe.start()
+        probe.start()  # idempotent while running
+        await asyncio.sleep(0.05)
+        probe.stop()
+        beats = probe.beats
+        await asyncio.sleep(0.05)
+        assert probe.beats == beats  # no heartbeat after stop
+        probe.stop()
+
+    run(scenario())
+
+
+def test_loopcheck_watchdog_captures_leaked_exception(run):
+    """A task that dies with nobody holding/awaiting it is recorded
+    with its name; the loop keeps running."""
+    from containerpilot_tpu.analysis import TaskWatchdog
+
+    async def scenario():
+        wd = TaskWatchdog(grace_s=0.01).install()
+
+        async def boom():
+            raise RuntimeError("kaput")
+
+        task = asyncio.get_event_loop().create_task(
+            boom(), name="leaky-relay"
+        )
+        del task  # fire-and-forget, the CP-TASKLEAK shape
+        await asyncio.sleep(0.1)
+        wd.uninstall()
+        return wd
+
+    wd = run(scenario())
+    assert wd.tasks_created >= 1
+    leaks = wd.snapshot()
+    assert len(leaks) == 1
+    assert leaks[0]["task"] == "leaky-relay"
+    assert "kaput" in leaks[0]["exception"]
+
+
+def test_loopcheck_watchdog_ignores_handled_and_cancelled(run):
+    """An exception the awaiter catches is not a leak, and a
+    cancelled task never is."""
+    from containerpilot_tpu.analysis import TaskWatchdog
+
+    async def scenario():
+        wd = TaskWatchdog(grace_s=0.01).install()
+
+        async def boom():
+            raise ValueError("handled")
+
+        try:
+            await asyncio.get_event_loop().create_task(boom())
+        except ValueError:
+            pass
+
+        async def forever():
+            await asyncio.sleep(60)
+
+        task = asyncio.get_event_loop().create_task(forever())
+        task.cancel()
+        await asyncio.sleep(0.1)
+        wd.uninstall()
+        # uninstall restores the previous factory
+        assert asyncio.get_event_loop().get_task_factory() is None
+        return wd
+
+    wd = run(scenario())
+    assert wd.snapshot() == []
+
+
+def test_spawn_holds_reference_and_logs_death(run, caplog):
+    """utils/tasks.spawn — the CP-TASKLEAK fix-in-a-call: the task is
+    referenced (module pending set or the owner set) and a
+    non-CancelledError death is logged immediately."""
+    import logging
+
+    from containerpilot_tpu.utils import tasks as task_util
+
+    async def scenario():
+        owned: set = set()
+
+        async def ok():
+            return 7
+
+        async def boom():
+            raise RuntimeError("spawned-death")
+
+        t1 = task_util.spawn(ok(), name="ok-task", owner=owned)
+        assert t1 in owned
+        with caplog.at_level(logging.ERROR, "containerpilot.tasks"):
+            task_util.spawn(boom(), name="doomed")
+            assert task_util.pending_count() >= 1
+            await asyncio.sleep(0.05)
+        assert t1.result() == 7
+        assert not owned  # done tasks leave their holder
+        assert task_util.pending_count() == 0
+        return [r.message for r in caplog.records]
+
+    messages = run(scenario())
+    assert any("doomed" in m and "spawned-death" in m for m in messages)

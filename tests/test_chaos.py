@@ -625,6 +625,13 @@ def _run_scenario_checked(name, tmp_path, seed=5):
     assert report["passed"], json.dumps(report["checks"], indent=2)
     assert report["score"]["count_5xx"] == 0
     assert report["score"]["transport_errors"] == 0
+    # loopcheck rode along: the lag bound was asserted as a check,
+    # the schema carries the gated number, and no task died unseen
+    check_names = {c["name"] for c in report["checks"]}
+    assert "loop_lag" in check_names
+    assert report["loop_lag_max_ms"] == report["loop"]["lag_max_ms"]
+    assert report["loop"]["heartbeats"] > 0
+    assert report["loop"]["task_exceptions"] == []
     json.dumps(report)  # the whole report is JSON-able
     return report
 

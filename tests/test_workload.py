@@ -3822,6 +3822,10 @@ def test_inference_server_metrics_endpoint(run):
         'containerpilot_serve_request_seconds_count{'
         'endpoint="generate"} 2.0' in text
     )
+    # the loopcheck sentinel surfaces on every replica (analysis/
+    # loopcheck.py; docs/70 has the runbook for reading it)
+    assert 'cp_loop_lag_ms{stat="max"}' in text
+    assert 'cp_loop_lag_ms{stat="p99"}' in text
 
 
 def test_generate_logprobs_echo(run):
