@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all build test test-fast test-workload integration fleet-smoke trace-smoke chaos chaos-smoke bench bench-gateway bench-reuse lint lint-baseline clean image
+.PHONY: all build test test-fast test-workload integration fleet-smoke trace-smoke chaos chaos-smoke bench bench-gateway bench-reuse bench-goodput lint lint-baseline clean image
 
 all: build test
 
@@ -74,6 +74,13 @@ bench-gateway:
 bench-reuse:
 	JAX_PLATFORMS=cpu $(PYTHON) -c "import json, bench; \
 		print(json.dumps(bench.prefix_reuse_bench(), indent=2))"
+
+# the device-time ledger's accounting bench (docs/90): every replica
+# wall-second attributed (|sum(stages) - uptime| <= 2%) plus the
+# dispatches/token trajectory the megakernel work must drive down
+bench-goodput:
+	JAX_PLATFORMS=cpu $(PYTHON) -c "import json, bench; \
+		print(json.dumps(bench.goodput_ledger_bench(), indent=2))"
 
 # cpcheck (AST invariant rules vs analysis/baseline.json) + compileall;
 # see docs/70-static-analysis.md. Non-zero on any non-baselined finding.

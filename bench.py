@@ -667,6 +667,12 @@ def host_overhead_bench(rounds: int = 40) -> dict:
         ).result(timeout=600)
         engine_times = engine.round_times_ms()[-rounds:]
         engine_host = engine.round_host_ms()[-rounds:]
+        # the dispatches/token series (ROADMAP: the megakernel work
+        # must drive this DOWN — today it is ~(lookahead-doubled
+        # rounds)/(chunk tokens); a device-side multi-round loop
+        # collapses the numerator)
+        eng_dispatches = engine.dispatches
+        eng_tokens = engine.tokens_out
     finally:
         engine.stop()
 
@@ -695,6 +701,14 @@ def host_overhead_bench(rounds: int = 40) -> dict:
         # round wall minus the engine's own bracketed jax calls:
         # the host work a shipped-engine round pays outside them
         "engine_host_overhead_ms": round(engine_over, 3),
+        # host->device dispatches per emitted token over the engine's
+        # whole run (warm admissions included): the megakernel
+        # yardstick, recorded so BENCH_r{N}.json shows it falling
+        "dispatches": eng_dispatches,
+        "tokens_out": eng_tokens,
+        "dispatches_per_token": round(
+            eng_dispatches / max(1, eng_tokens), 4
+        ),
         "overhead_vs_legacy": round(
             engine_over / max(legacy_over, 1e-9), 3
         ),
@@ -1024,6 +1038,142 @@ def gateway_overhead_bench(rounds: int = 60) -> dict:
     }
 
 
+def goodput_ledger_bench(requests: int = 6, max_new: int = 96) -> dict:
+    """The device-time ledger's accounting bench, runnable on ANY
+    backend (tiny CPU-sized config): boot one real InferenceServer
+    (slot engine on), drive a handful of buffered generations with a
+    deliberate idle gap and one drain/resume cycle, and read the
+    ledger back over its REAL surface (``GET /v1/goodput``). Records:
+
+    - ``accounting_error_fraction``: |sum(per-stage seconds) -
+      uptime| / uptime. The ledger closes by construction; the bench
+      proves the shipped wiring (engine stamps, warmup override,
+      drain override, HTTP read path) kept it closed — the
+      every-device-second-attributed acceptance bar is 2%.
+    - ``dispatches_per_token``: the megakernel yardstick off the
+      live engine counters — chunked decode must land well under one
+      host dispatch per token (chunk=8 with lookahead measures
+      ~0.15-0.45 depending on admission mix).
+    - stage sanity: compile_warmup seconds exist (stamped BEFORE
+      /health flipped 200), idle covers the injected gap, drain
+      covers the maintenance window, prefill+decode > 0.
+
+    ``meets_target`` pins accounting_error_fraction <= 0.02 AND
+    dispatches_per_token <= 0.5 — the badput trajectory bar
+    release-over-release (``make bench-goodput``)."""
+    import asyncio
+    import http.client
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import jax.numpy as jnp
+
+    from containerpilot_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=2, n_layers=2, d_ff=128,
+        max_seq_len=256, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    out: dict = {}
+
+    async def scenario() -> None:
+        server = InferenceServer(
+            cfg, params, "127.0.0.1", 0, max_len=256,
+            slots=4, slot_chunk=8,
+        )
+        await server.run()
+        loop = asyncio.get_event_loop()
+
+        def fetch(method: str, path: str, body: bytes = b"") -> bytes:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=120
+            )
+            try:
+                conn.request(
+                    method, path, body or None,
+                    {"Content-Type": "application/json"}
+                    if body else {},
+                )
+                resp = conn.getresponse()
+                payload = resp.read()
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"{path} -> {resp.status}: {payload[:120]!r}"
+                    )
+                return payload
+            finally:
+                conn.close()
+
+        body = json.dumps(
+            {"tokens": [[1, 2, 3, 4, 5, 6, 7, 8]],
+             "max_new_tokens": max_new}
+        ).encode()
+        for _ in range(requests):
+            await loop.run_in_executor(
+                None, fetch, "POST", "/v1/generate", body
+            )
+        # a deliberate idle gap the ledger must attribute as idle
+        await asyncio.sleep(0.5)
+        # one drain/resume cycle: the maintenance window is drain
+        server.enter_maintenance()
+        await asyncio.sleep(0.2)
+        server.exit_maintenance()
+        gp = json.loads(
+            await loop.run_in_executor(None, fetch, "GET", "/v1/goodput")
+        )
+        await server.stop()
+        stages = gp["stages_s"]
+        attributed = sum(stages.values())
+        uptime = gp["uptime_s"]
+        out.update(
+            backend=jax.default_backend(),
+            config=(
+                f"{cfg.n_layers}L d{cfg.d_model} v{cfg.vocab_size}, "
+                f"4 slots x 8-token chunks, {requests} x "
+                f"{max_new}-token requests"
+            ),
+            uptime_s=round(uptime, 3),
+            stages_s=stages,
+            attributed_s=round(attributed, 3),
+            accounting_error_fraction=round(
+                abs(attributed - uptime) / max(uptime, 1e-9), 5
+            ),
+            productive_fraction=gp["productive_fraction"],
+            dispatches=gp["dispatches"],
+            tokens_out=gp["tokens_out"],
+            dispatches_per_token=gp["dispatches_per_token"],
+            scheduling_gaps=len(gp["scheduling_gaps"]),
+            compile_warmup_s=stages["compile_warmup"],
+            drain_s=stages["drain"],
+        )
+
+    asyncio.run(scenario())
+    out["target"] = (
+        "accounting_error_fraction <= 0.02 and "
+        "dispatches_per_token <= 0.5 and every lifecycle stage "
+        "(compile_warmup, idle, drain, prefill+decode) attributed"
+    )
+    out["meets_target"] = bool(
+        out["accounting_error_fraction"] <= 0.02
+        and out["dispatches_per_token"] is not None
+        and out["dispatches_per_token"] <= 0.5
+        and out["compile_warmup_s"] > 0.0
+        and out["drain_s"] > 0.0
+        and out["stages_s"]["idle"] >= 0.5
+        and out["productive_fraction"] > 0.0
+    )
+    return out
+
+
 def chaos_goodput_bench(seed: int = 0) -> dict:
     """The robustness trajectory: run the QUICK chaos scenarios (a
     real multi-replica fleet + gateway replaying a seeded trace while
@@ -1078,6 +1228,23 @@ def chaos_goodput_bench(seed: int = 0) -> dict:
             "loop_lag_max_ms": report["loop_lag_max_ms"],
             "loop_task_exceptions": len(
                 report["loop"]["task_exceptions"]
+            ),
+            # device-time ledger (telemetry/goodput.py): the badput
+            # trajectory per scenario, tracked release-over-release
+            "productive_fraction": (
+                report["goodput_ledger"]["productive_fraction"]
+            ),
+            "dispatches_per_token": (
+                report["goodput_ledger"]["dispatches_per_token"]
+            ),
+            "scale_up_ttfrt_s": min(
+                (
+                    e["ttfrt_s"]
+                    for e in report["goodput_ledger"]["scale_events"]
+                    if e["direction"] == "up"
+                    and e.get("ttfrt_s") is not None
+                ),
+                default=None,
             ),
             "retried": report["gateway"]["retried"],
             "hedged": report["gateway"]["hedged"],
@@ -1329,6 +1496,12 @@ def workload_benches() -> dict:
     # number too: measure it on every backend
     extras["gateway_overhead"] = _bench_subprocess(
         "gateway_overhead_bench", 600,
+        env=None if backend == "tpu" else {"JAX_PLATFORMS": "cpu"},
+    )
+    # device-time ledger accounting + dispatches/token trajectory
+    # (the badput decomposition the goodput framing is built on)
+    extras["goodput_ledger"] = _bench_subprocess(
+        "goodput_ledger_bench", 600,
         env=None if backend == "tpu" else {"JAX_PLATFORMS": "cpu"},
     )
     # robustness trajectory: quick chaos scenarios' SLO-goodput under

@@ -40,8 +40,9 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, NamedTuple, Optional
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
 from ..events import Event, EventBus, EventCode
 from ..utils.tasks import spawn
@@ -101,6 +102,12 @@ class Autoscaler:
         self.bus = bus
         self.scale_ups = 0
         self.scale_downs = 0
+        #: every scale decision, stamped on the tick's monotonic
+        #: clock — the fleet goodput ledger reads this to compute
+        #: time-to-first-routed-token per launch (gateway.
+        #: scale_event_report). Bounded: a marathon autoscaler must
+        #: not grow an entry per event forever.
+        self._scale_log: "deque[Dict[str, Any]]" = deque(maxlen=128)
         self.last_utilization = 0.0
         self.ticks = 0
         self._over_since: Optional[float] = None
@@ -147,6 +154,11 @@ class Autoscaler:
             except asyncio.CancelledError:
                 pass
         self._task = None
+
+    @property
+    def scale_log(self) -> List[Dict[str, Any]]:
+        """Stamped scale events, oldest first (bounded window)."""
+        return list(self._scale_log)
 
     @property
     def stats(self) -> Dict[str, Any]:
@@ -220,8 +232,16 @@ class Autoscaler:
             self._under_since = None
 
     async def _scale_up(self, now: float, reason: str) -> None:
+        # the decision instant, stamped BEFORE the launch await: the
+        # ledger's time-to-first-routed-token must charge the whole
+        # cold start (spawn + boot + compile + register + route) to
+        # the scale event, not just the post-launch tail
+        decided = time.monotonic()
         replica_id = await self.launcher.launch()
         self.scale_ups += 1
+        self._scale_log.append(
+            {"direction": "up", "replica": replica_id, "at": decided}
+        )
         self._last_event = now  # the tick's clock, not the wall's
         self._over_since = None
         if self._m_scale is not None:
@@ -236,8 +256,12 @@ class Autoscaler:
         victim = self._least_loaded(load)
         if victim is None:
             return
+        decided = time.monotonic()
         await self.launcher.retire(victim)
         self.scale_downs += 1
+        self._scale_log.append(
+            {"direction": "down", "replica": victim, "at": decided}
+        )
         self._last_event = now  # the tick's clock, not the wall's
         self._under_since = None
         if self._m_scale is not None:

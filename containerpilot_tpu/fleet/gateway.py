@@ -79,6 +79,7 @@ from ..kvtier import (
     prefix_fingerprint,
 )
 from ..analysis.loopcheck import LoopLagProbe
+from ..telemetry import goodput as goodput_mod
 from ..telemetry import tracing
 from ..utils.http import (
     HTTPServer,
@@ -151,6 +152,14 @@ class Replica:
     digest_at: float = 0.0
     #: last-seen reuse counters from the ``kv=`` note field
     kv: Dict[str, int] = field(default_factory=dict)
+    #: last-seen device-time ledger totals from the ``gp=`` note
+    #: field (cumulative stage seconds + dispatches/tokens; merged
+    #: elementwise-max against torn notes, like ``kv``)
+    goodput: Dict[str, float] = field(default_factory=dict)
+    #: monotonic stamp of the first 200 a generate/completions got
+    #: from this replica — the gateway half of time-to-first-routed-
+    #: token after a scale event
+    first_ok_at: Optional[float] = None
 
     @property
     def load(self) -> int:
@@ -432,6 +441,16 @@ class FleetGateway:
         #: lets a flapped-then-rejoined replica reclaim its own entry
         #: instead of being double-counted
         self._reuse_departed: Dict[str, int] = {}
+        #: final ledger totals of replicas that LEFT the fleet, keyed
+        #: by id — the fleet device-time ledger folds departed
+        #: replicas in exactly like ``tokens_reused`` does (their
+        #: boot/compile badput happened; a drain must not erase it),
+        #: and a flapped-then-rejoined id reclaims its entry
+        self._goodput_departed: Dict[str, Dict[str, float]] = {}
+        #: first-200 stamps per replica id, surviving departure (a
+        #: scale-up that served traffic and then drained still has a
+        #: time-to-first-routed-token worth reporting)
+        self._first_ok: Dict[str, float] = {}
         self.connect_timeout = connect_timeout
         self.request_timeout = request_timeout
 
@@ -616,6 +635,16 @@ class FleetGateway:
             registry=self._registry,
         )
         self._g_fleet_reused.set_function(self._fleet_tokens_reused)
+        self._g_fleet_productive = Gauge(
+            "cp_fleet_productive_fraction",
+            "fleet device-time ledger: (prefill + decode) seconds "
+            "over all attributed seconds, live + departed replicas "
+            "(docs/90-observability.md § device-time ledger)",
+            registry=self._registry,
+        )
+        self._g_fleet_productive.set_function(
+            self._fleet_productive_fraction
+        )
         # per-stage latency decomposition: one histogram row per
         # tracing stage (admission_queue_wait, upstream_ttfb,
         # replica.prefill, ...) — the aggregate face of /v1/traces
@@ -641,6 +670,7 @@ class FleetGateway:
         self._server.route("GET", "/metrics", self._metrics)
         self._server.route("GET", "/fleet", self._fleet_status)
         self._server.route("GET", "/v1/traces", self._traces)
+        self._server.route("GET", "/v1/goodput", self._goodput)
         self._server.route("GET", "/v1/model", self._model_info)
         for path, endpoint in (
             ("/v1/generate", "generate"),
@@ -818,12 +848,18 @@ class FleetGateway:
                 # gateway over an autoscaled no-reuse fleet must not
                 # grow an entry per departed id forever
                 self._reuse_departed[rid] = gone.kv["tokens_reused"]
+            if rid not in fresh and any(gone.goodput.values()):
+                # same fold-in for the device-time ledger: a retired
+                # replica's boot/compile/serve seconds happened, and
+                # the fleet's badput decomposition must keep them
+                self._goodput_departed[rid] = dict(gone.goodput)
         for rid in fresh:
             # a replica that FLAPPED out and rejoined (wedge heal,
             # TTL-starved heartbeat, catalog flap) advertises the same
             # cumulative counter again — drop the parked copy or the
             # gauge double-counts it on every flap
             self._reuse_departed.pop(rid, None)
+            self._goodput_departed.pop(rid, None)
         self._replicas = fresh
         self._g_replicas.set(len(fresh))
         # admission capacity tracks the healthy set; growth grants
@@ -853,6 +889,14 @@ class FleetGateway:
                 name: max(value, replica.kv.get(name, 0))
                 for name, value in parsed.items()
             }
+        if "gp" in fields:
+            # device-time ledger totals: cumulative like the kv
+            # counters, so the same elementwise-max torn-note
+            # discipline applies — a truncated note's zero-filled
+            # tail must never regress a stage's known seconds
+            replica.goodput = goodput_mod.merge_note_max(
+                replica.goodput, goodput_mod.parse_note(fields["gp"])
+            )
         if "pd" in fields:
             version, fps = parse_digest(fields["pd"])
             if version is not None and version != replica.digest_version:
@@ -867,6 +911,82 @@ class FleetGateway:
             r.kv.get("tokens_reused", 0)
             for r in self._replicas.values()
         )
+
+    def _fleet_productive_fraction(self) -> float:
+        """Gauge body: the fleet ledger's headline number (0.0 until
+        any ledger note has arrived — gauges can't carry None)."""
+        fraction = goodput_mod.productive_fraction(
+            goodput_mod.sum_stage_totals(
+                [r.goodput for r in self._replicas.values()]
+                + list(self._goodput_departed.values())
+            )
+        )
+        return fraction if fraction is not None else 0.0
+
+    def scale_event_report(self) -> List[Dict[str, Any]]:
+        """Scale events stamped into the fleet ledger: each autoscaler
+        launch/retire with — for launches — the time-to-first-routed-
+        token, measured from the launch decision to the first 200 a
+        generate/completions got from the new replica. None until the
+        replica actually serves (the cold-start collapse item's
+        yardstick: this number must fall release-over-release)."""
+        if self._autoscaler is None:
+            return []
+        events: List[Dict[str, Any]] = []
+        for event in getattr(self._autoscaler, "scale_log", ()):
+            entry = {
+                "direction": event["direction"],
+                "replica": event["replica"],
+            }
+            if event["direction"] == "up":
+                first_ok = self._first_ok.get(event["replica"])
+                entry["ttfrt_s"] = (
+                    round(first_ok - event["at"], 3)
+                    if first_ok is not None
+                    and first_ok >= event["at"] else None
+                )
+            events.append(entry)
+        return events
+
+    def fleet_goodput(self) -> Dict[str, Any]:
+        """The fleet device-time ledger: per-stage seconds summed
+        over live AND departed replicas, productive fraction,
+        dispatches/token, the per-replica breakdown, and scale-event
+        TTFRT — the ``goodput`` block on ``/fleet`` and the body of
+        the gateway's ``/v1/goodput``."""
+        live = {
+            rid: dict(r.goodput) for rid, r in self._replicas.items()
+        }
+        summary = goodput_mod.fleet_summary(
+            list(live.values())
+            + list(self._goodput_departed.values())
+        )
+        summary["replicas"] = {
+            rid: {
+                "productive_fraction": (
+                    goodput_mod.productive_fraction(totals)
+                ),
+                "stages_s": {
+                    s: round(totals.get(s, 0.0), 3)
+                    for s in goodput_mod.STAGES
+                },
+            }
+            for rid, totals in sorted(live.items())
+        }
+        summary["departed"] = {
+            rid: {
+                "productive_fraction": (
+                    goodput_mod.productive_fraction(totals)
+                ),
+                "stages_s": {
+                    s: round(totals.get(s, 0.0), 3)
+                    for s in goodput_mod.STAGES
+                },
+            }
+            for rid, totals in sorted(self._goodput_departed.items())
+        }
+        summary["scale_events"] = self.scale_event_report()
+        return summary
 
     def _request_fingerprint(
         self, body: Dict[str, Any]
@@ -1055,6 +1175,14 @@ class FleetGateway:
             content_type="application/json",
         )
 
+    async def _goodput(self, _req: Request) -> Response:
+        """The fleet device-time ledger (same blob as ``/fleet``'s
+        ``goodput`` block, standalone for scrapers and runbooks)."""
+        return Response(
+            200, json.dumps(self.fleet_goodput()).encode(),
+            content_type="application/json",
+        )
+
     async def _fleet_status(self, _req: Request) -> Response:
         body = json.dumps(
             {
@@ -1090,6 +1218,11 @@ class FleetGateway:
                     "hint_hits": self.hint_hits,
                     "hint_misses": self.hint_misses,
                 },
+                # the fleet device-time ledger: where the fleet's
+                # device-seconds went (goodput vs decomposed badput),
+                # built from the gp= heartbeat notes — departed
+                # replicas folded in, scale events TTFRT-stamped
+                "goodput": self.fleet_goodput(),
                 "sticky": {
                     "size": len(self._sticky),
                     "capacity": self.sticky_capacity,
@@ -1384,6 +1517,15 @@ class FleetGateway:
             headers={"Retry-After": self._retry_after()},
         )
 
+    def _stamp_first_ok(self, replica: Replica) -> None:
+        """First successful generation served by this replica: the
+        other half of a scale event's time-to-first-routed-token."""
+        if replica.first_ok_at is None:
+            replica.first_ok_at = time.monotonic()
+            self._first_ok.setdefault(
+                replica.id, replica.first_ok_at
+            )
+
     def _evict_replica_pool(self, replica_id: str) -> None:
         """A request to this replica just transport-failed: its other
         pooled connections can't be trusted either."""
@@ -1605,6 +1747,8 @@ class FleetGateway:
             self._latencies.setdefault(
                 endpoint, deque(maxlen=512)
             ).append(time.perf_counter() - t0)
+            if endpoint in ("generate", "completions"):
+                self._stamp_first_ok(replica)
         return status, headers, payload
 
     async def _fetch_with_hedge(
@@ -1865,6 +2009,8 @@ class FleetGateway:
                             continue
                         return self._relay(status, headers, payload)
                     held = False  # ownership moves to the relay
+                    if status == 200:
+                        self._stamp_first_ok(replica)
                     return self._relay_mux_stream(replica, stream, status)
                 try:
                     conn, status, headers = await self._upstream_request(
@@ -1919,6 +2065,8 @@ class FleetGateway:
                         continue
                     return self._relay(status, headers, payload)
                 held = False  # ownership moves to the relay's close()
+                if status == 200:
+                    self._stamp_first_ok(replica)
                 return self._relay_stream(replica, conn, status)
             finally:
                 if held:

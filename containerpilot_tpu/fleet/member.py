@@ -162,6 +162,15 @@ class FleetMember(EventHandler):
                 extra = kv_note()
                 if extra:
                     output += " " + extra
+            # device-time ledger advertisement (``gp=`` — cumulative
+            # per-stage seconds + dispatches/tokens): the gateway's
+            # fleet goodput view is built entirely from this field,
+            # so fleets aggregate badput without a second RPC
+            gp_note = getattr(self.server, "goodput_note", None)
+            if callable(gp_note):
+                extra = gp_note()
+                if extra:
+                    output += " " + extra
             self.service.send_heartbeat(output=output)
         # not ready (warming, or wedged enough that ready regressed):
         # no beat — an existing record's TTL expiry flips it critical

@@ -1,13 +1,16 @@
 """Telemetry: Prometheus metrics + status server
 (reference: telemetry/ package), plus cross-hop request tracing
-(tracing.py — not the reference's; see docs/90-observability.md)."""
-from . import tracing
+(tracing.py) and the device-time goodput ledger (goodput.py) — not
+the reference's; see docs/90-observability.md."""
+from . import goodput, tracing
 from .config import MetricConfig, TelemetryConfig, TelemetryConfigError
+from .goodput import DeviceTimeLedger
 from .metrics import Metric
 from .telemetry import Telemetry
 from .tracing import Trace, TraceRecorder
 
 __all__ = [
+    "DeviceTimeLedger",
     "Metric",
     "MetricConfig",
     "Telemetry",
@@ -15,5 +18,6 @@ __all__ = [
     "TelemetryConfigError",
     "Trace",
     "TraceRecorder",
+    "goodput",
     "tracing",
 ]

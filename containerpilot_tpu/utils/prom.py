@@ -46,6 +46,53 @@ def ensure_build_info(registry, role: str) -> None:
     gauge.labels(VERSION, role).set(1)
 
 
+def ensure_goodput_gauges(registry, ledger, counters=None) -> None:
+    """Register the shared device-time-ledger gauges over a
+    ``telemetry/goodput.DeviceTimeLedger``:
+    ``cp_device_seconds_total{stage}`` (one row per ledger stage,
+    read live so the open segment is included) plus — when
+    ``counters`` (a zero-arg callable returning ``(dispatches,
+    tokens_out)``) is given — ``cp_decode_dispatches_total`` and
+    ``cp_tokens_out_total``, the dispatches/token series the
+    megakernel work is measured against. One definition, so the
+    replica and pod surfaces cannot drift. Idempotent per registry,
+    like ``ensure_build_info``."""
+    from prometheus_client import Gauge
+
+    from ..telemetry.goodput import STAGES
+
+    try:
+        gauge = Gauge(
+            "cp_device_seconds_total",
+            "device-time ledger: cumulative wall seconds attributed "
+            "to each stage of this replica's life "
+            "(docs/90-observability.md has the stage glossary)",
+            ["stage"],
+            registry=registry,
+        )
+    except ValueError:
+        return
+    for stage in STAGES:
+        gauge.labels(stage).set_function(
+            lambda s=stage: ledger.stage_seconds(s)
+        )
+    if counters is None:
+        return
+    Gauge(
+        "cp_decode_dispatches_total",
+        "host->device dispatches the decode path has issued "
+        "(prefills + chunk rounds); divide by cp_tokens_out_total "
+        "for dispatches/token",
+        registry=registry,
+    ).set_function(lambda: float(counters()[0]))
+    Gauge(
+        "cp_tokens_out_total",
+        "tokens the decode path has emitted (pre-trim engine "
+        "emission)",
+        registry=registry,
+    ).set_function(lambda: float(counters()[1]))
+
+
 def ensure_loop_lag_gauge(registry, probe) -> None:
     """Register the shared event-loop health gauge
     ``cp_loop_lag_ms{stat="max"|"p99"}`` over a

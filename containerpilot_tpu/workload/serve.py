@@ -110,6 +110,16 @@ class InferenceServer:
         self.port = port
         self.max_len = max_len
         self.ready = False
+        # device-time ledger (telemetry/goodput.py): every wall-second
+        # of this replica's life attributed to exactly one stage,
+        # starting NOW in ``boot`` — weight setup, engine construction
+        # and port binding are costed before warmup() moves the ledger
+        # to compile_warmup and, finally, idle (before /health flips
+        # 200, so a scale-up replica's badput is visible from its very
+        # first scrape)
+        from ..telemetry.goodput import DeviceTimeLedger
+
+        self.ledger = DeviceTimeLedger()
         # maintenance drain: /health goes 503 and NEW generate/
         # completions are rejected with 503 + Retry-After while
         # everything already admitted (including running slot-engine
@@ -224,6 +234,7 @@ class InferenceServer:
                 cp_mesh=self.cp_mesh, cp_min_len=self.cp_min_len,
                 prefill_chunk=prefill_chunk,
                 prefix_cache=self.prefix_cache,
+                ledger=self.ledger,
             )
         # prompts longer than this stream through decode_chunk pieces
         # (peak prefill activations O(chunk) instead of O(prompt))
@@ -263,9 +274,19 @@ class InferenceServer:
             "tokens returned by generate/completions (post-trim)",
             registry=self._metrics_registry,
         )
-        from ..utils.prom import ensure_build_info, ensure_loop_lag_gauge
+        from ..utils.prom import (
+            ensure_build_info,
+            ensure_goodput_gauges,
+            ensure_loop_lag_gauge,
+        )
 
         ensure_build_info(self._metrics_registry, "replica")
+        # the goodput ledger's metrics face: cp_device_seconds_total
+        # {stage} + the dispatches/token counter pair (engine-less
+        # servers report zeros; the ledger still accounts their life)
+        ensure_goodput_gauges(
+            self._metrics_registry, self.ledger, self._decode_counters
+        )
         # event-loop health sentinel (analysis/loopcheck.py): one
         # blocking call on this loop stalls every stream, heartbeat,
         # and health check the replica serves — cp_loop_lag_ms is the
@@ -290,6 +311,7 @@ class InferenceServer:
         self._server.route("GET", "/health", self._health)
         self._server.route("GET", "/metrics", self._metrics)
         self._server.route("GET", "/v1/traces", self._traces)
+        self._server.route("GET", "/v1/goodput", self._goodput)
         route = self._instrumented
         self._server.route("GET", "/v1/model", route(
             "model", self._model_info
@@ -346,12 +368,48 @@ class InferenceServer:
             content_type="application/json",
         )
 
+    def _decode_counters(self):
+        """(dispatches, tokens_out) for the goodput surfaces — the
+        slot engine's cumulative pair, zeros without an engine."""
+        engine = self.slot_engine
+        if engine is None:
+            return 0, 0
+        return engine.dispatches, engine.tokens_out
+
+    async def _goodput(self, _req: Request) -> Response:
+        """The device-time ledger, JSON: per-stage seconds (summing
+        to uptime by construction), productive fraction, the
+        dispatches/token pair, and any detected scheduling gaps —
+        requests whose trace says ``slot_queue_wait`` dominated while
+        this ledger shows idle seconds inside the same window (free
+        capacity the scheduler didn't use). All computed on this read
+        path; record paths stay boundary-floats only."""
+        from ..telemetry.goodput import goodput_payload
+
+        dispatches, tokens_out = self._decode_counters()
+        payload = goodput_payload(
+            self.ledger, self._tracer, dispatches, tokens_out,
+            role="replica", ready=self.ready, draining=self.draining,
+        )
+        return Response(
+            200, json.dumps(payload).encode(),
+            content_type="application/json",
+        )
+
     def _instrumented(self, endpoint: str, handler):
         """Count + time every API request, under a per-request trace
         (adopting the caller's X-CP-Trace id when present); token
         accounting happens in the handlers themselves (they know the
         post-trim lengths)."""
         import time as time_mod
+
+        # without a slot engine the ledger has no prefill/decode
+        # authority; the handler inflight window stands in (coarse:
+        # whole busy window -> decode), flipped at 0<->1 boundaries
+        # only. With an engine, its boundary stamps rule and this
+        # path stays off.
+        compute_endpoint = endpoint in ("generate", "completions",
+                                        "score")
 
         async def wrapped(req: Request) -> Response:
             # splice-safe ids only (tracing.safe_id): this id is
@@ -375,6 +433,11 @@ class InferenceServer:
             token = tracing.activate(trace)
             t0 = time_mod.perf_counter()
             self._inflight += 1
+            if (
+                self.slot_engine is None and compute_endpoint
+                and self._inflight == 1
+            ):
+                self.ledger.enter("decode")
             try:
                 # the hook runs inside the inflight window: a request
                 # parked in an injected delay must hold off a drain's
@@ -394,6 +457,11 @@ class InferenceServer:
                 raise
             finally:
                 self._inflight -= 1
+                if (
+                    self.slot_engine is None and compute_endpoint
+                    and self._inflight == 0
+                ):
+                    self.ledger.engine_idle()
                 tracing.deactivate(token)
             resp.headers.setdefault(
                 tracing.TRACE_HEADER, trace.trace_id
@@ -1133,18 +1201,33 @@ class InferenceServer:
             note += f" pd={digest}"
         return note
 
+    def goodput_note(self) -> str:
+        """The device-time ledger's heartbeat field (``gp=`` —
+        cumulative per-stage seconds + the dispatches/token pair),
+        appended by FleetMember the same duck-typed way ``kv_note``
+        is. Always present: a replica with zero reuse still has a
+        badput story to tell, and the gateway's fleet ledger must
+        fold in every member from its very first beat."""
+        dispatches, tokens_out = self._decode_counters()
+        return self.ledger.note(dispatches, tokens_out)
+
     def enter_maintenance(self) -> None:
         """Start draining: health 503, new generate/completions 503 +
         Retry-After, in-flight work (including running slot-engine
         rows) finishes. Idempotent."""
         if not self.draining:
             log.info("serve: entering maintenance (draining)")
+            # ledger: from here until exit, every second is drain
+            # badput — capacity leaving the fleet, the in-flight rows
+            # it still finishes included (they are the drain's cost)
+            self.ledger.set_override("drain")
         self.draining = True
 
     def exit_maintenance(self) -> None:
         """Stop draining and accept traffic again. Idempotent."""
         if self.draining:
             log.info("serve: exiting maintenance")
+            self.ledger.clear_override()
         self.draining = False
 
     async def warmup(self) -> None:
@@ -1154,6 +1237,14 @@ class InferenceServer:
         (shapes are static); the bucketed max_new keeps that churn
         bounded."""
         from ..models.decode import generate
+
+        # ledger: everything from here until ready flips — XLA
+        # compiles AND the dummy slot-engine request driving them —
+        # is compile_warmup, stamped via an override so the engine's
+        # own prefill/decode boundary stamps can't claim it. Costed
+        # BEFORE /health goes 200: the very first scrape of a
+        # scale-up replica already shows its compile badput.
+        self.ledger.set_override("compile_warmup")
 
         def run() -> None:
             for prompt_len in (4, 16):
@@ -1186,6 +1277,11 @@ class InferenceServer:
                 max_new=self.slot_engine.chunk + 1,
             )
             await asyncio.wrap_future(fut)
+        # warmup attribution closes here, and the serving clock opens
+        # in ``idle`` — both before ready flips, so no wall-second
+        # between "compiled" and "first scrape" is misattributed
+        self.ledger.clear_override()
+        self.ledger.enter("idle")
         self.ready = True
         log.info("serve: default shapes warm; accepting traffic")
 
@@ -1198,6 +1294,7 @@ class InferenceServer:
         await self.warmup()
 
     async def stop(self) -> None:
+        self.ledger.freeze()
         self._loop_probe.stop()
         await self._batcher.stop()
         if self.slot_engine is not None:
@@ -1217,6 +1314,7 @@ class InferenceServer:
         record is left to decay critical by TTL expiry, which is the
         crash signature gateways must route around."""
         self.ready = False
+        self.ledger.freeze()
         self._loop_probe.stop()
         await self._server.abort()
         await self._batcher.stop()

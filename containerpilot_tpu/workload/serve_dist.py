@@ -684,9 +684,25 @@ class _Frontend:
             registry=self._registry,
         )
         from ..telemetry import tracing
-        from ..utils.prom import ensure_build_info
+        from ..telemetry.goodput import DeviceTimeLedger
+        from ..utils.prom import ensure_build_info, ensure_goodput_gauges
 
         ensure_build_info(self._registry, "pod")
+        # device-time ledger, pod-shaped: process 0's round loop is
+        # the single writer for prefill/decode/idle (admission
+        # boundaries only — the lockstep chunk rounds in between
+        # stamp nothing), main() brackets warm_pod as compile_warmup.
+        # Followers replay broadcast ops in lockstep, so the
+        # frontend's ledger IS the pod's device-time story.
+        self.ledger = DeviceTimeLedger()
+        # the dispatches/token pair: broadcast rounds that touched
+        # the device vs tokens appended — bumped by the round loop
+        self.dispatches = 0
+        self.tokens_out = 0
+        ensure_goodput_gauges(
+            self._registry, self.ledger,
+            lambda: (self.dispatches, self.tokens_out),
+        )
         # request tracing, the single-host server's discipline
         # pod-shaped: adopt/mint a trace id per request, span the
         # queue->pod-loop dispatch, echo id + digest back (see
@@ -697,6 +713,7 @@ class _Frontend:
         self._server.route("GET", "/health", self._health)
         self._server.route("GET", "/metrics", self._metrics)
         self._server.route("GET", "/v1/traces", self._traces)
+        self._server.route("GET", "/v1/goodput", self._goodput)
         self._server.route("GET", "/v1/model", self._model)
         self._server.route(
             "POST", "/v1/generate", self._traced("generate", self._generate)
@@ -765,6 +782,23 @@ class _Frontend:
         return self._Response(
             200,
             self._tracer.snapshot_json(req.query),
+            content_type="application/json",
+        )
+
+    async def _goodput(self, _req):
+        """The pod's device-time ledger — same schema as the
+        single-host replica's ``/v1/goodput`` (scheduling gaps
+        included: the pod's queue->loop dispatch span plays the
+        slot_queue_wait role there when the ring ever records it)."""
+        from ..telemetry.goodput import goodput_payload
+
+        payload = goodput_payload(
+            self.ledger, self._tracer, self.dispatches,
+            self.tokens_out, role="pod", ready=self.ready,
+            draining=False,
+        )
+        return self._Response(
+            200, json.dumps(payload).encode(),
             content_type="application/json",
         )
 
@@ -1320,6 +1354,7 @@ def _run_frontend_loop(args, frontend: _Frontend, mirror: _SlotMirror,
         ended = append_chunk(
             row.emitted, toks, w["max_new"], w["eos_id"]
         )
+        frontend.tokens_out += len(row.emitted) - before
         if w["stop"] and not ended and _hit_stop(
             row.emitted, w["stop"]
         ):
@@ -1346,6 +1381,11 @@ def _run_frontend_loop(args, frontend: _Frontend, mirror: _SlotMirror,
         p["eos_id"] = np.asarray(work["eos_id"], np.int32)
         fill_extra(p)
         bcast(p)
+        # ledger: a one-shot op is a whole generation in one lockstep
+        # program — coarse-attributed to decode (the slot pool's
+        # admission rounds get the finer prefill/decode split)
+        frontend.ledger.enter("decode")
+        frontend.dispatches += 1
         try:
             row = run_op(p)
             beat()
@@ -1355,6 +1395,10 @@ def _run_frontend_loop(args, frontend: _Frontend, mirror: _SlotMirror,
             rows_out = InferenceServer._trim_stops(
                 rows_out, work["stop"]
             )
+            # one-shot rows bypass row_append: count their tokens
+            # here or the dispatches/token series overstates on
+            # beam/spec traffic
+            frontend.tokens_out += sum(len(r) for r in rows_out)
             result: Dict[str, Any] = {"tokens": rows_out}
             if work["logprobs"]:
                 result["logprobs"] = echo_logprobs(
@@ -1364,6 +1408,11 @@ def _run_frontend_loop(args, frontend: _Frontend, mirror: _SlotMirror,
             done_q.put(exc)
             fail_open(exc)
             raise
+        if not any(owners) and not pending:
+            # only flip back when the slot pool is truly empty: a
+            # beam answered between chunk rounds must not mark a
+            # busy pool idle (chunk-only rounds stamp nothing)
+            frontend.ledger.engine_idle()
         done_q.put(result)
 
     def classify(work, done_q) -> None:
@@ -1455,6 +1504,7 @@ def _run_frontend_loop(args, frontend: _Frontend, mirror: _SlotMirror,
         if not any(owners) and not pending:
             # fully idle: block for work, heartbeating on cadence so
             # followers' broadcast waits stay bounded
+            frontend.ledger.engine_idle()
             got = None
             idle_since = time.monotonic()
             while got is None and not stopping.is_set():
@@ -1520,12 +1570,20 @@ def _run_frontend_loop(args, frontend: _Frontend, mirror: _SlotMirror,
             continue  # e.g. everything was just cancelled
         payload["run_chunk"] = np.asarray(run_chunk, np.int32)
         payload["done"] = mask
+        # ledger stamps at ADMISSION boundaries only (the single-host
+        # engine's discipline): an admission round is prefill, the
+        # rounds after it decode; chunk-only rounds stamp nothing
+        if admit is not None:
+            frontend.ledger.enter("prefill")
         bcast(payload)
         try:
             first, toks = _apply_round(mirror, payload)
         except Exception as exc:  # noqa: BLE001 — pod-fatal
             fail_open(exc)
             raise
+        frontend.dispatches += 1
+        if admit is not None:
+            frontend.ledger.enter("decode")
         if admit is not None:
             req, ridx, _slot = admit
             row_append(req, req.rows[ridx], [first])
@@ -1974,6 +2032,11 @@ def main() -> int:
     # warmup in lockstep before /health goes 200 (warm_pod compiles
     # the pool's whole serve-path program set; see its docstring for
     # the no-post-grace-compiles invariant)
+    if frontend is not None:
+        # ledger: everything until ready flips is compile_warmup —
+        # stamped before /health goes 200 so the pod's first scrape
+        # already shows its compile badput (the no-idle-lie rule)
+        frontend.ledger.set_override("compile_warmup")
     mirror = _SlotMirror(
         cfg, params, args.max_len, args.slots, args.stream_chunk,
         mesh=mesh, sp=args.sp, cp_min_len=cp_min_len,
@@ -1995,6 +2058,8 @@ def main() -> int:
     if frontend is not None:
         # live prefix stats for /v1/model (the mirror owns the cache)
         frontend.prefix_cache = mirror.prefix_cache
+        frontend.ledger.clear_override()
+        frontend.ledger.enter("idle")
         frontend.ready = True
         print("pod warm; accepting traffic", flush=True)
 

@@ -110,6 +110,7 @@ class SlotEngine:
         cp_min_len: int = 0,
         prefill_chunk: int = 0,
         prefix_cache=None,
+        ledger=None,
     ) -> None:
         if slots < 1 or chunk < 1:
             raise ValueError("slots and chunk must be >= 1")
@@ -172,6 +173,20 @@ class SlotEngine:
         # a reused slot carries zero context from its previous
         # occupant — byte parity incl. re-admission is tested in
         # tests/test_slots.py::test_window_*
+        # device-time ledger (telemetry/goodput.py): the engine is
+        # the authority on prefill/decode/idle, stamped at the SAME
+        # request boundaries the tracing timings use — admission
+        # start, admission done, fully-idle — never per round or per
+        # token. None (direct engine construction, benches) costs one
+        # attribute load at those boundaries.
+        self.ledger = ledger
+        # dispatch accounting for the dispatches/token series (the
+        # number the ROADMAP's megakernel item must drive down): one
+        # int bump per device dispatch (prefill or chunk round), one
+        # add per emitted delta — same cost class as the per-slot
+        # rounds counter tracing already pays.
+        self.dispatches = 0
+        self.tokens_out = 0
         self.cfg = cfg
         self.params = params
         self.max_len = max_len
@@ -293,6 +308,10 @@ class SlotEngine:
             "chunk": self.chunk,
             "active": sum(s is not None for s in self._active),
             "queued": self._queue.qsize(),
+            # the dispatches/token pair (goodput ledger + megakernel
+            # yardstick): cumulative device dispatches vs tokens out
+            "dispatches": self.dispatches,
+            "tokens_out": self.tokens_out,
         }
 
     def round_times_ms(self) -> List[float]:
@@ -318,6 +337,8 @@ class SlotEngine:
         generate's exact key schedule."""
         if req.timings is not None:
             req.timings["admitted"] = time.monotonic()
+        if self.ledger is not None:
+            self.ledger.enter("prefill")
         cfg = self.cfg
         logits = row_cache = None
         pc = self.prefix_cache
@@ -337,11 +358,15 @@ class SlotEngine:
             )
             if hit is not None:
                 logits, row_cache = hit
-            if req.timings is not None and pc.readmit_seconds > 0.0:
+            if pc.readmit_seconds > 0.0:
                 # time spent readmitting a spilled base from host RAM
                 # (device_put roundtrip) — surfaces as the trace's
-                # ``kv`` stage, carved out of the prefill window
-                req.timings["kv"] = pc.readmit_seconds
+                # ``kv`` stage and the ledger's ``kv_readmit``, both
+                # carved out of the prefill window
+                if req.timings is not None:
+                    req.timings["kv"] = pc.readmit_seconds
+                if self.ledger is not None:
+                    self.ledger.carve("kv_readmit", pc.readmit_seconds)
         if row_cache is None:
             if (
                 self.cp_mesh is not None
@@ -407,10 +432,18 @@ class SlotEngine:
             bias_val=req.bias_val, done=state.finished,
         )
         self._active[slot_id] = state
+        # one admission = one prefill's worth of dispatches (the
+        # prefill program + first-sample/insert/admit ride together);
+        # counted as ONE toward dispatches/token so the series tracks
+        # the steady-state decode shape the megakernel work targets
+        self.dispatches += 1
+        self.tokens_out += 1
         if req.timings is not None:
             # prefill stage ends here: prompt prefilled, token 0
             # sampled, row inserted — everything after is decode
             req.timings["prefill_done"] = time.monotonic()
+        if self.ledger is not None:
+            self.ledger.enter("decode")
         self._notify(req, [first_host])
 
     def _harvest(self, slot_id: int) -> None:
@@ -504,6 +537,11 @@ class SlotEngine:
                 any_active = any(
                     s is not None for s in self._active
                 )
+                if not any_active and self.ledger is not None:
+                    # fully idle: the ledger flips to ``idle`` only
+                    # out of prefill/decode (engine_idle), so this
+                    # can't cut the server's boot/warmup stages short
+                    self.ledger.engine_idle()
                 # block for work only when fully idle; otherwise drain
                 # whatever is queued into free slots and keep decoding
                 try:
@@ -544,6 +582,7 @@ class SlotEngine:
                     self._fail_and_rebuild(exc)
                     continue
                 jax_s += time.perf_counter() - tj
+                self.dispatches += 1
             else:
                 toks, pending = pending, None
             # one-round lookahead: when no admission, cancel, or stop
@@ -573,6 +612,7 @@ class SlotEngine:
                     pending = None
                     continue
                 jax_s += time.perf_counter() - tj
+                self.dispatches += 1
             tj = time.perf_counter()
             try:
                 # the ONE deliberate sync per round: everything after
@@ -598,6 +638,7 @@ class SlotEngine:
                     req.eos_id,
                 )
                 if len(state.emitted) > before:
+                    self.tokens_out += len(state.emitted) - before
                     self._notify(req, state.emitted[before:])
                 if ended:
                     self._harvest(i)

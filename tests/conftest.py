@@ -77,6 +77,7 @@ _WORKLOAD_MODULES = {
     "test_workload", "test_window", "test_data", "test_flops",
     "test_capstone", "test_tuning", "test_slots",
     "test_serve_dist", "test_fleet", "test_chaos", "test_kvtier",
+    "test_goodput",
 }
 _WORKLOAD_TESTS = {"test_fuzz_sample_logits_invariants"}
 

@@ -97,9 +97,13 @@ def test_workload_benches_skip_still_runs_host_overhead(monkeypatch):
     assert extras["host_overhead"] == {"engine_host_overhead_ms": 0.1}
     assert extras["gateway_overhead"] == {"engine_host_overhead_ms": 0.1}
     assert extras["chaos_goodput"] == {"engine_host_overhead_ms": 0.1}
+    assert extras["goodput_ledger"] == {"engine_host_overhead_ms": 0.1}
+    assert extras["prefix_reuse"] == {"engine_host_overhead_ms": 0.1}
     # only the any-backend benches ran, pinned to cpu
     assert calls == [
         ("host_overhead_bench", {"JAX_PLATFORMS": "cpu"}),
         ("gateway_overhead_bench", {"JAX_PLATFORMS": "cpu"}),
+        ("goodput_ledger_bench", {"JAX_PLATFORMS": "cpu"}),
         ("chaos_goodput_bench", {"JAX_PLATFORMS": "cpu"}),
+        ("prefix_reuse_bench", {"JAX_PLATFORMS": "cpu"}),
     ]

@@ -632,6 +632,22 @@ def _run_scenario_checked(name, tmp_path, seed=5):
     assert report["loop_lag_max_ms"] == report["loop"]["lag_max_ms"]
     assert report["loop"]["heartbeats"] > 0
     assert report["loop"]["task_exceptions"] == []
+    # the device-time ledger rode along (telemetry/goodput.py):
+    # schema-stable stages, internally consistent sums, per-replica
+    # breakdown present for every replica the scenario ever booted
+    ledger = report["goodput_ledger"]
+    assert set(ledger["stages_s"]) == {
+        "boot", "compile_warmup", "idle", "prefill", "decode",
+        "kv_readmit", "drain",
+    }
+    assert ledger["device_seconds"] == pytest.approx(
+        sum(ledger["stages_s"].values()), abs=0.05
+    )
+    assert ledger["per_replica"]
+    for entry in ledger["per_replica"].values():
+        assert set(entry) == {
+            "departed", "productive_fraction", "stages_s",
+        }
     json.dumps(report)  # the whole report is JSON-able
     return report
 
@@ -742,6 +758,14 @@ def test_scenario_kill_under_burst_autoscaled(tmp_path):
         if int(rid.rsplit("-", 1)[1]) >= 2
     )
     assert report["gateway"]["catalog_flaps_damped"] >= 1
+    # the cold-start yardstick: every scale decision is stamped into
+    # the ledger, and at least one launch carries a finite
+    # time-to-first-routed-token (the expect_scale_up_ttfrt check
+    # gated it; assert the schema here too)
+    events = report["goodput_ledger"]["scale_events"]
+    ups = [e for e in events if e["direction"] == "up"]
+    assert len(ups) >= 1
+    assert any(e.get("ttfrt_s") is not None for e in ups)
 
 
 def test_scenario_multiturn_rebalance(tmp_path):
