@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all build test test-fast test-workload integration fleet-smoke trace-smoke chaos chaos-smoke bench bench-gateway bench-reuse bench-goodput lint lint-baseline clean image
+.PHONY: all build test test-fast test-workload integration fleet-smoke trace-smoke chaos chaos-smoke bench bench-gateway bench-reuse bench-goodput bench-coldstart lint lint-baseline clean image
 
 all: build test
 
@@ -81,6 +81,14 @@ bench-reuse:
 bench-goodput:
 	JAX_PLATFORMS=cpu $(PYTHON) -c "import json, bench; \
 		print(json.dumps(bench.goodput_ledger_bench(), indent=2))"
+
+# the cold-start collapse yardstick (docs/60 § cold-start runbook):
+# cold launch vs standby promotion vs peer weight-transfer launch,
+# TTFRT + per-stage ledger breakdown from /v1/goodput; meets_target
+# pins promoted <= 0.25x cold
+bench-coldstart:
+	JAX_PLATFORMS=cpu $(PYTHON) -c "import json, bench; \
+		print(json.dumps(bench.cold_start_bench(), indent=2))"
 
 # cpcheck (AST invariant rules vs analysis/baseline.json) + compileall;
 # see docs/70-static-analysis.md. Non-zero on any non-baselined finding.

@@ -1174,6 +1174,199 @@ def goodput_ledger_bench(requests: int = 6, max_new: int = 96) -> dict:
     return out
 
 
+def cold_start_bench(max_new: int = 16) -> dict:
+    """The cold-start collapse yardstick (``make bench-coldstart``):
+    time-to-first-routed-token for the three scale-up paths, with the
+    per-stage attribution from each replica's ``GET /v1/goodput``:
+
+    - **cold**: construct + boot + warmup-compile + first 200. Runs
+      FIRST in a fresh interpreter, so it pays the real XLA compiles
+      a production cold launch pays.
+    - **promoted**: a standby (booted and warmup-compiled OUTSIDE the
+      measured window — that is the warm-standby pool's whole
+      premise: the compile happened BEFORE the scale event) measured
+      from ``POST /v3/standby/promote`` to its first 200.
+    - **peer transfer**: a launch whose weights arrive from the warm
+      cold-arm replica over cp-mux/1 (``fleet.standby.fetch_params``,
+      digest-verified; byte-equality asserted) instead of disk/init,
+      then boot + warmup. In-process the jit caches play the shared
+      XLA compile cache's role, so this arm isolates the transfer +
+      boot cost the way a cache-warm same-host launch sees it.
+
+    ``meets_target`` pins promoted TTFRT <= 0.25x cold TTFRT (the
+    promoted path must dodge boot AND compile, not merely shave
+    them), every arm's first request answering 200, and the
+    transferred weights byte-identical to the peer's."""
+    import asyncio
+    import http.client
+    import os
+    import time as time_mod
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from containerpilot_tpu.fleet.standby import fetch_params
+    from containerpilot_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=2, n_layers=2, d_ff=128,
+        max_seq_len=256, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    out: dict = {}
+
+    async def scenario() -> None:
+        loop = asyncio.get_event_loop()
+
+        def request(port: int, method: str, path: str,
+                    body: bytes = b"") -> tuple:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=120
+            )
+            try:
+                conn.request(
+                    method, path, body or None,
+                    {"Content-Type": "application/json"}
+                    if body else {},
+                )
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            finally:
+                conn.close()
+
+        gen_body = json.dumps(
+            {"tokens": [[1, 2, 3, 4, 5, 6, 7, 8]],
+             "max_new_tokens": max_new}
+        ).encode()
+
+        async def first_token(port: int) -> int:
+            status, _payload = await loop.run_in_executor(
+                None, request, port, "POST", "/v1/generate", gen_body
+            )
+            return status
+
+        async def stages(port: int) -> dict:
+            _status, payload = await loop.run_in_executor(
+                None, request, port, "GET", "/v1/goodput"
+            )
+            gp = json.loads(payload)
+            return {
+                stage: round(gp["stages_s"].get(stage, 0.0), 3)
+                for stage in ("boot", "compile_warmup")
+            }
+
+        def server(**kwargs) -> InferenceServer:
+            return InferenceServer(
+                cfg, params, "127.0.0.1", 0, max_len=256,
+                slots=2, slot_chunk=8, **kwargs,
+            )
+
+        # -- arm 1: COLD (first in this interpreter: real compiles) --
+        t0 = time_mod.monotonic()
+        cold = server()
+        await cold.run()
+        cold_status = await first_token(cold.port)
+        cold_ttfrt = time_mod.monotonic() - t0
+        cold_stages = await stages(cold.port)
+
+        # -- arm 2: PROMOTED (standby boots OUTSIDE the window) ------
+        standby = server(role="standby")
+        await standby.run()  # boot + warmup paid before the event
+        t0 = time_mod.monotonic()
+        promote_status, _ = await loop.run_in_executor(
+            None, request, standby.port, "POST",
+            "/v3/standby/promote", b"{}",
+        )
+        promoted_status = await first_token(standby.port)
+        promoted_ttfrt = time_mod.monotonic() - t0
+        promoted_stages = await stages(standby.port)
+
+        # -- arm 3: PEER-TRANSFER launch (weights over cp-mux/1) -----
+        t0 = time_mod.monotonic()
+        like = init_params(jax.random.PRNGKey(1), cfg)  # same shapes,
+        # different values: byte-equality below proves the transfer
+        # actually replaced them
+        fetched = await fetch_params("127.0.0.1", cold.port, like)
+        transfer_s = time_mod.monotonic() - t0
+        transfer_ok = fetched is not None and all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(
+                jax.tree_util.tree_leaves(params),
+                jax.tree_util.tree_leaves(fetched),
+            )
+        )
+        xfer = InferenceServer(
+            cfg, fetched if fetched is not None else params,
+            "127.0.0.1", 0, max_len=256, slots=2, slot_chunk=8,
+        )
+        await xfer.run()
+        transfer_status = await first_token(xfer.port)
+        transfer_ttfrt = time_mod.monotonic() - t0
+        transfer_stages = await stages(xfer.port)
+
+        for s in (standby, xfer, cold):
+            await s.stop()
+
+        total_bytes = sum(
+            np.asarray(leaf).nbytes
+            for leaf in jax.tree_util.tree_leaves(params)
+        )
+        out.update(
+            backend=jax.default_backend(),
+            config=(
+                f"{cfg.n_layers}L d{cfg.d_model} v{cfg.vocab_size}, "
+                f"2 slots x 8-token chunks, first token = "
+                f"{max_new}-token generate"
+            ),
+            cold={
+                "ttfrt_s": round(cold_ttfrt, 3),
+                "status": cold_status,
+                "stages_s": cold_stages,
+            },
+            promoted={
+                "ttfrt_s": round(promoted_ttfrt, 3),
+                "promote_status": promote_status,
+                "status": promoted_status,
+                "stages_s": promoted_stages,
+            },
+            peer_transfer={
+                "ttfrt_s": round(transfer_ttfrt, 3),
+                "transfer_s": round(transfer_s, 3),
+                "bytes": int(total_bytes),
+                "verified": bool(transfer_ok),
+                "status": transfer_status,
+                "stages_s": transfer_stages,
+            },
+            promoted_over_cold=round(
+                promoted_ttfrt / max(cold_ttfrt, 1e-9), 4
+            ),
+        )
+
+    asyncio.run(scenario())
+    out["target"] = (
+        "promoted TTFRT <= 0.25x cold TTFRT, every arm's first "
+        "request 200, peer-transferred weights byte-identical"
+    )
+    out["meets_target"] = bool(
+        out["promoted_over_cold"] <= 0.25
+        and out["cold"]["status"] == 200
+        and out["promoted"]["status"] == 200
+        and out["promoted"]["promote_status"] == 200
+        and out["peer_transfer"]["status"] == 200
+        and out["peer_transfer"]["verified"]
+    )
+    return out
+
+
 def chaos_goodput_bench(seed: int = 0) -> dict:
     """The robustness trajectory: run the QUICK chaos scenarios (a
     real multi-replica fleet + gateway replaying a seeded trace while
@@ -1515,6 +1708,13 @@ def workload_benches() -> dict:
     # (6 cold scenario subprocesses: 2 arms x 3 seeds)
     extras["prefix_reuse"] = _bench_subprocess(
         "prefix_reuse_bench", 900,
+        env=None if backend == "tpu" else {"JAX_PLATFORMS": "cpu"},
+    )
+    # cold-start collapse trajectory: cold vs promoted vs
+    # peer-transfer TTFRT with per-stage ledger attribution — the
+    # number the warm-standby pool exists to drive down
+    extras["cold_start"] = _bench_subprocess(
+        "cold_start_bench", 600,
         env=None if backend == "tpu" else {"JAX_PLATFORMS": "cpu"},
     )
     if backend != "tpu":

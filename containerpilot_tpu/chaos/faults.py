@@ -21,6 +21,14 @@ reference ContainerPilot's design exists to absorb:
 - **Catalog flap**: the discovery backend transiently answers with an
   empty healthy set (torn NFS read, catalog restart). The gateway's
   hold-down must damp it instead of wiping its routing table.
+- **Slow boot**: every replica launched AFTER the fault arms takes an
+  extra N seconds in warmup (injected through the serve-side
+  ``chaos_hook`` seam, attributed as ``compile_warmup`` in the
+  device-time ledger) — the production shape of a cold scale-up
+  paying image pull + weight load + XLA compile mid-burst, and the
+  fault the warm-standby pool (fleet/standby.py) exists to mask:
+  promotion skips the slow boot entirely while the background refill
+  pays it off the critical path.
 
 Faults are declarative ``(at_s, kind, target)`` records; the scenario
 runner applies each when the trace clock passes ``at_s`` and logs it
@@ -206,8 +214,10 @@ class Fault:
     scenario runner applies it when the trace clock passes ``at_s``."""
 
     at_s: float
-    kind: str  # kill | wedge | unwedge | slow | lossy | flap
+    kind: str  # kill | wedge | unwedge | slow | slow_boot | lossy | flap
     replica: int = 0
-    #: kind-specific magnitude: slow -> delay seconds; lossy -> reset
-    #: after this many response bytes (0 disarms); flap -> poll count
+    #: kind-specific magnitude: slow -> delay seconds; slow_boot ->
+    #: warmup delay seconds for replicas launched after it arms (0
+    #: disarms); lossy -> reset after this many response bytes (0
+    #: disarms); flap -> poll count
     value: float = 0.0

@@ -42,6 +42,12 @@ from .autoscaler import Autoscaler, AutoscalerConfig, FleetLoad
 from .gateway import FleetGateway, Replica
 from .member import FleetMember
 from .pool import ConnectionPool, StaleConnection, UpstreamError
+from .standby import (
+    ROLE_ACTIVE,
+    ROLE_STANDBY,
+    StandbyLauncher,
+    fetch_params,
+)
 
 __all__ = [
     "AdmissionController",
@@ -53,9 +59,13 @@ __all__ = [
     "FleetGateway",
     "FleetLoad",
     "FleetMember",
+    "ROLE_ACTIVE",
+    "ROLE_STANDBY",
     "Replica",
     "SessionLimited",
     "ShedError",
     "StaleConnection",
+    "StandbyLauncher",
     "UpstreamError",
+    "fetch_params",
 ]

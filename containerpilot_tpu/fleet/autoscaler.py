@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -46,6 +47,7 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
 from ..events import Event, EventBus, EventCode
 from ..utils.tasks import spawn
+from .standby import equal_jitter
 
 log = logging.getLogger("containerpilot.fleet")
 
@@ -70,6 +72,14 @@ class AutoscalerConfig:
     down_sustain_s: float = 1.5
     cooldown_s: float = 1.0
     tick_interval: float = 0.2
+    #: failed-launch retry backoff (equal-jitter, doubling to the
+    #: cap): a launcher that keeps raising — bad image, full host —
+    #: must not be hammered every tick, but the fleet keeps trying
+    #: and converges to min the moment launches heal
+    launch_backoff_s: float = 0.5
+    launch_backoff_cap_s: float = 5.0
+    #: seed for the backoff jitter (chaos reproducibility)
+    jitter_seed: Optional[int] = None
 
     def __post_init__(self) -> None:
         if not 1 <= self.min_replicas <= self.max_replicas:
@@ -78,6 +88,12 @@ class AutoscalerConfig:
             raise ValueError("need 0 <= low_water < high_water")
         if self.slots_per_replica < 1:
             raise ValueError("slots_per_replica must be >= 1")
+        if self.launch_backoff_s <= 0 or (
+            self.launch_backoff_cap_s < self.launch_backoff_s
+        ):
+            raise ValueError(
+                "need 0 < launch_backoff_s <= launch_backoff_cap_s"
+            )
 
 
 class Autoscaler:
@@ -102,6 +118,15 @@ class Autoscaler:
         self.bus = bus
         self.scale_ups = 0
         self.scale_downs = 0
+        #: launches that raised (or replicas that died during their
+        #: warmup, surfacing as a raise from launch()): each one
+        #: decrements nothing — the failed replica never joined the
+        #: managed count — and arms the equal-jitter retry backoff so
+        #: a broken launcher can't be hammered every tick
+        self.launch_failures = 0
+        self._launch_backoff = self.cfg.launch_backoff_s
+        self._launch_retry_at = float("-inf")
+        self._rng = random.Random(self.cfg.jitter_seed)
         #: every scale decision, stamped on the tick's monotonic
         #: clock — the fleet goodput ledger reads this to compute
         #: time-to-first-routed-token per launch (gateway.
@@ -117,6 +142,7 @@ class Autoscaler:
         self._last_event = float("-inf")
         self._task: Optional["asyncio.Task[None]"] = None
         self._m_scale = self._g_replicas = self._g_util = None
+        self._m_launch_failed = None
         if registry is not None:
             # live in the caller's registry (the gateway's, usually)
             # so /metrics shows admission + autoscaler side by side
@@ -126,6 +152,12 @@ class Autoscaler:
                 "containerpilot_autoscaler_scale_events",
                 "replica launches/retires decided by the autoscaler",
                 ["direction"], registry=registry,
+            )
+            self._m_launch_failed = Counter(
+                "containerpilot_autoscaler_launch_failed",
+                "launch attempts that raised (or whose replica died "
+                "during warmup); retried with equal-jitter backoff",
+                registry=registry,
             )
             self._g_replicas = Gauge(
                 "containerpilot_autoscaler_replicas",
@@ -162,17 +194,25 @@ class Autoscaler:
 
     @property
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "replicas": self.launcher.count(),
             "min_replicas": self.cfg.min_replicas,
             "max_replicas": self.cfg.max_replicas,
             "scale_ups": self.scale_ups,
             "scale_downs": self.scale_downs,
+            "launch_failures": self.launch_failures,
             "utilization": round(self.last_utilization, 4),
             "high_water": self.cfg.high_water,
             "low_water": self.cfg.low_water,
             "cooldown_s": self.cfg.cooldown_s,
         }
+        # a StandbyLauncher exposes its pool (promotions, refills,
+        # failures) — surfaced here so /fleet shows the whole
+        # promote-instead-of-launch story in one block
+        standby = getattr(self.launcher, "standby_stats", None)
+        if callable(standby):
+            out["standby"] = standby()
+        return out
 
     # -- the control loop -----------------------------------------------
 
@@ -200,8 +240,11 @@ class Autoscaler:
             # invariant, and a production-scale cooldown must not
             # leave the fleet under-floor for a minute. launch() is
             # awaited inline and count() reflects it immediately, so
-            # repairs can't storm.
-            await self._scale_up(now, reason="below min")
+            # repairs can't storm — and a FAILING launcher is gated
+            # by the launch-retry backoff, so repairs can't storm
+            # through failures either.
+            if now >= self._launch_retry_at:
+                await self._scale_up(now, reason="below min")
             return
         capacity = max(1, n * self.cfg.slots_per_replica)
         util = (
@@ -216,7 +259,10 @@ class Autoscaler:
                 self._over_since = now
             sustained = now - self._over_since >= self.cfg.up_sustain_s
             cooled = now - self._last_event >= self.cfg.cooldown_s
-            if sustained and cooled and n < self.cfg.max_replicas:
+            if (
+                sustained and cooled and n < self.cfg.max_replicas
+                and now >= self._launch_retry_at
+            ):
                 await self._scale_up(now, reason=f"util {util:.2f}")
         elif util <= self.cfg.low_water:
             self._over_since = None
@@ -237,18 +283,47 @@ class Autoscaler:
         # cold start (spawn + boot + compile + register + route) to
         # the scale event, not just the post-launch tail
         decided = time.monotonic()
-        replica_id = await self.launcher.launch()
+        try:
+            replica_id = await self.launcher.launch()
+        except Exception as exc:
+            # a launch that raised (launcher bug, full host, replica
+            # died during its own warmup) must not leak a managed
+            # slot or be re-hammered every tick: count it, arm the
+            # equal-jitter retry backoff (the gateway's discipline),
+            # and let the next eligible tick try again — repair and
+            # pressure paths both honor _launch_retry_at
+            self.launch_failures += 1
+            if self._m_launch_failed is not None:
+                self._m_launch_failed.inc()
+            delay = equal_jitter(self._launch_backoff, self._rng)
+            self._launch_retry_at = now + delay
+            self._launch_backoff = min(
+                self._launch_backoff * 2, self.cfg.launch_backoff_cap_s
+            )
+            log.warning(
+                "autoscaler: launch failed (%s): %s; retrying in "
+                "%.2fs", reason, exc, delay,
+            )
+            return
+        self._launch_backoff = self.cfg.launch_backoff_s
+        self._launch_retry_at = float("-inf")
         self.scale_ups += 1
-        self._scale_log.append(
-            {"direction": "up", "replica": replica_id, "at": decided}
-        )
+        entry = {"direction": "up", "replica": replica_id, "at": decided}
+        # a StandbyLauncher reports HOW the launch happened
+        # ("promoted" vs "cold"): the split the TTFRT report — and
+        # the promoted-path chaos bound — are judged on
+        last = getattr(self.launcher, "last_launch", None)
+        if isinstance(last, dict) and last.get("mode"):
+            entry["mode"] = last["mode"]
+        self._scale_log.append(entry)
         self._last_event = now  # the tick's clock, not the wall's
         self._over_since = None
         if self._m_scale is not None:
             self._m_scale.labels("up").inc()
         log.info(
-            "autoscaler: launched %s (%s; fleet now %d)",
-            replica_id, reason, self.launcher.count(),
+            "autoscaler: launched %s (%s, %s; fleet now %d)",
+            replica_id, reason, entry.get("mode", "cold"),
+            self.launcher.count(),
         )
         self._announce("scale-up", replica_id)
 

@@ -112,6 +112,7 @@ from .pool import (
     StaleMuxConnection,
     UpstreamError,
 )
+from .standby import ROLE_ACTIVE, ROLE_STANDBY, equal_jitter
 
 log = logging.getLogger("containerpilot.fleet")
 
@@ -160,6 +161,14 @@ class Replica:
     #: from this replica — the gateway half of time-to-first-routed-
     #: token after a scale event
     first_ok_at: Optional[float] = None
+    #: fleet role from the ``role=`` heartbeat field: a ``standby``
+    #: replica is warm, promotable capacity — catalog-visible and
+    #: heartbeating, but excluded from ``_pick`` and from admission
+    #: capacity until its post-promotion beat drops the field
+    role: str = ROLE_ACTIVE
+    #: compile-cache advertisement (``cc=<digest>:<dir>``, raw):
+    #: same-host launches adopt the dir; surfaced on /fleet
+    compile_cache: str = ""
 
     @property
     def load(self) -> int:
@@ -535,6 +544,13 @@ class FleetGateway:
             "replicas currently in the healthy routing set",
             registry=self._registry,
         )
+        self._g_standby = Gauge(
+            "containerpilot_gateway_standby_replicas",
+            "healthy replicas parked in the standby role: warm, "
+            "promotable, excluded from routing and admission "
+            "capacity (fleet/standby.py)",
+            registry=self._registry,
+        )
         self._m_flaps_damped = Counter(
             "containerpilot_gateway_catalog_flaps_damped",
             "empty catalog polls absorbed by the hold-down instead of "
@@ -862,9 +878,16 @@ class FleetGateway:
             self._goodput_departed.pop(rid, None)
         self._replicas = fresh
         self._g_replicas.set(len(fresh))
-        # admission capacity tracks the healthy set; growth grants
-        # queued waiters immediately
-        self._admission.set_capacity(len(fresh))
+        # admission capacity tracks the ACTIVE healthy set — a parked
+        # standby contributes no dispatch slots until its promotion
+        # beat lands, at which point capacity grows and queued
+        # waiters are granted immediately (the promote-into-a-burst
+        # fast path); growth grants queued waiters immediately
+        active = sum(
+            1 for r in fresh.values() if r.role == ROLE_ACTIVE
+        )
+        self._g_standby.set(len(fresh) - active)
+        self._admission.set_capacity(active)
         # pooled connections to a replica that LEFT the healthy set
         # (drained, deregistered, TTL-expired) are evicted, never
         # reused: a draining replica would answer them 503, a dead one
@@ -903,6 +926,20 @@ class FleetGateway:
                 replica.digest = fps
                 replica.digest_version = version
                 replica.digest_at = time.monotonic()
+        # role rides every beat of a standby and is ABSENT from an
+        # active replica's note — the first post-promotion beat flips
+        # the routing view back to active by omission. Omission only
+        # counts on a note that PARSED (a real beat always carries at
+        # least occ=): a torn/empty read must keep the previous role,
+        # or one half-written catalog record routes a poll interval
+        # of traffic into a standby's 503s
+        if fields:
+            role = fields.get("role", ROLE_ACTIVE)
+            replica.role = (
+                ROLE_STANDBY if role == ROLE_STANDBY else ROLE_ACTIVE
+            )
+        if "cc" in fields:
+            replica.compile_cache = fields["cc"]
 
     def _fleet_tokens_reused(self) -> int:
         """Fleet-wide tokens_reused: live replicas' last-advertised
@@ -938,6 +975,11 @@ class FleetGateway:
                 "direction": event["direction"],
                 "replica": event["replica"],
             }
+            if "mode" in event:
+                # how the launch happened: "promoted" (warm standby
+                # flipped active) vs "cold" (full boot) — the split
+                # the cold-start-collapse yardstick is judged on
+                entry["mode"] = event["mode"]
             if event["direction"] == "up":
                 first_ok = self._first_ok.get(event["replica"])
                 entry["ttfrt_s"] = (
@@ -1028,10 +1070,16 @@ class FleetGateway:
         With a prefix fingerprint, a replica advertising it as warm
         is preferred — but only within ``cache_slack`` of the least
         load, so a warm-but-wedged replica never beats a healthy cold
-        one; among warm candidates least-loaded still decides."""
+        one; among warm candidates least-loaded still decides.
+
+        Standby-role replicas are never candidates: they are warm
+        capacity PARKED for promotion (fleet/standby.py), visible in
+        the catalog and on /fleet but outside the routing set until
+        their post-promotion heartbeat drops the role field."""
         excluded = set(exclude)
         candidates = [
-            r for r in self._replicas.values() if r.id not in excluded
+            r for r in self._replicas.values()
+            if r.id not in excluded and r.role == ROLE_ACTIVE
         ]
         if not candidates:
             return None
@@ -1229,6 +1277,18 @@ class FleetGateway:
                     "evicted": self.sticky_evicted,
                 },
                 "admission": self._admission.stats(),
+                # warm-standby visibility (fleet/standby.py): which
+                # healthy replicas are parked, promotable capacity
+                "standby": {
+                    "count": sum(
+                        1 for r in self._replicas.values()
+                        if r.role == ROLE_STANDBY
+                    ),
+                    "ids": sorted(
+                        r.id for r in self._replicas.values()
+                        if r.role == ROLE_STANDBY
+                    ),
+                },
                 "autoscaler": (
                     self._autoscaler.stats
                     if self._autoscaler is not None else None
@@ -1244,6 +1304,8 @@ class FleetGateway:
                         "id": r.id,
                         "address": r.address,
                         "port": r.port,
+                        "role": r.role,
+                        "compile_cache": r.compile_cache or None,
                         "outstanding": r.outstanding,
                         "queued": r.queued,
                         "age_s": round(
@@ -1499,7 +1561,8 @@ class FleetGateway:
         return min(backoff * 2, self.retry_backoff_cap)
 
     def _jittered(self, backoff: float) -> float:
-        """Equal-jitter backoff: a deterministic floor plus a uniform
+        """Equal-jitter backoff (the fleet's shared shape,
+        standby.equal_jitter): a deterministic floor plus a uniform
         random slice. A replica SIGKILLed under load fails every
         in-flight request in the same millisecond; without jitter the
         retries arrive at the surviving replicas as one synchronized
@@ -1507,8 +1570,7 @@ class FleetGateway:
         outstanding routing just absorbed."""
         if self.retry_jitter <= 0.0:
             return backoff
-        spread = backoff * self.retry_jitter
-        return backoff - spread + self._rng.random() * spread
+        return equal_jitter(backoff, self._rng, self.retry_jitter)
 
     def _failure_response(self, exc: Exception) -> Response:
         return Response(

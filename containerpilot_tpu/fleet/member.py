@@ -152,6 +152,22 @@ class FleetMember(EventHandler):
                 f"ok occ={occupancy:.2f}"
                 if isinstance(occupancy, (int, float)) else "ok"
             )
+            # role advertisement: a warm STANDBY heartbeats (it is
+            # alive and promotable) but must never be routed to — the
+            # gateway reads this field to exclude it from _pick and
+            # admission capacity. Active replicas omit it, so the
+            # first post-promote beat flips the gateway's view back.
+            role = getattr(self.server, "role", "")
+            if role and role != "active":
+                output += f" role={role}"
+            # compile-cache advertisement (``cc=``): same-host
+            # launches adopt the dir and skip warm-marked buckets,
+            # collapsing their compile_warmup seconds
+            cc_note = getattr(self.server, "compile_cache_note", None)
+            if callable(cc_note):
+                extra = cc_note()
+                if extra:
+                    output += " " + extra
             # KV-reuse advertisement (optional, duck-typed like the
             # rest of the server surface): reuse counters + the
             # prefix fingerprint digest ride the same check-output

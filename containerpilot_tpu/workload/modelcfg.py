@@ -5,14 +5,16 @@ apart and score/serve a differently-shaped model than was trained.
 """
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, Iterable, Optional, Tuple
 
 import jax
 
 
-def enable_compile_cache() -> Optional[str]:
+def enable_compile_cache(path: str = "") -> Optional[str]:
     """Opt-in persistent XLA compilation cache, shared by every
-    workload CLI (env: ``CONTAINERPILOT_COMPILE_CACHE=<dir>``).
+    workload CLI (env: ``CONTAINERPILOT_COMPILE_CACHE=<dir>``, or an
+    explicit ``path`` — e.g. one adopted from a fleet peer's
+    heartbeat advertisement, see ``adopt_fleet_compile_cache``).
 
     The supervisor's whole failure story is crash→restart→resume; the
     dominant cost of a reincarnation is recompiling the exact
@@ -23,7 +25,7 @@ def enable_compile_cache() -> Optional[str]:
     Returns the cache dir when enabled, else None."""
     import os
 
-    path = os.environ.get("CONTAINERPILOT_COMPILE_CACHE", "")
+    path = path or os.environ.get("CONTAINERPILOT_COMPILE_CACHE", "")
     if not path:
         return None
     os.makedirs(path, exist_ok=True)
@@ -32,6 +34,197 @@ def enable_compile_cache() -> Optional[str]:
     # model's programs; anything over half a second is worth a disk hit
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     return path
+
+
+# -- warm-bucket markers (the compile cache as a fleet artifact) ------
+#
+# The XLA disk cache makes a RE-compile cheap; nothing tells a fresh
+# replica it can skip driving the warmup compiles at all. The marker
+# file records, per warmup fingerprint (model/engine shape), which
+# warmup buckets a previous process on this cache dir already pushed
+# through XLA — a launch that finds its buckets marked skips those
+# warmup requests entirely and flips /health 200 in milliseconds,
+# which is the compile_warmup collapse the cold-start work needs.
+# All helpers are blocking (file I/O): executor-wrap them on serving
+# loops.
+
+WARM_MARKER = "cp_warm_buckets.json"
+
+
+def warmup_fingerprint(
+    cfg: Any,
+    max_len: int,
+    slots: int = 0,
+    slot_chunk: int = 0,
+    draft_layers: int = 0,
+    speculate: int = 0,
+) -> str:
+    """Stable hash of everything that shapes the warmup program set:
+    a marker written under one fingerprint must never skip warmup for
+    a differently-shaped server sharing the cache dir."""
+    import hashlib
+    import json as json_mod
+
+    key = json_mod.dumps(
+        {
+            # platform identity: XLA's disk cache keys include the
+            # backend, and the marker must too — a cpu process's
+            # marker must never skip a tpu launch's warmup (shared
+            # NFS cache dirs make this a real shape)
+            "backend": jax.default_backend(),
+            "jax": getattr(jax, "__version__", ""),
+            "vocab": getattr(cfg, "vocab_size", 0),
+            "d_model": getattr(cfg, "d_model", 0),
+            "n_heads": getattr(cfg, "n_heads", 0),
+            "kv_heads": getattr(cfg, "kv_heads", 0),
+            "n_layers": getattr(cfg, "n_layers", 0),
+            "d_ff": getattr(cfg, "d_ff", 0),
+            "window": getattr(cfg, "window", 0),
+            "moe_experts": getattr(cfg, "moe_experts", 0),
+            "kv_int8": bool(getattr(cfg, "kv_int8", False)),
+            "max_len": max_len,
+            "slots": slots,
+            "slot_chunk": slot_chunk,
+            "draft_layers": draft_layers,
+            "speculate": speculate,
+        },
+        sort_keys=True,
+    )
+    return hashlib.blake2b(key.encode(), digest_size=8).hexdigest()
+
+
+def load_warm_buckets(cache_dir: str, fingerprint: str) -> set:
+    """Warmup buckets already marked warm for this fingerprint in
+    this cache dir; tolerant of a missing/torn marker (empty set —
+    worst case the launch warms up fully, never a crash)."""
+    import json as json_mod
+    import os
+
+    if not cache_dir:
+        return set()
+    try:
+        with open(os.path.join(cache_dir, WARM_MARKER)) as fh:
+            marker = json_mod.load(fh)
+        buckets = marker.get(fingerprint, [])
+        return {b for b in buckets if isinstance(b, str)}
+    except (OSError, ValueError, AttributeError):
+        return set()
+
+
+def mark_warm_buckets(
+    cache_dir: str, fingerprint: str, buckets: Iterable[str]
+) -> None:
+    """Merge ``buckets`` into the marker under ``fingerprint``
+    (atomic tmp+rename write; concurrent markers last-write-win,
+    which only costs a redundant warmup, never a wrong skip)."""
+    import json as json_mod
+    import os
+
+    if not cache_dir:
+        return
+    path = os.path.join(cache_dir, WARM_MARKER)
+    try:
+        with open(path) as fh:
+            marker = json_mod.load(fh)
+        if not isinstance(marker, dict):
+            marker = {}
+    except (OSError, ValueError):
+        marker = {}
+    merged = set(marker.get(fingerprint, [])) | set(buckets)
+    marker[fingerprint] = sorted(merged)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json_mod.dump(marker, fh, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def compile_cache_note(cache_dir: str) -> str:
+    """The heartbeat advertisement (``cc=<digest>:<quoted dir>``) a
+    FleetMember appends for a replica serving with a compile cache:
+    peers on the same host adopt the dir, and the digest (over the
+    warm-bucket marker) tells readers when the warm set moved.
+    Empty when no cache dir is configured."""
+    import hashlib
+    import json as json_mod
+    import os
+    from urllib.parse import quote
+
+    if not cache_dir:
+        return ""
+    try:
+        with open(os.path.join(cache_dir, WARM_MARKER)) as fh:
+            marker_blob = json_mod.dumps(json_mod.load(fh), sort_keys=True)
+    except (OSError, ValueError):
+        marker_blob = ""
+    digest = hashlib.blake2b(
+        marker_blob.encode(), digest_size=4
+    ).hexdigest()
+    return f"cc={digest}:{quote(cache_dir, safe='')}"
+
+
+def parse_compile_cache_note(raw: object) -> Tuple[str, str]:
+    """Tolerant reader for the ``cc=`` field: (digest, dir); both
+    empty on garbage — never an exception on the routing path."""
+    from urllib.parse import unquote
+
+    if not isinstance(raw, str) or ":" not in raw:
+        return "", ""
+    digest, _, quoted = raw.partition(":")
+    try:
+        return digest, unquote(quoted)
+    except (ValueError, TypeError):
+        return "", ""
+
+
+def _local_addresses() -> set:
+    """Addresses that mean "this host" for cache adoption."""
+    import socket
+
+    local = {"127.0.0.1", "localhost", "0.0.0.0", "::1", ""}
+    try:
+        hostname = socket.gethostname()
+        local.add(hostname)
+        local.update(
+            info[4][0]
+            for info in socket.getaddrinfo(hostname, None)
+        )
+    except OSError:
+        # a host that can't resolve itself still adopts loopback
+        # advertisements; remote ones are skipped either way
+        return local
+    return local
+
+
+def adopt_fleet_compile_cache(
+    backend: Any, service_name: str
+) -> Optional[str]:
+    """Scan the catalog for a peer replica advertising a compile
+    cache dir on THIS host (``cc=`` heartbeat field) and enable it
+    for this process. Returns the adopted dir, or None when nobody
+    advertises one that exists locally — a launch that shares a
+    host with a warm peer reuses its compiled executables (and its
+    warm-bucket marker) instead of compiling from scratch. Only
+    SAME-HOST advertisements are considered: a remote peer's path
+    that happens to exist locally is a different host's cache (the
+    warmup fingerprint's platform field is the second guard, for
+    genuinely shared NFS dirs)."""
+    import os
+
+    from ..kvtier import parse_kv_note
+
+    try:
+        instances = backend.instances(service_name)
+    except Exception:
+        return None
+    local = _local_addresses()
+    for inst in instances:
+        if getattr(inst, "address", "") not in local:
+            continue
+        fields = parse_kv_note(getattr(inst, "notes", ""))
+        _digest, cache_dir = parse_compile_cache_note(fields.get("cc"))
+        if cache_dir and os.path.isdir(cache_dir):
+            return enable_compile_cache(cache_dir)
+    return None
 
 
 def derive_d_ff(d_model: int) -> int:

@@ -103,6 +103,8 @@ class InferenceServer:
         cp_mesh: Any = None,
         cp_min_len: int = 0,
         mux: bool = True,
+        role: str = "active",
+        compile_cache_dir: str = "",
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -110,6 +112,38 @@ class InferenceServer:
         self.port = port
         self.max_len = max_len
         self.ready = False
+        # fleet role: a "standby" replica boots, loads weights, and
+        # warmup-compiles exactly like an active one, but /health says
+        # so (503 standby) and new decode work is refused — it
+        # heartbeats into the catalog under role=standby and waits for
+        # POST /v3/standby/promote to flip it active in one
+        # assignment (fleet/standby.py is the pool that promotes)
+        if role not in ("active", "standby"):
+            raise ValueError("role must be 'active' or 'standby'")
+        self.role = role
+        # persistent XLA compile cache dir this replica serves with
+        # (advertised through heartbeat notes so same-host launches
+        # adopt it); warmup consults its warm-bucket marker and skips
+        # buckets a previous process already compiled. Enabled HERE,
+        # not only in the CLI: a warm-bucket marker must never be
+        # written by a process whose compiles didn't actually land in
+        # the disk cache — that marker would promise executables a
+        # later launch won't find
+        self.compile_cache_dir = compile_cache_dir
+        if compile_cache_dir:
+            from .modelcfg import enable_compile_cache
+
+            enable_compile_cache(compile_cache_dir)
+        # the cc= heartbeat advertisement, computed once at warmup
+        # end (executor-wrapped): heartbeats must never pay marker
+        # file I/O on the serving loop
+        self._compile_cache_note = ""
+        # peer weight transfer: the manifest is built once (executor)
+        # and cached — chunk bytes are re-derived lazily per request
+        # so the server never holds a second full copy of the params
+        self._weights_manifest_cache: Optional[Any] = None
+        self._weights_manifest_bytes = b""
+        self._weights_lock: Optional[asyncio.Lock] = None
         # device-time ledger (telemetry/goodput.py): every wall-second
         # of this replica's life attributed to exactly one stage,
         # starting NOW in ``boot`` — weight setup, engine construction
@@ -312,6 +346,15 @@ class InferenceServer:
         self._server.route("GET", "/metrics", self._metrics)
         self._server.route("GET", "/v1/traces", self._traces)
         self._server.route("GET", "/v1/goodput", self._goodput)
+        # cold-start collapse seams (fleet/standby.py): the promote
+        # verb flips a standby active in one assignment, and the
+        # weights endpoint serves this replica's params as a
+        # digest-verified chunk stream a launching peer fetches over
+        # cp-mux/1 instead of re-reading disk
+        self._server.route(
+            "POST", "/v3/standby/promote", self._promote_verb
+        )
+        self._server.route("GET", "/v1/weights", self._weights)
         route = self._instrumented
         self._server.route("GET", "/v1/model", route(
             "model", self._model_info
@@ -352,6 +395,14 @@ class InferenceServer:
             )
         if not self.ready:
             return Response(503, b"warming up\n")
+        if self.role == "standby":
+            # warm but deliberately not serving: a standby answers
+            # health probes honestly (it is NOT taking traffic) while
+            # its catalog heartbeat carries role=standby so gateways
+            # know it exists. Promotion flips this to 200 instantly.
+            return Response(
+                503, b"standby\n", headers={"Retry-After": "1"}
+            )
         return Response(200, b"ok\n")
 
     async def _metrics(self, _req: Request) -> Response:
@@ -396,6 +447,104 @@ class InferenceServer:
             content_type="application/json",
         )
 
+    # -- cold-start collapse surfaces (fleet/standby.py) ---------------
+
+    def promote(self) -> bool:
+        """Standby -> active in one assignment: /health flips 200 and
+        generate/completions open on the very next request. False
+        when this replica is not a promotable standby (already
+        active, or draining) — the 409 the HTTP verb answers, and
+        the signal the StandbyLauncher uses to drop a contended or
+        dying standby and try the next one."""
+        if self.role != "standby" or self.draining:
+            return False
+        self.role = "active"
+        log.info("serve: standby promoted to active")
+        return True
+
+    async def _promote_verb(self, _req: Request) -> Response:
+        """``POST /v3/standby/promote``: the control-plane face of
+        ``promote()``. Exactly one promoter wins a race — the second
+        call finds role already active and 409s (its caller returns
+        the loser to the pool or takes the cold path)."""
+        if self.role == "active":
+            return Response(409, b"already active\n")
+        if self.draining:
+            return Response(409, b"draining\n")
+        self.promote()
+        return Response(
+            200,
+            json.dumps(
+                {"promoted": True, "ready": self.ready}
+            ).encode(),
+            content_type="application/json",
+        )
+
+    async def _ensure_weights_manifest(self):
+        """Build (once, executor-wrapped) and cache the transfer
+        manifest: leaf/chunk table + digests. Chunk BYTES are not
+        cached — they re-derive deterministically at serve time, so
+        the server never holds a second full copy of the params."""
+        if self._weights_manifest_cache is not None:
+            return self._weights_manifest_cache
+        if self._weights_lock is None:
+            self._weights_lock = asyncio.Lock()
+        async with self._weights_lock:
+            if self._weights_manifest_cache is None:
+                from ..fleet.standby import (
+                    encode_manifest,
+                    weights_manifest,
+                )
+
+                loop = asyncio.get_event_loop()
+                manifest = await loop.run_in_executor(
+                    None, weights_manifest, self.params
+                )
+                self._weights_manifest_bytes = encode_manifest(manifest)
+                self._weights_manifest_cache = manifest
+        return self._weights_manifest_cache
+
+    async def _weights(self, req: Request) -> Response:
+        """``GET /v1/weights[?chunk=K]``: this replica's params as a
+        length-prefixed manifest followed by digest-verified chunks,
+        from flat chunk index K (the resume point after a connection
+        death). Served as a close-delimited stream — over cp-mux/1 it
+        rides one flow-controlled stream that interleaves with live
+        decode traffic. Each leaf is device-fetched on an executor as
+        the stream reaches it; the loop never blocks on a transfer."""
+        manifest = await self._ensure_weights_manifest()
+        try:
+            start = int(req.query.get("chunk", ["0"])[0])
+        except (ValueError, IndexError):
+            return Response(422, b"chunk must be an integer\n")
+        chunk_specs = manifest["chunks"]
+        if not 0 <= start <= len(chunk_specs):
+            return Response(
+                422,
+                f"chunk must be in [0, {len(chunk_specs)}]\n".encode(),
+            )
+        from ..fleet.standby import leaf_bytes
+
+        head = self._weights_manifest_bytes
+        flat_leaves = jax.tree_util.tree_leaves(self.params)
+        loop = asyncio.get_event_loop()
+
+        async def body():
+            yield head
+            current = -1
+            data = b""
+            for spec in chunk_specs[start:]:
+                if spec["leaf"] != current:
+                    current = spec["leaf"]
+                    data = await loop.run_in_executor(
+                        None, leaf_bytes, flat_leaves[current]
+                    )
+                yield data[spec["offset"]:spec["offset"] + spec["len"]]
+
+        return StreamingResponse(
+            body(), content_type="application/octet-stream"
+        )
+
     def _instrumented(self, endpoint: str, handler):
         """Count + time every API request, under a per-request trace
         (adopting the caller's X-CP-Trace id when present); token
@@ -417,17 +566,25 @@ class InferenceServer:
             inbound_id = tracing.safe_id(
                 req.headers.get("x-cp-trace")
             ) or ""
-            if self.draining and endpoint in ("generate", "completions"):
+            if (
+                self.draining or self.role == "standby"
+            ) and endpoint in ("generate", "completions"):
                 # drain rejects NEW decode work only; reads (model,
                 # score) stay up for the last consumers of this
                 # replica, and everything already admitted runs to
-                # completion. The refusal still echoes the caller's
+                # completion. A standby refuses the same way: it is
+                # warm capacity that has not been promoted — gateways
+                # never route here, so this answers only direct
+                # probes. The refusal still echoes the caller's
                 # trace id — an answered-503 must be findable too.
                 self._m_requests.labels(endpoint, "503").inc()
                 headers = {"Retry-After": "1"}
                 if inbound_id:
                     headers[tracing.TRACE_HEADER] = inbound_id
-                return Response(503, b"draining\n", headers=headers)
+                body = (
+                    b"draining\n" if self.draining else b"standby\n"
+                )
+                return Response(503, body, headers=headers)
             trace = self._tracer.start(inbound_id or None, endpoint)
             trace.stream_id = tracing.current_stream_id()
             token = tracing.activate(trace)
@@ -1230,12 +1387,44 @@ class InferenceServer:
             self.ledger.clear_override()
         self.draining = False
 
+    def _warmup_fingerprint(self) -> str:
+        """The warm-bucket marker key: everything that shapes this
+        server's warmup program set (modelcfg.warmup_fingerprint)."""
+        from .modelcfg import warmup_fingerprint
+
+        engine = self.slot_engine
+        return warmup_fingerprint(
+            self.cfg, self.max_len,
+            slots=getattr(engine, "slots", 0) if engine else 0,
+            slot_chunk=getattr(engine, "chunk", 0) if engine else 0,
+            draft_layers=(
+                self.draft_cfg.n_layers
+                if self.draft_cfg is not None else 0
+            ),
+            speculate=self.speculate,
+        )
+
+    def compile_cache_note(self) -> str:
+        """The ``cc=`` heartbeat field: this replica's compile-cache
+        dir + warm-marker digest, so same-host launches adopt the dir
+        and skip warm buckets. Computed ONCE at warmup end (the
+        marker only changes there) and cached — a heartbeat must
+        never pay marker file I/O on the serving loop. Empty without
+        a cache dir — fleets not sharing a cache pay zero note
+        bytes."""
+        return self._compile_cache_note
+
     async def warmup(self) -> None:
         """Compile the default-shaped programs before reporting healthy.
 
         Requests with other prompt lengths still compile on first use
         (shapes are static); the bucketed max_new keeps that churn
-        bounded."""
+        bounded. With a shared compile cache dir configured, buckets
+        a previous same-shaped process already marked warm are
+        SKIPPED — the XLA disk cache holds their executables, so the
+        first live request pays a fast cache load instead of a
+        compile, and this launch's ``compile_warmup`` seconds
+        collapse to near zero (the cold-start-collapse lever)."""
         from ..models.decode import generate
 
         # ledger: everything from here until ready flips — XLA
@@ -1245,11 +1434,29 @@ class InferenceServer:
         # BEFORE /health goes 200: the very first scrape of a
         # scale-up replica already shows its compile badput.
         self.ledger.set_override("compile_warmup")
+        # chaos seam: an injected slow boot parks HERE, inside the
+        # compile_warmup attribution window — the fault the standby
+        # pool exists to mask
+        if self.chaos_hook is not None:
+            await self.chaos_hook("warmup")
+        loop = asyncio.get_event_loop()
+        fingerprint = ""
+        warm: set = set()
+        if self.compile_cache_dir:
+            from .modelcfg import load_warm_buckets
+
+            fingerprint = self._warmup_fingerprint()
+            warm = await loop.run_in_executor(
+                None, load_warm_buckets,
+                self.compile_cache_dir, fingerprint,
+            )
 
         def run() -> None:
             for prompt_len in (4, 16):
                 if prompt_len + 16 > self.max_len:
                     continue
+                if f"p{prompt_len}" in warm:
+                    continue  # a same-shape process already compiled it
                 prompt = jnp.zeros((1, prompt_len), jnp.int32)
                 generate(
                     self.params, prompt, self.cfg, max_new_tokens=16,
@@ -1266,8 +1473,8 @@ class InferenceServer:
                         self.draft_cfg, self.speculate, self.max_len,
                     )
 
-        await asyncio.get_event_loop().run_in_executor(self._executor, run)
-        if self.slot_engine is not None:
+        await loop.run_in_executor(self._executor, run)
+        if self.slot_engine is not None and "slots" not in warm:
             # one dummy request through the engine compiles its whole
             # program set (standalone prefill, first-sample, insert,
             # and the (S, K) chunk) so the first live request doesn't
@@ -1277,13 +1484,36 @@ class InferenceServer:
                 max_new=self.slot_engine.chunk + 1,
             )
             await asyncio.wrap_future(fut)
+        if self.compile_cache_dir:
+            from .modelcfg import (
+                compile_cache_note,
+                mark_warm_buckets,
+            )
+
+            buckets = {"p4", "p16"}
+            if self.slot_engine is not None:
+                buckets.add("slots")
+            await loop.run_in_executor(
+                None, mark_warm_buckets,
+                self.compile_cache_dir, fingerprint, buckets,
+            )
+            # the advertisement heartbeats will carry from now on —
+            # digested off the marker just written, off-loop, once
+            self._compile_cache_note = await loop.run_in_executor(
+                None, compile_cache_note, self.compile_cache_dir
+            )
         # warmup attribution closes here, and the serving clock opens
         # in ``idle`` — both before ready flips, so no wall-second
         # between "compiled" and "first scrape" is misattributed
         self.ledger.clear_override()
         self.ledger.enter("idle")
         self.ready = True
-        log.info("serve: default shapes warm; accepting traffic")
+        log.info(
+            "serve: default shapes warm%s; %s",
+            " (marker-skipped)" if warm else "",
+            "standing by" if self.role == "standby"
+            else "accepting traffic",
+        )
 
     async def run(self) -> None:
         await self._server.start_tcp(self.host, self.port)

@@ -177,6 +177,32 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="instance id in the catalog (default: "
         "<service>-<random>)",
     )
+    # cold-start collapse knobs (fleet/standby.py, docs/60): boot as
+    # promotable warm capacity, fetch weights from a warm peer, and
+    # adopt a same-host peer's XLA compile cache
+    parser.add_argument(
+        "--standby", action="store_true",
+        help="boot as a warm STANDBY: load weights, warmup-compile, "
+        "register under role=standby (heartbeating, never routed "
+        "to); POST /v3/standby/promote flips it active in "
+        "milliseconds — the autoscaler's fast scale-up path",
+    )
+    parser.add_argument(
+        "--weights-from", default="",
+        help="fetch model weights from an already-warm peer replica "
+        "(host:port) over cp-mux/1 instead of reading a checkpoint "
+        "— digest-verified chunks with one resume redial; ANY "
+        "failure falls back to the normal --checkpoint-dir/init "
+        "load",
+    )
+    parser.add_argument(
+        "--adopt-compile-cache", default=True,
+        action=argparse.BooleanOptionalAction,
+        help="when joining a fleet without "
+        "CONTAINERPILOT_COMPILE_CACHE set, adopt a same-host peer's "
+        "advertised compile-cache dir (its cc= heartbeat field) so "
+        "this launch skips already-compiled warmup buckets",
+    )
     return parser
 
 
@@ -290,7 +316,10 @@ def load_model(args: argparse.Namespace):
 def main() -> int:
     import logging
 
-    from .modelcfg import enable_compile_cache
+    from .modelcfg import (
+        adopt_fleet_compile_cache,
+        enable_compile_cache,
+    )
     from .serve import InferenceServer
 
     # the server's operational lines (listening, warm/accepting
@@ -300,10 +329,69 @@ def main() -> int:
         level=logging.INFO,
         format="%(asctime)s %(name)s %(message)s",
     )
-    enable_compile_cache()
     args = build_arg_parser().parse_args()
+    backend = None
+    if getattr(args, "fleet_catalog", ""):
+        from ..discovery.factory import new_backend
+
+        backend = new_backend(args.fleet_catalog)
+        if backend is None:
+            raise SystemExit(
+                "--fleet-catalog resolved to no discovery backend"
+            )
+    # compile cache: the env knob first; failing that, adopt a
+    # same-host fleet peer's advertised dir (cc= heartbeat field) so
+    # this launch re-warms from its compiled executables — BEFORE
+    # model load, so every compile this process does lands in it
+    cache_dir = enable_compile_cache()
+    if (
+        cache_dir is None and backend is not None
+        and getattr(args, "adopt_compile_cache", True)
+    ):
+        cache_dir = adopt_fleet_compile_cache(
+            backend, args.fleet_service
+        )
+        if cache_dir:
+            print(f"adopted fleet compile cache {cache_dir}")
+    # peer weight transfer (fleet/standby.py): fetch the params from
+    # a warm peer over cp-mux/1 — digest-verified, one resume redial
+    # — INSTEAD of paying the checkpoint restore; the init-only tree
+    # (same shapes/shardings/transforms, cheap) is the template the
+    # fetch lands on. Fallback chain: peer -> checkpoint -> init —
+    # a failed transfer re-runs the full disk load, so the fast path
+    # is never a new way to fail a boot.
+    weights_from = getattr(args, "weights_from", "")
+    checkpoint_dir = args.checkpoint_dir
+    if weights_from:
+        host, _, port_s = weights_from.rpartition(":")
+        if not port_s.isdigit():
+            raise SystemExit(
+                f"--weights-from wants host:port, got {weights_from!r}"
+            )
+        args.checkpoint_dir = ""  # skip the restore the peer replaces
     cfg, params, mesh = load_model(args)
     cp = getattr(args, "cp", 1) or 1
+    if weights_from:
+        from ..fleet.standby import fetch_params
+
+        fetched = asyncio.run(
+            fetch_params(host or "127.0.0.1", int(port_s), params)
+        )
+        if fetched is not None:
+            params = fetched
+            print(f"weights fetched from peer {weights_from}")
+        elif checkpoint_dir:
+            print(
+                "peer weight transfer failed; falling back to the "
+                "checkpoint restore"
+            )
+            args.checkpoint_dir = checkpoint_dir
+            cfg, params, mesh = load_model(args)
+        else:
+            print(
+                "peer weight transfer failed; serving freshly "
+                "initialized weights"
+            )
     # the EXACT mesh the params loaded onto: the ring and the params
     # must share one device set (and do, structurally)
     cp_mesh = mesh if cp > 1 else None
@@ -318,17 +406,13 @@ def main() -> int:
         text=args.text,
         cp_mesh=cp_mesh, cp_min_len=getattr(args, "cp_min_len", 0),
         mux=args.mux,
+        role="standby" if getattr(args, "standby", False) else "active",
+        compile_cache_dir=cache_dir or "",
     )
     member = None
-    if getattr(args, "fleet_catalog", ""):
-        from ..discovery.factory import new_backend
+    if backend is not None:
         from ..fleet import FleetMember
 
-        backend = new_backend(args.fleet_catalog)
-        if backend is None:
-            raise SystemExit(
-                "--fleet-catalog resolved to no discovery backend"
-            )
         member = FleetMember(
             server, backend, args.fleet_service,
             ttl=args.fleet_ttl, address=args.fleet_address,

@@ -578,6 +578,87 @@ def test_autoscaler_repairs_below_min_immediately(run):
     run(scenario(), timeout=30)
 
 
+class _FailingLauncher(_FakeLauncher):
+    """launch() raises ``failures`` times before succeeding — the
+    launcher-bug / replica-died-during-warmup shape."""
+
+    def __init__(self, n, failures):
+        super().__init__(n)
+        self.failures = failures
+        self.attempts = 0
+
+    async def launch(self):
+        self.attempts += 1
+        if self.failures > 0:
+            self.failures -= 1
+            raise RuntimeError("replica died during warmup")
+        return await super().launch()
+
+
+def test_autoscaler_launch_failures_backoff_then_converge(run):
+    """Three consecutive launch failures on the repair path: each is
+    counted (launch_failures), no managed-count slot leaks, attempts
+    are SPACED by the equal-jitter backoff (no per-tick storm), and
+    the fleet still converges to min the moment launches heal — no
+    thrash, exactly one successful launch."""
+
+    async def scenario():
+        launcher = _FailingLauncher(1, failures=3)
+        scaler = Autoscaler(
+            launcher,
+            lambda: FleetLoad(queue_depth=0, per_replica={}),
+            AutoscalerConfig(
+                min_replicas=2, max_replicas=4, cooldown_s=0.0,
+                launch_backoff_s=0.5, launch_backoff_cap_s=2.0,
+                jitter_seed=7,
+            ),
+        )
+        await scaler.tick(now=0.0)  # failure 1 arms the backoff
+        assert scaler.launch_failures == 1
+        assert launcher.count() == 1  # nothing leaked into managed
+        # ticks inside the backoff window never attempt a launch —
+        # the no-storm half (first delay is in [0.25, 0.5])
+        await scaler.tick(now=0.05)
+        await scaler.tick(now=0.15)
+        assert launcher.attempts == 1
+        await scaler.tick(now=1.0)   # failure 2 (backoff now 1.0)
+        assert scaler.launch_failures == 2
+        await scaler.tick(now=1.2)   # still inside [0.5, 1.0] delay
+        assert launcher.attempts == 2
+        await scaler.tick(now=3.0)   # failure 3 (backoff now 2.0)
+        assert scaler.launch_failures == 3
+        await scaler.tick(now=10.0)  # healed: repair lands
+        assert launcher.count() == 2
+        assert launcher.attempts == 4
+        assert scaler.scale_ups == 1  # failures never counted as ups
+        assert scaler.stats["launch_failures"] == 3
+        # converged: further ticks change nothing
+        await scaler.tick(now=11.0)
+        await scaler.tick(now=12.0)
+        assert launcher.count() == 2 and launcher.attempts == 4
+
+    run(scenario(), timeout=30)
+
+
+def test_autoscaler_stamps_launch_mode_from_standby_launcher(run):
+    """A launcher exposing ``last_launch`` (the StandbyLauncher) gets
+    its mode stamped into the scale log — the promoted/cold split the
+    TTFRT report is judged on."""
+
+    async def scenario():
+        launcher = _FakeLauncher(1)
+        launcher.last_launch = {"mode": "promoted", "replica": "r9"}
+        scaler = Autoscaler(
+            launcher,
+            lambda: FleetLoad(queue_depth=0, per_replica={}),
+            AutoscalerConfig(min_replicas=2, max_replicas=4),
+        )
+        await scaler.tick(now=0.0)  # repair: below min
+        assert scaler.scale_log[-1]["mode"] == "promoted"
+
+    run(scenario(), timeout=30)
+
+
 def test_autoscaler_flapping_signal_causes_no_thrash(run):
     """A signal bouncing between hot and mid-band every tick (the
     shape a flapping catalog or bursty scrape produces) never sustains

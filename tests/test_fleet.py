@@ -259,6 +259,127 @@ def test_apply_notes_updates_kv_state_tolerantly():
     assert r.digest == frozenset({0xCC}) and r.digest_version == 4
 
 
+def test_pick_excludes_standby_role():
+    """A standby-role replica is warm, catalog-visible capacity that
+    the router must NEVER choose — even when it is the least loaded —
+    until its post-promotion beat drops the role field."""
+    gw = FleetGateway(NoopBackend(), "svc")
+    gw._replicas = {
+        "a": Replica("a", "h", 1, outstanding=5),
+        "sb": Replica("sb", "h", 2, outstanding=0, role="standby"),
+    }
+    assert gw._pick().id == "a"  # idle standby loses to loaded active
+    gw._replicas["a"].role = "standby"
+    assert gw._pick() is None    # all-standby fleet routes nowhere
+    # promotion (role field absent from the next note) restores it
+    gw._apply_notes(gw._replicas["sb"], "ok occ=0.00")
+    assert gw._pick().id == "sb"
+
+
+def test_apply_notes_parses_role_and_compile_cache():
+    """role= rides every standby beat and is absent from active
+    beats (promotion flips by omission); cc= is kept raw for /fleet
+    and adoption; garbage roles default to active."""
+    gw = FleetGateway(NoopBackend(), "svc")
+    r = Replica("a", "h", 1)
+    assert r.role == "active"
+    gw._apply_notes(r, "ok occ=0.00 role=standby cc=ab12:%2Ftmp%2Fcc")
+    assert r.role == "standby"
+    assert r.compile_cache == "ab12:%2Ftmp%2Fcc"
+    # a TORN/empty note must keep the previous role: flipping a
+    # standby routable off a half-written record would route a poll
+    # interval of traffic into its 503s
+    gw._apply_notes(r, "")
+    gw._apply_notes(r, "ok")
+    assert r.role == "standby"
+    # the first post-promotion beat has no role field but DID parse
+    # (a real beat always carries occ=): active by omission
+    gw._apply_notes(r, "ok occ=0.10")
+    assert r.role == "active"
+    assert r.compile_cache == "ab12:%2Ftmp%2Fcc"  # sticky until replaced
+    gw._apply_notes(r, "ok role=gibberish")
+    assert r.role == "active"
+
+
+def test_standby_member_note_and_gateway_capacity(run, tmp_path):
+    """Live wiring: a FleetMember fronting a standby-role stub
+    advertises role=standby (and cc=) through its TTL beat; the
+    gateway's poll excludes it from admission capacity and routing
+    while listing it on /fleet — and promotion (role attr flip +
+    next beat) brings capacity and routability back."""
+    backend = FileCatalogBackend(str(tmp_path / "catalog"))
+
+    class _RoleStub(_StubReplica):
+        def __init__(self):
+            super().__init__()
+            self.role = "standby"
+
+        def compile_cache_note(self):
+            return "cc=beef:%2Ftmp%2Fcc"
+
+    async def scenario():
+        active = _StubReplica()
+        standby = _RoleStub()
+        m1 = FleetMember(
+            active, backend, "svc", ttl=5, heartbeat_interval=0.05,
+            instance_id="r-active",
+        )
+        m2 = FleetMember(
+            standby, backend, "svc", ttl=5, heartbeat_interval=0.05,
+            instance_id="r-standby",
+        )
+        await m1.start()
+        await m2.start()
+        gw = FleetGateway(
+            backend, "svc", "127.0.0.1", 0, poll_interval=0.05,
+            admission={"per_replica_inflight": 2},
+        )
+        await gw.run()
+        for _ in range(100):
+            if (
+                gw.replica_count == 2
+                and gw._replicas.get("r-standby") is not None
+                and gw._replicas["r-standby"].role == "standby"
+            ):
+                break
+            await asyncio.sleep(0.05)
+        assert gw._replicas["r-standby"].role == "standby"
+        assert gw._replicas["r-standby"].compile_cache.startswith(
+            "beef:"
+        )
+        # routing: only the active replica is ever picked
+        assert gw._pick().id == "r-active"
+        # admission capacity: 1 active x 2 inflight, standby excluded
+        assert gw._admission.capacity == 2
+        # /fleet shows the parked capacity
+        status = json.loads(
+            (await gw._fleet_status(None)).body
+        )
+        assert status["standby"] == {
+            "count": 1, "ids": ["r-standby"],
+        }
+        roles = {
+            r["id"]: r["role"] for r in status["replicas"]
+        }
+        assert roles == {
+            "r-active": "active", "r-standby": "standby",
+        }
+        # promote: flip the role; the next beat drops the field and
+        # the next poll folds the capacity in
+        standby.role = "active"
+        for _ in range(100):
+            if gw._admission.capacity == 4:
+                break
+            await asyncio.sleep(0.05)
+        assert gw._admission.capacity == 4
+        assert gw._replicas["r-standby"].role == "active"
+        await gw.stop()
+        await m1.stop()
+        await m2.stop()
+
+    run(scenario(), timeout=60)
+
+
 def test_fleet_tokens_reused_survives_replica_departure(run, tmp_path):
     """The fleet-wide tokens_reused gauge folds a departed replica's
     final advertised counter into _reuse_departed instead of
