@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all build test test-fast test-workload integration fleet-smoke trace-smoke chaos chaos-smoke bench bench-gateway bench-reuse bench-goodput bench-coldstart lint lint-baseline clean image
+.PHONY: all build test test-fast test-workload integration fleet-smoke trace-smoke chaos chaos-smoke bench bench-host bench-gateway bench-reuse bench-goodput bench-coldstart lint lint-baseline clean image
 
 all: build test
 
@@ -60,6 +60,15 @@ chaos:
 
 bench:
 	$(PYTHON) bench.py
+
+# the decode loop's host-overhead + dispatch-count story on this box:
+# legacy vs device-resident engine per-round host ms, plus the fused
+# multi-round sweep (K in {1,4,8} rounds per dispatch) with
+# dispatches/token per K; meets_target pins overhead <= 0.5x legacy
+# AND K=8 dispatches/token <= 0.3x K=1
+bench-host:
+	JAX_PLATFORMS=cpu $(PYTHON) -c "import json, bench; \
+		print(json.dumps(bench.host_overhead_bench(), indent=2))"
 
 # the gateway hop's mux-vs-pooled-vs-per-dial cost on this box, plus
 # the concurrency-per-socket probe (host-side number; the CPU backend

@@ -526,7 +526,20 @@ def host_overhead_bench(rounds: int = 40) -> dict:
     ``engine_round_min_ms`` is a round whose lookahead chunk had
     already finished — fetch + bookkeeping only, no device wait.
     ``overhead_vs_legacy`` is the headline ratio — the PR's
-    acceptance bar is <= 0.5."""
+    acceptance bar is <= 0.5.
+
+    The ``fused`` arm sweeps the device-resident multi-round window
+    (K rounds per host dispatch, ``decode_slots_window``) over
+    K in {1, 4, 8} on one long steady-state decode each: per K it
+    reports ms/round (window wall / K) and dispatches/token off the
+    live engine counters, warm-admission dispatches excluded by
+    snapshotting after the warm request. The headline is
+    ``fused_k8_vs_k1_dispatch_ratio`` — the megakernel bar is
+    <= 0.3 (steady-state dispatches/token must fall at least
+    ~3.3x when 8 rounds fuse into one dispatch), ANDed into
+    ``meets_target`` next to the legacy-vs-engine overhead bar,
+    which keeps measuring the classic one-round engine
+    (``window=1``) unchanged."""
     import os
     import statistics as stats_mod
 
@@ -656,8 +669,13 @@ def host_overhead_bench(rounds: int = 40) -> dict:
 
     # --- the shipped engine: one long greedy request, decode-only
     # round wall times from the worker loop itself (admission rounds
-    # excluded there)
-    engine = SlotEngine(cfg, params, max_len, slots=slots, chunk=chunk)
+    # excluded there). window=1 pins the CLASSIC one-dispatch-per-
+    # round loop so the legacy-vs-engine host-overhead comparison
+    # keeps measuring the same thing it always did; the fused sweep
+    # below owns the multi-round story.
+    engine = SlotEngine(
+        cfg, params, max_len, slots=slots, chunk=chunk, window=1
+    )
     try:
         # warm the prefill/admit programs so compile never lands in a
         # timed round
@@ -675,6 +693,48 @@ def host_overhead_bench(rounds: int = 40) -> dict:
         eng_tokens = engine.tokens_out
     finally:
         engine.stop()
+
+    # --- fused-rounds sweep: K decode rounds per host dispatch via
+    # the device-side window loop; dispatches/token is the headline
+    # (ms/round rides along as context). Counters snapshot after the
+    # warm request so admissions don't blur the steady-state ratio.
+    fused: dict = {}
+    for k_rounds in (1, 4, 8):
+        eng_k = SlotEngine(
+            cfg, params, max_len, slots=slots, chunk=chunk,
+            window=k_rounds,
+        )
+        try:
+            eng_k.submit([1] * prompt_len, max_new=2).result(
+                timeout=600
+            )
+            base_d, base_t = eng_k.dispatches, eng_k.tokens_out
+            eng_k.submit(
+                [1] * prompt_len, max_new=rounds * chunk
+            ).result(timeout=600)
+            d = eng_k.dispatches - base_d
+            t = eng_k.tokens_out - base_t
+            window_times = eng_k.round_times_ms()[-rounds:]
+            fused[f"k{k_rounds}"] = {
+                "dispatches": d,
+                "tokens_out": t,
+                "dispatches_per_token": round(d / max(1, t), 4),
+                # a steady-state window runs all K rounds; the tail
+                # window may early-exit, so this slightly overstates
+                # ms/round — fine for a trajectory number
+                "round_ms": round(
+                    stats_mod.median(window_times) / k_rounds, 3
+                ),
+                "window_ms": round(
+                    stats_mod.median(window_times), 3
+                ),
+            }
+        finally:
+            eng_k.stop()
+    fused_ratio = (
+        fused["k8"]["dispatches_per_token"]
+        / max(fused["k1"]["dispatches_per_token"], 1e-9)
+    )
 
     device_ms = stats_mod.median(dev_times) * 1e3
     legacy_ms = stats_mod.median(legacy_times) * 1e3
@@ -712,10 +772,18 @@ def host_overhead_bench(rounds: int = 40) -> dict:
         "overhead_vs_legacy": round(
             engine_over / max(legacy_over, 1e-9), 3
         ),
+        # the device-resident multi-round sweep: K rounds fused into
+        # one dispatch, dispatches/token falling ~K-fold
+        "fused": fused,
+        "fused_k8_vs_k1_dispatch_ratio": round(fused_ratio, 3),
+        "fused_target_ratio": 0.3,
         # the PR's stated bar: the device-resident-state + lookahead
         # loop must at least halve per-round host overhead
         "target_ratio": 0.5,
-        "meets_target": engine_over <= 0.5 * legacy_over,
+        "meets_target": (
+            engine_over <= 0.5 * legacy_over
+            and fused_ratio <= 0.3
+        ),
     }
 
 
@@ -1051,16 +1119,20 @@ def goodput_ledger_bench(requests: int = 6, max_new: int = 96) -> dict:
       drain override, HTTP read path) kept it closed — the
       every-device-second-attributed acceptance bar is 2%.
     - ``dispatches_per_token``: the megakernel yardstick off the
-      live engine counters — chunked decode must land well under one
-      host dispatch per token (chunk=8 with lookahead measures
-      ~0.15-0.45 depending on admission mix).
+      live engine counters — fused multi-round decode (the default
+      window=4 engine) must land well under the old one-dispatch-
+      per-chunk floor (chunk=8 x window=4 measures ~0.04-0.1
+      depending on admission mix; the pre-fusion loop sat at
+      ~0.15-0.45).
     - stage sanity: compile_warmup seconds exist (stamped BEFORE
       /health flipped 200), idle covers the injected gap, drain
       covers the maintenance window, prefill+decode > 0.
 
     ``meets_target`` pins accounting_error_fraction <= 0.02 AND
-    dispatches_per_token <= 0.5 — the badput trajectory bar
-    release-over-release (``make bench-goodput``)."""
+    dispatches_per_token <= 0.2 (tightened from 0.5 when the fused
+    window landed: the dispatch tax is the thing the megakernel work
+    collapses, and the bar must fall with it) — the badput
+    trajectory bar release-over-release (``make bench-goodput``)."""
     import asyncio
     import http.client
     import os
@@ -1159,13 +1231,13 @@ def goodput_ledger_bench(requests: int = 6, max_new: int = 96) -> dict:
     asyncio.run(scenario())
     out["target"] = (
         "accounting_error_fraction <= 0.02 and "
-        "dispatches_per_token <= 0.5 and every lifecycle stage "
+        "dispatches_per_token <= 0.2 and every lifecycle stage "
         "(compile_warmup, idle, drain, prefill+decode) attributed"
     )
     out["meets_target"] = bool(
         out["accounting_error_fraction"] <= 0.02
         and out["dispatches_per_token"] is not None
-        and out["dispatches_per_token"] <= 0.5
+        and out["dispatches_per_token"] <= 0.2
         and out["compile_warmup_s"] > 0.0
         and out["drain_s"] > 0.0
         and out["stages_s"]["idle"] >= 0.5

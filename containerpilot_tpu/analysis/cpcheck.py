@@ -360,7 +360,8 @@ class DonateRule(Rule):
     bindings discovered in the module, plus this repo's known donating
     entry points (models/slots.py): ``insert_row``,
     ``admit_slot_state`` and ``retire_slot`` donate argument 0,
-    ``decode_slots_chunk`` donates arguments 1 and 2. A donated operand is cleared by being a
+    ``decode_slots_chunk`` and ``decode_slots_window`` donate
+    arguments 1 and 2. A donated operand is cleared by being a
     target of the same call's assignment (``state = step(state, x)``);
     any later *read* of a still-donated name in the same function body
     is flagged, any later rebind heals it.
@@ -373,6 +374,7 @@ class DonateRule(Rule):
         "admit_slot_state": (0,),
         "retire_slot": (0,),
         "decode_slots_chunk": (1, 2),
+        "decode_slots_window": (1, 2),
     }
 
     JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
@@ -960,7 +962,8 @@ class RetraceRule(Rule):
     expected milliseconds (the exact trap the chaos warmup had to
     pre-compile its way around). Inside ``# cpcheck: hotpath``
     regions, calls to locally-bound ``jax.jit``/``pjit`` objects —
-    and direct ``lax.scan`` calls — are checked: any argument whose
+    and direct ``lax.scan``/``lax.while_loop`` calls (the fused
+    decode window's shape) — are checked: any argument whose
     expression tree contains ``len(...)``, an f-string
     (``JoinedStr``), or a subscript with a non-constant key is
     flagged. Pad/bucket the value (the warmup's bucket set exists for
@@ -970,7 +973,15 @@ class RetraceRule(Rule):
     rule_id = "CP-RETRACE"
 
     JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
-    SCAN_NAMES = {"lax.scan", "jax.lax.scan"}
+    # direct structured-control-flow entry points: a lax.scan OR a
+    # lax.while_loop step program called with Python-varying operands
+    # retraces the same way a jit-bound callable does (the fused
+    # decode window is a while_loop — its rounds/chunk/slots must be
+    # padded/bucketed, never derived from request state)
+    SCAN_NAMES = {
+        "lax.scan", "jax.lax.scan",
+        "lax.while_loop", "jax.lax.while_loop",
+    }
     VARYING_CALLS = {"len"}
 
     def _jit_bound(self, ctx: ModuleContext) -> Set[str]:

@@ -214,3 +214,59 @@ def fused_mlp(
     act = (jax.nn.silu(gate) * up).astype(cfg.dtype)
     down = _fused_proj(act, layer_params, "w_down").reshape(b, s, d)
     return x + down
+
+
+# ---------------------------------------------------------------------------
+# step-program face: int8 weights under the slot engine
+# ---------------------------------------------------------------------------
+
+# Defined lazily (PEP 562 module __getattr__): transformer.py imports
+# this module at its top, and the step-program base lives in
+# stepprog.py which imports transformer — an eager subclass here
+# would close that cycle against a half-initialized module.
+_QUANTIZED_PROGRAM = None
+
+
+def _quantized_program_class():
+    global _QUANTIZED_PROGRAM
+    if _QUANTIZED_PROGRAM is not None:
+        return _QUANTIZED_PROGRAM
+    from .stepprog import PlainStepProgram
+
+    class QuantizedStepProgram(PlainStepProgram):
+        """Weight-only-int8 step program for the slot engine
+        (models/stepprog.py's protocol): the SAME chunk and
+        fused-window device programs as the plain transformer — the
+        forward dequantizes one layer at a time inside its scan body
+        (``maybe_dequant_layer``) or runs the fused int8 GEMMs
+        (``can_fuse_int8``), so quantized weights compose with
+        slots/prefix-cache/kvtier/pod parity structurally rather than
+        by accident. This class makes the composition EXPLICIT: it
+        validates the params really are quantized at construction (a
+        mis-wired full-precision pytree fails loudly at startup, not
+        as 4x the expected HBM at first decode) and is what
+        ``make_step_program`` returns for an int8 pytree. Everything
+        else is PlainStepProgram — deliberately: one decode
+        implementation, two weight layouts."""
+
+        def __init__(self, cfg, params, max_len, slots, chunk,
+                     rounds=1, out_sharding=None):
+            if not is_quantized(params):
+                raise ValueError(
+                    "QuantizedStepProgram needs "
+                    "quantize_model_params output (no *_q leaves "
+                    "found)"
+                )
+            super().__init__(
+                cfg, params, max_len, slots, chunk,
+                rounds=rounds, out_sharding=out_sharding,
+            )
+
+    _QUANTIZED_PROGRAM = QuantizedStepProgram
+    return _QUANTIZED_PROGRAM
+
+
+def __getattr__(name: str):
+    if name == "QuantizedStepProgram":
+        return _quantized_program_class()
+    raise AttributeError(name)

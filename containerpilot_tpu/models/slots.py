@@ -10,7 +10,10 @@ the decode step is the single-row ``decode_step`` vmapped over the
 slot axis (XLA still batches the matmuls — weights stream from HBM
 once per step for all slots), and admission/harvest happen between
 fixed-size chunks on the host. All shapes are static: one compiled
-chunk program per (config, S, K), no recompiles as traffic changes.
+chunk program per (config, S, chunk), plus one fused-window program
+per (config, S, chunk, K) that loops K chunk-rounds on device with
+early exit (``decode_slots_window``) so the host pays one dispatch
+per K rounds — no recompiles as traffic changes.
 
 Sampling reproduces ``generate``'s schedule exactly: per-row key =
 ``jax.random.split(PRNGKey(seed), 1)[0]``, sample i uses
@@ -242,6 +245,56 @@ def insert_row(pool: Cache, row: Cache, slot: int,
     )
 
 
+def _vstep(cfg: TransformerConfig):
+    """The single-row decode step vmapped over the slot axis — the
+    shared device kernel of the chunk AND fused-window programs."""
+    return jax.vmap(
+        lambda params, cache, token: decode_step(
+            params, cache, token, cfg
+        ),
+        in_axes=(None, 0, 0),
+    )
+
+
+def _round_step_body(params, state, vstep):
+    """The ONE per-token step body (scan shape) shared by the chunk
+    program and the fused K-round window program: both trace exactly
+    this function, so a fused window is the same computation as K
+    sequential chunk rounds token for token — the byte-parity
+    contract between them holds by construction, not by numerical
+    luck. Carry: (pool, last_token, done, step_idx, counts)."""
+    row_keys = state["keys"]
+    pad_id = state["pad_id"]
+    eos_id = state["eos_id"]
+
+    def body(carry, _):
+        pool, tok, done, idx, counts = carry
+        logits, pool = vstep(params, pool, tok[:, None])  # [S,1,V]
+        keys = jax.vmap(jax.random.fold_in)(row_keys, idx)
+        masked = apply_token_penalties(
+            logits[:, 0, :], counts, state["presence"],
+            state["frequency"],
+        )
+        # always-on operand (the pool program is ONE compile):
+        # idx -1 rows add exactly zero, bitwise-neutral
+        masked = apply_logit_bias(
+            masked, state["bias_idx"], state["bias_val"]
+        )
+        masked = mask_eos_before_min(
+            masked, idx, state["min_new"], eos_id
+        )
+        nxt = sample_logits(
+            masked, keys, state["temperature"], state["top_k"],
+            state["top_p"],
+        ).astype(jnp.int32)
+        nxt = jnp.where(done, pad_id, nxt)
+        done = done | (nxt == eos_id)
+        counts = count_token(counts, nxt, ~done)
+        return (pool, nxt, done, idx + 1, counts), nxt
+
+    return body
+
+
 @functools.lru_cache(maxsize=8)
 def _jitted_chunk(cfg: TransformerConfig, slots: int, chunk: int,
                   out_sharding=None):
@@ -256,44 +309,11 @@ def _jitted_chunk(cfg: TransformerConfig, slots: int, chunk: int,
     torn-step-index hazard cannot recur); the untouched knob leaves
     alias straight through the donation.
     """
-    vstep = jax.vmap(
-        lambda params, cache, token: decode_step(
-            params, cache, token, cfg
-        ),
-        in_axes=(None, 0, 0),
-    )
+    vstep = _vstep(cfg)
 
     def run(params, pool, state):
-        row_keys = state["keys"]
-        pad_id = state["pad_id"]
-        eos_id = state["eos_id"]
-
-        def body(carry, _):
-            pool, tok, done, idx, counts = carry
-            logits, pool = vstep(params, pool, tok[:, None])  # [S,1,V]
-            keys = jax.vmap(jax.random.fold_in)(row_keys, idx)
-            masked = apply_token_penalties(
-                logits[:, 0, :], counts, state["presence"],
-                state["frequency"],
-            )
-            # always-on operand (the pool program is ONE compile):
-            # idx -1 rows add exactly zero, bitwise-neutral
-            masked = apply_logit_bias(
-                masked, state["bias_idx"], state["bias_val"]
-            )
-            masked = mask_eos_before_min(
-                masked, idx, state["min_new"], eos_id
-            )
-            nxt = sample_logits(
-                masked, keys, state["temperature"], state["top_k"],
-                state["top_p"],
-            ).astype(jnp.int32)
-            nxt = jnp.where(done, pad_id, nxt)
-            done = done | (nxt == eos_id)
-            counts = count_token(counts, nxt, ~done)
-            return (pool, nxt, done, idx + 1, counts), nxt
-
-        (pool, last, done, _, counts), toks = lax.scan(
+        body = _round_step_body(params, state, vstep)
+        (pool, last, done, idx, counts), toks = lax.scan(
             body,
             (pool, state["last"], state["done"], state["step_idx"],
              state["counts"]),
@@ -301,9 +321,70 @@ def _jitted_chunk(cfg: TransformerConfig, slots: int, chunk: int,
         )
         new_state = dict(
             state, last=last, done=done, counts=counts,
-            step_idx=state["step_idx"] + chunk,
+            step_idx=idx,
         )
         return pool, new_state, toks.T  # [S, chunk]
+
+    return jax.jit(
+        run, donate_argnums=(1, 2), out_shardings=out_sharding
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_window(cfg: TransformerConfig, slots: int, chunk: int,
+                   rounds: int, out_sharding=None):
+    """K = ``rounds`` chunk-rounds fused into ONE dispatched program:
+    a device-side ``lax.while_loop`` whose body is the exact per-step
+    scan ``_jitted_chunk`` runs (``_round_step_body``), so the tokens
+    a window emits are byte-identical to K sequential chunk
+    dispatches. The loop exits EARLY when no slot is live — a slot is
+    live while its device ``done`` flag is clear AND it still has
+    window budget (``budget`` [S] int32, the host's remaining
+    max_new allowance per slot). Budget gates ONLY the exit test,
+    never the emission: a slot past its budget keeps decoding real
+    (append-discarded) tokens exactly like the sequential engine
+    whose host hadn't retired it yet, preserving bit-equality of
+    the shared rounds.
+
+    Returns (pool, state, tokens [S, rounds*chunk], rounds_run):
+    rounds not executed leave their token columns at the slot's
+    pad_id, and the state advances by exactly rounds_run chunks.
+    Pool and state are donated like the chunk program's."""
+    vstep = _vstep(cfg)
+
+    def run(params, pool, state, budget):
+        body = _round_step_body(params, state, vstep)
+        pad = state["pad_id"].astype(jnp.int32)
+        out0 = jnp.broadcast_to(
+            pad[:, None], (slots, rounds * chunk)
+        )
+
+        def cond(carry):
+            r, _pool, _last, done, _idx, _counts, _out = carry
+            return (r < rounds) & jnp.any(
+                ~done & (r * chunk < budget)
+            )
+
+        def round_body(carry):
+            r, pool, last, done, idx, counts, out = carry
+            (pool, last, done, idx, counts), toks = lax.scan(
+                body, (pool, last, done, idx, counts),
+                None, length=chunk,
+            )
+            out = lax.dynamic_update_slice(
+                out, toks.T, (0, r * chunk)
+            )
+            return (r + 1, pool, last, done, idx, counts, out)
+
+        r, pool, last, done, idx, counts, out = lax.while_loop(
+            cond, round_body,
+            (jnp.int32(0), pool, state["last"], state["done"],
+             state["step_idx"], state["counts"], out0),
+        )
+        new_state = dict(
+            state, last=last, done=done, counts=counts, step_idx=idx,
+        )
+        return pool, new_state, out, r
 
     return jax.jit(
         run, donate_argnums=(1, 2), out_shardings=out_sharding
@@ -330,6 +411,31 @@ def decode_slots_chunk(
     slots = int(state["last"].shape[0])
     return _jitted_chunk(cfg, slots, chunk, out_sharding)(
         params, pool, state
+    )
+
+
+def decode_slots_window(
+    params: Params,
+    pool: Cache,
+    state: dict,
+    cfg: TransformerConfig,
+    chunk: int,
+    rounds: int,
+    budget,
+    out_sharding=None,
+):
+    """Advance the whole pool up to ``rounds`` chunk-rounds in ONE
+    host->device dispatch (see _jitted_window): the device loops over
+    the same per-step body the chunk program runs and exits early
+    once every slot is done or out of ``budget`` (a [S] int32 of
+    remaining-token allowances — the one small host->device upload a
+    window pays, per K rounds instead of per round). Returns
+    (pool, state, tokens [S, rounds*chunk], rounds_run); pool and
+    state are donated, ``out_sharding`` pins output placement exactly
+    like decode_slots_chunk's."""
+    slots = int(state["last"].shape[0])
+    return _jitted_window(cfg, slots, chunk, rounds, out_sharding)(
+        params, pool, state, jnp.asarray(budget, jnp.int32)
     )
 
 

@@ -93,6 +93,53 @@ def _jit_verify_round(cfg: TransformerConfig, m: int):
     return jax.jit(fn)
 
 
+def _clamp_k(speculate: int, remaining: int, max_len: int,
+             pos: int) -> int:
+    """The ONE per-round k clamp: proposals are bounded by the
+    requested budget (``remaining`` tokens still wanted) and the
+    cache horizon — the verify chunk writes k+1 rows at pos..pos+k,
+    so k <= max_len - pos - 1. Shared by the standalone loop and the
+    step program so their round geometry cannot drift."""
+    return min(speculate, remaining, max_len - pos - 1)
+
+
+def _dispatch_round(params, draft_params, cfg: TransformerConfig,
+                    draft_cfg: TransformerConfig, cache: Cache,
+                    dcache: Cache, prev, k: int):
+    """The device half of one draft/verify round (two dispatches, no
+    host sync): k greedy draft proposals from ``prev``, then the
+    target's verify chunk over [prev, d_1..d_k]. Returns
+    (drafts [k], target_choice [k+1], cache, dcache)."""
+    drafts, dcache = _jit_draft_round(draft_cfg, k)(
+        draft_params, dcache, prev
+    )
+    chunk = jnp.concatenate([prev, drafts])[None, :]  # [1, k+1]
+    target_choice, cache = _jit_verify_round(cfg, k + 1)(
+        params, cache, chunk
+    )
+    return drafts, target_choice, cache, dcache
+
+
+def _accept_round(drafts_h, target_h, k: int) -> list:
+    """The host half: greedy acceptance over the fetched proposals —
+    the accepted prefix plus one target-chosen token (the correction
+    at the first mismatch, or the bonus after a full accept)."""
+    n_acc = 0
+    while n_acc < k and int(drafts_h[n_acc]) == int(target_h[n_acc]):
+        n_acc += 1
+    emitted = [int(t) for t in drafts_h[:n_acc]]
+    emitted.append(int(target_h[n_acc]))
+    return emitted
+
+
+def _rewind_caches(cache: Cache, dcache: Cache, pos: int):
+    """Roll both caches back to the accepted frontier: the last
+    emitted token is NOT processed yet — it is next round's prev.
+    Stale rows beyond pos get overwritten by design."""
+    p = jnp.asarray(pos, jnp.int32)
+    return {**cache, "pos": p}, {**dcache, "pos": p}
+
+
 def speculative_generate(
     params: Params,
     draft_params: Params,
@@ -154,37 +201,27 @@ def speculative_generate(
     ):
         # the verify chunk [prev, d_1..d_k] writes k+1 cache rows at
         # pos..pos+k (the draft's k+1 steps write the same rows), so
-        # the round needs pos + k + 1 <= max_len
-        k = min(speculate, max_new_tokens - len(out), max_len - pos - 1)
+        # the round needs pos + k + 1 <= max_len (_clamp_k)
+        k = _clamp_k(speculate, max_new_tokens - len(out), max_len,
+                     pos)
         # invariant: pos == prompt_len + len(out) - 1 and
         # prompt_len + max_new_tokens <= max_len, so k >= 1 here
         assert k >= 1, (pos, len(out))
-        drafts, dcache = _jit_draft_round(draft_cfg, k)(
-            draft_params, dcache, prev
-        )
-        chunk = jnp.concatenate([prev, drafts])[None, :]  # [1, k+1]
-        target_choice, cache = _jit_verify_round(cfg, k + 1)(
-            params, cache, chunk
+        drafts, target_choice, cache, dcache = _dispatch_round(
+            params, draft_params, cfg, draft_cfg, cache, dcache,
+            prev, k,
         )
         drafts_h = jax.device_get(drafts)
         target_h = jax.device_get(target_choice)  # [k+1]
-        n_acc = 0
-        while n_acc < k and int(drafts_h[n_acc]) == int(target_h[n_acc]):
-            n_acc += 1
-        # accepted prefix + one target-chosen token: the correction at
-        # the first mismatch, or the bonus token after a full accept
-        emitted = [int(t) for t in drafts_h[:n_acc]] + [int(target_h[n_acc])]
+        emitted = _accept_round(drafts_h, target_h, k)
         out.extend(emitted)
         rounds += 1
-        accepted_total += n_acc
-        # roll back both caches to the accepted frontier: the last
-        # emitted token is NOT processed yet — it is next round's prev.
-        # Both models hold rows pos..pos+k, and len(emitted) <= k+1, so
-        # the new frontier never exceeds what each cache actually holds
-        # (stale rows beyond it get overwritten).
+        accepted_total += len(emitted) - 1
+        # both models hold rows pos..pos+k and len(emitted) <= k+1,
+        # so the rewound frontier never exceeds what each cache
+        # actually holds (_rewind_caches)
         pos += len(emitted)
-        cache = {**cache, "pos": jnp.asarray(pos, jnp.int32)}
-        dcache = {**dcache, "pos": jnp.asarray(pos, jnp.int32)}
+        cache, dcache = _rewind_caches(cache, dcache, pos)
         prev = jnp.asarray([emitted[-1]], jnp.int32)
         if eos_id >= 0 and eos_id in emitted:
             # done: everything past the first eos is trim fodder —
@@ -248,3 +285,131 @@ def warm_speculative(
         _jit_verify_round(cfg, k + 1)(
             params, tcache, jnp.zeros((1, k + 1), jnp.int32)
         )
+
+
+# ---------------------------------------------------------------------------
+# step-program face: draft/verify rounds under the slot engine
+# ---------------------------------------------------------------------------
+
+
+class SpeculativeStepProgram:
+    """Speculative decoding as a slot-engine step program
+    (models/stepprog.py's protocol), replacing the legacy one-shot
+    ``serve_strategies.run_speculative`` path: the engine owns
+    admission/queueing/streaming/cancel/tracing, this program owns
+    the draft/verify round — and multi-token emission per dispatch
+    comes for free through the protocol's ``valid`` counts.
+
+    Shape discipline matches ``speculative_generate`` exactly: batch
+    1 (``slots`` must be 1 — the verify rollback is a per-sequence
+    pos rewind, not a per-slot mask), greedy only (the engine routes
+    only temperature<=0, penalty-free, bias-free requests here), one
+    draft round + one verify chunk per dispatch (``dispatch_cost``
+    2), k clamped per round by the remaining budget and the cache
+    horizon so every emitted token is byte-identical to
+    ``speculative_generate`` — and therefore to plain greedy decode —
+    on the same prompt.
+
+    ``supports_lookahead`` is False: round N+1's draft starts from
+    round N's accepted frontier, a host-side decision, so the engine
+    serializes dispatch->fetch per round exactly like the standalone
+    loop (whose per-round host trip is the same cadence a vanilla
+    decode pays)."""
+
+    supports_lookahead = False
+    dispatch_cost = 2  # one draft scan + one verify chunk
+    rounds = 1
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        draft_cfg: TransformerConfig,
+        params: Params,
+        draft_params: Params,
+        max_len: int,
+        speculate: int = 4,
+    ) -> None:
+        if speculate < 1:
+            raise ValueError("speculate must be >= 1")
+        if cfg.window > 0 or draft_cfg.window > 0:
+            raise ValueError(
+                "speculative decoding does not compose with sliding-"
+                "window attention (ring-cache writes are destructive)"
+            )
+        if cfg.vocab_size != draft_cfg.vocab_size:
+            raise ValueError("draft and target must share a vocab")
+        self.cfg = cfg
+        self.draft_cfg = draft_cfg
+        self.params = params
+        self.draft_params = draft_params
+        self.max_len = max_len
+        self.speculate = speculate
+        self.slots = 1
+        # max tokens one dispatch can emit: k accepted drafts + the
+        # target's correction/bonus token
+        self.chunk = speculate + 1
+        self.reset()
+
+    def reset(self) -> None:
+        self._cache = None
+        self._dcache = None
+        self._prev = None
+        self._pos = 0
+
+    def admit(self, slot: int, req, logits, row_cache) -> int:
+        """The engine prefilled the TARGET (``row_cache``); prefill
+        the draft here and take the target's greedy prefill argmax as
+        token 0 — ``speculative_generate``'s exact first step. The
+        greedy routing contract means first_sample would compute the
+        same argmax; using argmax directly keeps this byte-locked to
+        the standalone loop."""
+        if slot != 0:
+            raise ValueError("speculative program serves one slot")
+        prompt = jnp.asarray([req.tokens], jnp.int32)
+        _dlogits, self._dcache = prefill(
+            self.draft_params, prompt, self.draft_cfg, self.max_len
+        )
+        self._cache = row_cache
+        prev = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [1]
+        self._prev = prev
+        self._pos = len(req.tokens)
+        return int(jax.device_get(prev)[0])
+
+    def retire(self, slot: int) -> None:
+        self.reset()
+
+    # cpcheck: hotpath — one draft + one verify dispatch, no syncs
+    def dispatch(self, budgets, fused: bool):
+        # the SAME per-round geometry as speculative_generate, by
+        # shared helper (_clamp_k): budgets[0] is max_new minus
+        # tokens already emitted — the standalone loop's
+        # ``max_new_tokens - len(out)``
+        k = _clamp_k(
+            self.speculate, int(budgets[0]), self.max_len, self._pos
+        )
+        assert k >= 1, (self._pos, budgets)
+        drafts, target_choice, self._cache, self._dcache = (
+            _dispatch_round(
+                self.params, self.draft_params, self.cfg,
+                self.draft_cfg, self._cache, self._dcache,
+                self._prev, k,
+            )
+        )
+        return drafts, target_choice, k
+
+    # cpcheck: hotpath — the acceptance fetch, the round's one sync
+    def tokens(self, handle):
+        import numpy as np
+
+        drafts, target_choice, k = handle
+        drafts_h, target_h = jax.device_get((drafts, target_choice))  # cpcheck: disable=CP-HOTSYNC the per-round acceptance fetch
+        emitted = _accept_round(drafts_h, target_h, k)
+        self._pos += len(emitted)
+        self._cache, self._dcache = _rewind_caches(
+            self._cache, self._dcache, self._pos
+        )
+        self._prev = jnp.asarray([emitted[-1]], jnp.int32)
+        toks = np.zeros((1, self.chunk), np.int64)
+        toks[0, : len(emitted)] = emitted
+        valid = np.full((1,), len(emitted), np.int64)
+        return toks, valid, 1

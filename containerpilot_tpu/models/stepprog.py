@@ -1,0 +1,191 @@
+"""The step-program interface: one slot-engine driver for every
+decode strategy.
+
+The continuous-batching engine (workload/serve_slots.py) used to call
+``decode_slots_chunk`` directly, which welded it to the plain
+transformer; speculative decoding lived on a legacy one-shot path and
+quantized weights composed only by accident. A **step program** is the
+seam: it owns the device-resident decode state for a fixed pool of S
+slots and exposes five verbs with STATIC shapes per
+``(config, S, chunk, K)`` — one compiled program set, no recompiles
+as traffic changes:
+
+- ``admit(slot, req, logits, row_cache)`` — write one prefilled
+  request into ``slot`` and return its first sampled token (a host
+  int). The ENGINE computes the prefill and passes the result in:
+  prefix-cache rewind+extend, cp-ring, chunked and plain prefill stay
+  engine policy, shared identically by every program.
+- ``dispatch(budgets, fused)`` — advance every live slot by up to
+  ``rounds * chunk`` tokens (``fused=True``; one ``chunk`` otherwise)
+  in ONE logical step, returning an opaque handle. Never syncs the
+  host; ``dispatch_cost`` is the number of device dispatches one call
+  ships (1 for the fused/plain programs, 2 for draft+verify).
+  ``budgets`` is a [S] int array of remaining max_new allowances —
+  the early-exit gate, never an emission mask.
+- ``tokens(handle)`` — the round trip: fetch the handle's tokens (the
+  one deliberate host sync per window) and return
+  ``(toks [S, W], valid [S], rounds_run)`` where ``valid[i]`` bounds
+  the tokens slot i actually produced (the engine appends
+  ``toks[i, :valid[i]]`` through the shared ``append_chunk``
+  convention, so eos/max_new capping stays in one place).
+- ``retire(slot)`` — free one row (harvest or cancel); pads follow
+  until re-admission.
+- ``reset()`` — rebuild the device buffers after a failed dispatch
+  (the failure died holding the donated pool/state).
+
+``supports_lookahead`` says whether the engine may dispatch window
+N+1 before fetching window N (true when the next dispatch does not
+depend on host-side decisions about N's tokens — the plain programs;
+false for draft/verify, whose next round needs the acceptance
+result).
+
+Implementations: :class:`PlainStepProgram` (models/slots.py's chunk +
+fused-window programs), ``models.quantized.QuantizedStepProgram``
+(the same programs over int8 weights — the forward dequantizes per
+layer, so composition is structural) and
+``models.speculative.SpeculativeStepProgram`` (draft/verify rounds:
+multi-token emission per dispatch). ``make_step_program`` picks the
+right default for a params pytree.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from .slots import (
+    admit_slot_state,
+    decode_slots_chunk,
+    decode_slots_window,
+    first_sample,
+    init_slot_state,
+    insert_row,
+    retire_slot,
+    slot_cache,
+)
+from .transformer import Params, TransformerConfig
+
+
+class PlainStepProgram:
+    """The plain transformer's step program: the slot pool + the
+    device-resident sampling state, advanced by decode_slots_chunk
+    (``fused=False``) or the K-round fused window
+    (``decode_slots_window``, ``fused=True``) — one device dispatch
+    either way. ``out_sharding`` pins output placement (the pod's
+    mirror passes fully-replicated)."""
+
+    supports_lookahead = True
+    dispatch_cost = 1
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        params: Params,
+        max_len: int,
+        slots: int,
+        chunk: int,
+        rounds: int = 1,
+        out_sharding=None,
+    ) -> None:
+        if slots < 1 or chunk < 1 or rounds < 1:
+            raise ValueError("slots, chunk and rounds must be >= 1")
+        self.cfg = cfg
+        self.params = params
+        self.max_len = max_len
+        self.slots = slots
+        self.chunk = chunk
+        self.rounds = rounds
+        self.out_sharding = out_sharding
+        self.reset()
+
+    def reset(self) -> None:
+        self._pool = slot_cache(self.cfg, self.slots, self.max_len)
+        self._state = init_slot_state(self.cfg, self.slots)
+
+    def admit(self, slot: int, req, logits, row_cache) -> int:
+        """Sample token 0 with the server key convention (row 0 of
+        ``req.seed``), write the prefilled row + the whole sampling
+        state row in two dispatches, return the first token."""
+        cfg = self.cfg
+        row_key = jax.random.fold_in(
+            jax.random.PRNGKey(req.seed), 0
+        )
+        first = first_sample(
+            logits, row_key, req.temperature, req.top_k, req.top_p,
+            cfg, eos_id=req.eos_id, min_new=req.min_new,
+            bias_idx=req.bias_idx, bias_val=req.bias_val,
+        )
+        first_host = int(jax.device_get(first))
+        self._pool = insert_row(
+            self._pool, row_cache, slot, cfg, self.out_sharding
+        )
+        done = first_host == req.eos_id or req.max_new <= 1
+        self._state = admit_slot_state(
+            self._state, slot, cfg,
+            last=first, key=row_key,
+            temperature=req.temperature, top_k=req.top_k,
+            top_p=req.top_p, eos_id=req.eos_id, pad_id=req.pad_id,
+            min_new=req.min_new, presence=req.presence,
+            frequency=req.frequency, bias_idx=req.bias_idx,
+            bias_val=req.bias_val, done=done,
+            out_sharding=self.out_sharding,
+        )
+        return first_host
+
+    def retire(self, slot: int) -> None:
+        self._state = retire_slot(
+            self._state, slot, self.out_sharding
+        )
+
+    # cpcheck: hotpath — the fused window dispatch: one device call,
+    # zero host syncs (the budgets upload is async and per-window)
+    def dispatch(self, budgets, fused: bool):
+        if fused and self.rounds > 1:
+            self._pool, self._state, toks, run = decode_slots_window(
+                self.params, self._pool, self._state, self.cfg,
+                self.chunk, self.rounds, budgets, self.out_sharding,
+            )
+            return toks, run
+        self._pool, self._state, toks = decode_slots_chunk(
+            self.params, self._pool, self._state, self.cfg,
+            self.chunk, self.out_sharding,
+        )
+        return toks, None
+
+    # cpcheck: hotpath — the one deliberate sync per window
+    def tokens(self, handle):
+        toks, run = handle
+        if run is None:
+            toks_host = np.asarray(jax.device_get(toks))  # cpcheck: disable=CP-HOTSYNC the per-window token fetch
+            rounds_run = 1
+        else:
+            toks_host, run_host = jax.device_get((toks, run))  # cpcheck: disable=CP-HOTSYNC the per-window token fetch
+            rounds_run = int(run_host)
+            toks_host = toks_host[:, : rounds_run * self.chunk]
+        valid = np.full(
+            (self.slots,), rounds_run * self.chunk, np.int64
+        )
+        return toks_host, valid, rounds_run
+
+
+def make_step_program(
+    cfg: TransformerConfig,
+    params: Params,
+    max_len: int,
+    slots: int,
+    chunk: int,
+    rounds: int = 1,
+    out_sharding=None,
+):
+    """The default step program for a params pytree: quantized params
+    get the quantized program (same device programs, the composition
+    made explicit and validated), everything else the plain one."""
+    from .quantized import QuantizedStepProgram, is_quantized
+
+    kind = (
+        QuantizedStepProgram if is_quantized(params)
+        else PlainStepProgram
+    )
+    return kind(
+        cfg, params, max_len, slots, chunk,
+        rounds=rounds, out_sharding=out_sharding,
+    )

@@ -56,6 +56,7 @@ def warmup_fingerprint(
     max_len: int,
     slots: int = 0,
     slot_chunk: int = 0,
+    slot_window: int = 0,
     draft_layers: int = 0,
     speculate: int = 0,
 ) -> str:
@@ -85,6 +86,12 @@ def warmup_fingerprint(
             "max_len": max_len,
             "slots": slots,
             "slot_chunk": slot_chunk,
+            # fused decode rounds per dispatch: the (S, chunk, K)
+            # window program is part of the engine's compiled set, so
+            # K is part of the marker identity — a K=1 process's
+            # marker must never skip the fused program a K=4 launch
+            # needs
+            "slot_window": slot_window,
             "draft_layers": draft_layers,
             "speculate": speculate,
         },

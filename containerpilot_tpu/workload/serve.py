@@ -27,8 +27,9 @@ API (token-level; tokenization is the caller's concern):
 Generation runs on a worker thread so the asyncio loop (health checks
 included) never blocks on TPU execution. The serving concerns live in
 sibling modules: serve_batcher (continuous batching), serve_prefix
-(prefix KV reuse), serve_strategies (beam/speculative/chunked),
-serve_cli (flags + model loading).
+(prefix KV reuse), serve_strategies (beam/cp/chunked), serve_slots +
+models/stepprog (the step-program engine — plain, quantized, and
+speculative decode), serve_cli (flags + model loading).
 """
 from __future__ import annotations
 
@@ -100,6 +101,7 @@ class InferenceServer:
         text: bool = False,
         slots: int = 0,
         slot_chunk: int = 8,
+        slot_window: int = 4,
         cp_mesh: Any = None,
         cp_min_len: int = 0,
         mux: bool = True,
@@ -240,6 +242,8 @@ class InferenceServer:
         # running K-token chunk loop over a fixed slot pool instead of
         # queueing behind whole generations (serve_slots.py)
         self.slot_engine = None
+        if slot_window < 1:
+            raise ValueError("slot_window must be >= 1")
         if slots > 0:
             # warmup() pushes a dummy request of 4 prompt ids +
             # (chunk+1) new tokens through the engine; a legal but
@@ -253,6 +257,14 @@ class InferenceServer:
                     f"chunk+1={slot_chunk + 1} new tokens; max_len is "
                     f"{max_len})"
                 )
+            # fused K-round windows need a warmup request that rides
+            # at least one pure-decode cycle (chunk+2 new tokens); a
+            # max_len too tight for that clamps the engine back to
+            # one-round dispatches rather than leaving the fused
+            # program to compile under a live request behind a 200
+            # /health (the no-post-grace-compiles invariant)
+            if WARMUP_PROMPT_LEN + slot_chunk + 2 > max_len:
+                slot_window = 1
             from .serve_slots import SlotEngine
 
             # --cp composes: long-prompt admissions ring their
@@ -265,19 +277,45 @@ class InferenceServer:
             # admission seeds the cache) — both inside the engine
             self.slot_engine = SlotEngine(
                 cfg, params, max_len, slots=slots, chunk=slot_chunk,
+                window=slot_window,
                 cp_mesh=self.cp_mesh, cp_min_len=self.cp_min_len,
                 prefill_chunk=prefill_chunk,
                 prefix_cache=self.prefix_cache,
                 ledger=self.ledger,
             )
+        self.slot_window = slot_window
         # prompts longer than this stream through decode_chunk pieces
         # (peak prefill activations O(chunk) instead of O(prompt))
         self.prefill_chunk = prefill_chunk
+        self.spec_engine = None
         if draft_layers > 0:
-            from ..models.speculative import layer_prefix_draft
+            from ..models.speculative import (
+                SpeculativeStepProgram,
+                layer_prefix_draft,
+            )
+            from .serve_slots import SlotEngine
 
             self.draft_params, self.draft_cfg = layer_prefix_draft(
                 params, cfg, draft_layers
+            )
+            # speculative decoding rides the slot engine as a step
+            # program (models/stepprog.py) instead of the legacy
+            # one-shot serve_strategies path: the engine brings
+            # queueing/cancel/tracing and the protocol brings
+            # multi-token emission per round. One slot, batch 1 —
+            # the verify rollback is a per-sequence pos rewind.
+            # ledger=None deliberately: with a slot engine present
+            # it owns the prefill/decode stamps, and without one the
+            # handler-inflight window in _instrumented coarse-stamps
+            # every compute request (spec included) — a second
+            # stamping authority would fight either one.
+            self.spec_engine = SlotEngine(
+                cfg, params, max_len,
+                prefill_chunk=prefill_chunk,
+                program=SpeculativeStepProgram(
+                    cfg, self.draft_cfg, params, self.draft_params,
+                    max_len, speculate=speculate,
+                ),
             )
         self._executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="inference"
@@ -421,11 +459,16 @@ class InferenceServer:
 
     def _decode_counters(self):
         """(dispatches, tokens_out) for the goodput surfaces — the
-        slot engine's cumulative pair, zeros without an engine."""
-        engine = self.slot_engine
-        if engine is None:
-            return 0, 0
-        return engine.dispatches, engine.tokens_out
+        slot and speculative engines' cumulative pairs summed (each
+        engine bumps dispatches once per DEVICE dispatch: one per
+        fused window, two per draft+verify round), zeros without
+        either engine."""
+        dispatches = tokens_out = 0
+        for engine in (self.slot_engine, self.spec_engine):
+            if engine is not None:
+                dispatches += engine.dispatches
+                tokens_out += engine.tokens_out
+        return dispatches, tokens_out
 
     async def _goodput(self, _req: Request) -> Response:
         """The device-time ledger, JSON: per-stage seconds (summing
@@ -668,6 +711,11 @@ class InferenceServer:
                     {
                         "draft_layers": self.draft_cfg.n_layers,
                         "speculate": self.speculate,
+                        # draft/verify rides the step-program engine
+                        # (not the legacy one-shot path); its
+                        # dispatch/token counters fold into the
+                        # goodput pair below
+                        "engine": self.spec_engine.stats,
                     }
                     if self.draft_cfg is not None
                     else None
@@ -860,20 +908,33 @@ class InferenceServer:
                 p["length_penalty"],
             ))
         if (
-            self.draft_params is not None
+            self.spec_engine is not None
             and p["temperature"] <= 0.0
             and p["min_new"] == 0
             and not p["presence"] and not p["frequency"]
             and not p["logit_bias"]
             and len(tokens) == 1
         ):
-            # greedy single-sequence: draft-and-verify, identical
-            # output. The eos trim afterwards applies the same
-            # truncation the padded greedy path would get.
-            return await timed(trace, in_exec(
-                self._executor, serve_strategies.run_speculative, self,
-                tokens, p["max_new"], p["eos_id"],
-            ))
+            # greedy single-sequence: draft-and-verify through the
+            # speculative step program (the engine's emission is
+            # already eos-capped, and the request's exact max_new
+            # bounds it — no bucketed over-decode to trim). Output is
+            # byte-identical to speculative_generate and therefore to
+            # plain greedy decode. The engine stamps request-boundary
+            # timings the trace converts to slot_queue_wait/prefill/
+            # decode spans, same as the slot path below.
+            timings: Optional[Dict[str, float]] = (
+                {} if trace is not None else None
+            )
+            fut = self.spec_engine.submit(
+                tokens[0], p["max_new_requested"],
+                eos_id=p["eos_id"], seed=p["seed"],
+                timings=timings,
+            )
+            rows = [await asyncio.wrap_future(fut)]
+            if trace is not None:
+                tracing.add_engine_spans(trace, timings)
+            return rows
         if self.slot_engine is not None and len(tokens) == 1:
             # joins the running chunk loop at the next boundary; output
             # is already pad-trimmed at eos (the _trim downstream is
@@ -1319,9 +1380,10 @@ class InferenceServer:
         The double count while a buffered request waits on its slot
         future only makes drain-waiting conservative."""
         n = self._inflight
-        if self.slot_engine is not None:
-            stats = self.slot_engine.stats
-            n += stats["active"] + stats["queued"]
+        for engine in (self.slot_engine, self.spec_engine):
+            if engine is not None:
+                stats = engine.stats
+                n += stats["active"] + stats["queued"]
         return n
 
     @property
@@ -1397,6 +1459,13 @@ class InferenceServer:
             self.cfg, self.max_len,
             slots=getattr(engine, "slots", 0) if engine else 0,
             slot_chunk=getattr(engine, "chunk", 0) if engine else 0,
+            # the fused window K shapes the engine's compiled program
+            # set: a marker written at K=1 must never skip the fused
+            # program a K=4 launch needs (PR 13's compile-cache skip
+            # stays correct only if K is part of the identity)
+            slot_window=(
+                getattr(engine, "window", 1) if engine else 0
+            ),
             draft_layers=(
                 self.draft_cfg.n_layers
                 if self.draft_cfg is not None else 0
@@ -1477,13 +1546,33 @@ class InferenceServer:
         if self.slot_engine is not None and "slots" not in warm:
             # one dummy request through the engine compiles its whole
             # program set (standalone prefill, first-sample, insert,
-            # and the (S, K) chunk) so the first live request doesn't
-            # stall on multi-second compilation behind a 200 /health
-            fut = self.slot_engine.submit(
-                [0] * WARMUP_PROMPT_LEN,
-                max_new=self.slot_engine.chunk + 1,
+            # the (S, chunk) chunk program and — with window > 1 —
+            # the fused (S, chunk, K) window: max_new = chunk+2
+            # leaves one token past the admission round, so the
+            # second cycle dispatches fused) so the first live
+            # request doesn't stall on multi-second compilation
+            # behind a 200 /health
+            engine = self.slot_engine
+            warm_new = engine.chunk + (
+                2 if engine.window > 1 else 1
+            )
+            fut = engine.submit(
+                [0] * WARMUP_PROMPT_LEN, max_new=warm_new,
             )
             await asyncio.wrap_future(fut)
+        if self.spec_engine is not None and "spec" not in warm:
+            # same discipline for the speculative engine: one dummy
+            # generation compiles its admission glue (the per-k
+            # draft/verify variants compiled in warm_speculative
+            # above, inside the same grace)
+            spec_new = min(
+                self.speculate + 2, self.max_len - WARMUP_PROMPT_LEN
+            )
+            if spec_new >= 1:
+                fut = self.spec_engine.submit(
+                    [0] * WARMUP_PROMPT_LEN, max_new=spec_new,
+                )
+                await asyncio.wrap_future(fut)
         if self.compile_cache_dir:
             from .modelcfg import (
                 compile_cache_note,
@@ -1493,6 +1582,8 @@ class InferenceServer:
             buckets = {"p4", "p16"}
             if self.slot_engine is not None:
                 buckets.add("slots")
+            if self.spec_engine is not None:
+                buckets.add("spec")
             await loop.run_in_executor(
                 None, mark_warm_buckets,
                 self.compile_cache_dir, fingerprint, buckets,
@@ -1527,12 +1618,13 @@ class InferenceServer:
         self.ledger.freeze()
         self._loop_probe.stop()
         await self._batcher.stop()
-        if self.slot_engine is not None:
-            # joins the worker thread; run off-loop so in-flight
-            # chunks can't block the event loop
-            await asyncio.get_event_loop().run_in_executor(
-                None, self.slot_engine.stop
-            )
+        for engine in (self.slot_engine, self.spec_engine):
+            if engine is not None:
+                # joins the worker thread; run off-loop so in-flight
+                # dispatches can't block the event loop
+                await asyncio.get_event_loop().run_in_executor(
+                    None, engine.stop
+                )
         await self._server.stop()
 
     async def abort(self) -> None:
@@ -1548,10 +1640,11 @@ class InferenceServer:
         self._loop_probe.stop()
         await self._server.abort()
         await self._batcher.stop()
-        if self.slot_engine is not None:
-            await asyncio.get_event_loop().run_in_executor(
-                None, self.slot_engine.stop
-            )
+        for engine in (self.slot_engine, self.spec_engine):
+            if engine is not None:
+                await asyncio.get_event_loop().run_in_executor(
+                    None, engine.stop
+                )
 
 
 if __name__ == "__main__":

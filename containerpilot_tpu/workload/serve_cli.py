@@ -131,6 +131,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="tokens decoded per slot-engine chunk between admissions",
     )
     parser.add_argument(
+        "--slot-window", type=int, default=4,
+        help="decode chunk-rounds fused into ONE device dispatch (a "
+        "device-side loop with early exit): the host re-enters at "
+        "chunk granularity only when an admission/cancel/stop "
+        "decision is pending, so steady-state dispatches/token falls "
+        "~K-fold; 1 = the classic one-dispatch-per-chunk loop. "
+        "Trade-off: a request arriving mid-window waits up to "
+        "window*slot-chunk tokens for a freed slot",
+    )
+    parser.add_argument(
         "--tp", type=int, default=1,
         help="tensor-parallel ways: shard the model over the first N "
         "local devices (heads/ffn/vocab partitioned, XLA inserts the "
@@ -403,6 +413,7 @@ def main() -> int:
         kv_spill_bytes=int(args.kv_spill_mb * 1024 * 1024),
         prefill_chunk=args.prefill_chunk,
         slots=args.slots, slot_chunk=args.slot_chunk,
+        slot_window=args.slot_window,
         text=args.text,
         cp_mesh=cp_mesh, cp_min_len=getattr(args, "cp_min_len", 0),
         mux=args.mux,

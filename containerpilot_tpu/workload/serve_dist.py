@@ -142,6 +142,15 @@ def _payload_zeros(max_len: int, slots: int) -> Dict[str, np.ndarray]:
         # slots — disconnects, stop matches — flip to 1 here)
         "run_chunk": np.zeros((), np.int32),
         "done": np.ones((slots,), np.int32),
+        # fused decode: run this many chunk-rounds in ONE device
+        # dispatch (the (S, chunk, K) window program, early-exiting
+        # when every slot is done or out of ``budget`` tokens);
+        # 1 = the classic single-chunk round. The frontend fuses only
+        # pure-decode rounds — admissions, queued work, cancels and
+        # stop-sequence watches keep chunk granularity — so followers
+        # replay the identical program by construction.
+        "rounds": np.ones((), np.int32),
+        "budget": np.zeros((slots,), np.int32),
     }
 
 
@@ -193,7 +202,7 @@ class _SlotMirror:
     def __init__(self, cfg, params, max_len: int, slots: int,
                  chunk: int, mesh=None, sp: int = 1,
                  cp_min_len: int = 0, prefix_entries: int = 0,
-                 prefill_chunk: int = 0) -> None:
+                 prefill_chunk: int = 0, window: int = 1) -> None:
         from ..models.slots import init_slot_state, slot_cache
 
         self.cfg = cfg
@@ -201,6 +210,10 @@ class _SlotMirror:
         self.max_len = max_len
         self.slots = slots
         self.chunk = chunk
+        # fused window size K: the frontend may broadcast rounds=K on
+        # pure-decode rounds; every process compiles the same
+        # (S, chunk, K) window program at warmup
+        self.window = max(1, int(window))
         self.mesh = mesh
         # context-parallel admission (``--sp``): prompts at least
         # cp_min_len long ring a STARTUP-COMPILED head bucket over the
@@ -416,23 +429,33 @@ class _SlotMirror:
 
     # cpcheck: hotpath — the pod's per-round chunk step; one annotated
     # fetch, and the mask upload only on rounds where it changed
-    def run_chunk(self, done_mask) -> np.ndarray:
-        """Advance every slot one chunk under the broadcast inactive
-        mask; returns the [slots, chunk] sampled tokens (fetched on
-        every process — the fetch is what synchronizes device work, so
-        a wedged computation stalls THIS cycle, not some later one).
+    def run_chunk(self, done_mask, rounds: int = 1,
+                  budget=None) -> np.ndarray:
+        """Advance every slot ``rounds`` chunk-rounds under the
+        broadcast inactive mask — ONE device dispatch either way
+        (rounds > 1 takes the fused (S, chunk, K) window program of
+        models/slots.py, early-exiting on done/budget); returns the
+        [slots, rounds_run*chunk] sampled tokens (fetched on every
+        process — the fetch is what synchronizes device work, so a
+        wedged computation stalls THIS cycle, not some later one).
+        ``rounds`` and ``budget`` ride the broadcast payload, so
+        every process dispatches the identical program.
 
         The mask rides the device-resident state: it is re-uploaded
         (one [S] bool array, pinned replicated) ONLY on rounds where
         it differs from the last value written — retirements and
         evictions — so a steady decode round ships zero host->device
-        transfers. The old full block_until_ready barrier is gone
+        transfers (a fused window adds one [S] int32 budget upload
+        per K rounds). The old full block_until_ready barrier is gone
         with its root causes: there are no zero-copied numpy operands
         left to mutate in place (step_idx advances on device), and
         the donated pool/state order into the next dispatch by device
         dataflow (the 2-process co-batch parity and torn-state tests
         hold without the barrier — they decided)."""
-        from ..models.slots import decode_slots_chunk
+        from ..models.slots import (
+            decode_slots_chunk,
+            decode_slots_window,
+        )
 
         mask = np.asarray(done_mask, bool)  # cpcheck: disable=CP-HOTSYNC host-side numpy only, no device operand
         if not np.array_equal(mask, self._done_host):
@@ -440,6 +463,16 @@ class _SlotMirror:
                 self.state, done=self._g(jnp.asarray(mask))
             )
             self._done_host = mask.copy()
+        if rounds > 1:
+            # the broadcast budget is already a host [S] int32 array;
+            # decode_slots_window's wrapper uploads it
+            self.pool, self.state, toks, run = decode_slots_window(
+                self.params, self.pool, self.state,
+                self.cfg, self.chunk, rounds, budget,
+                out_sharding=self.rep,
+            )
+            toks_host, run_host = jax.device_get((toks, run))  # cpcheck: disable=CP-HOTSYNC the per-window token fetch
+            return toks_host[:, : int(run_host) * self.chunk]
         self.pool, self.state, toks = decode_slots_chunk(
             self.params, self.pool, self.state,
             self.cfg, self.chunk,
@@ -483,7 +516,10 @@ def _apply_round(mirror: _SlotMirror, payload):
     if int(payload["admit_slot"]) >= 0:
         first = mirror.admit(payload)
     if int(payload["run_chunk"]):
-        toks = mirror.run_chunk(payload["done"])
+        toks = mirror.run_chunk(
+            payload["done"], rounds=int(payload["rounds"]),
+            budget=payload["budget"],
+        )
     if os.environ.get("CONTAINERPILOT_POD_DEBUG"):
         _debug_round(mirror, payload, first, toks)
     return first, toks
@@ -1253,6 +1289,18 @@ def warm_pod(mirror: _SlotMirror) -> None:
     warm["run_chunk"] = np.asarray(1, np.int32)
     warm["done"][0] = 0
     _apply_round(mirror, warm)
+    if mirror.window > 1:
+        # compile the fused (S, chunk, K) window program inside the
+        # same grace: one pure-decode window over the still-admitted
+        # warm slot, budget 1 so the device loop runs exactly one
+        # round and exits
+        warm_w = _payload_zeros(mirror.max_len, mirror.slots)
+        warm_w["op"] = np.asarray(OP_ROUND, np.int32)
+        warm_w["run_chunk"] = np.asarray(1, np.int32)
+        warm_w["done"][0] = 0
+        warm_w["rounds"] = np.asarray(mirror.window, np.int32)
+        warm_w["budget"][0] = 1
+        _apply_round(mirror, warm_w)
     warm_score = _payload_zeros(mirror.max_len, mirror.slots)
     warm_score["plen"] = np.asarray(5, np.int32)
     _score_pod(mirror.params, mirror.cfg, warm_score, mirror.max_len)
@@ -1570,6 +1618,35 @@ def _run_frontend_loop(args, frontend: _Frontend, mirror: _SlotMirror,
             continue  # e.g. everything was just cancelled
         payload["run_chunk"] = np.asarray(run_chunk, np.int32)
         payload["done"] = mask
+        # fuse K chunk-rounds into one dispatch on pure-decode rounds
+        # (the single-host engine's host-re-entry rule, pod-shaped):
+        # an admission round, queued HTTP work, a PENDING row waiting
+        # for a free slot, or an active row watching stop sequences
+        # keeps chunk granularity — stop eviction saves real decode,
+        # and a waiting request must grab the next freed slot within
+        # one chunk, not one window. Budget = each row's remaining
+        # max_new, the window's early-exit gate.
+        rounds = 1
+        budget = np.zeros(S, np.int32)
+        if (
+            mirror.window > 1 and run_chunk and admit is None
+            and not pending
+            and frontend.requests.empty()
+            and not any(
+                o is not None and o[0].work["stop"]
+                for o in owners
+            )
+        ):
+            rounds = mirror.window
+            for i, o in enumerate(owners):
+                if o is not None and not mask[i]:
+                    req_o, ridx_o = o
+                    budget[i] = max(
+                        req_o.work["max_new"]
+                        - len(req_o.rows[ridx_o].emitted), 0,
+                    )
+        payload["rounds"] = np.asarray(rounds, np.int32)
+        payload["budget"] = budget
         # ledger stamps at ADMISSION boundaries only (the single-host
         # engine's discipline): an admission round is prefill, the
         # rounds after it decode; chunk-only rounds stamp nothing
@@ -1690,6 +1767,14 @@ def main() -> int:
                         "admission latency, the SSE delta "
                         "granularity, and the watchdog's progress "
                         "quantum")
+    parser.add_argument("--slot-window", type=int, default=4,
+                        help="chunk-rounds fused into one device "
+                        "dispatch on pure-decode rounds (device-side "
+                        "loop, early exit on done/budget); "
+                        "admissions, queued work and stop-sequence "
+                        "watches keep chunk granularity. 1 = off. "
+                        "The watchdog quantum grows to "
+                        "window*stream-chunk tokens on fused rounds")
     parser.add_argument("--draft-layers", type=int, default=0,
                         help="self-speculative decoding: greedy "
                         "single requests against an idle pool draft "
@@ -2005,6 +2090,7 @@ def main() -> int:
                 "slot_engine": {
                     "slots": args.slots,
                     "chunk": args.stream_chunk,
+                    "window": max(1, args.slot_window),
                 },
                 "pod": {
                     "num_processes": args.num_processes,
@@ -2042,6 +2128,7 @@ def main() -> int:
         mesh=mesh, sp=args.sp, cp_min_len=cp_min_len,
         prefix_entries=args.prefix_cache,
         prefill_chunk=args.prefill_chunk,
+        window=max(1, args.slot_window),
     )
     warm_pod(mirror)
     if draft is not None:

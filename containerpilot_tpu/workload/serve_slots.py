@@ -2,21 +2,37 @@
 
 ``Batcher`` (serve_batcher.py) coalesces requests that ARRIVE
 together; this engine lets requests JOIN a running decode. A fixed
-pool of S slots decodes in K-token chunks (models/slots.py — one
-compiled program, static shapes); between chunks the engine harvests
-finished rows and admits queued requests into free slots, so a short
-request lands mid-flight next to a long one instead of waiting for
-the whole batch generation to finish.
+pool of S slots decodes in fixed-size chunks (models/slots.py — one
+compiled program set, static shapes); between dispatches the engine
+harvests finished rows and admits queued requests into free slots, so
+a short request lands mid-flight next to a long one instead of
+waiting for the whole batch generation to finish.
+
+The engine drives a **step program** (models/stepprog.py), not a
+model directly: the plain transformer, quantized weights and
+speculative draft/verify all implement the same
+admit/dispatch/tokens/retire protocol, so every decode strategy
+inherits admission, streaming, cancel, tracing and the ledger from
+ONE driver. With ``window`` K > 1 the plain program fuses K
+chunk-rounds into one device-side loop per host dispatch
+(``decode_slots_window``): the host's per-round loop becomes a
+per-K-window loop and dispatches/token falls ~K-fold on steady-state
+decode. The host re-enters at chunk granularity exactly when a
+decision is pending — queued admissions, a cancel flag, or stop —
+the same lookahead test that already gated pipelining, generalized
+from one round to one window.
 
 Per-request output is byte-identical to a solo ``generate`` call with
 the same arguments (the key schedule is reproduced exactly; each
-slot's draw depends only on its own key and step index) — tested
-against staggered concurrent traffic.
+slot's draw depends only on its own key and step index; a fused
+window runs the same per-step body as K sequential chunks) — tested
+against staggered concurrent traffic at K=1 and K>1.
 
-One engine per server process; it owns a worker thread and the pool
-buffers (chunk/insert donate them). ``submit`` is thread-safe and
-returns a concurrent.futures.Future resolving to the generated ids
-(pad-trimmed after eos, capped at the request's max_new_tokens).
+One engine per server process; it owns a worker thread and the step
+program's device buffers (chunk/insert donate them). ``submit`` is
+thread-safe and returns a concurrent.futures.Future resolving to the
+generated ids (pad-trimmed after eos, capped at the request's
+max_new_tokens).
 """
 from __future__ import annotations
 
@@ -29,7 +45,6 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -38,16 +53,8 @@ from ..models.decode import (
     _jitted_prefill,
     normalize_logit_bias,
 )
-from ..models.slots import (
-    admit_slot_state,
-    append_chunk,
-    decode_slots_chunk,
-    first_sample,
-    init_slot_state,
-    insert_row,
-    retire_slot,
-    slot_cache,
-)
+from ..models.slots import append_chunk
+from ..models.stepprog import make_step_program
 from ..models.transformer import TransformerConfig
 from .serve_prefix import MIN_REUSE as PREFIX_MIN_REUSE
 
@@ -106,14 +113,18 @@ class SlotEngine:
         max_len: int,
         slots: int = 8,
         chunk: int = 8,
+        window: int = 4,
         cp_mesh=None,
         cp_min_len: int = 0,
         prefill_chunk: int = 0,
         prefix_cache=None,
         ledger=None,
+        program=None,
     ) -> None:
         if slots < 1 or chunk < 1:
             raise ValueError("slots and chunk must be >= 1")
+        if window < 1:
+            raise ValueError("window must be >= 1")
         # context-parallel admission: prompts at least cp_min_len
         # long ring their prefill over cp_mesh's seq axis
         # (parallel/context.py cp_prefill_with_remainder — the same
@@ -190,17 +201,24 @@ class SlotEngine:
         self.cfg = cfg
         self.params = params
         self.max_len = max_len
-        self.slots = slots
-        self.chunk = chunk
-        self._pool = slot_cache(cfg, slots, max_len)
-        # per-slot sampling state, ENTIRELY device-resident
-        # (models/slots.py SLOT_STATE_KEYS): written only at admission
-        # (one row) and retirement (one done flag), read by the chunk
-        # program every round with zero host->device uploads — and
-        # with no host-side numpy buffers left, the zero-copy
-        # in-place-mutation hazard class is gone by construction.
-        self._state = init_slot_state(cfg, slots)
-        self._active: List[Optional[_Slot]] = [None] * slots
+        # the step program (models/stepprog.py): owns the pool cache
+        # and the ENTIRELY device-resident per-slot sampling state
+        # (written only at admission/retirement, read every dispatch
+        # with zero host->device uploads beyond the window's [S]
+        # budget ints — no host numpy buffers left, so the zero-copy
+        # in-place-mutation hazard class is gone by construction).
+        # None builds the default for the params (plain or
+        # quantized); an explicit program (e.g. speculative) brings
+        # its own slots/chunk geometry, which wins.
+        if program is None:
+            program = make_step_program(
+                cfg, params, max_len, slots, chunk, rounds=window
+            )
+        self.program = program
+        self.slots = program.slots
+        self.chunk = program.chunk
+        self.window = getattr(program, "rounds", 1)
+        self._active: List[Optional[_Slot]] = [None] * self.slots
         # per-round wall times for decode-only rounds (no admission),
         # seconds; bench.py's host_overhead_bench reads these through
         # round_times_ms(). _round_host_times is the same rounds with
@@ -306,6 +324,9 @@ class SlotEngine:
         return {
             "slots": self.slots,
             "chunk": self.chunk,
+            # decode rounds fused per host dispatch (1 = the classic
+            # one-dispatch-per-chunk loop)
+            "window": self.window,
             "active": sum(s is not None for s in self._active),
             "queued": self._queue.qsize(),
             # the dispatches/token pair (goodput ledger + megakernel
@@ -332,13 +353,10 @@ class SlotEngine:
 
     # ----------------------------------------------------------- worker
 
-    def _admit(self, slot_id: int, req: _Request) -> None:
-        """Prefill the prompt into the slot and sample token 0 with
-        generate's exact key schedule."""
-        if req.timings is not None:
-            req.timings["admitted"] = time.monotonic()
-        if self.ledger is not None:
-            self.ledger.enter("prefill")
+    def _prefill(self, req: _Request):
+        """The engine's prefill POLICY, shared by every step program:
+        prefix-cache rewind+extend, cp-ring, chunked, or plain — and
+        the cache-seeding side effect. Returns (logits, row_cache)."""
         cfg = self.cfg
         logits = row_cache = None
         pc = self.prefix_cache
@@ -402,35 +420,22 @@ class SlotEngine:
             # store the completed prompt's cache for future turns
             # (standalone buffer — see the __init__ soundness note)
             pc.store(tuple(req.tokens), row_cache)
-        # the server-wide convention: row i of a request samples from
-        # fold_in(PRNGKey(seed), i) — single-row here, so i = 0
-        # (serve_batcher/serve_prefix/serve_strategies do the same),
-        # keeping seeded output identical across serving configs
-        row_key = jax.random.fold_in(
-            jax.random.PRNGKey(req.seed), 0
-        )
-        first = first_sample(
-            logits, row_key, req.temperature, req.top_k, req.top_p,
-            cfg, eos_id=req.eos_id, min_new=req.min_new,
-            bias_idx=req.bias_idx, bias_val=req.bias_val,
-        )
-        first_host = int(jax.device_get(first))
-        self._pool = insert_row(self._pool, row_cache, slot_id, cfg)
+        return logits, row_cache
+
+    def _admit(self, slot_id: int, req: _Request) -> None:
+        """Prefill the prompt (engine policy) and hand the result to
+        the step program, which samples token 0 with generate's exact
+        key schedule and writes the whole admission row into its
+        device-resident state in one dispatch."""
+        if req.timings is not None:
+            req.timings["admitted"] = time.monotonic()
+        if self.ledger is not None:
+            self.ledger.enter("prefill")
+        logits, row_cache = self._prefill(req)
+        first_host = self.program.admit(slot_id, req, logits, row_cache)
         state = _Slot(req=req, emitted=[first_host])
         if first_host == req.eos_id or req.max_new <= 1:
             state.finished = True
-        # ONE dispatch writes the whole admission row into the
-        # device-resident state (incl. the counts row, seeded on
-        # device from the first sample)
-        self._state = admit_slot_state(
-            self._state, slot_id, cfg,
-            last=first, key=row_key,
-            temperature=req.temperature, top_k=req.top_k,
-            top_p=req.top_p, eos_id=req.eos_id, pad_id=req.pad_id,
-            min_new=req.min_new, presence=req.presence,
-            frequency=req.frequency, bias_idx=req.bias_idx,
-            bias_val=req.bias_val, done=state.finished,
-        )
         self._active[slot_id] = state
         # one admission = one prefill's worth of dispatches (the
         # prefill program + first-sample/insert/admit ride together);
@@ -458,7 +463,7 @@ class SlotEngine:
             req.timings["done"] = time.monotonic()
             req.timings["rounds"] = state.rounds
         self._active[slot_id] = None
-        self._state = retire_slot(self._state, slot_id)
+        self.program.retire(slot_id)
         if not req.future.done():
             req.future.set_result(out)
 
@@ -477,9 +482,13 @@ class SlotEngine:
 
     def _sweep_cancelled(self) -> None:
         """Free slots whose requests were cancelled (client gone):
-        the slot returns to the pool at this chunk boundary and the
-        future resolves with the partial emission (nobody is usually
-        waiting — the disconnect is why we're here)."""
+        the slot returns to the pool at this window boundary — within
+        ONE window of the disconnect, by the host-re-entry rule — and
+        the future resolves with the partial emission (nobody is
+        usually waiting — the disconnect is why we're here). The
+        ``done`` stamp lands here, at the abandon instant, so decode
+        is accounted up to it and no further (the tracing
+        contract)."""
         for i, s in enumerate(self._active):
             if (
                 s is not None
@@ -490,7 +499,7 @@ class SlotEngine:
                     s.req.timings["done"] = time.monotonic()
                     s.req.timings["rounds"] = s.rounds
                 self._active[i] = None
-                self._state = retire_slot(self._state, i)
+                self.program.retire(i)
                 if not s.req.future.done():
                     s.req.future.set_result(list(s.emitted))
                 log.info(
@@ -500,16 +509,15 @@ class SlotEngine:
 
     def _fail_and_rebuild(self, exc: Exception) -> None:
         """Fail every in-flight request loudly, once, and rebuild the
-        device buffers: the failed chunk DONATED the pool and state,
-        so every later admission would die on a deleted array while
-        /health stays 200."""
-        log.exception("slot chunk failed")
+        device buffers: the failed dispatch DONATED the pool and
+        state, so every later admission would die on a deleted array
+        while /health stays 200."""
+        log.exception("slot dispatch failed")
         for i, s in enumerate(self._active):
             if s is not None and not s.req.future.done():
                 s.req.future.set_exception(exc)
             self._active[i] = None
-        self._pool = slot_cache(self.cfg, self.slots, self.max_len)
-        self._state = init_slot_state(self.cfg, self.slots)
+        self.program.reset()
 
     def _cancel_pending(self) -> bool:
         return any(
@@ -519,15 +527,28 @@ class SlotEngine:
             for s in self._active
         )
 
-    # cpcheck: hotpath — the continuous-batching decode round; a steady
-    # round must ship zero host syncs beyond the one annotated fetch
+    def _budgets(self) -> np.ndarray:
+        """Per-slot remaining max_new allowance, the fused window's
+        early-exit gate (models/slots.py: it never masks emission, so
+        a stale-by-one-window value stays correct — budgets only
+        shrink, and excess tokens are append-discarded exactly like
+        the sequential engine's)."""
+        budgets = np.zeros((self.slots,), np.int32)
+        for i, s in enumerate(self._active):
+            if s is not None:
+                budgets[i] = max(s.req.max_new - len(s.emitted), 0)
+        return budgets
+
+    # cpcheck: hotpath — the continuous-batching decode loop; a steady
+    # window must ship zero host syncs beyond the program's one fetch
     def _run(self) -> None:
-        # one-round lookahead: the [S, chunk] token output of a chunk
-        # already dispatched for the NEXT round (None = serial)
+        # one-window lookahead: the step-program handle of a window
+        # already dispatched for the NEXT cycle (None = serial)
         pending = None
+        program = self.program
         while not self._stopped.is_set():
             t0 = time.perf_counter()
-            jax_s = 0.0  # time inside jax calls this round
+            jax_s = 0.0  # time inside jax calls this cycle
             admitted = False
             if pending is None:
                 self._sweep_cancelled()
@@ -572,52 +593,61 @@ class SlotEngine:
                         self._harvest(i)
                 if not any(s is not None for s in self._active):
                     continue
+                # fuse K rounds only when no host decision can be
+                # pending: an admission just landed (more queued
+                # work likely) or a non-empty queue (a waiting
+                # request must grab the next freed slot at chunk
+                # granularity) keeps the single-chunk program — the
+                # host re-enters exactly when it has something to do
+                fused = (
+                    not admitted
+                    and self._queue.empty()
+                    and not self._cancel_pending()
+                )
                 tj = time.perf_counter()
                 try:
-                    self._pool, self._state, toks = decode_slots_chunk(
-                        self.params, self._pool, self._state,
-                        self.cfg, self.chunk,
-                    )
+                    handle = program.dispatch(self._budgets(), fused)
                 except Exception as exc:  # noqa: BLE001
                     self._fail_and_rebuild(exc)
                     continue
                 jax_s += time.perf_counter() - tj
-                self.dispatches += 1
+                self.dispatches += program.dispatch_cost
             else:
-                toks, pending = pending, None
-            # one-round lookahead: when no admission, cancel, or stop
-            # decision is pending, dispatch chunk N+1 BEFORE fetching
-            # chunk N's tokens — device dataflow orders the donated
-            # pool/state, so the token fetch, host bookkeeping, and
-            # streaming callbacks below overlap chunk N+1's device
-            # compute instead of serializing with it. Whenever a
-            # decision IS needed (queued work, a cancel flag, stop)
-            # the serial path runs and the decision lands at the very
-            # next chunk boundary, exactly as before.
+                handle, pending = pending, None
+            # one-WINDOW lookahead (the PR 1 one-round lookahead,
+            # window-sized): when no admission, cancel, or stop
+            # decision is pending, dispatch window N+1 BEFORE
+            # fetching window N's tokens — device dataflow orders the
+            # donated pool/state, so the token fetch, host
+            # bookkeeping, and streaming callbacks below overlap
+            # window N+1's device compute instead of serializing
+            # with it. Whenever a decision IS needed the serial path
+            # runs and the decision lands at the very next window
+            # boundary. Budgets are stale by one window here — an
+            # upper bound, see _budgets. Programs whose next dispatch
+            # depends on this window's tokens (speculative
+            # acceptance) opt out via supports_lookahead.
             if (
-                any(s is not None for s in self._active)
+                program.supports_lookahead
+                and any(s is not None for s in self._active)
                 and self._queue.empty()
                 and not self._cancel_pending()
             ):
                 tj = time.perf_counter()
                 try:
-                    (self._pool, self._state, pending) = (
-                        decode_slots_chunk(
-                            self.params, self._pool, self._state,
-                            self.cfg, self.chunk,
-                        )
-                    )
+                    pending = program.dispatch(self._budgets(), True)
                 except Exception as exc:  # noqa: BLE001
                     self._fail_and_rebuild(exc)
                     pending = None
                     continue
                 jax_s += time.perf_counter() - tj
-                self.dispatches += 1
+                self.dispatches += program.dispatch_cost
             tj = time.perf_counter()
             try:
-                # the ONE deliberate sync per round: everything after
-                # it overlaps the lookahead chunk's device compute
-                toks_host = np.asarray(jax.device_get(toks))  # cpcheck: disable=CP-HOTSYNC the per-round token fetch
+                # the ONE deliberate sync per window lives inside
+                # program.tokens; everything after it overlaps the
+                # lookahead window's device compute
+                toks_host, valid, rounds_run = program.tokens(handle)
             except Exception as exc:  # noqa: BLE001 — fail loud, once
                 self._fail_and_rebuild(exc)
                 pending = None
@@ -626,16 +656,16 @@ class SlotEngine:
             for i, state in enumerate(self._active):
                 if state is None:
                     continue
-                # per-round tracing cost is ONE int bump per live
+                # per-window tracing cost is ONE int bump per live
                 # slot; the stamps themselves land only at admission/
                 # harvest boundaries (batched per request, never per
                 # token)
-                state.rounds += 1
+                state.rounds += rounds_run
                 req = state.req
                 before = len(state.emitted)
                 ended = append_chunk(
-                    state.emitted, toks_host[i], req.max_new,
-                    req.eos_id,
+                    state.emitted, toks_host[i][: valid[i]],
+                    req.max_new, req.eos_id,
                 )
                 if len(state.emitted) > before:
                     self.tokens_out += len(state.emitted) - before

@@ -2,8 +2,12 @@
 
 Each runner executes on the inference executor thread and returns the
 generated token rows; the server's /v1/generate dispatch picks one
-based on the request (beam / speculative / chunked prefill — the
-continuous batcher and prefix cache live in their own modules).
+based on the request (beam / cp / chunked prefill — the continuous
+batcher and prefix cache live in their own modules). Speculative
+decoding no longer lives here: it rides the slot engine as a step
+program (models/stepprog.py + models/speculative.py's
+SpeculativeStepProgram), inheriting queueing/cancel/tracing from the
+one engine driver.
 """
 from __future__ import annotations
 
@@ -33,23 +37,6 @@ def run_beam(
     srv.batch_stats["calls"] += 1
     srv.batch_stats["rows"] += 1
     return [jax.device_get(out).tolist()]
-
-
-def run_speculative(
-    srv: Any, tokens: List[List[int]], max_new: int, eos_id: int = -1
-) -> List[List[int]]:
-    """Greedy single-sequence draft-and-verify: identical output,
-    ~accepted-per-round fewer target passes (and an eos early-exit —
-    the trim would discard the tail anyway)."""
-    from ..models.speculative import speculative_generate
-
-    out, _stats = speculative_generate(
-        srv.params, srv.draft_params,
-        jnp.asarray(tokens, jnp.int32), srv.cfg,
-        srv.draft_cfg, max_new_tokens=max_new,
-        max_len=srv.max_len, speculate=srv.speculate, eos_id=eos_id,
-    )
-    return jax.device_get(out).tolist()
 
 
 def run_cp(srv: Any, tokens: List[List[int]], p: dict) -> List[List[int]]:

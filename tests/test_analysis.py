@@ -434,6 +434,58 @@ def test_retrace_fires_on_varying_args_in_hotpath():
     assert "recompile" in found[0].message
 
 
+def test_retrace_fires_on_while_loop_step_program():
+    """The fused decode window's shape: a ``lax.while_loop`` step
+    program dispatched in a hot path with Python-varying operands is
+    the same silent-recompile trap as a varying-arg jit call."""
+    src = """
+    # cpcheck: hotpath
+    def dispatch_window(self, pool, state, batch):
+        out = lax.while_loop(cond, body, (pool, state, len(batch)))
+        return out
+    """
+    found = findings_for(src, "CP-RETRACE")
+    assert len(found) == 1 and "recompile" in found[0].message
+
+
+def test_retrace_clean_on_stable_while_loop():
+    """A while_loop window driven by stable operands (the shipped
+    shape: static rounds/chunk, device budgets) is clean — and a cold
+    warmup path may shape-probe freely."""
+    src = """
+    # cpcheck: hotpath
+    def dispatch_window(self, pool, state, budget):
+        out = lax.while_loop(cond, body, (pool, state, budget))
+        return out
+
+    def warm(self, pool, state, batch):
+        return lax.while_loop(cond, body, (pool, state, len(batch)))
+    """
+    assert findings_for(src, "CP-RETRACE") == []
+
+
+def test_hotsync_on_while_loop_step_program():
+    """CP-HOTSYNC over the fused-window driver shape: the one
+    deliberate per-window fetch must carry its pragma (firing twin:
+    the same fetch without one)."""
+    firing = """
+    # cpcheck: hotpath — the fused window fetch
+    def tokens(self, handle):
+        toks, run = handle
+        host = np.asarray(jax.device_get(toks))
+        return host
+    """
+    assert len(findings_for(firing, "CP-HOTSYNC")) == 2
+    clean = """
+    # cpcheck: hotpath — the fused window fetch
+    def tokens(self, handle):
+        toks, run = handle
+        host, rounds_run = jax.device_get((toks, run))  # cpcheck: disable=CP-HOTSYNC the per-window token fetch
+        return host, rounds_run
+    """
+    assert findings_for(clean, "CP-HOTSYNC") == []
+
+
 def test_retrace_clean_on_stable_args_or_cold_path():
     """Stable operands in the hot path are fine; a warmup path may
     shape-probe all it wants; constant subscripts are static."""

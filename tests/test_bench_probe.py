@@ -99,6 +99,7 @@ def test_workload_benches_skip_still_runs_host_overhead(monkeypatch):
     assert extras["chaos_goodput"] == {"engine_host_overhead_ms": 0.1}
     assert extras["goodput_ledger"] == {"engine_host_overhead_ms": 0.1}
     assert extras["prefix_reuse"] == {"engine_host_overhead_ms": 0.1}
+    assert extras["cold_start"] == {"engine_host_overhead_ms": 0.1}
     # only the any-backend benches ran, pinned to cpu
     assert calls == [
         ("host_overhead_bench", {"JAX_PLATFORMS": "cpu"}),
@@ -106,4 +107,5 @@ def test_workload_benches_skip_still_runs_host_overhead(monkeypatch):
         ("goodput_ledger_bench", {"JAX_PLATFORMS": "cpu"}),
         ("chaos_goodput_bench", {"JAX_PLATFORMS": "cpu"}),
         ("prefix_reuse_bench", {"JAX_PLATFORMS": "cpu"}),
+        ("cold_start_bench", {"JAX_PLATFORMS": "cpu"}),
     ]

@@ -118,10 +118,14 @@ def test_submit_validation(params, engine):
 
 def test_chunk_failure_recovers_pool(params):
     """A failed chunk donates the pool buffer; the engine must
-    rebuild it and keep serving instead of failing forever."""
+    rebuild it and keep serving instead of failing forever. The
+    decode call lives in the step program now (models/stepprog.py),
+    so that is where the fault injects; the first dispatch after an
+    admission is always the single-chunk program, so the patch
+    intercepts round one."""
     eng = SlotEngine(CFG, params, MAX_LEN, slots=2, chunk=2)
     try:
-        import containerpilot_tpu.workload.serve_slots as mod
+        import containerpilot_tpu.models.stepprog as mod
 
         original = mod.decode_slots_chunk
         calls = {"n": 0}
@@ -277,7 +281,8 @@ def test_inference_server_slot_engine(run, params):
     assert stats.pop("dispatches") >= 1
     assert stats.pop("tokens_out") >= 1
     assert stats == {
-        "slots": 2, "chunk": 4, "active": 0, "queued": 0,
+        "slots": 2, "chunk": 4, "window": 4, "active": 0,
+        "queued": 0,
     }
     assert outs[0]["tokens"][0] == _solo(
         params, [1, 2, 3], 6, temperature=0.8, seed=5
