@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all build test test-fast test-workload integration fleet-smoke trace-smoke chaos chaos-smoke bench bench-host bench-gateway bench-reuse bench-goodput bench-coldstart lint lint-baseline clean image
+.PHONY: all build test test-fast test-workload integration fleet-smoke trace-smoke chaos chaos-smoke bench bench-host bench-gateway bench-reuse bench-goodput bench-coldstart bench-disagg lint lint-baseline clean image
 
 all: build test
 
@@ -83,6 +83,15 @@ bench-gateway:
 bench-reuse:
 	JAX_PLATFORMS=cpu $(PYTHON) -c "import json, bench; \
 		print(json.dumps(bench.prefix_reuse_bench(), indent=2))"
+
+# disaggregated prefill/decode vs the same-size mixed fleet (docs/60):
+# decode-pool TPOT p99, per-transfer KV handoff cost, and per-role
+# productive fraction; meets_target pins the decode tail strictly
+# under mixed with handoffs completed and the decode pool's ledger
+# fraction at or above the mixed arm's
+bench-disagg:
+	JAX_PLATFORMS=cpu $(PYTHON) -c "import json, bench; \
+		print(json.dumps(bench.disagg_bench(), indent=2))"
 
 # the device-time ledger's accounting bench (docs/90): every replica
 # wall-second attributed (|sum(stages) - uptime| <= 2%) plus the

@@ -1499,6 +1499,14 @@ def chaos_goodput_bench(seed: int = 0) -> dict:
             "productive_fraction": (
                 report["goodput_ledger"]["productive_fraction"]
             ),
+            # per-role cut of the same ledger (disaggregated
+            # scenarios split prefill/decode; mixed fleets report
+            # one "active" pool) — tracked release-over-release
+            "productive_fraction_by_role": {
+                role: stats["productive_fraction"]
+                for role, stats in report["goodput_ledger"]
+                .get("per_role", {}).items()
+            },
             "dispatches_per_token": (
                 report["goodput_ledger"]["dispatches_per_token"]
             ),
@@ -1666,6 +1674,157 @@ def prefix_reuse_bench(seeds: tuple = (0, 1, 2)) -> dict:
     }
 
 
+def disagg_bench(seeds: tuple = (0, 1)) -> dict:
+    """Disaggregated prefill/decode vs the mixed fleet: replay the
+    SAME multi-turn streaming trace (chaos/scenarios.py's
+    ``_DISAGG_TRACE``, every cold prefill paying a synthetic
+    admission floor that stands in for a production-sized prompt
+    occupying the slot worker) through two fleets of the SAME size —
+    ``disagg_mixed_baseline`` (3 mixed replicas; cold prefills block
+    decode windows) and ``disagg_split`` (1 prefill + 2 decode
+    replicas; fresh prompts prefill on the prefill pool and the KV
+    prefix ships replica-to-replica over the cp-mux/1 handoff
+    stream, readmitted through the same ``reuse_admission`` path a
+    local spill takes). Each scenario runs in its OWN interpreter
+    (the cold-process regime the tier-1 tests gate on, same as
+    prefix_reuse_bench). ``meets_target`` = both arms clear their
+    invariants at every seed AND the split arm's TPOT p99 (its
+    streams all ride the decode pool) is STRICTLY under the mixed
+    arm's AND every split seed completed handoffs with per-transfer
+    wall ms recorded AND the decode pool's driven-window productive
+    fraction (PR 12 ledger, per-role cut) is >= the mixed fleet's —
+    phase specialization must buy tail decode latency without
+    idling the pool it carved out. Host-side and CPU-sized; see
+    docs/80-chaos.md."""
+    import logging as logging_mod
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    logging_mod.disable(logging_mod.CRITICAL)
+
+    def run_cold(name: str, seed: int) -> dict:
+        with tempfile.TemporaryDirectory(prefix="disagg-bench-") as d:
+            out = os.path.join(d, "report.json")
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m", "containerpilot_tpu.chaos",
+                    "--scenario", name, "--seed", str(seed),
+                    "--json", out,
+                ],
+                capture_output=True, text=True, timeout=240,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            )
+            try:
+                with open(out, encoding="utf-8") as f:
+                    return json.load(f)["scenarios"][0]
+            except (OSError, ValueError, KeyError, IndexError):
+                raise RuntimeError(
+                    f"{name} seed {seed} produced no report "
+                    f"(exit {proc.returncode}): {proc.stderr[-300:]!r}"
+                ) from None
+
+    arms: dict = {}
+    for arm, name in (
+        ("mixed", "disagg_mixed_baseline"),
+        ("disagg", "disagg_split"),
+    ):
+        runs = []
+        for seed in seeds:
+            report = run_cold(name, seed)
+            score = report["score"]
+            handoff = report["gateway"]["handoff"]
+            per_role = report["goodput_ledger"].get("per_role", {})
+            runs.append({
+                "seed": seed,
+                "passed": report["passed"],
+                "requests": score["requests"],
+                "goodput_fraction": score["goodput_fraction"],
+                "count_5xx": score["count_5xx"],
+                "ttft_p50_ms": score["ttft_ms"]["p50"],
+                "ttft_p99_ms": score["ttft_ms"]["p99"],
+                # the headline: every stream in the split arm decodes
+                # on the decode pool, so the arm's TPOT p99 IS the
+                # decode pool's under concurrent cold-prefill pressure
+                "tpot_p50_ms": score["tpot_ms"]["p50"],
+                "tpot_p99_ms": score["tpot_ms"]["p99"],
+                "handoffs": handoff["total"],
+                "handoff_failed": handoff["failed"],
+                "handoff_skipped_warm": handoff["skipped_warm"],
+                "handoff_bytes": handoff["bytes"],
+                "handoff_mean_ms": round(
+                    handoff["ms_sum"] / handoff["total"], 2
+                ) if handoff["total"] else None,
+                "productive_fraction": (
+                    report["goodput_ledger"]["productive_fraction"]
+                ),
+                "productive_fraction_by_role": {
+                    role: stats["productive_fraction"]
+                    for role, stats in per_role.items()
+                },
+                "tokens_reused": report["kv"]["tokens_reused"],
+                "readmitted": report["kv"]["readmitted"],
+            })
+        arms[arm] = {
+            "scenario": name,
+            "passed": all(r["passed"] for r in runs),
+            "tpot_p99_ms": round(
+                sum(r["tpot_p99_ms"] for r in runs) / len(runs), 2
+            ),
+            "ttft_p99_ms": round(
+                sum(r["ttft_p99_ms"] for r in runs) / len(runs), 2
+            ),
+            "runs": runs,
+        }
+    mixed = arms["mixed"]
+    split = arms["disagg"]
+    decode_pf = [
+        r["productive_fraction_by_role"].get("decode")
+        for r in split["runs"]
+    ]
+    mixed_pf = [r["productive_fraction"] for r in mixed["runs"]]
+    split["decode_productive_fraction"] = round(
+        sum(decode_pf) / len(decode_pf), 4
+    ) if all(f is not None for f in decode_pf) else None
+    mixed["productive_fraction"] = round(
+        sum(mixed_pf) / len(mixed_pf), 4
+    )
+    handoffs_every_seed = all(
+        r["handoffs"] >= 1 and r["handoff_mean_ms"] is not None
+        for r in split["runs"]
+    )
+    return {
+        "backend": jax.default_backend(),
+        "seeds": list(seeds),
+        "arms": arms,
+        "tpot_p99_advantage_ms": round(
+            mixed["tpot_p99_ms"] - split["tpot_p99_ms"], 2
+        ),
+        # the handoff tax, stated next to the win it buys
+        "ttft_p99_cost_ms": round(
+            split["ttft_p99_ms"] - mixed["ttft_p99_ms"], 2
+        ),
+        # the bar: both arms hold their invariants at every seed,
+        # the decode pool's tail beats the mixed fleet's STRICTLY,
+        # KV actually moved (with its cost on the ledger), and the
+        # carved-out decode pool out-produces the mixed fleet
+        "meets_target": bool(
+            mixed["passed"] and split["passed"]
+            and split["tpot_p99_ms"] < mixed["tpot_p99_ms"]
+            and handoffs_every_seed
+            and split["decode_productive_fraction"] is not None
+            and split["decode_productive_fraction"]
+            >= mixed["productive_fraction"]
+        ),
+    }
+
+
 def _bench_subprocess(fn_name: str, timeout_s: int,
                       env: dict | None = None) -> dict:
     """Run one workload bench in its own interpreter with a hard
@@ -1787,6 +1946,13 @@ def workload_benches() -> dict:
     # number the warm-standby pool exists to drive down
     extras["cold_start"] = _bench_subprocess(
         "cold_start_bench", 600,
+        env=None if backend == "tpu" else {"JAX_PLATFORMS": "cpu"},
+    )
+    # disaggregation trajectory: decode-pool TPOT p99 + handoff cost
+    # vs the same-size mixed fleet (4 cold scenario subprocesses:
+    # 2 arms x 2 seeds)
+    extras["disagg"] = _bench_subprocess(
+        "disagg_bench", 900,
         env=None if backend == "tpu" else {"JAX_PLATFORMS": "cpu"},
     )
     if backend != "tpu":

@@ -102,6 +102,30 @@ class _HarnessLauncher:
         await self.harness.retire_replica(replica_id)
 
 
+class _PoolLauncher(_HarnessLauncher):
+    """One phase pool's launcher for a disaggregated fleet: the same
+    harness duck type scoped to replicas of ONE role, so a prefill
+    autoscaler and a decode autoscaler can size their pools
+    independently off ``gateway.pool_load(role)`` without either
+    counting (or launching into) the other's capacity."""
+
+    def __init__(self, harness: "FleetHarness", role: str) -> None:
+        super().__init__(harness)
+        self.role = role
+
+    def ids(self) -> List[str]:
+        h = self.harness
+        return [
+            f"replica-{i}"
+            for i in range(len(h.servers))
+            if i not in h.killed and i not in h.retired
+            and h.roles.get(i) == self.role
+        ]
+
+    async def launch(self) -> str:
+        return await self.harness.spawn_replica(role=self.role)
+
+
 class FleetHarness:
     """A live multi-replica fleet the fault verbs operate on."""
 
@@ -117,6 +141,10 @@ class FleetHarness:
         autoscaler_kwargs: Optional[Dict[str, Any]] = None,
         server_kwargs: Optional[Dict[str, Any]] = None,
         standby_count: int = 0,
+        roles: Tuple[str, ...] = (),
+        pool_autoscaler_kwargs: Optional[
+            Dict[str, Dict[str, Any]]
+        ] = None,
     ) -> None:
         self.catalog_dir = catalog_dir
         self.n_replicas = replicas
@@ -135,6 +163,17 @@ class FleetHarness:
         # active fleet converges, promoted by the autoscaler's
         # launch path — requires autoscaler_kwargs
         self.standby_count = standby_count
+        # disaggregated boot roles: replica i boots with
+        # init_roles[i] ("prefill"/"decode"), or "active" (mixed)
+        # past the tuple's end — the serve --role flag's in-process
+        # twin, carried to the catalog by the same heartbeat note
+        self.init_roles = tuple(roles)
+        # role -> AutoscalerConfig kwargs: one INDEPENDENT autoscaler
+        # per phase pool, signalled by gateway.pool_load(role) —
+        # prefill sizes on admission-queue/TTFT pressure, decode on
+        # slot occupancy (docs/60 § pool sizing)
+        self.pool_autoscaler_kwargs = dict(pool_autoscaler_kwargs or {})
+        self.pool_autoscalers: Dict[str, Any] = {}
         self.servers: List[Any] = []
         self.members: List[Any] = []
         self.proxies: List[Optional[ChaosProxy]] = []
@@ -303,8 +342,12 @@ class FleetHarness:
         params = init_params(jax.random.PRNGKey(0), cfg)
         self._model = (cfg, params)
         self.backend = FileCatalogBackend(self.catalog_dir)
-        for _ in range(self.n_replicas):
-            await self.spawn_replica()
+        for i in range(self.n_replicas):
+            role = (
+                self.init_roles[i]
+                if i < len(self.init_roles) else "active"
+            )
+            await self.spawn_replica(role=role)
         self.flaky = FlakyBackend(self.backend)
         kwargs = dict(
             poll_interval=0.1, retries=3, retry_backoff=0.02,
@@ -353,8 +396,30 @@ class FleetHarness:
             )
             self.gateway.attach_autoscaler(self.autoscaler)
             self.autoscaler.start()
+        for role, kwargs in self.pool_autoscaler_kwargs.items():
+            pool_kwargs = dict(kwargs)
+            pool_kwargs.setdefault(
+                "jitter_seed", self.gateway_kwargs.get("jitter_seed")
+            )
+            # registry=None: co-attached autoscalers would collide on
+            # the per-pool metric names — the fleet-wide autoscaler
+            # (when present) keeps the prometheus side; every
+            # attached scaler's stats still reach /fleet and the
+            # scenario report through scale_event_report
+            scaler = Autoscaler(
+                _PoolLauncher(self, role),
+                lambda r=role: self.gateway.pool_load(r),
+                AutoscalerConfig(**pool_kwargs),
+                registry=None,
+                pool=role,
+            )
+            self.gateway.attach_autoscaler(scaler)
+            scaler.start()
+            self.pool_autoscalers[role] = scaler
 
     async def stop(self) -> None:
+        for scaler in self.pool_autoscalers.values():
+            await scaler.stop()
         if self.autoscaler is not None:
             await self.autoscaler.stop()
         if self.standby_launcher is not None:
@@ -449,6 +514,26 @@ class FleetHarness:
             }
         return out
 
+    def goodput_stats_by_role(self) -> Dict[str, Dict[str, float]]:
+        """Per-ROLE summed stage totals (cumulative; snapshot twice
+        and difference for the driven window) — the disaggregation
+        ledger: a decode pool whose productive fraction beats the
+        mixed arm's is the whole point of the split, and only a
+        per-role cut of the PR 12 ledger can say so. Roles reflect
+        end state (a promoted standby's life lands under "active"),
+        and departed replicas' frozen ledgers fold in as ever."""
+        per: Dict[str, List[Dict[str, float]]] = {}
+        for index, server in enumerate(self.servers):
+            ledger = getattr(server, "ledger", None)
+            if ledger is None:
+                continue
+            role = self.roles.get(index, "active")
+            per.setdefault(role, []).append(ledger.totals())
+        return {
+            role: goodput_mod.sum_stage_totals(totals)
+            for role, totals in per.items()
+        }
+
     async def apply(self, fault: Fault) -> None:
         self._log(fault)
         if fault.kind == "kill":
@@ -530,8 +615,20 @@ class ScenarioSpec:
     #: extra InferenceServer knobs per replica (e.g.
     #: prefix_cache_entries + kv_spill_bytes for KV-reuse scenarios)
     server: Dict[str, Any] = field(default_factory=dict)
+    #: disaggregated boot roles: replica i boots with roles[i]
+    #: ("prefill"/"decode"); replicas past the tuple's end (and the
+    #: whole fleet when empty) boot mixed. The role rides the same
+    #: heartbeat note role=standby does, and the gateway's
+    #: phase-aware _pick degrades to mixed routing the moment a pool
+    #: empties — which is exactly what prefill_pool_killed proves
+    roles: Tuple[str, ...] = ()
     #: AutoscalerConfig kwargs; None runs without an autoscaler
     autoscaler: Optional[Dict[str, Any]] = None
+    #: role -> AutoscalerConfig kwargs: one independent autoscaler
+    #: per phase pool (prefill sizes on admission-queue pressure,
+    #: decode on slot occupancy — gateway.pool_load(role) is the
+    #: signal); None runs without pool autoscalers
+    pool_autoscaler: Optional[Dict[str, Dict[str, Any]]] = None
     #: warm-standby pool size (fleet/standby.py; needs autoscaler):
     #: booted before traffic, promoted instead of launched on scale
     #: events, refilled in the background
@@ -584,6 +681,13 @@ class ScenarioSpec:
     #: spill-tier readmissions (device LRU eviction -> host RAM ->
     #: device again) that must have happened
     expect_readmitted_min: int = 0
+    # -- disaggregation invariants -------------------------------------
+    #: completed prefill->decode KV handoffs (gateway-orchestrated
+    #: /v1/prefill seed + /v1/kv/pull, the cp-mux/1 stream) the run
+    #: must have performed — proves the split fleet actually moved
+    #: KV replica-to-replica instead of silently falling back to
+    #: decode-side prefill on every request
+    expect_handoffs_min: int = 0
     # -- latency-attribution invariants --------------------------------
     #: violation class -> the stage that must dominate it in the
     #: report's stage_attribution (e.g. {"ttft":
@@ -733,6 +837,8 @@ async def run_scenario_async(
         autoscaler_kwargs=spec.autoscaler,
         server_kwargs=spec.server,
         standby_count=spec.standby,
+        roles=spec.roles,
+        pool_autoscaler_kwargs=spec.pool_autoscaler,
     )
     try:
         # start() inside the try: a boot that fails half-way (e.g.
@@ -751,6 +857,7 @@ async def run_scenario_async(
         # goodput ledger scores the DRIVEN window (a mid-run
         # scale-up's cold start still lands inside it, deliberately)
         gp_before = harness.goodput_stats()
+        gp_role_before = harness.goodput_stats_by_role()
         probe.start()
         clock_zero = time.monotonic()
         schedule = asyncio.ensure_future(
@@ -798,6 +905,10 @@ async def run_scenario_async(
                 "capacity": gw.sticky_capacity,
                 "evicted": gw.sticky_evicted,
             },
+            # disaggregation ledger: completed KV handoffs, bytes
+            # moved, failures (fell back to local prefill),
+            # digest-warm skips, and summed transfer wall ms
+            "handoff": dict(gw.handoffs),
         }
         kv_after = harness.kv_stats()
         prompt_tokens = sum(len(r.tokens) for r in requests)
@@ -848,6 +959,35 @@ async def run_scenario_async(
             "per_replica": harness.goodput_breakdown(),
             "scale_events": gw.scale_event_report(),
         }
+        # the per-ROLE cut of the same driven-window delta: the
+        # disagg_bench compares the decode pool's productive
+        # fraction against the mixed arm's fleet-wide number, and
+        # prefill_pool_killed reads it to show where the TTFT hit
+        # migrated when the pool died
+        gp_role_after = harness.goodput_stats_by_role()
+        per_role: Dict[str, Any] = {}
+        for role, totals in gp_role_after.items():
+            before = gp_role_before.get(role, {})
+            role_delta = {
+                key: max(totals[key] - before.get(key, 0.0), 0.0)
+                for key in totals
+            }
+            per_role[role] = {
+                "replicas": sum(
+                    1 for i in harness.roles
+                    if harness.roles[i] == role
+                ),
+                "productive_fraction": (
+                    goodput_mod.productive_fraction(role_delta)
+                ),
+                "device_seconds": round(
+                    sum(
+                        role_delta.get(s, 0.0)
+                        for s in goodput_mod.STAGES
+                    ), 3
+                ),
+            }
+        goodput_ledger["per_role"] = per_role
     finally:
         probe.stop()
         await harness.stop()
@@ -1042,6 +1182,19 @@ async def run_scenario_async(
             f"{kv_stats['readmitted']} spill-tier readmissions "
             f"(expected >= {spec.expect_readmitted_min}; evicted KV "
             f"must come back from host RAM, not re-prefill)",
+        )
+    if spec.expect_handoffs_min > 0:
+        done = gateway_stats["handoff"]["total"]
+        check(
+            "kv_handoffs",
+            done >= spec.expect_handoffs_min,
+            f"{done:.0f} completed prefill->decode KV handoffs, "
+            f"{gateway_stats['handoff']['bytes']:.0f} bytes in "
+            f"{gateway_stats['handoff']['ms_sum']:.0f}ms total "
+            f"(failed={gateway_stats['handoff']['failed']:.0f}, "
+            f"digest-warm skips="
+            f"{gateway_stats['handoff']['skipped_warm']:.0f}; "
+            f"expected >= {spec.expect_handoffs_min})",
         )
     if spec.min_productive_fraction is not None:
         fraction = goodput_ledger["productive_fraction"]
@@ -1712,6 +1865,160 @@ _register(ScenarioSpec(
     max_5xx=30,
     min_goodput_fraction=0.0,
     expect_tokens_reused_min=1,
+))
+
+#: the disaggregation fleet's server knobs: the KV-reuse tiering
+#: (tiny device LRU + host spill, so handoffs adopt into the spill
+#: tier and readmit on demand) PLUS the synthetic cold-admission
+#: floor. The lab model prefills in ~ms, so phase specialization
+#: would have nothing to relieve; prefill_floor_s stands in for a
+#: production-sized prompt occupying the slot worker between decode
+#: windows, and serve_slots carves the floor's seconds to IDLE in
+#: the device-time ledger so the mixed arm's productive fraction is
+#: not inflated by the very interference the split removes
+_DISAGG_SERVER = dict(_REUSE_SERVER, prefill_floor_s=0.25)
+
+#: default-capacity sticky pins (the decode pin made by the handoff
+#: orchestration must survive until the generation routes) with the
+#: reuse scenarios' cache_slack and retry depth
+_DISAGG_GATEWAY = {"cache_slack": 2, "retries": 3}
+
+#: the disaggregation workload: multiturn conversations whose first
+#: turns all clear the fingerprint floor (handoff-eligible by
+#: construction: first_turn_min=16), streaming-heavy with NO
+#: abandons so nearly every request carries a measurable TPOT — the
+#: headline disagg_bench metric is the decode pool's TPOT p99 under
+#: concurrent cold-prefill pressure
+_DISAGG_TRACE = _trace(
+    multiturn=True, duration_s=1.6,
+    think_time_s=0.5, think_floor_s=0.4,
+    tenants=3, sessions_per_tenant=3, turns_per_session=4,
+    max_prompt=56, max_output=10, output_median=8,
+    stream_fraction=0.85, abandon_fraction=0.0,
+)
+
+#: one prefill replica, two decode replicas — the SAME fleet size as
+#: the mixed baseline, split into phase pools
+_DISAGG_ROLES = ("prefill", "decode", "decode")
+
+_register(ScenarioSpec(
+    name="disagg_mixed_baseline",
+    description=(
+        "the disaggregation comparison arm: three MIXED replicas "
+        "serve the multiturn streaming trace while every cold "
+        "prefill occupies its replica's slot worker for the "
+        "injected admission floor — the interference that inflates "
+        "co-resident streams' TPOT and that disagg_split removes. "
+        "disagg_bench replays this arm and the split arm on the "
+        "same seed and compares decode TPOT p99, handoff cost, and "
+        "per-role productive fraction"
+    ),
+    trace=_DISAGG_TRACE,
+    replicas=3,
+    # ttl 2: three replicas + gateway + client in one lab-box
+    # process, same heartbeat-starvation mitigation as the other
+    # multiturn scenarios
+    ttl=2,
+    server=dict(_DISAGG_SERVER),
+    gateway=dict(_DISAGG_GATEWAY),
+    settle_s=1.0,
+    quick=False,  # the bench drives it explicitly, by name
+    # spill readmits + the deliberate admission floors burst the GIL
+    # from executor threads — the multiturn scenarios' stated bound
+    max_loop_lag_ms=2500.0,
+    # loose bars: this arm is the MEASUREMENT BASELINE — the floors
+    # are supposed to hurt its tail, and the bench reads the p99s
+    # from both arms' reports rather than this spec failing the run
+    slo=SLO(ttft_s=4.0, tpot_s=0.5),
+    min_goodput_fraction=0.5,
+    min_productive_fraction=0.01,
+))
+
+_register(ScenarioSpec(
+    name="disagg_split",
+    description=(
+        "the SAME trace, fleet size, and admission floor as "
+        "disagg_mixed_baseline, with the fleet split into phase "
+        "pools (1 prefill + 2 decode): fresh prompts prefill on the "
+        "prefill pool, the KV prefix ships replica-to-replica over "
+        "the cp-mux/1 handoff stream, and the decode pool readmits "
+        "it through the same reuse_admission path a local spill "
+        "takes — so decode slot workers never stall on a cold "
+        "prefill floor, and each pool's independent autoscaler "
+        "(admission-pressure for prefill, slot occupancy for "
+        "decode) holds its own size"
+    ),
+    trace=_DISAGG_TRACE,
+    replicas=3,
+    roles=_DISAGG_ROLES,
+    ttl=2,
+    server=dict(_DISAGG_SERVER),
+    gateway=dict(_DISAGG_GATEWAY),
+    # one independent autoscaler per pool, signalled by
+    # gateway.pool_load(role); min==max holds the 1+2 split so the
+    # bench compares a FIXED fleet, but the wiring (pool-stamped
+    # scale log + stats, per-pool load signal) runs for real
+    pool_autoscaler={
+        "prefill": {
+            "min_replicas": 1, "max_replicas": 1,
+            "slots_per_replica": 2, "tick_interval": 0.2,
+        },
+        "decode": {
+            "min_replicas": 2, "max_replicas": 2,
+            "slots_per_replica": 2, "tick_interval": 0.2,
+        },
+    },
+    settle_s=1.0,
+    quick=False,  # the bench drives it explicitly, by name
+    max_loop_lag_ms=2500.0,
+    slo=SLO(ttft_s=4.0, tpot_s=0.5),
+    min_goodput_fraction=0.5,
+    # the split must actually MOVE KV: fresh first turns (>= 3 per
+    # seed with 9 sessions) each complete a prefill->decode handoff,
+    # and the decode pool readmits what it adopted
+    expect_handoffs_min=3,
+    expect_tokens_reused_min=50,
+    expect_readmitted_min=1,
+    min_productive_fraction=0.01,
+))
+
+_register(ScenarioSpec(
+    name="prefill_pool_killed",
+    description=(
+        "the ENTIRE prefill pool is SIGKILLed early in a multiturn "
+        "streaming run: in-flight handoff legs fail onto the "
+        "degradation ladder (dead leg excluded + sticky pin "
+        "invalidated in the same cycle), fresh prompts fall back to "
+        "decode-side local prefill — paying the admission floor "
+        "there, which is exactly where the TTFT attribution must "
+        "land (replica.prefill, not a mystery smear) — and every "
+        "conversation completes with zero client-visible 5xx"
+    ),
+    trace=_DISAGG_TRACE,
+    # kill at 0.25s: early enough that most sessions' first turns
+    # arrive AFTER the pool is gone (the local-prefill cohort must
+    # dominate the TTFT attribution), late enough that in-flight
+    # handoffs are routinely caught mid-leg
+    faults=(Fault(at_s=0.25, kind="kill", replica=0),),
+    replicas=3,
+    roles=_DISAGG_ROLES,
+    ttl=2,
+    server=dict(_DISAGG_SERVER),
+    gateway=dict(_DISAGG_GATEWAY),
+    # killed-corpse TTL expiry (2s) + a poll must land before the
+    # end-state absence checks
+    settle_s=2.5,
+    max_loop_lag_ms=2500.0,
+    # the TTFT bar sits BELOW the admission floor on purpose, the
+    # burst_10x discipline: every floor-paying cold prefill is a
+    # violation, and the invariant pins WHERE the time went — the
+    # decode replicas' own prefill windows — while the goodput floor
+    # (warm turns reuse and stay fast) keeps the run honest
+    slo=SLO(ttft_s=0.2, tpot_s=0.5),
+    min_goodput_fraction=0.5,
+    expect_absent=(0,),
+    expect_dominant_stage={"ttft": "replica.prefill"},
+    min_productive_fraction=0.01,
 ))
 
 _register(ScenarioSpec(

@@ -44,6 +44,8 @@ from .member import FleetMember
 from .pool import ConnectionPool, StaleConnection, UpstreamError
 from .standby import (
     ROLE_ACTIVE,
+    ROLE_DECODE,
+    ROLE_PREFILL,
     ROLE_STANDBY,
     StandbyLauncher,
     fetch_params,
@@ -60,6 +62,8 @@ __all__ = [
     "FleetLoad",
     "FleetMember",
     "ROLE_ACTIVE",
+    "ROLE_DECODE",
+    "ROLE_PREFILL",
     "ROLE_STANDBY",
     "Replica",
     "SessionLimited",

@@ -111,11 +111,19 @@ class Autoscaler:
         *,
         bus: Optional[EventBus] = None,
         registry: Any = None,
+        pool: str = "",
     ) -> None:
         self.launcher = launcher
         self.signals = signals
         self.cfg = cfg or AutoscalerConfig()
         self.bus = bus
+        #: which pool this actor sizes ("" = the whole mixed fleet;
+        #: a disaggregated fleet runs one autoscaler per role with
+        #: pool="prefill"/"decode") — stamped into scale_log entries
+        #: and stats so /fleet attributes every decision to its pool.
+        #: Only ONE of the co-attached autoscalers may pass the
+        #: gateway registry (the metric names would collide).
+        self.pool = pool
         self.scale_ups = 0
         self.scale_downs = 0
         #: launches that raised (or replicas that died during their
@@ -195,6 +203,7 @@ class Autoscaler:
     @property
     def stats(self) -> Dict[str, Any]:
         out = {
+            "pool": self.pool or "fleet",
             "replicas": self.launcher.count(),
             "min_replicas": self.cfg.min_replicas,
             "max_replicas": self.cfg.max_replicas,
@@ -309,6 +318,8 @@ class Autoscaler:
         self._launch_retry_at = float("-inf")
         self.scale_ups += 1
         entry = {"direction": "up", "replica": replica_id, "at": decided}
+        if self.pool:
+            entry["pool"] = self.pool
         # a StandbyLauncher reports HOW the launch happened
         # ("promoted" vs "cold"): the split the TTFRT report — and
         # the promoted-path chaos bound — are judged on
@@ -334,9 +345,10 @@ class Autoscaler:
         decided = time.monotonic()
         await self.launcher.retire(victim)
         self.scale_downs += 1
-        self._scale_log.append(
-            {"direction": "down", "replica": victim, "at": decided}
-        )
+        entry = {"direction": "down", "replica": victim, "at": decided}
+        if self.pool:
+            entry["pool"] = self.pool
+        self._scale_log.append(entry)
         self._last_event = now  # the tick's clock, not the wall's
         self._under_since = None
         if self._m_scale is not None:

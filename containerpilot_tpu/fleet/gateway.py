@@ -112,13 +112,32 @@ from .pool import (
     StaleMuxConnection,
     UpstreamError,
 )
-from .standby import ROLE_ACTIVE, ROLE_STANDBY, equal_jitter
+from .standby import (
+    ROLE_ACTIVE,
+    ROLE_DECODE,
+    ROLE_PREFILL,
+    ROLE_STANDBY,
+    equal_jitter,
+)
 
 log = logging.getLogger("containerpilot.fleet")
 
 # upstream statuses worth moving to another replica for: 503 is a
 # draining/warming replica by this repo's own convention
 RETRYABLE_STATUSES = frozenset({503})
+#: roles a heartbeat note may carry; anything else (a newer replica
+#: speaking a role this gateway predates) routes as active — advice
+#: degrades, it never partitions
+_KNOWN_ROLES = frozenset(
+    {ROLE_ACTIVE, ROLE_STANDBY, ROLE_PREFILL, ROLE_DECODE}
+)
+#: every role that serves traffic (standby is parked capacity)
+_SERVING_ROLES = (ROLE_ACTIVE, ROLE_PREFILL, ROLE_DECODE)
+# replica endpoints the disaggregated handoff drives (serve.py):
+# seed a prefill replica's cache, then have the decode replica pull
+# the KV prefix replica-to-replica (kvtier/handoff.py)
+PREFILL_PATH = "/v1/prefill"
+KV_PULL_PATH = "/v1/kv/pull"
 AFFINITY_MODES = ("none", "session", "prefix")
 STICKY_CAPACITY = 4096
 PREFIX_TOKENS = 16  # ids of the prompt prefix hashed in "prefix" mode
@@ -444,6 +463,17 @@ class FleetGateway:
         self.sticky_evicted = 0  # plain mirror for /fleet
         self.hint_hits = 0       # plain mirrors of the hint counters
         self.hint_misses = 0
+        #: plain mirrors of the KV-handoff counters for /fleet
+        #: (docs/60 § disaggregated serving): completed transfers,
+        #: bytes moved, failures (fell back to local prefill), and
+        #: handoffs skipped because the decode target was already
+        #: digest-warm (the multiturn follow-up fast path); ms_sum
+        #: accumulates per-transfer wall ms so total/ms_sum yields
+        #: the mean handoff cost without scraping the histogram
+        self.handoffs: Dict[str, float] = {
+            "total": 0, "bytes": 0, "failed": 0, "skipped_warm": 0,
+            "ms_sum": 0.0,
+        }
         #: final tokens_reused advertised by replicas that have LEFT
         #: the fleet, keyed by id — the fleet-wide gauge must not
         #: forget a drained replica's contribution, and keying by id
@@ -489,7 +519,10 @@ class FleetGateway:
         self._admission = AdmissionController(**(admission or {}))
         # graceful shutdown: stop admitting, finish queued + in-flight
         self.draining = False
-        self._autoscaler: Optional[Any] = None
+        #: attached autoscalers, in attach order — a mixed fleet has
+        #: one; a disaggregated fleet attaches one per pool so the
+        #: prefill and decode pools size independently
+        self._autoscalers: List[Any] = []
         self._sticky: "OrderedDict[str, str]" = OrderedDict()
         # per-endpoint pools of recent 200-latencies (seconds): the
         # hedge threshold for generate must not be poisoned by
@@ -550,6 +583,40 @@ class FleetGateway:
             "promotable, excluded from routing and admission "
             "capacity (fleet/standby.py)",
             registry=self._registry,
+        )
+        self._g_role = Gauge(
+            "containerpilot_gateway_replicas_by_role",
+            "healthy replicas by fleet role (active/prefill/decode/"
+            "standby) — the disaggregated pool-size view (docs/60)",
+            ["role"], registry=self._registry,
+        )
+        self._m_handoffs = Counter(
+            "containerpilot_gateway_handoffs_total",
+            "prefill->decode KV handoffs completed (prefix prefilled "
+            "on the prefill pool, pulled by the decode target over "
+            "cp-mux/1, readmitted through reuse_admission)",
+            registry=self._registry,
+        )
+        self._m_handoff_failed = Counter(
+            "containerpilot_gateway_handoffs_failed",
+            "KV handoffs that failed any leg (prefill seed, pull, "
+            "digest verify); the request fell back to local prefill "
+            "on its routed replica — never a client-visible error",
+            registry=self._registry,
+        )
+        self._m_handoff_bytes = Counter(
+            "containerpilot_gateway_handoff_bytes",
+            "KV bytes moved replica-to-replica by completed handoffs",
+            registry=self._registry,
+        )
+        self._m_handoff_ms = Histogram(
+            "containerpilot_gateway_handoff_ms",
+            "wall milliseconds per completed KV handoff (prefill "
+            "seed + replica-to-replica pull), the cost bound the "
+            "disagg bench pins",
+            registry=self._registry,
+            buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000,
+                     2500, 5000),
         )
         self._m_flaps_damped = Counter(
             "containerpilot_gateway_catalog_flaps_damped",
@@ -763,8 +830,38 @@ class FleetGateway:
 
     def attach_autoscaler(self, autoscaler: Any) -> None:
         """Surface an autoscaler's stats on ``GET /fleet`` (its
-        prometheus side joins via ``registry=gateway.registry``)."""
-        self._autoscaler = autoscaler
+        prometheus side joins via ``registry=gateway.registry``).
+        Call once per pool in a disaggregated fleet — every attached
+        autoscaler's stats and scale events are reported; only the
+        FIRST should pass the gateway registry (the per-pool metric
+        names would collide)."""
+        self._autoscalers.append(autoscaler)
+
+    def pool_load(self, role: str = "") -> "FleetLoad":
+        """One pool's demand snapshot for its autoscaler's
+        ``signals`` hook. ``role=""`` folds every serving replica
+        (the classic mixed-fleet signal). The admission queue depth
+        rides the PREFILL pool's signal (and the mixed one's):
+        queued work is work nobody has prefilled yet, i.e. TTFT
+        deadline pressure on admissions — while the decode pool
+        scales on pure slot occupancy (TPOT pressure), which is what
+        lets the two pools size independently (docs/60)."""
+        from .autoscaler import FleetLoad
+
+        if role:
+            members = self._role_members(role)
+        else:
+            members = [
+                r for r in self._replicas.values()
+                if r.role != ROLE_STANDBY
+            ]
+        depth = (
+            self._admission.depth if role != ROLE_DECODE else 0
+        )
+        return FleetLoad(
+            queue_depth=depth,
+            per_replica={r.id: float(r.load) for r in members},
+        )
 
     def _pool_event(self, event: str, replica_id: str) -> None:
         """Mirror pool bookkeeping into the prometheus registry."""
@@ -878,16 +975,21 @@ class FleetGateway:
             self._goodput_departed.pop(rid, None)
         self._replicas = fresh
         self._g_replicas.set(len(fresh))
-        # admission capacity tracks the ACTIVE healthy set — a parked
+        # admission capacity tracks the SERVING healthy set — a parked
         # standby contributes no dispatch slots until its promotion
         # beat lands, at which point capacity grows and queued
         # waiters are granted immediately (the promote-into-a-burst
-        # fast path); growth grants queued waiters immediately
-        active = sum(
-            1 for r in fresh.values() if r.role == ROLE_ACTIVE
+        # fast path). Phase-specialized replicas (prefill/decode)
+        # serve traffic and count like active ones.
+        serving = sum(
+            1 for r in fresh.values() if r.role != ROLE_STANDBY
         )
-        self._g_standby.set(len(fresh) - active)
-        self._admission.set_capacity(active)
+        self._g_standby.set(len(fresh) - serving)
+        for role in _KNOWN_ROLES:
+            self._g_role.labels(role).set(
+                sum(1 for r in fresh.values() if r.role == role)
+            )
+        self._admission.set_capacity(serving)
         # pooled connections to a replica that LEFT the healthy set
         # (drained, deregistered, TTL-expired) are evicted, never
         # reused: a draining replica would answer them 503, a dead one
@@ -926,17 +1028,20 @@ class FleetGateway:
                 replica.digest = fps
                 replica.digest_version = version
                 replica.digest_at = time.monotonic()
-        # role rides every beat of a standby and is ABSENT from an
-        # active replica's note — the first post-promotion beat flips
-        # the routing view back to active by omission. Omission only
-        # counts on a note that PARSED (a real beat always carries at
-        # least occ=): a torn/empty read must keep the previous role,
-        # or one half-written catalog record routes a poll interval
-        # of traffic into a standby's 503s
+        # role rides every beat of a non-active replica (standby,
+        # prefill, decode) and is ABSENT from an active one's note —
+        # the first post-promotion beat flips the routing view back
+        # to active by omission. Omission only counts on a note that
+        # PARSED (a real beat always carries at least occ=): a
+        # torn/empty read must keep the previous role, or one
+        # half-written catalog record routes a poll interval of
+        # traffic into a standby's 503s. An UNKNOWN role value (a
+        # newer replica generation) routes as active: role is advice,
+        # and degrading to mixed routing beats partitioning traffic.
         if fields:
             role = fields.get("role", ROLE_ACTIVE)
             replica.role = (
-                ROLE_STANDBY if role == ROLE_STANDBY else ROLE_ACTIVE
+                role if role in _KNOWN_ROLES else ROLE_ACTIVE
             )
         if "cc" in fields:
             replica.compile_cache = fields["cc"]
@@ -967,28 +1072,38 @@ class FleetGateway:
         generate/completions got from the new replica. None until the
         replica actually serves (the cold-start collapse item's
         yardstick: this number must fall release-over-release)."""
-        if self._autoscaler is None:
+        if not self._autoscalers:
             return []
         events: List[Dict[str, Any]] = []
-        for event in getattr(self._autoscaler, "scale_log", ()):
-            entry = {
-                "direction": event["direction"],
-                "replica": event["replica"],
-            }
-            if "mode" in event:
-                # how the launch happened: "promoted" (warm standby
-                # flipped active) vs "cold" (full boot) — the split
-                # the cold-start-collapse yardstick is judged on
-                entry["mode"] = event["mode"]
-            if event["direction"] == "up":
-                first_ok = self._first_ok.get(event["replica"])
-                entry["ttfrt_s"] = (
-                    round(first_ok - event["at"], 3)
-                    if first_ok is not None
-                    and first_ok >= event["at"] else None
-                )
-            events.append(entry)
+        for scaler in self._autoscalers:
+            for event in getattr(scaler, "scale_log", ()):
+                self._scale_event(events, event)
         return events
+
+    def _scale_event(
+        self, events: List[Dict[str, Any]], event: Dict[str, Any]
+    ) -> None:
+        entry = {
+            "direction": event["direction"],
+            "replica": event["replica"],
+        }
+        if "mode" in event:
+            # how the launch happened: "promoted" (warm standby
+            # flipped active) vs "cold" (full boot) — the split
+            # the cold-start-collapse yardstick is judged on
+            entry["mode"] = event["mode"]
+        if "pool" in event:
+            # which pool's autoscaler decided it (disaggregated
+            # fleets size prefill and decode independently)
+            entry["pool"] = event["pool"]
+        if event["direction"] == "up":
+            first_ok = self._first_ok.get(event["replica"])
+            entry["ttfrt_s"] = (
+                round(first_ok - event["at"], 3)
+                if first_ok is not None
+                and first_ok >= event["at"] else None
+            )
+        events.append(entry)
 
     def fleet_goodput(self) -> Dict[str, Any]:
         """The fleet device-time ledger: per-stage seconds summed
@@ -1060,6 +1175,7 @@ class FleetGateway:
         self,
         exclude: Iterable[str] = (),
         fp: Optional[int] = None,
+        phase: Optional[str] = None,
     ) -> Optional[Replica]:
         """Least-loaded (dispatched + admission-queue-assigned);
         replica id breaks ties so the choice is deterministic under
@@ -1075,12 +1191,31 @@ class FleetGateway:
         Standby-role replicas are never candidates: they are warm
         capacity PARKED for promotion (fleet/standby.py), visible in
         the catalog and on /fleet but outside the routing set until
-        their post-promotion heartbeat drops the role field."""
+        their post-promotion heartbeat drops the role field.
+
+        ``phase`` is the disaggregated fleet's soft preference:
+        ``"decode"`` keeps generation off prefill-pool replicas,
+        ``"prefill"`` keeps prefix seeding off decode-pool ones —
+        mixed/active replicas qualify for both. SOFT by design: when
+        the preferred subset is empty (a pool scaled to zero, or the
+        whole pool is excluded by retries) the pick degrades to every
+        serving candidate, so a disaggregated fleet losing one pool
+        routes like a mixed fleet instead of 503ing."""
         excluded = set(exclude)
         candidates = [
             r for r in self._replicas.values()
-            if r.id not in excluded and r.role == ROLE_ACTIVE
+            if r.id not in excluded and r.role != ROLE_STANDBY
         ]
+        if phase == "decode":
+            preferred = [
+                r for r in candidates if r.role != ROLE_PREFILL
+            ]
+            candidates = preferred or candidates
+        elif phase == "prefill":
+            preferred = [
+                r for r in candidates if r.role != ROLE_DECODE
+            ]
+            candidates = preferred or candidates
         if not candidates:
             return None
         coldest = min(candidates, key=lambda r: (r.load, r.id))
@@ -1135,6 +1270,8 @@ class FleetGateway:
         key: Optional[str],
         exclude: Iterable[str] = (),
         fp: Optional[int] = None,
+        phase: Optional[str] = None,
+        dead: Iterable[str] = (),
     ) -> Optional[Replica]:
         """Sticky affinity first, cache-overlap-blended least-
         outstanding otherwise. A sticky target that LEFT the fleet
@@ -1145,14 +1282,24 @@ class FleetGateway:
         pick, or a retry's re-route) consults the request's prefix
         fingerprint, so a session whose replica drained lands on the
         warmest surviving replica instead of wherever least-loaded
-        points."""
+        points.
+
+        ``dead`` names replicas this request PROVED unreachable
+        (transport failure on a handoff or proxy leg) that the
+        catalog poll hasn't expired yet. A pin on one is invalidated
+        and re-pinned NOW — treating it as a transient exclusion kept
+        the stale pin alive for up to a poll interval, and every
+        sticky retry in that window burned an attempt re-discovering
+        the same dead replica."""
         excluded = set(exclude)
+        dead_ids = set(dead)
+        excluded |= dead_ids
         repin = True
         if key is not None:
             pinned = self._sticky.get(key)
             if pinned is not None:
                 replica = self._replicas.get(pinned)
-                if replica is None:
+                if replica is None or pinned in dead_ids:
                     self._m_drained.labels(pinned).inc()
                     self._sticky.pop(key, None)
                 elif pinned not in excluded:
@@ -1160,7 +1307,7 @@ class FleetGateway:
                     return replica
                 else:
                     repin = False  # transient exclusion: keep the pin
-        replica = self._pick(excluded, fp)
+        replica = self._pick(excluded, fp, phase)
         if replica is not None and key is not None and repin:
             self._sticky[key] = replica.id
             self._sticky.move_to_end(key)
@@ -1289,10 +1436,23 @@ class FleetGateway:
                         if r.role == ROLE_STANDBY
                     ),
                 },
+                # disaggregated serving (docs/60): per-role pool
+                # sizes and the KV-handoff counters
+                "roles": {
+                    role: sum(
+                        1 for r in self._replicas.values()
+                        if r.role == role
+                    )
+                    for role in _SERVING_ROLES + (ROLE_STANDBY,)
+                },
+                "handoff": dict(self.handoffs),
                 "autoscaler": (
-                    self._autoscaler.stats
-                    if self._autoscaler is not None else None
+                    self._autoscalers[0].stats
+                    if self._autoscalers else None
                 ),
+                "autoscalers": [
+                    scaler.stats for scaler in self._autoscalers
+                ],
                 "pool": {
                     "max_idle": self._pool.max_idle,
                     "idle_ttl_s": self._pool.idle_ttl,
@@ -1351,6 +1511,9 @@ class FleetGateway:
                 parsed = {}
             key = self._affinity_key(req, parsed)
             fp = self._request_fingerprint(parsed)
+            # the single token row, for the disaggregated handoff's
+            # replica-side POSTs; a non-None fp proves the shape
+            row = parsed["tokens"][0] if fp is not None else None
             # mint (or adopt the client's) trace id and bind it for
             # the whole routing lifetime: spans recorded anywhere
             # downstream — admission, hedge legs, relays — attach to
@@ -1371,6 +1534,7 @@ class FleetGateway:
                     endpoint, path, body, key, req,
                     stream=bool(parsed.get("stream")),
                     fp=fp,
+                    tokens=row,
                 )
             except asyncio.CancelledError:
                 # client abandon: the server cancels the handler task
@@ -1429,6 +1593,7 @@ class FleetGateway:
         *,
         stream: bool,
         fp: Optional[int] = None,
+        tokens: Optional[List[int]] = None,
     ) -> Response:
         """Admission in front of routing: shed/expire before a replica
         slot is spent, then dispatch holding a ticket. A streaming
@@ -1504,14 +1669,25 @@ class FleetGateway:
             released = True
             self._admission.release(ticket, completed=ok)
 
+        # phase-aware routing: generation is decode-phase work — in a
+        # disaggregated fleet it lands on the decode pool, with the
+        # prefill pool seeding the KV prefix first (handoff below);
+        # score/model stay phase-free
+        phase = (
+            "decode" if endpoint in ("generate", "completions")
+            else None
+        )
+        dead: Set[str] = set()
+        if phase == "decode" and fp is not None and tokens:
+            dead = await self._disagg_prepare(key, fp, tokens)
         try:
             if stream:
                 resp = await self._proxy_stream(
-                    endpoint, path, body, key, fp
+                    endpoint, path, body, key, fp, phase, dead
                 )
             else:
                 resp = await self._proxy_buffered(
-                    endpoint, "POST", path, body, key, fp
+                    endpoint, "POST", path, body, key, fp, phase, dead
                 )
         except BaseException:
             release(False)
@@ -1822,6 +1998,7 @@ class FleetGateway:
         body: bytes,
         tried: Set[str],
         fp: Optional[int] = None,
+        phase: Optional[str] = None,
     ) -> Tuple[int, Dict[str, str], bytes, Replica]:
         """Dispatch to ``replica``; if the response is still not back
         at the hedge threshold, race a second replica. First success
@@ -1840,7 +2017,7 @@ class FleetGateway:
         done, _ = await asyncio.wait({primary}, timeout=threshold)
         if done:
             return (*primary.result(), replica)
-        hedge_replica = self._pick(tried | {replica.id}, fp)
+        hedge_replica = self._pick(tried | {replica.id}, fp, phase)
         if hedge_replica is None:
             status, headers, payload = await primary
             return status, headers, payload, replica
@@ -1903,6 +2080,112 @@ class FleetGateway:
                             "gateway: cancelled race leg failed: %s", exc
                         )
 
+    # -- disaggregated prefill/decode handoff ---------------------------
+
+    def _role_members(self, role: str) -> List[Replica]:
+        return [
+            r for r in self._replicas.values() if r.role == role
+        ]
+
+    async def _disagg_prepare(
+        self, key: Optional[str], fp: int, row: List[int]
+    ) -> Set[str]:
+        """Phase-split dispatch: before a generation lands on the
+        decode pool, run its prompt through the prefill pool and pull
+        the resulting KV prefix onto the decode target replica-to-
+        replica (serve.py's /v1/prefill + /v1/kv/pull, the cp-mux/1
+        stream in kvtier/handoff.py). The generation that follows
+        readmits the prefix through the SAME ``reuse_admission``
+        protocol a local spill takes — byte parity by construction —
+        so the decode replica never pays the cold prefill that would
+        otherwise block its slot engine between decode windows.
+
+        Best-effort by design, the degradation ladder (docs/60):
+        either pool empty, the decode target already digest-warm, or
+        ANY leg failing (transport, non-200, digest mismatch inside
+        the pull) → return and let the routed replica prefill
+        locally. Never raises; never surfaces to the client.
+
+        Returns replica ids a leg PROVED unreachable, so the caller's
+        routing retry starts with them excluded and their sticky pins
+        invalidated (see ``_route``'s ``dead``)."""
+        dead: Set[str] = set()
+        if not self._role_members(ROLE_PREFILL) or not (
+            self._role_members(ROLE_DECODE)
+        ):
+            # not a disaggregated fleet (or a whole pool died):
+            # mixed routing handles everything
+            return dead
+        # pin the decode target FIRST — the pull must land on the
+        # replica the generation will route to, and pinning here is
+        # what makes the follow-up _route calls agree with it
+        decode = self._route(key, (), fp, phase="decode")
+        if decode is None or decode.role == ROLE_PREFILL:
+            return dead
+        if fp in decode.digest:
+            # digest-warm multiturn follow-up: the target already
+            # advertises this prefix — route straight to it
+            self.handoffs["skipped_warm"] += 1
+            return dead
+        members = [
+            r for r in self._role_members(ROLE_PREFILL)
+            if r.id != decode.id
+        ]
+        if not members:
+            return dead
+        prefill = min(members, key=lambda r: (r.load, r.id))
+        seed = json.dumps({"tokens": [row]}).encode()
+        pull = json.dumps(
+            {"tokens": [row], "from": prefill.authority}
+        ).encode()
+        t0 = time.perf_counter()
+        moved: Optional[int] = None
+        # one named trace stage for the whole transfer: the TTFT cost
+        # of disaggregation must be attributable, not smeared into
+        # upstream_ttfb (docs/90 § replica.kv_handoff)
+        with tracing.span("replica.kv_handoff"):
+            # blame a transport failure on whichever leg was in
+            # flight: the seed runs against the prefill replica, the
+            # pull against the decode target
+            leg = prefill
+            try:
+                status, _, _ = await self._fetch_from(
+                    "prefill", prefill, "POST", PREFILL_PATH, seed
+                )
+                if status == 200:
+                    leg = decode
+                    status, _, payload = await self._fetch_from(
+                        "kv_pull", decode, "POST", KV_PULL_PATH, pull
+                    )
+                    if status == 200:
+                        try:
+                            moved = int(
+                                json.loads(payload.decode())
+                                .get("bytes", 0)
+                            )
+                        except (ValueError, AttributeError,
+                                UnicodeDecodeError):
+                            moved = 0
+            except UpstreamError as exc:
+                dead.add(leg.id)
+                log.warning("gateway: kv handoff failed: %s", exc)
+        if moved is None:
+            self._m_handoff_failed.inc()
+            self.handoffs["failed"] += 1
+            return dead
+        handoff_ms = (time.perf_counter() - t0) * 1e3
+        self._m_handoffs.inc()
+        self._m_handoff_bytes.inc(moved)
+        self._m_handoff_ms.observe(handoff_ms)
+        self.handoffs["total"] += 1
+        self.handoffs["bytes"] += moved
+        self.handoffs["ms_sum"] += handoff_ms
+        log.debug(
+            "gateway: kv handoff %s -> %s: %d bytes in %.1fms",
+            prefill.id, decode.id, moved, handoff_ms,
+        )
+        return dead
+
     async def _proxy_buffered(
         self,
         endpoint: str,
@@ -1911,28 +2194,38 @@ class FleetGateway:
         body: bytes,
         key: Optional[str],
         fp: Optional[int] = None,
+        phase: Optional[str] = None,
+        dead: Optional[Set[str]] = None,
     ) -> Response:
-        tried: Set[str] = set()
+        # replicas a failed handoff already proved unreachable start
+        # excluded AND invalidate their sticky pin (see _route)
+        dead_ids: Set[str] = set(dead or ())
+        tried: Set[str] = set(dead_ids)
         backoff = self.retry_backoff
         last: Optional[Response] = None
         for attempt in range(self.retries + 1):
-            replica = self._route(key, tried, fp)
+            replica = self._route(key, tried, fp, phase, dead_ids)
             if replica is None:
                 break
             try:
                 status, headers, payload, served_by = (
                     await self._fetch_with_hedge(
                         endpoint, replica, method, path, body, tried,
-                        fp,
+                        fp, phase,
                     )
                 )
             except UpstreamError as exc:
                 log.warning("gateway: %s failed: %s", endpoint, exc)
                 last = self._failure_response(exc)
+                failed = (
+                    getattr(exc, "failed_ids", None) or {replica.id}
+                )
+                # a transport failure is PROOF of death for the pin's
+                # purposes — later attempts must re-pin, not wait out
+                # the catalog poll
+                dead_ids |= set(failed)
                 backoff = await self._retry_pause(
-                    tried,
-                    getattr(exc, "failed_ids", None) or {replica.id},
-                    attempt, backoff,
+                    tried, failed, attempt, backoff,
                 )
                 continue
             if status in RETRYABLE_STATUSES and attempt < self.retries:
@@ -1995,17 +2288,20 @@ class FleetGateway:
         body: bytes,
         key: Optional[str],
         fp: Optional[int] = None,
+        phase: Optional[str] = None,
+        dead: Optional[Set[str]] = None,
     ) -> Response:
         """SSE relay. Retries/re-routing apply only while nothing has
         been sent downstream; once the upstream stream starts, the
         gateway forwards bytes verbatim until EOF and mirrors client
         disconnects upstream (closing the connection sets the
         replica's cancel path at the next chunk boundary)."""
-        tried: Set[str] = set()
+        dead_ids: Set[str] = set(dead or ())
+        tried: Set[str] = set(dead_ids)
         backoff = self.retry_backoff
         last: Optional[Response] = None
         for attempt in range(self.retries + 1):
-            replica = self._route(key, tried, fp)
+            replica = self._route(key, tried, fp, phase, dead_ids)
             if replica is None:
                 break
             self._m_routed.labels(replica.id).inc()
@@ -2024,6 +2320,7 @@ class FleetGateway:
                         "gateway: %s stream failed: %s", endpoint, exc
                     )
                     last = self._failure_response(exc)
+                    dead_ids.add(replica.id)  # proven unreachable
                     backoff = await self._retry_pause(
                         tried, {replica.id}, attempt, backoff
                     )
@@ -2083,6 +2380,7 @@ class FleetGateway:
                         "gateway: %s stream failed: %s", endpoint, exc
                     )
                     last = self._failure_response(exc)
+                    dead_ids.add(replica.id)  # proven unreachable
                     backoff = await self._retry_pause(
                         tried, {replica.id}, attempt, backoff
                     )

@@ -67,6 +67,13 @@ log = logging.getLogger("containerpilot.fleet")
 #: the first post-promote beat lands
 ROLE_ACTIVE = "active"
 ROLE_STANDBY = "standby"
+#: phase-specialized roles for a disaggregated fleet (docs/60):
+#: routing ADVICE, not a serving restriction — a prefill replica
+#: takes fresh prompts and ships the KV prefix to a decode peer
+#: (kvtier/handoff.py), a decode replica generates off handed-off
+#: prefixes, and either serves anything when the other pool is empty
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
 
 #: path a peer serves its weights on (and the standby fetches from)
 WEIGHTS_PATH = "/v1/weights"

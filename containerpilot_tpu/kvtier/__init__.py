@@ -13,6 +13,11 @@ in the fleet:
   they have cached: a compact, versioned fingerprint set of cached
   prompt prefixes, published through heartbeat notes and
   ``/v1/model``, which the gateway blends into its routing pick.
+- :mod:`.handoff` moves one cached entry BETWEEN replicas — the
+  disaggregated prefill/decode fleet's live KV transfer: a
+  length-prefixed, digest-verified cp-mux/1 stream (the PR 13
+  weight-transfer discipline) whose receiver injects into the spill
+  tier and readmits through the same ``reuse_admission`` path.
 
 The package is import-light by design (no JAX at import time): the
 gateway imports the digest codec without pulling an accelerator
@@ -28,15 +33,27 @@ from .digest import (
     parse_kv_note,
     prefix_fingerprint,
 )
+from .handoff import (
+    KV_PATH,
+    KVTransferError,
+    fetch_kv,
+    kv_transfer_plan,
+    rebuild_kv,
+)
 from .spill import HostSpillTier
 
 __all__ = [
     "DIGEST_MAX_BYTES",
     "FP_TOKENS",
     "HostSpillTier",
+    "KVTransferError",
+    "KV_PATH",
     "encode_fingerprints",
+    "fetch_kv",
+    "kv_transfer_plan",
     "parse_digest",
     "parse_kv_counters",
     "parse_kv_note",
     "prefix_fingerprint",
+    "rebuild_kv",
 ]

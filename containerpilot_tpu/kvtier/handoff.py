@@ -1,0 +1,364 @@
+"""Live KV handoff: ship one prefix-cache entry replica -> replica.
+
+The disaggregated prefill/decode fleet (fleet/gateway.py) runs a
+prompt through a *prefill* replica's slot-engine admission, then moves
+the resulting KV prefix to the pinned *decode* replica so its decode
+rounds never pay a cold prefill. This module is the wire for that
+move, deliberately the SAME discipline as PR 13's peer weight
+transfer (fleet/standby.py):
+
+    u64 manifest_len | manifest JSON | chunk bytes back-to-back
+
+served by ``POST /v1/kv`` (workload/serve.py) as one close-delimited
+cp-mux/1 stream, with ``?chunk=K`` resuming at the first unverified
+chunk and ONE transparent redial on connection death. Every chunk
+carries a blake2b-8 digest; a mismatch is corruption, not a transport
+problem, so it fails the transfer immediately and the receiver
+returns None — the decode replica then prefills locally, exactly as
+an unhinted request would. Handoff is an accelerator, never a new
+failure mode.
+
+Unlike the weight manifest (whose treedef comes from the fetcher's
+own ``like`` tree), a KV entry's structure is not known to the
+receiver in advance, so the manifest here is **self-describing**: a
+JSON skeleton mirrors the pytree's dict/list/tuple structure with
+leaf indices at the arrays, and ``rebuild_kv`` reassembles the host
+tree from skeleton + leaf table + verified chunks with no template.
+
+Byte parity holds by construction: the receiver injects the rebuilt
+host tree into its spill tier (``HostSpillTier.put_host``), and the
+next request readmits it through the SAME ``reuse_admission``
+protocol a locally-spilled entry takes — device_get/device_put
+round-trips are bit-exact, so a handed-off conversation decodes
+token-for-token like a local one.
+
+Import-light like the rest of the package: jax and the fleet
+transport load inside functions, so the gateway can import the codec
+without an accelerator stack (and without an import cycle — fleet
+imports kvtier at module scope).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger("containerpilot.kvtier")
+
+__all__ = [
+    "KVTransferError",
+    "KV_CHUNK",
+    "KV_PATH",
+    "encode_kv_manifest",
+    "fetch_kv",
+    "kv_transfer_plan",
+    "rebuild_kv",
+]
+
+#: path a replica serves (and pulls) prefix-cache entries on
+KV_PATH = "/v1/kv"
+
+#: bytes per chunk — the weight stream's economics apply unchanged
+#: (amortize the per-chunk digest, keep resume re-ship small)
+KV_CHUNK = 256 * 1024
+
+#: sanity cap on a KV manifest (skeleton + tables; entries are a few
+#: hundred leaves at most, nothing like a weight manifest)
+_MANIFEST_CAP = 8 * 1024 * 1024
+
+_MANIFEST_LEN_BYTES = 8
+
+
+class KVTransferError(RuntimeError):
+    """The handoff failed in a way a redial cannot fix (digest
+    mismatch, manifest drift, malformed skeleton): the receiver
+    falls back to a local prefill, it does not retry the peer."""
+
+
+def _chunk_digest(data: bytes) -> str:
+    import hashlib
+
+    return hashlib.blake2b(data, digest_size=8).hexdigest()
+
+
+# -- the self-describing tree codec ------------------------------------
+
+
+def _flatten(node: Any, leaves: List[Any]) -> Any:
+    """Walk a host pytree into a JSON skeleton; every non-container
+    node becomes ``{"x": i}`` pointing into ``leaves``. Dict keys
+    must be strings (a KV cache's are) — anything else cannot
+    round-trip JSON and refuses the transfer."""
+    if isinstance(node, dict):
+        if any(not isinstance(k, str) for k in node):
+            raise KVTransferError(
+                "KV tree has non-string dict keys; not transferable"
+            )
+        return {"d": {k: _flatten(v, leaves) for k, v in node.items()}}
+    if isinstance(node, (list, tuple)):
+        kind = "l" if isinstance(node, list) else "t"
+        return {kind: [_flatten(v, leaves) for v in node]}
+    leaves.append(node)
+    return {"x": len(leaves) - 1}
+
+
+def _unflatten(skeleton: Any, leaves: List[Any]) -> Any:
+    if not isinstance(skeleton, dict) or len(skeleton) != 1:
+        raise KVTransferError("malformed KV skeleton node")
+    (kind, value), = skeleton.items()
+    if kind == "d":
+        if not isinstance(value, dict):
+            raise KVTransferError("malformed KV skeleton dict")
+        return {k: _unflatten(v, leaves) for k, v in value.items()}
+    if kind in ("l", "t"):
+        if not isinstance(value, list):
+            raise KVTransferError("malformed KV skeleton sequence")
+        seq = [_unflatten(v, leaves) for v in value]
+        return seq if kind == "l" else tuple(seq)
+    if kind == "x":
+        if not isinstance(value, int) or not 0 <= value < len(leaves):
+            raise KVTransferError("KV skeleton leaf index out of range")
+        return leaves[value]
+    raise KVTransferError(f"unknown KV skeleton node kind {kind!r}")
+
+
+def kv_transfer_plan(
+    host_tree: Any, chunk_bytes: int = KV_CHUNK
+) -> Tuple[Dict[str, Any], List[bytes]]:
+    """(manifest, per-leaf byte blobs) for one host-side KV entry.
+    Blocking-ish (numpy ``tobytes`` per leaf): executor-wrap it.
+    Deterministic for the same entry, so a resumed stream's digests
+    match the first attempt's manifest."""
+    import numpy as np
+
+    raw_leaves: List[Any] = []
+    skeleton = _flatten(host_tree, raw_leaves)
+    leaves: List[Dict[str, Any]] = []
+    blobs: List[bytes] = []
+    chunks: List[Dict[str, Any]] = []
+    for index, leaf in enumerate(raw_leaves):
+        arr = np.asarray(leaf)
+        data = arr.tobytes()
+        leaves.append(
+            {
+                "dtype": arr.dtype.name,
+                "shape": list(arr.shape),
+                "bytes": len(data),
+            }
+        )
+        blobs.append(data)
+        for offset in range(0, len(data) or 1, chunk_bytes):
+            piece = data[offset:offset + chunk_bytes]
+            chunks.append(
+                {
+                    "leaf": index,
+                    "offset": offset,
+                    "len": len(piece),
+                    "digest": _chunk_digest(piece),
+                }
+            )
+    manifest = {
+        "version": 1,
+        "skeleton": skeleton,
+        "total_bytes": sum(entry["bytes"] for entry in leaves),
+        "leaves": leaves,
+        "chunks": chunks,
+    }
+    return manifest, blobs
+
+
+def encode_kv_manifest(manifest: Dict[str, Any]) -> bytes:
+    """Length-prefixed manifest blob — the stream's first bytes
+    (the weight stream's framing, verbatim)."""
+    body = json.dumps(manifest, sort_keys=True).encode()
+    return len(body).to_bytes(_MANIFEST_LEN_BYTES, "big") + body
+
+
+def rebuild_kv(
+    manifest: Dict[str, Any], chunks: List[bytes]
+) -> Any:
+    """Reassemble the host KV tree from a verified chunk list — no
+    template needed, the manifest's skeleton IS the treedef. Raises
+    KVTransferError on any structural disagreement."""
+    import numpy as np
+
+    specs = manifest.get("leaves")
+    chunk_specs = manifest.get("chunks")
+    skeleton = manifest.get("skeleton")
+    if not isinstance(specs, list) or not isinstance(chunk_specs, list):
+        raise KVTransferError("KV manifest missing its tables")
+    if len(chunks) != len(chunk_specs):
+        raise KVTransferError(
+            f"{len(chunks)} chunks received, manifest names "
+            f"{len(chunk_specs)}"
+        )
+    by_leaf: List[List[bytes]] = [[] for _ in specs]
+    for spec, data in zip(chunk_specs, chunks):
+        leaf = spec.get("leaf")
+        if not isinstance(leaf, int) or not 0 <= leaf < len(specs):
+            raise KVTransferError("KV chunk names a leaf out of range")
+        by_leaf[leaf].append(data)
+    leaves: List[Any] = []
+    for spec, pieces in zip(specs, by_leaf):
+        data = b"".join(pieces)
+        if len(data) != int(spec["bytes"]):
+            raise KVTransferError(
+                f"leaf byte count {len(data)} != manifest "
+                f"{spec['bytes']}"
+            )
+        try:
+            arr = np.frombuffer(
+                data, dtype=np.dtype(spec["dtype"])
+            ).reshape(spec["shape"])
+        except (TypeError, ValueError) as exc:
+            raise KVTransferError(
+                f"leaf does not reassemble: {exc}"
+            ) from None
+        leaves.append(arr)
+    return _unflatten(skeleton, leaves)
+
+
+# -- the fetch client (decode-replica side) ----------------------------
+
+
+async def _read_kv_manifest(reader: Any) -> Dict[str, Any]:
+    from ..fleet.pool import UpstreamError
+
+    raw_len = await reader.read_exact(_MANIFEST_LEN_BYTES)
+    length = int.from_bytes(raw_len, "big")
+    if not 0 < length <= _MANIFEST_CAP:
+        raise UpstreamError(f"implausible KV manifest length {length}")
+    try:
+        manifest = json.loads((await reader.read_exact(length)).decode())
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise UpstreamError(f"malformed KV manifest: {exc}") from None
+    if not isinstance(manifest, dict) or not isinstance(
+        manifest.get("chunks"), list
+    ):
+        raise UpstreamError("KV manifest missing its chunk table")
+    return manifest
+
+
+async def fetch_kv_chunks(
+    address: str,
+    port: int,
+    tokens: List[int],
+    *,
+    connect_timeout: float = 5.0,
+    read_timeout: float = 30.0,
+) -> Tuple[Dict[str, Any], List[bytes]]:
+    """Fetch one prompt's KV entry from a peer over cp-mux/1:
+    (manifest, verified chunks). The weight transfer's exact
+    resume/redial discipline — ONE transparent redial on connection
+    death resuming at the first unverified chunk, digest mismatches
+    and manifest drift raising KVTransferError immediately (a redial
+    cannot fix corruption)."""
+    from ..fleet.pool import ConnectionPool, UpstreamError
+    from ..fleet.standby import _ChunkedReader, _Peer
+
+    pool = ConnectionPool(mux=True)
+    peer = _Peer(address, port)
+    # one row in the token-matrix shape every serve endpoint parses
+    body = json.dumps({"tokens": [list(tokens)]}).encode()
+    got: List[bytes] = []
+    manifest: Optional[Dict[str, Any]] = None
+    redialed = False
+    try:
+        while True:
+            try:
+                conn = await pool.acquire_mux(peer, connect_timeout)
+                if conn is None:
+                    raise UpstreamError(
+                        f"{peer.authority} declined the cp-mux/1 "
+                        f"upgrade"
+                    )
+                stream = await conn.open_stream(
+                    "POST", f"{KV_PATH}?chunk={len(got)}", body=body
+                )
+                status, _headers = await stream.response_head(
+                    read_timeout
+                )
+                if status != 200:
+                    raise UpstreamError(
+                        f"KV fetch answered {status}"
+                    )
+                reader = _ChunkedReader(stream, read_timeout)
+                fresh = await _read_kv_manifest(reader)
+                if manifest is None:
+                    manifest = fresh
+                elif fresh != manifest:
+                    # the peer's entry changed between attempts
+                    # (evicted and recomputed): the already-verified
+                    # prefix belongs to a different serialization
+                    raise KVTransferError(
+                        "peer KV manifest changed across the redial"
+                    )
+                specs = manifest["chunks"]
+                while len(got) < len(specs):
+                    spec = specs[len(got)]
+                    data = await reader.read_exact(int(spec["len"]))
+                    if _chunk_digest(data) != spec["digest"]:
+                        raise KVTransferError(
+                            f"KV chunk {len(got)} digest mismatch"
+                        )
+                    got.append(data)
+                return manifest, got
+            except KVTransferError:
+                raise
+            except UpstreamError:
+                if redialed:
+                    raise
+                redialed = True
+                # drop the dead shared connection so the next acquire
+                # dials fresh; fully-verified chunks stay counted
+                pool.close_all()
+                log.warning(
+                    "kv handoff: peer stream died at chunk %d; "
+                    "redialing once to resume", len(got),
+                )
+    finally:
+        pool.close_all()
+
+
+async def fetch_kv(
+    address: str,
+    port: int,
+    tokens: List[int],
+    *,
+    connect_timeout: float = 5.0,
+    read_timeout: float = 30.0,
+) -> Optional[Tuple[Any, int]]:
+    """Fetch + reassemble one prompt's KV entry from a peer:
+    ``(host_tree, total_bytes)`` on success, None on ANY failure —
+    poisoned chunk, declined upgrade, 404, second connection death —
+    so the caller falls back to a local prefill and corrupt KV is
+    never served. Assembly (numpy) runs on an executor; no device
+    ops happen here at all — injection stays host-side until the
+    inference thread readmits through ``reuse_admission``."""
+    from ..fleet.pool import UpstreamError
+
+    try:
+        manifest, chunks = await fetch_kv_chunks(
+            address, port, tokens,
+            connect_timeout=connect_timeout,
+            read_timeout=read_timeout,
+        )
+    except (KVTransferError, UpstreamError, OSError) as exc:
+        log.warning(
+            "kv handoff: fetch from %s:%d failed (%s); falling back "
+            "to local prefill", address, port, exc,
+        )
+        return None
+    loop = asyncio.get_event_loop()
+    try:
+        host_tree = await loop.run_in_executor(
+            None, rebuild_kv, manifest, chunks
+        )
+    except (KVTransferError, ValueError, TypeError) as exc:
+        log.warning(
+            "kv handoff: fetched entry does not reassemble (%s); "
+            "falling back to local prefill", exc,
+        )
+        return None
+    return host_tree, int(manifest.get("total_bytes", 0))

@@ -150,6 +150,43 @@ class HostSpillTier:
         self.stats["spilled"] += 1
         return True
 
+    def put_host(self, key: Tuple[int, ...], host_tree: Any) -> int:
+        """Insert an entry that is ALREADY host-side (a handed-off KV
+        prefix rebuilt from the wire — kvtier/handoff.py) without any
+        device round-trip. Returns the bytes stored, 0 when refused
+        for budget. The entry then readmits through the exact
+        ``take``/``reuse_admission`` path a locally-spilled one
+        takes, which is what makes handoff byte-parity hold by
+        construction."""
+        nbytes = _tree_nbytes(host_tree)
+        if nbytes > self.max_bytes:
+            self.stats["refused"] += 1
+            return 0
+        with self._lock:
+            old = self._store.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            else:
+                self._index(key)
+            self._store[key] = (host_tree, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and self._store:
+                evicted, (_, dropped) = self._store.popitem(last=False)
+                self._unindex(evicted)
+                self._bytes -= dropped
+                self.stats["evicted"] += 1
+        self.stats["spilled"] += 1
+        return nbytes
+
+    def peek(self, key: Tuple[int, ...]) -> Optional[Any]:
+        """Non-destructive host-side read for EXPORT (the handoff
+        send path): the stored host tree itself, no device ops, no
+        LRU movement, the entry stays readmittable. Callers only
+        serialize from it (leaves are effectively immutable)."""
+        with self._lock:
+            entry = self._store.get(key)
+            return entry[0] if entry is not None else None
+
     def take(self, key: Tuple[int, ...]) -> Optional[Any]:
         """Pop one entry and readmit it to the device, or None when
         the key isn't spilled (evicted for budget, never spilled, or

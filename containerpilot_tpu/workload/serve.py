@@ -107,6 +107,7 @@ class InferenceServer:
         mux: bool = True,
         role: str = "active",
         compile_cache_dir: str = "",
+        prefill_floor_s: float = 0.0,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -119,9 +120,19 @@ class InferenceServer:
         # so (503 standby) and new decode work is refused — it
         # heartbeats into the catalog under role=standby and waits for
         # POST /v3/standby/promote to flip it active in one
-        # assignment (fleet/standby.py is the pool that promotes)
-        if role not in ("active", "standby"):
-            raise ValueError("role must be 'active' or 'standby'")
+        # assignment (fleet/standby.py is the pool that promotes).
+        # "prefill" and "decode" are the disaggregated pools' phase
+        # roles: both serve traffic and answer /health 200 like an
+        # active replica (so degradation to mixed routing always has
+        # somewhere to go) — the role is ROUTING ADVICE the gateway
+        # reads off the same heartbeat note channel, steering fresh
+        # prompts at the prefill pool and decode continuations at the
+        # decode pool (fleet/gateway.py's phase-aware _pick).
+        if role not in ("active", "standby", "prefill", "decode"):
+            raise ValueError(
+                "role must be 'active', 'standby', 'prefill', or "
+                "'decode'"
+            )
         self.role = role
         # persistent XLA compile cache dir this replica serves with
         # (advertised through heartbeat notes so same-host launches
@@ -282,6 +293,7 @@ class InferenceServer:
                 prefill_chunk=prefill_chunk,
                 prefix_cache=self.prefix_cache,
                 ledger=self.ledger,
+                prefill_floor_s=prefill_floor_s,
             )
         self.slot_window = slot_window
         # prompts longer than this stream through decode_chunk pieces
@@ -393,6 +405,15 @@ class InferenceServer:
             "POST", "/v3/standby/promote", self._promote_verb
         )
         self._server.route("GET", "/v1/weights", self._weights)
+        # disaggregated prefill/decode handoff (kvtier/handoff.py):
+        # the prefill verb seeds this replica's prefix cache through
+        # the ordinary slot-engine admission; the kv export serves
+        # one cached entry as a digest-verified chunk stream; the
+        # pull verb fetches an entry from a named peer and injects
+        # it into the spill tier for the next request to readmit
+        self._server.route("POST", "/v1/prefill", self._prefill_verb)
+        self._server.route("POST", "/v1/kv", self._kv_export)
+        self._server.route("POST", "/v1/kv/pull", self._kv_pull)
         route = self._instrumented
         self._server.route("GET", "/v1/model", route(
             "model", self._model_info
@@ -586,6 +607,194 @@ class InferenceServer:
 
         return StreamingResponse(
             body(), content_type="application/octet-stream"
+        )
+
+    # -- disaggregated prefill/decode handoff (kvtier/handoff.py) ------
+
+    async def _prefill_verb(self, req: Request) -> Response:
+        """``POST /v1/prefill {"tokens": [[...]]}``: run one prompt
+        through the ordinary slot-engine admission path for its SIDE
+        EFFECT — the completed prompt's KV lands in the prefix cache
+        (and its fingerprint in the next digest beat) — discarding
+        the single sampled token. The prefill half of a disaggregated
+        handoff: the gateway calls this on the prefill pool, then
+        tells the pinned decode replica to pull the entry."""
+        if self.slot_engine is None or self.prefix_cache is None:
+            return Response(
+                409,
+                b"prefill handoff needs --slots and --prefix-cache\n",
+            )
+        if self.draining:
+            return Response(
+                503, b"draining\n", headers={"Retry-After": "1"}
+            )
+        try:
+            body = json.loads(req.body.decode() or "{}")
+            tokens, prompt_len = _parse_token_rows(
+                body, self.cfg.vocab_size, min_row_len=1
+            )
+            if len(tokens) != 1:
+                raise ValueError("prefill takes a single token row")
+            if prompt_len + 1 > self.max_len:
+                raise ValueError(
+                    f"prompt_len + 1 exceeds max_len {self.max_len}"
+                )
+        except (ValueError, KeyError, TypeError) as exc:
+            return Response(422, f"{exc}\n".encode())
+        row = tokens[0]
+        fut = self.slot_engine.submit(row, max_new=1)
+        await asyncio.wrap_future(fut)
+        key = tuple(row)
+        pc = self.prefix_cache
+        cached = pc.device_entry(key) is not None or (
+            pc.spill is not None and pc.spill.peek(key) is not None
+        )
+        return Response(
+            200,
+            json.dumps(
+                {
+                    "ok": True,
+                    # False for prompts under the reuse floor — they
+                    # can never be reused, so the engine didn't cache
+                    # them and there is nothing to hand off
+                    "cached": bool(cached),
+                    "tokens_prefilled": prompt_len,
+                }
+            ).encode(),
+            content_type="application/json",
+        )
+
+    async def _kv_export(self, req: Request) -> Response:
+        """``POST /v1/kv[?chunk=K] {"tokens": [[...]]}``: this
+        replica's prefix-cache entry for exactly that prompt, as a
+        length-prefixed manifest followed by digest-verified chunks
+        from flat index K — the weight stream's framing and resume
+        discipline (kvtier/handoff.py). 404 when the entry is gone
+        from both tiers: the puller returns None and its gateway
+        falls back to a local prefill. Serialization (device_get +
+        tobytes) runs on an executor; the loop never blocks."""
+        pc = self.prefix_cache
+        if pc is None:
+            return Response(409, b"no prefix cache on this replica\n")
+        try:
+            body = json.loads(req.body.decode() or "{}")
+            tokens, _plen = _parse_token_rows(
+                body, self.cfg.vocab_size, min_row_len=1
+            )
+            if len(tokens) != 1:
+                raise ValueError("kv export takes a single token row")
+        except (ValueError, KeyError, TypeError) as exc:
+            return Response(422, f"{exc}\n".encode())
+        try:
+            start = int(req.query.get("chunk", ["0"])[0])
+        except (ValueError, IndexError):
+            return Response(422, b"chunk must be an integer\n")
+        if start < 0:
+            return Response(422, b"chunk must be >= 0\n")
+        key = tuple(tokens[0])
+        loop = asyncio.get_event_loop()
+
+        def plan():
+            from ..kvtier.handoff import kv_transfer_plan
+
+            cache = pc.device_entry(key)
+            if cache is not None:
+                host = jax.device_get(cache)
+            elif pc.spill is not None:
+                # spilled entries are already host numpy — export
+                # without waking the device or disturbing the LRU
+                host = pc.spill.peek(key)
+            else:
+                host = None
+            if host is None:
+                return None
+            return kv_transfer_plan(host)
+
+        built = await loop.run_in_executor(None, plan)
+        if built is None:
+            return Response(404, b"prefix not cached here\n")
+        manifest, blobs = built
+        chunk_specs = manifest["chunks"]
+        if start > len(chunk_specs):
+            return Response(
+                422,
+                f"chunk must be in [0, {len(chunk_specs)}]\n".encode(),
+            )
+        from ..kvtier.handoff import encode_kv_manifest
+
+        head = encode_kv_manifest(manifest)
+
+        async def stream():
+            yield head
+            for spec in chunk_specs[start:]:
+                yield blobs[spec["leaf"]][
+                    spec["offset"]:spec["offset"] + spec["len"]
+                ]
+
+        return StreamingResponse(
+            stream(), content_type="application/octet-stream"
+        )
+
+    async def _kv_pull(self, req: Request) -> Response:
+        """``POST /v1/kv/pull {"tokens": [[...]], "from":
+        "host:port"}``: fetch that prompt's KV entry from the named
+        peer (digest-verified, one redial — kvtier/handoff.py) and
+        inject it HOST-side into the spill tier; the next request
+        for the prompt readmits it through the same reuse_admission
+        path a locally-spilled entry takes. Any failure answers
+        non-200 and caches nothing — the gateway falls back to a
+        local prefill, so corrupt KV is never served."""
+        pc = self.prefix_cache
+        if pc is None or pc.spill is None:
+            return Response(
+                409, b"kv pull needs --prefix-cache and --kv-spill\n"
+            )
+        try:
+            body = json.loads(req.body.decode() or "{}")
+            tokens, _plen = _parse_token_rows(
+                body, self.cfg.vocab_size, min_row_len=1
+            )
+            if len(tokens) != 1:
+                raise ValueError("kv pull takes a single token row")
+            peer = body.get("from", "")
+            if not isinstance(peer, str) or ":" not in peer:
+                raise ValueError("'from' must be \"host:port\"")
+            address, _, port_raw = peer.rpartition(":")
+            port = int(port_raw)
+            if not address or not 0 < port < 65536:
+                raise ValueError("'from' must be \"host:port\"")
+        except (ValueError, KeyError, TypeError) as exc:
+            return Response(422, f"{exc}\n".encode())
+        import time as time_mod
+
+        from ..kvtier.handoff import fetch_kv
+
+        row = tokens[0]
+        t0 = time_mod.monotonic()
+        fetched = await fetch_kv(address, port, row)
+        if fetched is None:
+            return Response(502, b"kv fetch failed\n")
+        host_tree, total_bytes = fetched
+        loop = asyncio.get_event_loop()
+        adopted = await loop.run_in_executor(
+            None, pc.adopt_host, tuple(row), host_tree
+        )
+        if not adopted:
+            return Response(
+                507, b"kv entry refused (spill budget)\n"
+            )
+        return Response(
+            200,
+            json.dumps(
+                {
+                    "ok": True,
+                    "bytes": int(total_bytes),
+                    "ms": round(
+                        (time_mod.monotonic() - t0) * 1e3, 3
+                    ),
+                }
+            ).encode(),
+            content_type="application/json",
         )
 
     def _instrumented(self, endpoint: str, handler):

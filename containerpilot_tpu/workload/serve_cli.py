@@ -198,6 +198,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "milliseconds — the autoscaler's fast scale-up path",
     )
     parser.add_argument(
+        "--role", default="mixed",
+        choices=("mixed", "prefill", "decode"),
+        help="phase specialization for a disaggregated fleet "
+        "(docs/60): 'prefill' replicas take fresh prompts and ship "
+        "the resulting KV prefix to a decode peer over cp-mux/1; "
+        "'decode' replicas run token generation off handed-off "
+        "prefixes; 'mixed' (default) serves both phases — existing "
+        "fleets are untouched. Routing advice only: every role "
+        "serves any request it receives. --standby wins over this",
+    )
+    parser.add_argument(
         "--weights-from", default="",
         help="fetch model weights from an already-warm peer replica "
         "(host:port) over cp-mux/1 instead of reading a checkpoint "
@@ -405,6 +416,16 @@ def main() -> int:
     # the EXACT mesh the params loaded onto: the ring and the params
     # must share one device set (and do, structurally)
     cp_mesh = mesh if cp > 1 else None
+    # role resolution: --standby wins (a standby is promotable warm
+    # capacity regardless of what it will specialize into); "mixed"
+    # maps to the internal "active" so fleets that never pass --role
+    # emit the exact notes/registrations they always did
+    if getattr(args, "standby", False):
+        role = "standby"
+    else:
+        role = getattr(args, "role", "mixed")
+        if role == "mixed":
+            role = "active"
     server = InferenceServer(
         cfg, params, args.host, args.port, args.max_len,
         draft_layers=args.draft_layers, speculate=args.speculate,
@@ -417,7 +438,7 @@ def main() -> int:
         text=args.text,
         cp_mesh=cp_mesh, cp_min_len=getattr(args, "cp_min_len", 0),
         mux=args.mux,
-        role="standby" if getattr(args, "standby", False) else "active",
+        role=role,
         compile_cache_dir=cache_dir or "",
     )
     member = None

@@ -121,6 +121,31 @@ class PrefixCache:
         self.store(key, cache)
         return cache
 
+    def device_entry(self, key: Tuple[int, ...]) -> Optional[Any]:
+        """The device-tier entry for ``key``, untouched: no readmit,
+        no MRU bump — the handoff EXPORT path's read (a fresh
+        prefill's entry lives here, and serializing it for a peer
+        must not disturb LRU order or the spill tier)."""
+        with self._lock:
+            return self._cache.get(key)
+
+    def adopt_host(self, key: Tuple[int, ...], host_tree: Any) -> int:
+        """Inject a handed-off HOST-side entry (kvtier/handoff.py)
+        into the spill tier and republish the digest. Returns the
+        bytes adopted, 0 without a spill tier or when the budget
+        refuses it. The entry readmits through the SAME
+        ``get``/``reuse_admission`` path a locally-spilled one takes
+        — which is what makes handoff byte-parity hold by
+        construction."""
+        if self.spill is None:
+            return 0
+        adopted = self.spill.put_host(key, host_tree)
+        if adopted:
+            with self._lock:
+                self.version += 1
+            self.stats["spill_bytes"] = self.spill.bytes_used
+        return adopted
+
     def store(self, key: Tuple[int, ...], cache: Any) -> None:
         evicted: List[Tuple[Tuple[int, ...], Any]] = []
         with self._lock:

@@ -120,9 +120,21 @@ class SlotEngine:
         prefix_cache=None,
         ledger=None,
         program=None,
+        prefill_floor_s: float = 0.0,
     ) -> None:
         if slots < 1 or chunk < 1:
             raise ValueError("slots and chunk must be >= 1")
+        if prefill_floor_s < 0:
+            raise ValueError("prefill_floor_s must be >= 0")
+        # synthetic cold-admission floor (chaos/bench seam, never set
+        # in production): every COLD prefill of a reusable-length
+        # prompt blocks the worker thread this many extra seconds —
+        # standing in for a production-sized prompt's prefill compute
+        # on the toy model, the way the chaos suite's ``slow`` faults
+        # stand in for decode time. Reuse hits (including handed-off
+        # KV) skip it entirely, which is exactly the interference the
+        # disaggregation bench measures.
+        self.prefill_floor_s = prefill_floor_s
         if window < 1:
             raise ValueError("window must be >= 1")
         # context-parallel admission: prompts at least cp_min_len
@@ -386,6 +398,22 @@ class SlotEngine:
                 if self.ledger is not None:
                     self.ledger.carve("kv_readmit", pc.readmit_seconds)
         if row_cache is None:
+            if (
+                self.prefill_floor_s > 0.0
+                and len(req.tokens) >= PREFIX_MIN_REUSE
+            ):
+                # the synthetic floor: pay it on the worker thread —
+                # exactly where real prefill compute would run — then
+                # carve the seconds out of the ledger's prefill stage
+                # so productive_fraction keeps measuring real device
+                # work. The trace's prefill span (admitted ->
+                # prefill_done) still carries the hit, so
+                # dominant-stage attribution names it. Warmup's
+                # short dummy prompt stays under the reuse floor and
+                # skips this.
+                time.sleep(self.prefill_floor_s)
+                if self.ledger is not None:
+                    self.ledger.carve("idle", self.prefill_floor_s)
             if (
                 self.cp_mesh is not None
                 and len(req.tokens) >= self.cp_min_len

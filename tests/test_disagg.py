@@ -1,0 +1,537 @@
+"""Disaggregated prefill/decode fleet tests (docs/60 § disaggregated
+serving): the kv handoff codec's byte parity and strictness, the spill
+tier's host-side export/inject surface, phase-aware routing units
+(preference, degradation, the dead-pin invalidation regression), the
+tolerant heartbeat note parser with every field coexisting, the pool
+autoscaler label — and the tier-1 integration scenario: a real
+prefill+decode fleet behind the gateway whose handed-off generations
+are byte-identical to a standalone mixed replica's, buffered AND SSE,
+with a poisoned-chunk handoff degrading to a local prefill (never
+serving corrupt KV) on the same fleet.
+"""
+import asyncio
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from containerpilot_tpu.discovery import FileCatalogBackend, NoopBackend
+from containerpilot_tpu.fleet import FleetGateway, FleetMember
+from containerpilot_tpu.fleet.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    FleetLoad,
+)
+from containerpilot_tpu.fleet.gateway import Replica
+from containerpilot_tpu.kvtier.digest import prefix_fingerprint
+from containerpilot_tpu.kvtier.handoff import (
+    KVTransferError,
+    encode_kv_manifest,
+    kv_transfer_plan,
+    rebuild_kv,
+)
+from containerpilot_tpu.kvtier.spill import HostSpillTier
+
+def _counter(metric, label: str) -> float:
+    return metric.labels(label)._value.get()  # noqa: SLF001
+
+
+def _post(port, path, payload, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode(), dict(exc.headers)
+
+
+def _wire_chunks(manifest, blobs):
+    """Slice leaf blobs into the wire chunks the manifest names —
+    what the export stream yields after the length-prefixed head."""
+    return [
+        blobs[spec["leaf"]][spec["offset"]:spec["offset"] + spec["len"]]
+        for spec in manifest["chunks"]
+    ]
+
+
+# -- the self-describing KV codec (no servers, no JAX) -----------------
+
+
+def test_kv_codec_roundtrip_byte_parity():
+    """plan -> frame -> chunk -> rebuild is byte-exact: every leaf
+    comes back with its dtype, shape, and bytes intact, containers
+    keep their kinds (tuple stays tuple), and zero-length leaves
+    survive the trip."""
+    tree = {
+        "layers": [
+            {
+                "k": np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+                "v": np.full((2, 3, 4), 0.5, dtype=np.float16),
+            },
+        ],
+        "lens": (np.array([7, 9], dtype=np.int32),),
+        "scalar": np.float64(3.5),
+        "empty": np.zeros((0,), dtype=np.float32),
+    }
+    # tiny chunk size forces multi-chunk leaves, so reassembly from
+    # pieces (not just one chunk per leaf) is what's being pinned
+    manifest, blobs = kv_transfer_plan(tree, chunk_bytes=16)
+    assert manifest["version"] == 1
+    assert manifest["total_bytes"] == sum(len(b) for b in blobs)
+    assert any(
+        sum(1 for s in manifest["chunks"] if s["leaf"] == i) > 1
+        for i in range(len(blobs))
+    )
+    head = encode_kv_manifest(manifest)
+    assert int.from_bytes(head[:8], "big") == len(head) - 8
+    assert json.loads(head[8:].decode()) == json.loads(
+        json.dumps(manifest)
+    )
+    rebuilt = rebuild_kv(manifest, _wire_chunks(manifest, blobs))
+    assert isinstance(rebuilt["layers"], list)
+    assert isinstance(rebuilt["lens"], tuple)
+    for path in ("k", "v"):
+        orig = tree["layers"][0][path]
+        back = rebuilt["layers"][0][path]
+        assert back.dtype == orig.dtype and back.shape == orig.shape
+        assert back.tobytes() == orig.tobytes()
+    assert rebuilt["lens"][0].tobytes() == tree["lens"][0].tobytes()
+    assert np.asarray(rebuilt["scalar"]).item() == 3.5
+    assert rebuilt["empty"].shape == (0,)
+    # determinism: a resumed stream's digests must match the first
+    # attempt's manifest
+    again, _ = kv_transfer_plan(tree, chunk_bytes=16)
+    assert again == manifest
+
+
+def test_kv_codec_refuses_malformed():
+    """Structural disagreement is KVTransferError everywhere — the
+    receiver falls back to a local prefill instead of guessing."""
+    with pytest.raises(KVTransferError):
+        kv_transfer_plan({1: np.zeros(2, dtype=np.float32)})
+    manifest, blobs = kv_transfer_plan(
+        {"a": np.arange(8, dtype=np.int32)}, chunk_bytes=16
+    )
+    chunks = _wire_chunks(manifest, blobs)
+    with pytest.raises(KVTransferError):
+        rebuild_kv(manifest, chunks[:-1])  # chunk count mismatch
+    with pytest.raises(KVTransferError):
+        rebuild_kv(manifest, [chunks[0][:-1]] + chunks[1:])  # short leaf
+    with pytest.raises(KVTransferError):
+        rebuild_kv({"skeleton": {"x": 0}}, [])  # missing tables
+    for skeleton in ({"x": 99}, {"z": 1}, {"d": [1]}, "junk"):
+        bad = json.loads(json.dumps(manifest))
+        bad["skeleton"] = skeleton
+        with pytest.raises(KVTransferError):
+            rebuild_kv(bad, chunks)
+
+
+def test_spill_put_host_peek_and_budget():
+    """put_host injects an already-host-side entry with no device
+    round-trip; peek reads it non-destructively for export; the byte
+    budget refuses oversized entries and evicts LRU-first."""
+    tier = HostSpillTier(1024)
+    key = tuple(range(20))
+    host = {"k": np.ones((4, 4), dtype=np.float32)}  # 64 bytes
+    assert tier.put_host(key, host) == 64
+    assert tier.bytes_used == 64 and tier.stats["spilled"] == 1
+    # peek: the stored tree itself, still resident afterwards
+    assert tier.peek(key) is host
+    assert tier.peek(key) is host
+    assert key in tier.candidates(prefix_fingerprint(key))
+    # oversized: refused, counted, nothing stored
+    big = {"k": np.zeros((64, 64), dtype=np.float32)}  # 16 KiB
+    assert tier.put_host(tuple(range(100, 120)), big) == 0
+    assert tier.stats["refused"] == 1 and len(tier) == 1
+    # budget pressure evicts least-recently-used spilled entries
+    half = {"k": np.zeros((8, 16), dtype=np.float32)}  # 512 bytes
+    assert tier.put_host(tuple(range(200, 220)), half) == 512
+    assert tier.put_host(tuple(range(300, 320)), half) == 512
+    assert tier.stats["evicted"] >= 1 and tier.bytes_used <= 1024
+    # take pops: readmitted once, gone after
+    taken_key = tuple(range(300, 320))
+    assert tier.take(taken_key) is not None
+    assert tier.peek(taken_key) is None
+    assert tier.stats["readmitted"] == 1
+
+
+# -- phase-aware routing units (no servers, no JAX) --------------------
+
+
+def test_pick_phase_preference_and_degradation():
+    """phase='decode' keeps generation off the prefill pool and
+    phase='prefill' keeps seeding off the decode pool — softly: a
+    pool that empties (or is wholly excluded) degrades to every
+    serving candidate, while standby stays unroutable throughout."""
+    gw = FleetGateway(NoopBackend(), "svc")
+    gw._replicas = {
+        "d1": Replica("d1", "h", 1, role="decode"),
+        "m1": Replica("m1", "h", 2, outstanding=1),
+        "p1": Replica("p1", "h", 3, role="prefill"),
+        "sb": Replica("sb", "h", 4, role="standby"),
+    }
+    assert gw._pick(phase="decode").id == "d1"
+    assert gw._pick(phase="prefill").id == "p1"
+    # mixed replicas qualify for both phases on load
+    gw._replicas["d1"].outstanding = 3
+    gw._replicas["p1"].outstanding = 3
+    assert gw._pick(phase="decode").id == "m1"
+    assert gw._pick(phase="prefill").id == "m1"
+    # the preferred subset emptied by exclusion: degrade to mixed
+    # routing (the prefill replica serves decode) instead of 503ing
+    assert gw._pick(exclude={"d1", "m1"}, phase="decode").id == "p1"
+    assert gw._pick(exclude={"p1", "m1"}, phase="prefill").id == "d1"
+    # a standby is NEVER the degradation target
+    for rid in ("d1", "m1", "p1"):
+        del gw._replicas[rid]
+    assert gw._pick(phase="decode") is None
+
+
+def test_route_dead_pin_invalidated_same_cycle():
+    """Regression: a sticky pin on a replica a handoff/proxy leg
+    PROVED unreachable must be invalidated and re-pinned in the SAME
+    routing call — not kept as a transient exclusion that burns every
+    retry until the catalog poll expires it."""
+    gw = FleetGateway(NoopBackend(), "svc", affinity="session")
+    gw._replicas = {
+        "a": Replica("a", "h", 1),
+        "b": Replica("b", "h", 2),
+    }
+    first = gw._route("s:conv")
+    other = "b" if first.id == "a" else "a"
+    # contrast: a plain retry exclusion re-routes this request but
+    # KEEPS the pin and counts nothing
+    assert gw._route("s:conv", exclude={first.id}).id == other
+    assert gw._sticky["s:conv"] == first.id
+    assert _counter(gw._m_drained, first.id) == 0
+    # a dead id — still in the routing view, the poll hasn't noticed —
+    # invalidates the pin, counts drained_away, and re-pins NOW
+    rerouted = gw._route("s:conv", dead={first.id})
+    assert rerouted.id == other
+    assert gw._sticky["s:conv"] == other
+    assert _counter(gw._m_drained, first.id) == 1
+    # the fresh pin then holds without further dead hints
+    assert gw._route("s:conv").id == other
+
+
+def test_pool_load_signal_split():
+    """The admission queue depth rides the prefill/mixed signals
+    (TTFT pressure) while the decode pool's is pure slot occupancy —
+    what lets the two autoscalers size independently."""
+    import types
+
+    gw = FleetGateway(NoopBackend(), "svc")
+    gw._replicas = {
+        "p1": Replica("p1", "h", 1, outstanding=2, role="prefill"),
+        "d1": Replica("d1", "h", 2, outstanding=3, role="decode"),
+        "m1": Replica("m1", "h", 3, outstanding=1),
+        "sb": Replica("sb", "h", 4, role="standby"),
+    }
+    gw._admission = types.SimpleNamespace(depth=7)
+    prefill = gw.pool_load("prefill")
+    decode = gw.pool_load("decode")
+    mixed = gw.pool_load()
+    assert prefill.queue_depth == 7
+    assert prefill.per_replica == {"p1": 2.0}
+    assert decode.queue_depth == 0
+    assert decode.per_replica == {"d1": 3.0}
+    assert mixed.queue_depth == 7
+    # the mixed signal folds every SERVING replica; standby is parked
+    assert set(mixed.per_replica) == {"p1", "d1", "m1"}
+
+
+def test_apply_notes_all_fields_coexist_and_torn_never_throw():
+    """One heartbeat note carrying role= AND kv= AND gp= AND pd= AND
+    cc= parses field-by-field; garbage values degrade per-field; any
+    truncation parses without throwing; and role flips to active only
+    on a note that PARSED without a role field."""
+    from containerpilot_tpu.kvtier import encode_fingerprints
+
+    gw = FleetGateway(NoopBackend(), "svc")
+    r = Replica("a", "h", 1)
+    digest = encode_fingerprints(1, {0xAB})
+    note = (
+        "ok occ=0.25 role=decode kv=4,2,96,1,1 "
+        "gp=1.0,2.5,0.5,3.0,4.0,0.25,0.0,12,340 "
+        f"pd={digest} cc=beef:%2Ftmp%2Fcc"
+    )
+    gw._apply_notes(r, note)
+    assert r.role == "decode"
+    assert r.kv["hits"] == 4 and r.kv["tokens_reused"] == 96
+    assert r.goodput["prefill"] == 3.0 and r.goodput["decode"] == 4.0
+    assert r.goodput["tokens_out"] == 340.0
+    assert r.digest == frozenset({0xAB})
+    assert r.compile_cache == "beef:%2Ftmp%2Fcc"
+    # garbage values next to a good role: per-field tolerance, and
+    # cumulative counters never regress
+    gw._apply_notes(
+        r, "ok occ=0.30 role=decode kv=nonsense gp=nonsense pd=garbage"
+    )
+    assert r.role == "decode"
+    assert r.kv["tokens_reused"] == 96
+    assert r.digest == frozenset({0xAB})
+    # every prefix of the full note parses without throwing
+    torn = Replica("b", "h", 2, role="decode")
+    for i in range(len(note)):
+        gw._apply_notes(torn, note[:i])
+    # a read that parsed NO fields keeps the previous role…
+    gw._apply_notes(r, "")
+    gw._apply_notes(r, "ok")
+    assert r.role == "decode"
+    # …a parsed beat without role= is a promotion (active by
+    # omission), and an unknown role value routes as active
+    gw._apply_notes(r, "ok occ=0.10")
+    assert r.role == "active"
+    gw._apply_notes(r, "ok role=superdecode")
+    assert r.role == "active"
+
+
+def test_autoscaler_pool_label(run):
+    """A pool autoscaler stamps its pool into stats and into every
+    scale_log entry, so /fleet attributes each decision to the pool
+    that made it; the classic mixed actor reports 'fleet'."""
+
+    class _StubLauncher:
+        def __init__(self):
+            self._ids = ["r0"]
+
+        def count(self):
+            return len(self._ids)
+
+        def ids(self):
+            return list(self._ids)
+
+        async def launch(self):
+            rid = f"r{len(self._ids)}"
+            self._ids.append(rid)
+            return rid
+
+        async def retire(self, rid):
+            self._ids.remove(rid)
+
+    cfg = AutoscalerConfig(
+        min_replicas=1, max_replicas=2, slots_per_replica=1,
+        high_water=0.5, up_sustain_s=0.0, cooldown_s=0.0,
+        tick_interval=0.01,
+    )
+    scaler = Autoscaler(
+        _StubLauncher(),
+        lambda: FleetLoad(queue_depth=5, per_replica={"r0": 5.0}),
+        cfg, registry=None, pool="prefill",
+    )
+    assert scaler.stats["pool"] == "prefill"
+    assert Autoscaler(
+        _StubLauncher(), lambda: FleetLoad(0, {}), registry=None,
+    ).stats["pool"] == "fleet"
+
+    async def drive():
+        for _ in range(10):
+            await scaler.tick()
+            if scaler.scale_ups:
+                break
+            await asyncio.sleep(0.01)
+
+    run(drive())
+    assert scaler.scale_ups >= 1
+    ups = [e for e in scaler.scale_log if e["direction"] == "up"]
+    assert ups and all(e["pool"] == "prefill" for e in ups)
+
+
+# -- the tier-1 integration scenario -----------------------------------
+
+
+def _sse_tokens(text):
+    events = [
+        json.loads(line[len("data: "):])
+        for line in text.splitlines()
+        if line.startswith("data: ")
+    ]
+    assert events and events[-1].get("done") is True
+    return [t for e in events if "tokens" in e for t in e["tokens"]]
+
+
+def test_disagg_fleet_byte_parity_and_poisoned_handoff(
+    run, tmp_path, monkeypatch
+):
+    """A prefill+decode fleet behind the gateway vs one standalone
+    mixed replica with the same weights: handed-off generations are
+    byte-identical, buffered AND SSE — parity by construction through
+    the shared reuse_admission path. A digest-warm repeat skips the
+    handoff. Then a poisoned chunk (corrupted after digests were
+    computed) makes the pull fail digest verification: the decode
+    replica adopts nothing, the gateway counts a failed handoff, and
+    the client still gets the byte-identical answer from a local
+    prefill."""
+    import jax
+    import jax.numpy as jnp
+
+    import containerpilot_tpu.kvtier.handoff as handoff_mod
+    from containerpilot_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    server_kwargs = dict(
+        max_len=64, slots=2, slot_chunk=4,
+        prefix_cache_entries=2, kv_spill_bytes=512 * 1024,
+    )
+    ref = InferenceServer(cfg, params, "127.0.0.1", 0, **server_kwargs)
+    prefill_srv = InferenceServer(
+        cfg, params, "127.0.0.1", 0, role="prefill", **server_kwargs
+    )
+    decode_srv = InferenceServer(
+        cfg, params, "127.0.0.1", 0, role="decode", **server_kwargs
+    )
+    backend = FileCatalogBackend(str(tmp_path))
+    # three prompts, each >= 16 tokens (handoff-eligible) with
+    # distinct 16-token prefixes (distinct fingerprints)
+    row1 = list(range(1, 25))
+    row2 = list(range(30, 54))
+    row3 = list(range(5, 29))
+
+    real_plan = handoff_mod.kv_transfer_plan
+
+    def poisoned_plan(host_tree, chunk_bytes=handoff_mod.KV_CHUNK):
+        # corrupt one blob byte AFTER the manifest's digests were
+        # computed from the pristine data: the wire chunk no longer
+        # matches its digest, which is corruption (not transport)
+        manifest, blobs = real_plan(host_tree, chunk_bytes)
+        for i, blob in enumerate(blobs):
+            if blob:
+                flipped = bytearray(blob)
+                flipped[-1] ^= 0xFF
+                blobs[i] = bytes(flipped)
+                break
+        return manifest, blobs
+
+    async def scenario():
+        loop = asyncio.get_event_loop()
+        await ref.run()
+        await prefill_srv.run()
+        await decode_srv.run()
+        member_p = FleetMember(
+            prefill_srv, backend, "inference", ttl=5,
+            heartbeat_interval=0.1, instance_id="prefill-1",
+        )
+        member_d = FleetMember(
+            decode_srv, backend, "inference", ttl=5,
+            heartbeat_interval=0.1, instance_id="decode-1",
+        )
+        await member_p.start()
+        await member_d.start()
+        gateway = FleetGateway(
+            backend, "inference", "127.0.0.1", 0,
+            poll_interval=0.2, hedge=False, retry_backoff=0.01,
+        )
+        await gateway.run()
+        # converge on both replicas AND their roles (the role rides
+        # the heartbeat note; routing is phase-blind until it lands)
+        for _ in range(200):
+            rs = gateway._replicas
+            if (
+                rs.get("prefill-1") is not None
+                and rs["prefill-1"].role == "prefill"
+                and rs.get("decode-1") is not None
+                and rs["decode-1"].role == "decode"
+            ):
+                break
+            await asyncio.sleep(0.05)
+        assert gateway._replicas["prefill-1"].role == "prefill"
+        assert gateway._replicas["decode-1"].role == "decode"
+
+        async def generate(port, body):
+            return await loop.run_in_executor(
+                None, _post, port, "/v1/generate", body
+            )
+
+        # -- buffered parity through a live handoff ----------------
+        body1 = {"tokens": [row1], "max_new_tokens": 8, "seed": 11}
+        via_gw = await generate(gateway.port, body1)
+        direct = await generate(ref.port, body1)
+        assert via_gw[0] == 200 and direct[0] == 200
+        tokens_gw = json.loads(via_gw[1])["tokens"]
+        tokens_ref = json.loads(direct[1])["tokens"]
+        assert tokens_gw == tokens_ref
+        assert gateway.handoffs["total"] >= 1
+        assert gateway.handoffs["failed"] == 0
+        assert gateway.handoffs["bytes"] > 0
+        assert gateway.handoffs["ms_sum"] > 0.0
+        # the handed-off entry actually fed the decode replica: it
+        # readmitted through the spill tier's reuse_admission path
+        spill_stats = decode_srv.prefix_cache.spill.snapshot()
+        assert spill_stats["readmitted"] >= 1
+
+        # -- SSE parity through a second handoff -------------------
+        body2 = {
+            "tokens": [row2], "max_new_tokens": 8, "seed": 12,
+            "stream": True,
+        }
+        sse_gw = await generate(gateway.port, body2)
+        sse_ref = await generate(ref.port, body2)
+        assert sse_gw[0] == 200 and sse_ref[0] == 200
+        ct = {k.lower(): v for k, v in sse_gw[2].items()}["content-type"]
+        assert "text/event-stream" in ct
+        streamed_gw = _sse_tokens(sse_gw[1])
+        streamed_ref = _sse_tokens(sse_ref[1])
+        assert streamed_gw == streamed_ref and streamed_gw
+        assert gateway.handoffs["total"] >= 2
+
+        # -- digest-warm repeat skips the handoff ------------------
+        fp1 = prefix_fingerprint(row1)
+        for _ in range(200):
+            if fp1 in gateway._replicas["decode-1"].digest:
+                break
+            await asyncio.sleep(0.05)
+        assert fp1 in gateway._replicas["decode-1"].digest
+        total_before = gateway.handoffs["total"]
+        repeat = await generate(gateway.port, body1)
+        assert repeat[0] == 200
+        assert json.loads(repeat[1])["tokens"] == tokens_ref
+        assert gateway.handoffs["skipped_warm"] >= 1
+        assert gateway.handoffs["total"] == total_before
+
+        # -- poisoned chunk: fall back, never adopt corrupt KV -----
+        monkeypatch.setattr(
+            handoff_mod, "kv_transfer_plan", poisoned_plan
+        )
+        failed_before = gateway.handoffs["failed"]
+        total_before = gateway.handoffs["total"]
+        # had the corrupt entry been adopted, the generation would
+        # READMIT it (readmitted +1); local LRU churn can legitimately
+        # bump "spilled", so readmissions are the adoption signal
+        readmitted_before = decode_srv.prefix_cache.spill.snapshot()[
+            "readmitted"
+        ]
+        body3 = {"tokens": [row3], "max_new_tokens": 8, "seed": 13}
+        via_gw3 = await generate(gateway.port, body3)
+        direct3 = await generate(ref.port, body3)
+        assert via_gw3[0] == 200 and direct3[0] == 200
+        assert (
+            json.loads(via_gw3[1])["tokens"]
+            == json.loads(direct3[1])["tokens"]
+        )
+        assert gateway.handoffs["failed"] == failed_before + 1
+        assert gateway.handoffs["total"] == total_before
+        after = decode_srv.prefix_cache.spill.snapshot()
+        assert after["readmitted"] == readmitted_before
+
+        await gateway.stop()
+        await member_p.stop()
+        await member_d.stop()
+        await decode_srv.stop()
+        await prefill_srv.stop()
+        await ref.stop()
+
+    run(scenario(), timeout=600)
