@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all build test test-fast test-workload integration fleet-smoke trace-smoke chaos chaos-smoke bench bench-host bench-gateway bench-reuse bench-goodput bench-coldstart bench-disagg lint lint-baseline clean image
+.PHONY: all build test test-fast test-workload integration fleet-smoke trace-smoke chaos chaos-smoke bench bench-host bench-gateway bench-reuse bench-goodput bench-coldstart bench-disagg bench-migrate lint lint-baseline clean image
 
 all: build test
 
@@ -92,6 +92,15 @@ bench-reuse:
 bench-disagg:
 	JAX_PLATFORMS=cpu $(PYTHON) -c "import json, bench; \
 		print(json.dumps(bench.disagg_bench(), indent=2))"
+
+# the drain-migration yardstick (docs/60 § drain runbook): next-turn
+# latency for a session whose replica drains — warm ceiling vs
+# migrated-over-the-wire vs the re-prefill baseline; meets_target
+# pins migrated strictly below re-prefill and near warm, with bytes
+# moved and zero counted fallbacks
+bench-migrate:
+	JAX_PLATFORMS=cpu $(PYTHON) -c "import json, bench; \
+		print(json.dumps(bench.migration_bench(), indent=2))"
 
 # the device-time ledger's accounting bench (docs/90): every replica
 # wall-second attributed (|sum(stages) - uptime| <= 2%) plus the

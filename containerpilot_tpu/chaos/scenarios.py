@@ -395,7 +395,6 @@ class FleetHarness:
                 registry=self.gateway.registry,
             )
             self.gateway.attach_autoscaler(self.autoscaler)
-            self.autoscaler.start()
         for role, kwargs in self.pool_autoscaler_kwargs.items():
             pool_kwargs = dict(kwargs)
             pool_kwargs.setdefault(
@@ -414,8 +413,19 @@ class FleetHarness:
                 pool=role,
             )
             self.gateway.attach_autoscaler(scaler)
-            scaler.start()
             self.pool_autoscalers[role] = scaler
+
+    def start_autoscalers(self) -> None:
+        """Arm the scaler tick loops. Called AFTER warmup, not inside
+        ``start()``: warm requests bypass the gateway, so a fleet
+        booted above ``min_replicas`` would read as sustained-idle
+        during the (minutes-long on a cold box) compile window and
+        scale down replicas the warmup is still talking to. The
+        autoscaler's clock starts with the traffic clock."""
+        if self.autoscaler is not None:
+            self.autoscaler.start()
+        for scaler in self.pool_autoscalers.values():
+            scaler.start()
 
     async def stop(self) -> None:
         for scaler in self.pool_autoscalers.values():
@@ -688,6 +698,24 @@ class ScenarioSpec:
     #: KV replica-to-replica instead of silently falling back to
     #: decode-side prefill on every request
     expect_handoffs_min: int = 0
+    # -- drain-migration invariants ------------------------------------
+    #: sessions a draining replica must have pushed (KV prefix over
+    #: the handoff wire, or digest-warm landing) onto survivors —
+    #: proves drain ran as a migration, not an eviction
+    expect_migrations_min: int = 0
+    #: ceiling on migration window timeouts (0 gates "nothing fell
+    #: back to the eviction path"; None skips)
+    expect_migration_timeouts_max: Optional[int] = None
+    #: sessions_migrated must cover pins_repointed: every sticky pin
+    #: the gateway moved off an mg= landing corresponds to a prefix
+    #: that actually landed on the survivor first
+    expect_migrations_cover_moves: bool = False
+    #: violation class -> a stage that must NOT dominate it (e.g.
+    #: {"ttft": "replica.prefill"}: migrated sessions' TTFT misses
+    #: must not be re-prefill — the KV landed, so blame belongs to
+    #: queueing/transport, never recompute). Vacuously true when the
+    #: class has no violations.
+    forbid_dominant_stage: Dict[str, str] = field(default_factory=dict)
     # -- latency-attribution invariants --------------------------------
     #: violation class -> the stage that must dominate it in the
     #: report's stage_attribution (e.g. {"ttft":
@@ -848,6 +876,7 @@ async def run_scenario_async(
         await harness.start()
         gw = harness.gateway
         await _warm_fleet(harness, requests)
+        harness.start_autoscalers()
         # reuse accounting starts AFTER warmup: the warm requests
         # seed replica-0's prefix cache with [1]*L prompts whose
         # chained matches must not inflate the trace's reuse numbers
@@ -909,6 +938,11 @@ async def run_scenario_async(
             # moved, failures (fell back to local prefill),
             # digest-warm skips, and summed transfer wall ms
             "handoff": dict(gw.handoffs),
+            # drain-migration ledger: sessions landed on survivors,
+            # counted fallbacks (failed pushes / window timeouts),
+            # sticky pins repointed off mg= landings, and 503 drain
+            # answers that carried X-CP-Migrated-To
+            "migration": dict(gw.migrations),
         }
         kv_after = harness.kv_stats()
         prompt_tokens = sum(len(r.tokens) for r in requests)
@@ -1196,6 +1230,37 @@ async def run_scenario_async(
             f"{gateway_stats['handoff']['skipped_warm']:.0f}; "
             f"expected >= {spec.expect_handoffs_min})",
         )
+    migration_stats = gateway_stats.get("migration", {})
+    if spec.expect_migrations_min > 0:
+        moved = migration_stats.get("sessions_migrated", 0)
+        check(
+            "sessions_migrated",
+            moved >= spec.expect_migrations_min,
+            f"{moved} sessions migrated off draining replicas "
+            f"(failed={migration_stats.get('failed', 0)}, "
+            f"timeout={migration_stats.get('timeout', 0)}; expected "
+            f">= {spec.expect_migrations_min}; a drain must push its "
+            f"live KV to survivors, not evict it)",
+        )
+    if spec.expect_migration_timeouts_max is not None:
+        timed_out = migration_stats.get("timeout", 0)
+        check(
+            "migration_timeouts",
+            timed_out <= spec.expect_migration_timeouts_max,
+            f"{timed_out} migration window timeouts (bound "
+            f"{spec.expect_migration_timeouts_max}; a timeout is the "
+            f"counted eviction fallback — this run must not need it)",
+        )
+    if spec.expect_migrations_cover_moves:
+        moved = migration_stats.get("sessions_migrated", 0)
+        repointed = migration_stats.get("pins_repointed", 0)
+        check(
+            "migrations_cover_moves",
+            moved >= repointed,
+            f"{moved} sessions migrated vs {repointed} sticky pins "
+            f"repointed (every repoint must ride an mg= landing — a "
+            f"pin moved without its KV is a silent re-prefill)",
+        )
     if spec.min_productive_fraction is not None:
         fraction = goodput_ledger["productive_fraction"]
         check(
@@ -1275,6 +1340,27 @@ async def run_scenario_async(
                 attributed["dominant"] == want,
                 f"{attributed['count']} {cls} violations dominated by "
                 f"{attributed['dominant']!r} (expected {want!r}; "
+                f"stage totals {attributed['stages_ms']})",
+            )
+    for cls, banned in sorted(spec.forbid_dominant_stage.items()):
+        attributed = score["stage_attribution"].get(cls)
+        if attributed is None:
+            check(
+                f"not_dominant_{cls}", True,
+                f"no {cls} violations to attribute (vacuous pass)",
+            )
+        elif attributed["with_stage_data"] == 0:
+            check(
+                f"not_dominant_{cls}", False,
+                f"{attributed['count']} {cls} violations but none "
+                f"carried stage data — trace propagation broken?",
+            )
+        else:
+            check(
+                f"not_dominant_{cls}",
+                attributed["dominant"] != banned,
+                f"{attributed['count']} {cls} violations dominated by "
+                f"{attributed['dominant']!r} (must NOT be {banned!r}; "
                 f"stage totals {attributed['stages_ms']})",
             )
 
@@ -1825,6 +1911,13 @@ _register(ScenarioSpec(
     expect_cache_hint_hits_min=1,
     expect_tokens_reused_min=100,
     expect_readmitted_min=1,
+    # the drain is now a MIGRATION: at least one session's KV must
+    # land on a survivor over the handoff wire, and every sticky pin
+    # the gateway repoints must ride one of those landings (reuse
+    # holding through the drain is the expect_tokens_reused_min gate
+    # above — migration is HOW it holds)
+    expect_migrations_min=1,
+    expect_migrations_cover_moves=True,
     # device-time floor: measured ~0.044 warm-process (tier-1 module
     # runs — the tiny model's reuse-accelerated turns cost ms) up to
     # ~0.59 cold (mid-trace extend-bucket compiles billed to
@@ -1865,6 +1958,72 @@ _register(ScenarioSpec(
     max_5xx=30,
     min_goodput_fraction=0.0,
     expect_tokens_reused_min=1,
+))
+
+_register(ScenarioSpec(
+    name="scale_down_migrated",
+    description=(
+        "the AUTOSCALER retires a replica out of a live multi-turn "
+        "fleet (3 -> min 2) and the retire path runs the migrate "
+        "window: every live session's KV pushes to a digest-chosen "
+        "survivor over the handoff wire before the record "
+        "deregisters, sticky pins repoint off mg= landings, and the "
+        "next turns land warm — zero client-visible 5xx, zero "
+        "migration-window timeouts, and any TTFT violations must "
+        "NOT be re-prefill (the KV moved, so recompute is the one "
+        "cause this scenario forbids)"
+    ),
+    trace=_REUSE_TRACE,
+    # no injected fault: the scale-down IS the event, decided by the
+    # autoscaler when the trace's load falls away
+    faults=(),
+    replicas=3,
+    # ttl 2 for the same reason as multiturn_rebalance: one lab-box
+    # process carries the whole fleet, and a contention spike must
+    # not flap a healthy replica mid-retire
+    ttl=2,
+    server=dict(_REUSE_SERVER),
+    # sticky capacity raised well above the session count: this
+    # scenario gates on pins REPOINTING (mg= landings / drain
+    # answers), so pins must still exist when the retire fires —
+    # LRU churn is multiturn_rebalance's subject, not this one's
+    gateway=dict(_REUSE_GATEWAY, sticky_capacity=12),
+    autoscaler={
+        "min_replicas": 2,
+        "max_replicas": 3,
+        "slots_per_replica": 2,
+        # high_water parked out of reach: the scenario is about the
+        # way DOWN — a surprise scale-up would hide the migration
+        # under fresh capacity
+        "high_water": 0.95,
+        # low_water UNDER one outstanding request's occupancy (1/6):
+        # only a totally idle fleet reads as under, so the down can
+        # only fire once the conversations have stopped arriving —
+        # when the victim's prefix cache is at its fullest
+        "low_water": 0.1,
+        "up_sustain_s": 10.0,
+        "down_sustain_s": 2.0,
+        "cooldown_s": 0.5,
+        "tick_interval": 0.15,
+    },
+    # the idle tail is where down_sustain elapses, the retire's
+    # migrate window runs, and the survivors serve the repointed
+    # sessions' final turns
+    settle_s=5.0,
+    # spill readmits + extend-bucket compiles burst the GIL exactly
+    # as in multiturn_rebalance — same raised, stated bound
+    max_loop_lag_ms=2500.0,
+    slo=SLO(ttft_s=4.0, tpot_s=0.5),
+    min_goodput_fraction=0.8,
+    expect_scale_down_min=1,
+    expect_managed_at_end=2,
+    expect_migrations_min=1,
+    # the counted eviction fallback must stay unused: a localhost
+    # push inside a 5s window has no business timing out
+    expect_migration_timeouts_max=0,
+    expect_migrations_cover_moves=True,
+    forbid_dominant_stage={"ttft": "replica.prefill"},
+    min_productive_fraction=0.01,
 ))
 
 #: the disaggregation fleet's server knobs: the KV-reuse tiering

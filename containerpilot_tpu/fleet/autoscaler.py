@@ -132,6 +132,10 @@ class Autoscaler:
         #: managed count — and arms the equal-jitter retry backoff so
         #: a broken launcher can't be hammered every tick
         self.launch_failures = 0
+        #: retires whose drain raised (drainer died mid-migration,
+        #: TTL-expired during its migrate window): the tick survives,
+        #: the managed-count repair refills any real loss next tick
+        self.retire_failures = 0
         self._launch_backoff = self.cfg.launch_backoff_s
         self._launch_retry_at = float("-inf")
         self._rng = random.Random(self.cfg.jitter_seed)
@@ -210,6 +214,7 @@ class Autoscaler:
             "scale_ups": self.scale_ups,
             "scale_downs": self.scale_downs,
             "launch_failures": self.launch_failures,
+            "retire_failures": self.retire_failures,
             "utilization": round(self.last_utilization, 4),
             "high_water": self.cfg.high_water,
             "low_water": self.cfg.low_water,
@@ -343,7 +348,26 @@ class Autoscaler:
         if victim is None:
             return
         decided = time.monotonic()
-        await self.launcher.retire(victim)
+        try:
+            await self.launcher.retire(victim)
+        except Exception as exc:
+            # the drainer can die MID-retire (TTL expiry inside its
+            # migrate window, a SIGKILL racing the drain): the tick
+            # must survive it. Count the failure, don't record a
+            # scale-down that didn't cleanly happen, and leave the
+            # cooldown armed — if the victim really is gone the
+            # managed count falls below min and the ordinary repair
+            # path relaunches next tick (no slot leak); sessions the
+            # partial migration already landed keep their repointed
+            # pins (the gateway applied those as they beat).
+            self.retire_failures += 1
+            self._last_event = now
+            self._under_since = None
+            log.warning(
+                "autoscaler: retire of %s failed mid-drain: %s",
+                victim, exc,
+            )
+            return
         self.scale_downs += 1
         entry = {"direction": "down", "replica": victim, "at": decided}
         if self.pool:

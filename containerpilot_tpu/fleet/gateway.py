@@ -76,6 +76,7 @@ from ..kvtier import (
     parse_digest,
     parse_kv_counters,
     parse_kv_note,
+    parse_migration_note,
     prefix_fingerprint,
 )
 from ..analysis.loopcheck import LoopLagProbe
@@ -188,6 +189,18 @@ class Replica:
     #: compile-cache advertisement (``cc=<digest>:<dir>``, raw):
     #: same-host launches adopt the dir; surfaced on /fleet
     compile_cache: str = ""
+    #: True while this replica is evacuating its sessions (``mg=``
+    #: note, active flag): routing avoids NEW pins on it whenever
+    #: any alternative exists — it is about to leave, and a fresh
+    #: session there would need migrating right back
+    migrating: bool = False
+    #: last-seen cumulative ``mg=`` counters (the delta source for
+    #: the fleet migration accounting; elementwise-max merged like
+    #: the kv counters, so torn notes never regress them)
+    migration: Dict[str, int] = field(default_factory=dict)
+    #: fp -> survivor id landings already applied (so each landing
+    #: repoints pins exactly once however many beats re-carry it)
+    migrated: Dict[int, str] = field(default_factory=dict)
 
     @property
     def load(self) -> int:
@@ -474,6 +487,19 @@ class FleetGateway:
             "total": 0, "bytes": 0, "failed": 0, "skipped_warm": 0,
             "ms_sum": 0.0,
         }
+        #: plain mirrors of the drain-migration counters for /fleet
+        #: (docs/60 § drain runbook): sessions landed on a survivor,
+        #: failed pushes (fell back to re-prefill), window-expiry
+        #: timeouts, sticky pins repointed off landings, and 503
+        #: drain answers that carried X-CP-Migrated-To
+        self.migrations: Dict[str, int] = {
+            "sessions_migrated": 0, "failed": 0, "timeout": 0,
+            "pins_repointed": 0, "drain_answers": 0,
+        }
+        #: sticky key -> prefix fingerprint, recorded as pins form:
+        #: the join the migration repoint needs (an ``mg=`` landing
+        #: names an fp; this maps it back to the pinned sessions)
+        self._session_fp: Dict[str, int] = {}
         #: final tokens_reused advertised by replicas that have LEFT
         #: the fleet, keyed by id — the fleet-wide gauge must not
         #: forget a drained replica's contribution, and keying by id
@@ -617,6 +643,28 @@ class FleetGateway:
             registry=self._registry,
             buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000,
                      2500, 5000),
+        )
+        self._m_migrated = Counter(
+            "containerpilot_gateway_sessions_migrated",
+            "sessions landed on a survivor by a drain migration "
+            "(KV pushed — or already warm — and the fingerprint "
+            "advertised as landed over the mg= note channel)",
+            registry=self._registry,
+        )
+        self._m_migration_failed = Counter(
+            "containerpilot_gateway_migration_failed",
+            "drain-migration pushes that failed (dead target, "
+            "poisoned chunk, declined adoption); the session fell "
+            "back to re-prefill on its survivor — never a client "
+            "error",
+            registry=self._registry,
+        )
+        self._m_migration_timeout = Counter(
+            "containerpilot_gateway_migration_timeout",
+            "sessions left unmoved when a drain's migrate window "
+            "expired; they fell back to cache-aware re-pin + "
+            "re-prefill, today's drain behavior",
+            registry=self._registry,
         )
         self._m_flaps_damped = Counter(
             "containerpilot_gateway_catalog_flaps_damped",
@@ -1028,6 +1076,37 @@ class FleetGateway:
                 replica.digest = fps
                 replica.digest_version = version
                 replica.digest_at = time.monotonic()
+        if "mg" in fields:
+            # drain-migration progress: cumulative counters (same
+            # elementwise-max torn-note discipline as kv=) whose
+            # deltas feed the fleet accounting, plus fp->target
+            # landings — each NEW landing repoints the drainer's
+            # matching sticky pins onto the survivor immediately
+            counters, landed = parse_migration_note(fields["mg"])
+            prev = replica.migration
+            merged = {
+                name: max(counters.get(name, 0), prev.get(name, 0))
+                for name in ("done", "total", "failed", "timeout")
+            }
+            moved = merged["done"] - prev.get("done", 0)
+            failed = merged["failed"] - prev.get("failed", 0)
+            timed_out = merged["timeout"] - prev.get("timeout", 0)
+            if moved:
+                self._m_migrated.inc(moved)
+                self.migrations["sessions_migrated"] += moved
+            if failed:
+                self._m_migration_failed.inc(failed)
+                self.migrations["failed"] += failed
+            if timed_out:
+                self._m_migration_timeout.inc(timed_out)
+                self.migrations["timeout"] += timed_out
+            replica.migration = merged
+            replica.migrating = bool(counters.get("active", 0))
+            for landed_fp, target in landed.items():
+                if replica.migrated.get(landed_fp) == target:
+                    continue
+                replica.migrated[landed_fp] = target
+                self._repoint_sessions(replica.id, landed_fp, target)
         # role rides every beat of a non-active replica (standby,
         # prefill, decode) and is ABSENT from an active one's note —
         # the first post-promotion beat flips the routing view back
@@ -1045,6 +1124,24 @@ class FleetGateway:
             )
         if "cc" in fields:
             replica.compile_cache = fields["cc"]
+
+    def _repoint_sessions(
+        self, source_id: str, fp: int, target_id: str
+    ) -> None:
+        """Apply one migration landing: every sticky key pinned to
+        the draining ``source_id`` whose recorded session fingerprint
+        matches moves to the survivor NOW — the client's next turn
+        lands where its KV already is, warm, instead of bouncing off
+        the drainer's 503 or re-prefilling cold after deregister.
+        A landing naming a target this gateway can't see (not yet
+        polled, already gone) is skipped; the pin falls back to the
+        ordinary drained-away re-pin path."""
+        if target_id == source_id or target_id not in self._replicas:
+            return
+        for k, rid in self._sticky.items():
+            if rid == source_id and self._session_fp.get(k) == fp:
+                self._sticky[k] = target_id
+                self.migrations["pins_repointed"] += 1
 
     def _fleet_tokens_reused(self) -> int:
         """Fleet-wide tokens_reused: live replicas' last-advertised
@@ -1206,6 +1303,12 @@ class FleetGateway:
             r for r in self._replicas.values()
             if r.id not in excluded and r.role != ROLE_STANDBY
         ]
+        # a replica mid-evacuation (mg= active) takes no NEW work
+        # while any alternative exists: it is leaving, and a fresh
+        # session there would need migrating right back. Soft like
+        # the phase preference — sole-survivor fleets still route.
+        settled = [r for r in candidates if not r.migrating]
+        candidates = settled or candidates
         if phase == "decode":
             preferred = [
                 r for r in candidates if r.role != ROLE_PREFILL
@@ -1296,12 +1399,18 @@ class FleetGateway:
         excluded |= dead_ids
         repin = True
         if key is not None:
+            if fp is not None:
+                # remember the session's fingerprint while it is
+                # routed at all: the join a drain migration's mg=
+                # landings repoint pins through
+                self._session_fp[key] = fp
             pinned = self._sticky.get(key)
             if pinned is not None:
                 replica = self._replicas.get(pinned)
                 if replica is None or pinned in dead_ids:
                     self._m_drained.labels(pinned).inc()
                     self._sticky.pop(key, None)
+                    self._session_fp.pop(key, None)
                 elif pinned not in excluded:
                     self._sticky.move_to_end(key)
                     return replica
@@ -1312,7 +1421,8 @@ class FleetGateway:
             self._sticky[key] = replica.id
             self._sticky.move_to_end(key)
             while len(self._sticky) > self.sticky_capacity:
-                self._sticky.popitem(last=False)
+                evicted_key, _rid = self._sticky.popitem(last=False)
+                self._session_fp.pop(evicted_key, None)
                 self._m_sticky_evicted.inc()
                 self.sticky_evicted += 1
         return replica
@@ -1446,6 +1556,11 @@ class FleetGateway:
                     for role in _SERVING_ROLES + (ROLE_STANDBY,)
                 },
                 "handoff": dict(self.handoffs),
+                # drain migration (docs/60 § drain runbook): sessions
+                # moved to survivors over the handoff wire in reverse,
+                # counted fallbacks, and the pins repointed off mg=
+                # landings / X-CP-Migrated-To drain answers
+                "migration": dict(self.migrations),
                 "autoscaler": (
                     self._autoscalers[0].stats
                     if self._autoscalers else None
@@ -1735,6 +1850,45 @@ class FleetGateway:
         if retrying:
             await asyncio.sleep(self._jittered(backoff))
         return min(backoff * 2, self.retry_backoff_cap)
+
+    async def _drain_bounce(
+        self,
+        key: Optional[str],
+        replica_id: str,
+        headers: Dict[str, str],
+        tried: Set[str],
+        attempt: int,
+        backoff: float,
+    ) -> float:
+        """Retry bookkeeping for a retryable 503 that may be a
+        DRAINING replica's migration-aware answer: when the response
+        names the survivor the session already landed on
+        (``X-CP-Migrated-To``), repoint the pin NOW — the retry
+        reconnects warm instead of re-prefilling cold — and bill the
+        bounce wait to the ``replica.kv_migrate`` trace stage so a
+        TTFT violation blames the migration, not the survivor's
+        prefill. Plain drain 503s take exactly the old path."""
+        target = headers.get("x-cp-migrated-to", "")
+        if target:
+            self.migrations["drain_answers"] += 1
+            if (
+                key is not None
+                and target in self._replicas
+                and self._sticky.get(key) == replica_id
+            ):
+                self._sticky[key] = target
+                self.migrations["pins_repointed"] += 1
+        t0 = time.monotonic()
+        backoff = await self._retry_pause(
+            tried, {replica_id}, attempt, backoff
+        )
+        if target:
+            trace = tracing.current_trace()
+            if trace is not None:
+                trace.add_span(
+                    "replica.kv_migrate", t0, time.monotonic()
+                )
+        return backoff
 
     def _jittered(self, backoff: float) -> float:
         """Equal-jitter backoff (the fleet's shared shape,
@@ -2232,8 +2386,9 @@ class FleetGateway:
                 # blame the replica whose response this actually is —
                 # under hedging that may be the hedge, not the primary
                 last = self._relay(status, headers, payload)
-                backoff = await self._retry_pause(
-                    tried, {served_by.id}, attempt, backoff
+                backoff = await self._drain_bounce(
+                    key, served_by.id, headers, tried, attempt,
+                    backoff,
                 )
                 continue
             self._stitch_upstream(headers)
@@ -2362,8 +2517,9 @@ class FleetGateway:
                             and attempt < self.retries
                         ):
                             last = self._relay(status, headers, payload)
-                            backoff = await self._retry_pause(
-                                tried, {replica.id}, attempt, backoff
+                            backoff = await self._drain_bounce(
+                                key, replica.id, headers, tried,
+                                attempt, backoff,
                             )
                             continue
                         return self._relay(status, headers, payload)
@@ -2419,8 +2575,9 @@ class FleetGateway:
                         and attempt < self.retries
                     ):
                         last = self._relay(status, headers, payload)
-                        backoff = await self._retry_pause(
-                            tried, {replica.id}, attempt, backoff
+                        backoff = await self._drain_bounce(
+                            key, replica.id, headers, tried,
+                            attempt, backoff,
                         )
                         continue
                     return self._relay(status, headers, payload)

@@ -14,11 +14,18 @@ watched, or drained. A FleetMember closes that gap for an in-process
   Because catalog ops drain through the discovery FIFO's long-lived
   thread, an HTTP backend (consul) serves every TTL refresh over ONE
   persistent keep-alive connection instead of dialing each beat.
-- **Drain.** ``drain()`` flips the server into maintenance (health
-  503, new generate/completions rejected with 503 + Retry-After),
-  deregisters the catalog record so gateways route away within one
-  poll interval, and waits for in-flight requests — including running
-  slot-engine rows — to finish. ``resume()`` undoes it; the next
+- **Drain = migrate, then deregister.** ``drain()`` flips the server
+  into maintenance (health 503, new generate/completions rejected with
+  503 + Retry-After), then — before the catalog record vanishes —
+  evacuates the replica's cached KV prefixes to the digest-coldest
+  healthy survivors over the handoff wire in reverse
+  (``server.migrate_sessions``, bounded by ``migrate_window``),
+  heartbeating ``mg=`` progress so the gateway repoints sticky pins as
+  each session lands. Only then does it deregister and wait for
+  in-flight requests — including running slot-engine rows — to finish.
+  Migration failure of any kind (no survivors, dead targets, window
+  expiry) falls back to today's behavior: deregister and let the
+  survivors re-prefill. ``resume()`` undoes maintenance; the next
   heartbeat lazily re-registers.
 - **Control plane.** ``attach_bus(bus)`` subscribes to the event
   bus's maintenance events, so the supervisor's
@@ -65,10 +72,13 @@ class FleetMember(EventHandler):
         instance_id: str = "",
         tags: Iterable[str] = (),
         advertise_port: Optional[int] = None,
+        migrate_window: float = 5.0,
     ) -> None:
         super().__init__()
         if ttl < 1:
             raise ValueError("ttl must be >= 1 second")
+        if migrate_window < 0:
+            raise ValueError("migrate_window must be >= 0 seconds")
         self.server = server
         self.backend = backend
         self.service_name = service_name
@@ -83,6 +93,14 @@ class FleetMember(EventHandler):
         # advertise a different port than the server's bind (NAT'd
         # deployments; the chaos harness's transport proxies)
         self.advertise_port = advertise_port
+        #: seconds a drain spends evacuating KV to survivors before
+        #: deregistering; 0 disables migration (today's drain)
+        self.migrate_window = float(migrate_window)
+        # True only while drain() is inside its migrate window: the
+        # ONE draining state that still heartbeats (carrying mg=
+        # progress) — after deregister the flag is down again, so a
+        # drained replica can never lazily re-register itself
+        self._evacuating = False
         self.service = ServiceDefinition(
             ServiceRegistration(
                 id=self.instance_id,
@@ -139,7 +157,10 @@ class FleetMember(EventHandler):
             await asyncio.sleep(self.heartbeat_interval)
 
     def _beat_once(self) -> None:
-        if getattr(self.server, "draining", False):
+        if (
+            getattr(self.server, "draining", False)
+            and not self._evacuating
+        ):
             return  # drained replicas stay out of the catalog
         if getattr(self.server, "ready", False):
             # lazy-register + TTL refresh; enqueued FIFO off-loop.
@@ -187,6 +208,15 @@ class FleetMember(EventHandler):
                 extra = gp_note()
                 if extra:
                     output += " " + extra
+            # drain-migration advertisement (``mg=`` — cumulative
+            # counters + the latest fp->target landings): the channel
+            # the gateway repoints sticky pins off while this replica
+            # evacuates. Empty until a migration has ever run.
+            mg_note = getattr(self.server, "migrate_note", None)
+            if callable(mg_note):
+                extra = mg_note()
+                if extra:
+                    output += " " + extra
             self.service.send_heartbeat(output=output)
         # not ready (warming, or wedged enough that ready regressed):
         # no beat — an existing record's TTL expiry flips it critical
@@ -206,10 +236,51 @@ class FleetMember(EventHandler):
     async def drain(
         self, wait: bool = True, timeout: float = 30.0
     ) -> bool:
-        """Maintenance: stop advertising, stop accepting, finish
-        in-flight. Returns True once the replica is idle (always True
-        for ``wait=False``; False only on timeout)."""
+        """Maintenance: stop accepting, MIGRATE cached KV to the
+        survivors, then stop advertising and finish in-flight.
+        Returns True once the replica is idle (always True for
+        ``wait=False``; False only on timeout).
+
+        The ordering is the tentpole: migrate -> deregister ->
+        in-flight completion. During the bounded migrate window the
+        catalog record stays alive and heartbeats ``mg=`` progress,
+        so the gateway repoints each landed session's pin BEFORE the
+        record vanishes; any migration failure degrades to exactly
+        the old drain (deregister + survivor re-prefill), never an
+        error."""
         self.server.enter_maintenance()
+        if self.migrate_window > 0 and callable(
+            getattr(self.server, "migrate_sessions", None)
+        ):
+            self._evacuating = True
+            try:
+                targets = await self._survivors()
+                if targets:
+                    reg = self.service.registration
+                    summary = await self.server.migrate_sessions(
+                        targets,
+                        window_s=self.migrate_window,
+                        authority=f"{reg.address}:{reg.port}",
+                    )
+                    # flush the final landings into the catalog, then
+                    # linger two beats — long enough for one full
+                    # gateway poll cycle to read them (gateways poll
+                    # at least as often as members beat) before the
+                    # record deregisters
+                    self._beat_once()
+                    if int(summary.get("done", 0) or 0) > 0:
+                        await asyncio.sleep(
+                            min(self.heartbeat_interval * 2.0, 1.0)
+                        )
+            except Exception as exc:
+                # migration is an accelerator for the drain, never a
+                # blocker: any failure here means survivors re-prefill
+                log.warning(
+                    "%s: drain migration failed (%s); falling back "
+                    "to plain drain", self.instance_id, exc,
+                )
+            finally:
+                self._evacuating = False
         await self._deregister()
         if not wait:
             return True
@@ -225,6 +296,47 @@ class FleetMember(EventHandler):
             await asyncio.sleep(0.02)
         log.info("%s: drained", self.instance_id)
         return True
+
+    async def _survivors(self) -> list:
+        """The healthy peers a drain may migrate KV toward:
+        ``(instance_id, address, port, fingerprint_set)`` per catalog
+        record, excluding self, standbys and the prefill pool (a
+        session's KV belongs where decode runs), and peers that are
+        themselves mid-migration. Catalog errors return [] — the
+        drain then falls back to a plain deregister."""
+        from ..kvtier.digest import (
+            parse_digest,
+            parse_kv_note,
+            parse_migration_note,
+        )
+
+        loop = asyncio.get_event_loop()
+        try:
+            instances = await loop.run_in_executor(
+                None, self.backend.instances, self.service_name
+            )
+        except Exception as exc:
+            log.warning(
+                "%s: survivor discovery failed: %s",
+                self.instance_id, exc,
+            )
+            return []
+        out = []
+        for inst in instances or []:
+            if inst.id == self.instance_id:
+                continue
+            fields = parse_kv_note(getattr(inst, "notes", ""))
+            if fields.get("role", "") in ("standby", "prefill"):
+                continue
+            mg, _landed = parse_migration_note(fields.get("mg", ""))
+            if mg["active"]:
+                continue
+            _ver, fps = parse_digest(fields.get("pd", ""))
+            out.append(
+                (inst.id, inst.address, int(inst.port), fps)
+            )
+        out.sort(key=lambda t: t[0])
+        return out
 
     def resume(self) -> None:
         """Exit maintenance; the next heartbeat lazily re-registers

@@ -28,16 +28,22 @@ from .digest import (
     DIGEST_MAX_BYTES,
     FP_TOKENS,
     encode_fingerprints,
+    encode_migration_note,
     parse_digest,
     parse_kv_counters,
     parse_kv_note,
+    parse_migration_note,
     prefix_fingerprint,
 )
 from .handoff import (
     KV_PATH,
+    KV_PULL_PATH,
     KVTransferError,
+    MIGRATE_PATH,
     fetch_kv,
     kv_transfer_plan,
+    plan_migration,
+    push_kv,
     rebuild_kv,
 )
 from .spill import HostSpillTier
@@ -48,12 +54,18 @@ __all__ = [
     "HostSpillTier",
     "KVTransferError",
     "KV_PATH",
+    "KV_PULL_PATH",
+    "MIGRATE_PATH",
     "encode_fingerprints",
+    "encode_migration_note",
     "fetch_kv",
     "kv_transfer_plan",
     "parse_digest",
     "parse_kv_counters",
     "parse_kv_note",
+    "parse_migration_note",
+    "plan_migration",
     "prefix_fingerprint",
+    "push_kv",
     "rebuild_kv",
 ]

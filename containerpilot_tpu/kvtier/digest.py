@@ -111,6 +111,75 @@ def parse_kv_note(notes: object) -> Dict[str, str]:
     return out
 
 
+#: migration-note counter names, wire order (all cumulative over the
+#: replica's life; ``active`` is a 0/1 flag, not a counter)
+MIGRATION_FIELDS = ("done", "total", "failed", "timeout", "active")
+
+
+def encode_migration_note(
+    done: int,
+    total: int,
+    failed: int,
+    timeout: int,
+    active: bool,
+    landed: Iterable[Tuple[int, str]] = (),
+    max_bytes: int = DIGEST_MAX_BYTES,
+) -> str:
+    """Encode a drain-migration progress report for the ``mg=``
+    heartbeat-note field: ``done,total,failed,timeout,active`` plus
+    zero or more ``;<fp hex8>:<target_id>`` landing segments — all
+    non-whitespace, so :func:`parse_kv_note` carries it intact.
+    Landings are size-bounded; callers pass them most-recent-first so
+    truncation drops the repoints the gateway has already seen."""
+    head = "%d,%d,%d,%d,%d" % (
+        max(0, int(done)), max(0, int(total)), max(0, int(failed)),
+        max(0, int(timeout)), 1 if active else 0,
+    )
+    out = [head]
+    budget = max_bytes - len(head)
+    for fp, target in landed:
+        tid = "".join(
+            ch for ch in str(target) if not ch.isspace() and ch != ";"
+        )
+        seg = f";{int(fp) & 0xFFFFFFFF:08x}:{tid}"
+        if len(seg) > budget:
+            break
+        out.append(seg)
+        budget -= len(seg)
+    return "".join(out)
+
+
+def parse_migration_note(
+    raw: object,
+) -> Tuple[Dict[str, int], Dict[int, str]]:
+    """Tolerant inverse of :func:`encode_migration_note`. Returns
+    ``(counters, landed)`` where counters zero-fill on short or torn
+    input (same discipline as :func:`parse_kv_counters`: a half-
+    written note must not zero a replica's migration state) and
+    malformed landing segments are skipped, never thrown on."""
+    out = {name: 0 for name in MIGRATION_FIELDS}
+    landed: Dict[int, str] = {}
+    if not isinstance(raw, str) or not raw:
+        return out, landed
+    head, _, tail = raw.partition(";")
+    for name, part in zip(MIGRATION_FIELDS, head.split(",")):
+        try:
+            out[name] = max(0, int(part))
+        except ValueError:
+            break
+    out["active"] = min(1, out["active"])
+    for seg in tail.split(";") if tail else ():
+        fp_hex, sep, target = seg.partition(":")
+        if not sep or len(fp_hex) != 8 or not target:
+            continue
+        try:
+            fp = int(fp_hex, 16)
+        except ValueError:
+            continue
+        landed.setdefault(fp, target)
+    return out, landed
+
+
 def parse_kv_counters(raw: object) -> Dict[str, int]:
     """Decode the ``kv=`` note field: five comma-separated ints
     (hits, misses, tokens_reused, spilled, readmitted). Short or

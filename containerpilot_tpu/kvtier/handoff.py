@@ -50,14 +50,26 @@ __all__ = [
     "KVTransferError",
     "KV_CHUNK",
     "KV_PATH",
+    "KV_PULL_PATH",
+    "MIGRATE_PATH",
     "encode_kv_manifest",
     "fetch_kv",
     "kv_transfer_plan",
+    "plan_migration",
+    "push_kv",
     "rebuild_kv",
 ]
 
 #: path a replica serves (and pulls) prefix-cache entries on
 KV_PATH = "/v1/kv"
+
+#: path a replica adopts a peer's entry on ({"tokens", "from"}) — the
+#: same verb the gateway's disaggregated handoff POSTs, and the one a
+#: DRAINING replica drives in reverse to evacuate its sessions
+KV_PULL_PATH = "/v1/kv/pull"
+
+#: path a replica reports (and takes) migration instructions on
+MIGRATE_PATH = "/v1/migrate"
 
 #: bytes per chunk — the weight stream's economics apply unchanged
 #: (amortize the per-chunk digest, keep resume re-ship small)
@@ -362,3 +374,150 @@ async def fetch_kv(
         )
         return None
     return host_tree, int(manifest.get("total_bytes", 0))
+
+
+# -- drain migration: the same wire, driven in reverse ------------------
+
+
+def plan_migration(
+    keys: Any, targets: List[Tuple[str, Any]]
+) -> List[Dict[str, Any]]:
+    """Deterministic reverse-push plan for a draining replica: which
+    cached prefix goes to which survivor.
+
+    ``keys`` are the drainer's cached prompt keys (token tuples,
+    device + spill tiers); ``targets`` are ``(target_id,
+    fingerprint_set)`` pairs — each survivor's advertised ``pd=``
+    digest, parsed. The plan is a list of ``{"key", "fp", "target",
+    "warm"}`` entries, one per migratable key:
+
+    - keys under the fingerprint floor are dropped (they can never be
+      reused, so there is nothing worth moving);
+    - a fingerprint already warm on a survivor is recorded as landed
+      there with ``warm=True`` — zero bytes move, but the landing
+      still repoints the gateway's pin;
+    - every key sharing a fingerprint goes to ONE survivor (a
+      conversation's turns share their first-FP_TOKENS ids, and
+      splitting the family would strand its longest prefixes);
+    - cold fingerprints go to the digest-coldest target (fewest
+      advertised + already-planned fingerprints), ties broken by id.
+
+    Pure and deterministic — same keys + same targets produce the
+    same plan regardless of input order, so a resumed or re-driven
+    drain pushes the same assignments (tests pin this).
+    """
+    from .digest import prefix_fingerprint
+
+    plan: List[Dict[str, Any]] = []
+    if not targets:
+        return plan
+    warmth: Dict[str, Any] = {
+        tid: frozenset(fps) for tid, fps in targets
+    }
+    ids = sorted(warmth)
+    # longest prefixes first: they carry the most recompute, and the
+    # family placement they decide is the one the shorter turns join
+    ordered = sorted(
+        {tuple(k) for k in keys}, key=lambda k: (-len(k), k)
+    )
+    assigned: Dict[str, int] = {tid: 0 for tid in ids}
+    placed: Dict[int, str] = {}  # fp -> survivor chosen this plan
+    for key in ordered:
+        fp = prefix_fingerprint(list(key))
+        if fp is None:
+            continue
+        tid = placed.get(fp)
+        if tid is None:
+            warm_ids = [t for t in ids if fp in warmth[t]]
+            tid = warm_ids[0] if warm_ids else min(
+                ids,
+                key=lambda t: (len(warmth[t]) + assigned[t], t),
+            )
+            placed[fp] = tid
+        warm = fp in warmth[tid]
+        if not warm:
+            assigned[tid] += 1
+        plan.append(
+            {"key": key, "fp": fp, "target": tid, "warm": warm}
+        )
+    return plan
+
+
+async def push_kv(
+    address: str,
+    port: int,
+    tokens: List[int],
+    source: str,
+    *,
+    connect_timeout: float = 5.0,
+    read_timeout: float = 30.0,
+) -> Optional[int]:
+    """POST a pull instruction at a survivor: ask ``address:port`` to
+    ``fetch_kv`` this prompt's entry from ``source`` (the draining
+    replica's advertised ``host:port``) and adopt it into its spill
+    tier — the existing handoff wire driven in reverse, so byte
+    parity holds by the same construction the prefill->decode hop
+    relies on. Returns the adopted byte count on success, None on ANY
+    failure (declined upgrade, non-200, transport death after the one
+    redial): the drainer counts it and moves on — a failed push is a
+    fallback to today's re-prefill behavior, never a new error."""
+    from ..fleet.pool import ConnectionPool, UpstreamError
+    from ..fleet.standby import _Peer
+
+    pool = ConnectionPool(mux=True)
+    peer = _Peer(address, port)
+    body = json.dumps(
+        {"tokens": [list(tokens)], "from": source, "migrate": True}
+    ).encode()
+    redialed = False
+    try:
+        while True:
+            try:
+                conn = await pool.acquire_mux(peer, connect_timeout)
+                if conn is None:
+                    raise UpstreamError(
+                        f"{peer.authority} declined the cp-mux/1 "
+                        f"upgrade"
+                    )
+                stream = await conn.open_stream(
+                    "POST", KV_PULL_PATH, body=body
+                )
+                status, _headers = await stream.response_head(
+                    read_timeout
+                )
+                payload = await stream.read_body(
+                    read_timeout, _MANIFEST_CAP
+                )
+                if status != 200:
+                    log.warning(
+                        "kv migrate: %s refused the push (%d)",
+                        peer.authority, status,
+                    )
+                    return None
+                try:
+                    return int(
+                        json.loads(payload.decode()).get("bytes", 0)
+                    )
+                except (ValueError, AttributeError,
+                        UnicodeDecodeError):
+                    return 0
+            except UpstreamError as exc:
+                if redialed:
+                    log.warning(
+                        "kv migrate: push to %s failed (%s)",
+                        peer.authority, exc,
+                    )
+                    return None
+                redialed = True
+                pool.close_all()
+                log.warning(
+                    "kv migrate: peer stream died (%s); redialing "
+                    "once", exc,
+                )
+    except OSError as exc:
+        log.warning(
+            "kv migrate: push to %s failed (%s)", peer.authority, exc
+        )
+        return None
+    finally:
+        pool.close_all()

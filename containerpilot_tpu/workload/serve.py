@@ -36,6 +36,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Awaitable, Callable, Dict, List, Optional
 
@@ -175,6 +176,20 @@ class InferenceServer:
         # control plane's /v3/maintenance endpoints.
         self.draining = False
         self._inflight = 0
+        # drain migration (kvtier/handoff.py in reverse): progress of
+        # the CURRENT evacuation plus cumulative counters for the
+        # ``mg=`` heartbeat field. ``landed`` maps fingerprint ->
+        # target instance id, most-recent-last (the note encoder
+        # reverses it so truncation drops the oldest repoints); the
+        # gateway repoints its sticky pins off these landings.
+        self.migration: Dict[str, Any] = {
+            "active": False, "total": 0, "done": 0, "failed": 0,
+            "timeout": 0, "window_s": 0.0, "started_at": 0.0,
+        }
+        self._migration_landed: "OrderedDict[int, str]" = OrderedDict()
+        self._migration_counters = {
+            "done": 0, "total": 0, "failed": 0, "timeout": 0,
+        }
         # test-only fault-injection seam (chaos harness): when set,
         # awaited before every instrumented API handler. Injects
         # per-request latency (slow-replica brownouts) or raises to
@@ -414,6 +429,10 @@ class InferenceServer:
         self._server.route("POST", "/v1/prefill", self._prefill_verb)
         self._server.route("POST", "/v1/kv", self._kv_export)
         self._server.route("POST", "/v1/kv/pull", self._kv_pull)
+        # drain migration: registered DIRECTLY (not _instrumented)
+        # like /v1/kv — a DRAINING replica must still take migration
+        # instructions and answer progress queries
+        self._server.route("POST", "/v1/migrate", self._migrate_verb)
         route = self._instrumented
         self._server.route("GET", "/v1/model", route(
             "model", self._model_info
@@ -770,15 +789,28 @@ class InferenceServer:
         from ..kvtier.handoff import fetch_kv
 
         row = tokens[0]
+        # a DRAIN-driven pull ("migrate": true) mints a trace so the
+        # adoption is findable on this survivor's /v1/traces ring —
+        # the gateway never saw this hop, so nobody else records it
+        trace = (
+            self._tracer.start(None, "kv_migrate")
+            if body.get("migrate") else None
+        )
         t0 = time_mod.monotonic()
         fetched = await fetch_kv(address, port, row)
         if fetched is None:
+            if trace is not None:
+                trace.add_span("kv_migrate", t0, time_mod.monotonic())
+                trace.finish(502)
             return Response(502, b"kv fetch failed\n")
         host_tree, total_bytes = fetched
         loop = asyncio.get_event_loop()
         adopted = await loop.run_in_executor(
             None, pc.adopt_host, tuple(row), host_tree
         )
+        if trace is not None:
+            trace.add_span("kv_migrate", t0, time_mod.monotonic())
+            trace.finish(200 if adopted else 507)
         if not adopted:
             return Response(
                 507, b"kv entry refused (spill budget)\n"
@@ -794,6 +826,60 @@ class InferenceServer:
                     ),
                 }
             ).encode(),
+            content_type="application/json",
+        )
+
+    async def _migrate_verb(self, req: Request) -> Response:
+        """``POST /v1/migrate``: the drain-migration verb. With
+        ``"targets"`` in the body, run an evacuation toward them (the
+        operator-drain entry point — the FleetMember drain path calls
+        :meth:`migrate_sessions` directly instead); without, answer a
+        progress report including the landed fp -> target map, the
+        POST-back a gateway or operator polls for completion. Served
+        while draining by design — that is exactly when it is used."""
+        try:
+            body = json.loads(req.body.decode() or "{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, UnicodeDecodeError):
+            return Response(422, b"body must be a JSON object\n")
+        targets_raw = body.get("targets")
+        if targets_raw is None:
+            report = dict(self.migration)
+            report["landed"] = {
+                f"{fp:08x}": tid
+                for fp, tid in self._migration_landed.items()
+            }
+            report["cumulative"] = dict(self._migration_counters)
+            return Response(
+                200, json.dumps(report).encode(),
+                content_type="application/json",
+            )
+        if self.prefix_cache is None:
+            return Response(409, b"migration needs --prefix-cache\n")
+        if self.migration["active"]:
+            return Response(409, b"migration already running\n")
+        from ..kvtier.digest import parse_digest
+
+        try:
+            targets = []
+            for t in targets_raw:
+                _ver, fps = parse_digest(t.get("digest", ""))
+                targets.append(
+                    (str(t["id"]), str(t["address"]), int(t["port"]),
+                     fps)
+                )
+            window = float(body.get("window_s", 5.0))
+        except (KeyError, TypeError, ValueError, AttributeError) as exc:
+            return Response(422, f"targets malformed: {exc}\n".encode())
+        authority = str(body.get("authority", "")) or (
+            f"{self.host}:{self.port}"
+        )
+        summary = await self.migrate_sessions(
+            targets, window_s=window, authority=authority
+        )
+        return Response(
+            200, json.dumps(summary).encode(),
             content_type="application/json",
         )
 
@@ -829,8 +915,18 @@ class InferenceServer:
                 # never route here, so this answers only direct
                 # probes. The refusal still echoes the caller's
                 # trace id — an answered-503 must be findable too.
+                # A DRAINING answer is migration-aware: Retry-After
+                # tracks evacuation progress, and once this request's
+                # prefix has landed on a survivor the header names it
+                # so the gateway repoints the pin instead of letting
+                # the client re-prefill cold.
                 self._m_requests.labels(endpoint, "503").inc()
                 headers = {"Retry-After": "1"}
+                if self.draining:
+                    headers["Retry-After"] = self._drain_retry_after()
+                    target = self._drain_migrated_to(req)
+                    if target:
+                        headers["X-CP-Migrated-To"] = target
                 if inbound_id:
                     headers[tracing.TRACE_HEADER] = inbound_id
                 body = (
@@ -1638,6 +1734,172 @@ class InferenceServer:
         fold in every member from its very first beat."""
         dispatches, tokens_out = self._decode_counters()
         return self.ledger.note(dispatches, tokens_out)
+
+    # -- drain migration ------------------------------------------------
+
+    async def migrate_sessions(
+        self,
+        targets: List[Any],
+        window_s: float = 5.0,
+        authority: str = "",
+    ) -> Dict[str, Any]:
+        """Evacuate this replica's cached prefixes to the survivors
+        before a drain deregisters it: plan deterministically
+        (kvtier.plan_migration — digest-coldest target, fp-family
+        affinity, warm fps land with zero bytes), then push each cold
+        entry inside the bounded window by POSTing a pull instruction
+        at its target (the handoff wire in reverse; the target
+        ``fetch_kv``s from ``authority`` — this replica's advertised
+        host:port — and adopts via the same ``reuse_admission`` path).
+        Every failure is a COUNTED fallback to today's re-prefill
+        behavior, never an error: a dead target or poisoned chunk
+        bumps ``failed``, window expiry bumps ``timeout`` for each
+        un-pushed entry, and the drain proceeds regardless.
+
+        ``targets`` is a list of ``(instance_id, address, port,
+        fingerprint_set)`` tuples (a survivor's advertised ``pd=``
+        digest, parsed). Returns the migration summary dict."""
+        import time as time_mod
+
+        pc = self.prefix_cache
+        m = self.migration
+        if pc is None or not targets or m["active"]:
+            return dict(m)
+        from ..kvtier.handoff import plan_migration, push_kv
+
+        loop = asyncio.get_event_loop()
+        keys = await loop.run_in_executor(None, pc.export_keys)
+        plan = plan_migration(
+            keys, [(t[0], t[3]) for t in targets]
+        )
+        addr = {t[0]: (t[1], int(t[2])) for t in targets}
+        m.update(
+            active=True, total=len(plan), done=0, failed=0,
+            timeout=0, window_s=float(window_s),
+            started_at=time_mod.monotonic(),
+        )
+        self._migration_counters["total"] += len(plan)
+        deadline = m["started_at"] + max(0.0, float(window_s))
+        bytes_moved = 0
+        try:
+            for entry in plan:
+                if time_mod.monotonic() >= deadline:
+                    left = m["total"] - m["done"] - m["failed"]
+                    m["timeout"] += left
+                    self._migration_counters["timeout"] += left
+                    log.warning(
+                        "serve: migrate window expired with %d "
+                        "entries unmoved", left,
+                    )
+                    break
+                if entry["warm"]:
+                    # already warm on the survivor: landed with zero
+                    # bytes moved, but the pin still repoints
+                    m["done"] += 1
+                    self._migration_counters["done"] += 1
+                    self._record_landing(entry["fp"], entry["target"])
+                    continue
+                host, port = addr[entry["target"]]
+                got = await push_kv(
+                    host, port, list(entry["key"]), authority,
+                    read_timeout=max(
+                        1.0, deadline - time_mod.monotonic()
+                    ),
+                )
+                if got is None:
+                    m["failed"] += 1
+                    self._migration_counters["failed"] += 1
+                else:
+                    bytes_moved += got
+                    m["done"] += 1
+                    self._migration_counters["done"] += 1
+                    self._record_landing(entry["fp"], entry["target"])
+        finally:
+            m["active"] = False
+        summary = dict(m)
+        summary["bytes"] = bytes_moved
+        log.info(
+            "serve: migration moved %d/%d entries (%d bytes, "
+            "%d failed, %d timed out)",
+            m["done"], m["total"], bytes_moved, m["failed"],
+            m["timeout"],
+        )
+        return summary
+
+    def _record_landing(self, fp: int, target: str) -> None:
+        landed = self._migration_landed
+        landed[fp] = target
+        landed.move_to_end(fp)
+        while len(landed) > 256:
+            landed.popitem(last=False)
+
+    def migrate_note(self) -> str:
+        """The ``mg=`` heartbeat field: cumulative migration counters
+        plus the most recent fp -> target landings, which the gateway
+        uses to repoint sticky pins as sessions land. Empty until a
+        migration has ever run — replicas that never drain pay zero
+        note bytes."""
+        c = self._migration_counters
+        if not c["total"] and not self.migration["active"]:
+            return ""
+        from ..kvtier.digest import encode_migration_note
+
+        landed = list(self._migration_landed.items())
+        landed.reverse()  # most-recent-first survives truncation
+        return "mg=" + encode_migration_note(
+            c["done"], c["total"], c["failed"], c["timeout"],
+            bool(self.migration["active"]), landed,
+        )
+
+    def _drain_retry_after(self) -> str:
+        """Retry-After for a drain 503, derived from migration
+        progress: the observed per-entry pace extrapolated over what
+        is left, capped by the remaining window — a polite-retry
+        client comes back right as its session lands warm instead of
+        after a fixed beat."""
+        import time as time_mod
+
+        m = self.migration
+        if not m["active"] or m["total"] <= 0:
+            return "1"
+        elapsed = max(0.0, time_mod.monotonic() - m["started_at"])
+        settled = m["done"] + m["failed"]
+        if settled <= 0:
+            remaining = float(m["window_s"])
+        else:
+            remaining = elapsed * (m["total"] - settled) / settled
+        remaining = min(
+            remaining, max(0.0, float(m["window_s"]) - elapsed)
+        )
+        return str(max(1, min(30, int(remaining + 0.999))))
+
+    def _drain_migrated_to(self, req: Request) -> str:
+        """The survivor instance id this 503'd request's prefix has
+        already landed on, or "" — advertised in X-CP-Migrated-To so
+        the gateway repoints the pin instead of re-prefilling cold.
+        Tolerant: any unparseable body simply gets no header."""
+        if not self._migration_landed:
+            return ""
+        from ..kvtier.digest import prefix_fingerprint
+
+        try:
+            body = json.loads(req.body.decode() or "{}")
+            rows = body.get("tokens")
+            if (isinstance(rows, list) and rows
+                    and isinstance(rows[0], list)):
+                row = [int(t) for t in rows[0]]
+            elif (self.tokenizer is not None
+                  and isinstance(body.get("prompt"), str)):
+                row = self.tokenizer.encode(body["prompt"])
+            else:
+                return ""
+            fp = prefix_fingerprint(row)
+        except (ValueError, TypeError, AttributeError,
+                UnicodeDecodeError):
+            return ""
+        if fp is None:
+            return ""
+        return self._migration_landed.get(fp, "")
 
     def enter_maintenance(self) -> None:
         """Start draining: health 503, new generate/completions 503 +

@@ -187,6 +187,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="instance id in the catalog (default: "
         "<service>-<random>)",
     )
+    parser.add_argument(
+        "--migrate-window", type=float, default=5.0,
+        help="seconds a drain spends migrating this replica's live "
+        "KV prefixes to the digest-coldest healthy survivors (the "
+        "handoff wire in reverse) before deregistering; sessions "
+        "reconnect warm instead of re-prefilling cold. 0 disables "
+        "migration (plain drain). Timeouts, dead targets and "
+        "poisoned chunks fall back to re-prefill, counted, never a "
+        "client error",
+    )
     # cold-start collapse knobs (fleet/standby.py, docs/60): boot as
     # promotable warm capacity, fetch weights from a warm peer, and
     # adopt a same-host peer's XLA compile cache
@@ -449,6 +459,7 @@ def main() -> int:
             server, backend, args.fleet_service,
             ttl=args.fleet_ttl, address=args.fleet_address,
             instance_id=args.fleet_id,
+            migrate_window=args.migrate_window,
         )
 
     async def serve() -> None:
@@ -465,7 +476,14 @@ def main() -> int:
             loop.add_signal_handler(sig, stop.set)
         await stop.wait()
         if member is not None:
-            await member.stop()  # deregister before the port dies
+            # SIGTERM is a DRAIN, not an eviction: migrate live KV
+            # to the survivors inside --migrate-window, flush the
+            # mg= landings, deregister, finish in-flight — the same
+            # path an autoscaler retire takes. Any migration failure
+            # inside drain() degrades to the plain deregister this
+            # branch used to be.
+            await member.drain(timeout=30.0)
+            await member.stop(deregister=False)
         await server.stop()
 
     asyncio.run(serve())

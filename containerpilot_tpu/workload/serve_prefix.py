@@ -168,6 +168,21 @@ class PrefixCache:
             self.version += 1
         self.stats["spill_bytes"] = self.spill.bytes_used
 
+    def export_keys(self) -> List[Tuple[int, ...]]:
+        """Every migratable prompt key this cache holds, device tier
+        first in MRU order, then spilled keys — the drain-migration
+        enumeration (kvtier.plan_migration's input). Read-only: no
+        MRU bump, no readmit, nothing below the reuse floor (it can
+        never match again, so it is not worth moving)."""
+        with self._lock:
+            keys = list(reversed(self._cache))
+        if self.spill is not None:
+            seen = set(keys)
+            keys.extend(
+                k for k in self.spill.keys() if k not in seen
+            )
+        return [k for k in keys if len(k) >= MIN_REUSE]
+
     def digest(self, max_bytes: Optional[int] = None) -> str:
         """Versioned fingerprint digest of every reusable prefix this
         cache holds (device + spill tiers), for gateway routing —

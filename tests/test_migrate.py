@@ -1,0 +1,600 @@
+"""Live drain-migration tests (docs/60 § drain runbook): the
+deterministic push plan (fp-family affinity, digest-coldest balancing,
+warm short-circuit), the ``mg=`` heartbeat note codec and the
+gateway's torn-note-tolerant repoint path, the migration-aware drain
+answer (progress-derived Retry-After + X-CP-Migrated-To), the
+autoscaler's retire path surviving a drainer that dies mid-migration —
+and the tier-1 integration scenario: a sticky session whose replica
+drains mid-conversation lands its KV on the survivor over the handoff
+wire and answers its next turns byte-identically, buffered AND SSE,
+with a poisoned chunk degrading to a counted re-prefill fallback that
+never surfaces as a client error.
+"""
+import asyncio
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from containerpilot_tpu.discovery import FileCatalogBackend, NoopBackend
+from containerpilot_tpu.fleet import FleetGateway, FleetMember
+from containerpilot_tpu.fleet.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    FleetLoad,
+)
+from containerpilot_tpu.fleet.gateway import Replica
+from containerpilot_tpu.kvtier import (
+    encode_migration_note,
+    parse_migration_note,
+    plan_migration,
+)
+from containerpilot_tpu.kvtier.digest import prefix_fingerprint
+
+
+def _post(port, path, payload, timeout=120, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers=dict(
+            {"Content-Type": "application/json"}, **(headers or {})
+        ),
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode(), dict(exc.headers)
+
+
+# -- the deterministic push plan (no servers, no JAX) ------------------
+
+
+def test_plan_migration_deterministic_affine_and_warm():
+    """Same inputs -> identical plan; target-list order never changes
+    the assignment; keys sharing a fingerprint family all land on ONE
+    survivor; a digest-warm fp goes to its warm holder flagged
+    warm=True; cold families balance toward the digest-coldest."""
+    fam_a = tuple(range(100, 124))        # 24 tokens, one fp family
+    fam_a_long = fam_a + tuple(range(124, 140))
+    fam_b = tuple(range(500, 520))
+    fam_c = tuple(range(900, 920))
+    keys = [fam_a, fam_b, fam_a_long, fam_c]
+    fp_a = prefix_fingerprint(list(fam_a))
+    fp_b = prefix_fingerprint(list(fam_b))
+    targets = [
+        ("s1", frozenset({fp_b, 1, 2, 3})),   # warm for fam_b, busy
+        ("s2", frozenset()),                   # coldest
+    ]
+    plan = plan_migration(keys, targets)
+    assert plan == plan_migration(keys, targets)
+    assert plan == plan_migration(keys, list(reversed(targets)))
+    by_fp = {}
+    for entry in plan:
+        by_fp.setdefault(entry["fp"], set()).add(entry["target"])
+    # family affinity: both fam_a keys share one survivor
+    assert len(by_fp[fp_a]) == 1
+    # warm fp lands on its warm holder, flagged, zero cost
+    b_entries = [e for e in plan if e["fp"] == fp_b]
+    assert b_entries == [
+        {"key": fam_b, "fp": fp_b, "target": "s1", "warm": True}
+    ]
+    # cold families avoid the digest-heavy survivor
+    cold = [e for e in plan if not e["warm"]]
+    assert cold and all(e["target"] == "s2" for e in cold)
+    # longer keys are planned first (most prefill value moves before
+    # a window can expire)
+    assert plan[0]["key"] == fam_a_long
+    # degenerate inputs: no targets / sub-fingerprint keys plan empty
+    assert plan_migration(keys, []) == []
+    assert plan_migration([tuple(range(4))], targets) == []
+
+
+# -- the mg= note codec + the gateway's repoint path -------------------
+
+
+def test_migration_note_roundtrip_truncation_and_garbage():
+    note = encode_migration_note(
+        3, 7, 1, 2, True, [(0xDEADBEEF, "replica-1"), (0xAB, "r2")]
+    )
+    counters, landed = parse_migration_note(note)
+    assert counters == {
+        "done": 3, "total": 7, "failed": 1, "timeout": 2, "active": 1,
+    }
+    assert landed == {0xDEADBEEF: "replica-1", 0xAB: "r2"}
+    # most-recent-first: truncation drops OLD landings; the duplicate
+    # fp keeps its freshest (first-encoded) target
+    dup = encode_migration_note(
+        1, 1, 0, 0, False, [(0xAB, "new"), (0xAB, "old")]
+    )
+    assert parse_migration_note(dup)[1] == {0xAB: "new"}
+    # a tight budget drops landings, never the counter head
+    tight = encode_migration_note(
+        9, 9, 0, 0, False,
+        [(i, f"survivor-{i}") for i in range(64)], max_bytes=40,
+    )
+    assert len(tight) <= 40
+    assert parse_migration_note(tight)[0]["done"] == 9
+    # every torn prefix parses without throwing, zero-filled
+    for i in range(len(note)):
+        c, _l = parse_migration_note(note[:i])
+        assert set(c) == {"done", "total", "failed", "timeout",
+                          "active"}
+    for garbage in ("", "x", "1,2", "a,b,c,d,e", "1,2,3,4,5;zz:t",
+                    "1,2,3,4,9000;deadbeef:"):
+        c, landed = parse_migration_note(garbage)
+        assert all(v >= 0 for v in c.values()) and c["active"] <= 1
+        assert landed == {}
+
+
+def test_gateway_repoints_pins_on_mg_landings():
+    """An mg= landing moves exactly the sticky pins whose session
+    fingerprint matches, counts the move, and never regresses the
+    cumulative mirrors on a torn re-read."""
+    gw = FleetGateway(NoopBackend(), "svc", affinity="session")
+    gw._replicas = {
+        "a": Replica("a", "h", 1),
+        "b": Replica("b", "h", 2),
+    }
+    gw._route("s:conv", fp=0xAB)
+    gw._route("s:other", fp=0xCD)
+    gw._sticky["s:conv"] = "a"
+    gw._sticky["s:other"] = "a"
+    note = "ok occ=0.5 mg=" + encode_migration_note(
+        2, 3, 0, 0, True, [(0xAB, "b")]
+    )
+    gw._apply_notes(gw._replicas["a"], note)
+    assert gw._sticky["s:conv"] == "b"          # landed fp repointed
+    assert gw._sticky["s:other"] == "a"         # other fp untouched
+    assert gw.migrations["sessions_migrated"] == 2
+    assert gw.migrations["pins_repointed"] == 1
+    assert gw._replicas["a"].migrating is True
+    assert gw._m_migrated._value.get() == 2  # noqa: SLF001
+    # replayed/torn notes with LOWER counters never regress, and a
+    # re-announced landing does not double-repoint
+    gw._apply_notes(
+        gw._replicas["a"],
+        "ok mg=" + encode_migration_note(1, 3, 0, 0, False,
+                                         [(0xAB, "b")]),
+    )
+    assert gw.migrations["sessions_migrated"] == 2
+    assert gw.migrations["pins_repointed"] == 1
+    assert gw._replicas["a"].migrating is False
+    # failures/timeouts mirror as deltas
+    gw._apply_notes(
+        gw._replicas["a"],
+        "ok mg=" + encode_migration_note(2, 5, 2, 1, False),
+    )
+    assert gw.migrations["failed"] == 2
+    assert gw.migrations["timeout"] == 1
+    # a landing naming an UNKNOWN survivor repoints nothing (the
+    # ordinary drained-away re-pin covers it) and never throws
+    gw._apply_notes(
+        gw._replicas["a"],
+        "ok mg=" + encode_migration_note(3, 5, 2, 1, False,
+                                         [(0xCD, "gone")]),
+    )
+    assert gw._sticky["s:other"] == "a"
+    # byte-level fuzz: every prefix of a full note applies cleanly,
+    # and the elementwise-max merge counts replica c's done=2 ONCE
+    # across all the torn re-reads
+    torn = Replica("c", "h", 3)
+    gw._replicas["c"] = torn
+    for i in range(len(note) + 1):
+        gw._apply_notes(torn, note[:i])
+    assert gw.migrations["sessions_migrated"] == 5
+
+
+def test_drain_bounce_repoints_on_migrated_to_header(run):
+    """A 503 bounce carrying X-CP-Migrated-To repoints the pin
+    synchronously (warm reconnect even if the drainer deregisters
+    before its final mg= beat lands); an unknown target or a missing
+    header takes the plain retry path."""
+    gw = FleetGateway(
+        NoopBackend(), "svc", affinity="session", retry_backoff=0.001,
+    )
+    gw._replicas = {
+        "a": Replica("a", "h", 1),
+        "b": Replica("b", "h", 2),
+    }
+    gw._sticky["s:conv"] = "a"
+
+    async def bounce(headers):
+        return await gw._drain_bounce(
+            "s:conv", "a", headers, {"a"}, 0, 0.001
+        )
+
+    run(bounce({"x-cp-migrated-to": "b"}))
+    assert gw._sticky["s:conv"] == "b"
+    assert gw.migrations == {
+        "sessions_migrated": 0, "failed": 0, "timeout": 0,
+        "pins_repointed": 1, "drain_answers": 1,
+    }
+    # pin no longer on the drainer: counted as an answer, not a move
+    run(bounce({"x-cp-migrated-to": "b"}))
+    assert gw.migrations["pins_repointed"] == 1
+    assert gw.migrations["drain_answers"] == 2
+    # unknown survivor: answer counted, pin untouched
+    gw._sticky["s:conv"] = "a"
+    run(bounce({"x-cp-migrated-to": "zz"}))
+    assert gw._sticky["s:conv"] == "a"
+    assert gw.migrations["drain_answers"] == 3
+    # plain drain 503: nothing counted
+    run(bounce({}))
+    assert gw.migrations["drain_answers"] == 3
+
+
+# -- the autoscaler's retire path vs a dying drainer -------------------
+
+
+class _FragileLauncher:
+    """Retire raises mid-drain (the drainer died inside its migrate
+    window) — but the victim really is gone from the managed view."""
+
+    def __init__(self, ids):
+        self._ids = list(ids)
+        self.retire_calls = 0
+
+    def count(self):
+        return len(self._ids)
+
+    def ids(self):
+        return list(self._ids)
+
+    async def launch(self):
+        rid = f"relaunched-{len(self._ids)}"
+        self._ids.append(rid)
+        return rid
+
+    async def retire(self, rid):
+        self.retire_calls += 1
+        self._ids.remove(rid)
+        raise RuntimeError("drainer died mid-migration")
+
+
+def test_autoscaler_retire_failure_counted_and_repaired(run):
+    """retire() raising mid-migration must not kill the tick or
+    record a scale-down that didn't cleanly happen; the failure is
+    counted, and when the fleet falls below min the ordinary repair
+    path relaunches — no slot leak."""
+    launcher = _FragileLauncher(["r0", "r1"])
+    scaler = Autoscaler(
+        launcher,
+        lambda: FleetLoad(queue_depth=0, per_replica={}),
+        AutoscalerConfig(
+            min_replicas=1, max_replicas=3, slots_per_replica=1,
+            high_water=0.9, low_water=0.5, up_sustain_s=0.0,
+            down_sustain_s=0.0, cooldown_s=0.0, tick_interval=0.01,
+        ),
+        registry=None,
+    )
+
+    async def drive():
+        for _ in range(10):
+            await scaler.tick()
+            if launcher.retire_calls:
+                break
+            await asyncio.sleep(0.01)
+
+    run(drive())
+    assert launcher.retire_calls == 1
+    assert scaler.retire_failures == 1
+    assert scaler.scale_downs == 0            # not a clean scale-down
+    assert scaler.stats["retire_failures"] == 1
+    assert not any(
+        e["direction"] == "down" for e in scaler.scale_log
+    )
+    # the victim's death took the fleet to min; a second casualty
+    # drops it below and the next ticks repair back up to min
+    launcher._ids.clear()
+
+    async def repair():
+        for _ in range(20):
+            await scaler.tick()
+            if launcher.count() >= 1:
+                return
+            await asyncio.sleep(0.01)
+
+    run(repair())
+    assert launcher.count() == 1
+
+
+# -- the tier-1 integration scenario -----------------------------------
+
+
+def _sse_tokens(text):
+    events = [
+        json.loads(line[len("data: "):])
+        for line in text.splitlines()
+        if line.startswith("data: ")
+    ]
+    assert events and events[-1].get("done") is True
+    return [t for e in events if "tokens" in e for t in e["tokens"]]
+
+
+def _server_kwargs():
+    return dict(
+        max_len=64, slots=2, slot_chunk=4,
+        prefix_cache_entries=4, kv_spill_bytes=512 * 1024,
+    )
+
+
+def _build_servers(n):
+    import jax
+    import jax.numpy as jnp
+
+    from containerpilot_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+    )
+    from containerpilot_tpu.workload.serve import InferenceServer
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=1, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    return [
+        InferenceServer(cfg, params, "127.0.0.1", 0, **_server_kwargs())
+        for _ in range(n)
+    ]
+
+
+def test_drain_migrates_session_byte_parity_buffered_and_sse(
+    run, tmp_path
+):
+    """A pinned session's replica drains mid-conversation: the drain
+    pushes its KV to the survivor over the handoff wire, the gateway
+    repoints the pin off the mg= landing, and the session's next
+    turns — buffered AND SSE — answer byte-identically to a standalone
+    replica that never lost its cache, with the survivor serving them
+    from ADOPTED KV (spill readmission), not a re-prefill."""
+    serv_a, serv_b, ref = _build_servers(3)
+    backend = FileCatalogBackend(str(tmp_path))
+    row1 = list(range(1, 25))  # 24 tokens: migration-eligible
+
+    async def scenario():
+        loop = asyncio.get_event_loop()
+        for s in (serv_a, serv_b, ref):
+            await s.run()
+        members = {
+            "replica-a": FleetMember(
+                serv_a, backend, "inference", ttl=5,
+                heartbeat_interval=0.1, instance_id="replica-a",
+            ),
+            "replica-b": FleetMember(
+                serv_b, backend, "inference", ttl=5,
+                heartbeat_interval=0.1, instance_id="replica-b",
+            ),
+        }
+        servers = {"replica-a": serv_a, "replica-b": serv_b}
+        for m in members.values():
+            await m.start()
+        gateway = FleetGateway(
+            backend, "inference", "127.0.0.1", 0,
+            affinity="session", poll_interval=0.1, hedge=False,
+            retry_backoff=0.01,
+        )
+        await gateway.run()
+        for _ in range(200):
+            if len(gateway._replicas) == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert len(gateway._replicas) == 2
+
+        async def generate(port, body, headers=None):
+            return await loop.run_in_executor(
+                None, lambda: _post(port, "/v1/generate", body,
+                                    120, headers)
+            )
+
+        # -- turn 1 pins the session and seeds its KV --------------
+        body1 = {
+            "tokens": [row1], "max_new_tokens": 6, "seed": 11,
+            "session_id": "conv",
+        }
+        turn1 = await generate(gateway.port, body1)
+        ref1 = await generate(ref.port, body1)
+        assert turn1[0] == 200 and ref1[0] == 200
+        tokens1 = json.loads(turn1[1])["tokens"]
+        assert tokens1 == json.loads(ref1[1])["tokens"]
+        pinned = gateway._sticky["s:conv"]
+        survivor = "replica-b" if pinned == "replica-a" else "replica-a"
+
+        # -- the pinned replica drains: migrate, repoint, deregister
+        drained = await members[pinned].drain()
+        assert drained is True
+        summary = servers[pinned].migration
+        assert summary["done"] >= 1
+        assert summary["failed"] == 0 and summary["timeout"] == 0
+        # the landing repointed the pin (mg= beat or POST-back; the
+        # gateway read it before the record deregistered)
+        for _ in range(100):
+            if (
+                gateway._sticky.get("s:conv") == survivor
+                and gateway.migrations["sessions_migrated"] >= 1
+                and pinned not in gateway._replicas
+            ):
+                break
+            await asyncio.sleep(0.05)
+        assert gateway._sticky["s:conv"] == survivor
+        assert gateway.migrations["sessions_migrated"] >= 1
+        assert gateway.migrations["timeout"] == 0
+
+        # the drained replica's /v1/migrate progress report (served
+        # while draining) names the landing the pin followed
+        fp1 = prefix_fingerprint(row1)
+        progress = await generate(servers[pinned].port, {})
+        assert progress[0] == 503  # generate is closed...
+        report = await loop.run_in_executor(
+            None, _post, servers[pinned].port, "/v1/migrate", {}
+        )
+        assert report[0] == 200  # ...the migration verb is not
+        landed = json.loads(report[1])["landed"]
+        assert landed.get(f"{fp1:08x}") == survivor
+        assert json.loads(report[1])["cumulative"]["done"] >= 1
+        malformed = await loop.run_in_executor(
+            None, _post, servers[pinned].port, "/v1/migrate",
+            {"targets": [{"bogus": 1}]},
+        )
+        assert malformed[0] == 422
+
+        # -- turn 2, buffered, on the survivor: byte parity from
+        # ADOPTED KV --------------------------------------------------
+        readmit_before = servers[survivor].prefix_cache.spill.snapshot()[
+            "readmitted"
+        ]
+        row2 = row1 + tokens1[0] + [3, 5]
+        body2 = {
+            "tokens": [row2], "max_new_tokens": 6, "seed": 12,
+            "session_id": "conv",
+        }
+        turn2 = await generate(gateway.port, body2)
+        ref2 = await generate(ref.port, dict(body2, session_id=None))
+        assert turn2[0] == 200 and ref2[0] == 200
+        tokens2 = json.loads(turn2[1])["tokens"]
+        assert tokens2 == json.loads(ref2[1])["tokens"]
+        after = servers[survivor].prefix_cache.spill.snapshot()
+        assert after["readmitted"] >= readmit_before + 1
+
+        # -- turn 3, SSE, still on the survivor ---------------------
+        row3 = row2 + tokens2[0]
+        body3 = {
+            "tokens": [row3], "max_new_tokens": 6, "seed": 13,
+            "session_id": "conv", "stream": True,
+        }
+        turn3 = await generate(gateway.port, body3)
+        ref3 = await generate(ref.port, dict(body3, session_id=None))
+        assert turn3[0] == 200 and ref3[0] == 200
+        ct = {k.lower(): v for k, v in turn3[2].items()}["content-type"]
+        assert "text/event-stream" in ct
+        assert _sse_tokens(turn3[1]) == _sse_tokens(ref3[1])
+        assert gateway._sticky["s:conv"] == survivor
+
+        await gateway.stop()
+        for m in members.values():
+            await m.stop()
+        for s in (serv_a, serv_b, ref):
+            await s.stop()
+
+    run(scenario(), timeout=600)
+
+
+def test_poisoned_chunk_counts_failed_fallback_zero_5xx(
+    run, monkeypatch
+):
+    """A poisoned chunk (corrupted after digests were computed) makes
+    the survivor's pull fail verification: the push is a COUNTED
+    failed fallback on the drainer, the survivor adopts nothing, and
+    both replicas keep answering 200 — corruption never becomes a
+    client error."""
+    import containerpilot_tpu.kvtier.handoff as handoff_mod
+
+    drainer, survivor = _build_servers(2)
+    row = list(range(1, 25))
+    real_plan = handoff_mod.kv_transfer_plan
+
+    def poisoned_plan(host_tree, chunk_bytes=handoff_mod.KV_CHUNK):
+        manifest, blobs = real_plan(host_tree, chunk_bytes)
+        for i, blob in enumerate(blobs):
+            if blob:
+                flipped = bytearray(blob)
+                flipped[-1] ^= 0xFF
+                blobs[i] = bytes(flipped)
+                break
+        return manifest, blobs
+
+    async def scenario():
+        loop = asyncio.get_event_loop()
+        await drainer.run()
+        await survivor.run()
+        seed = await loop.run_in_executor(
+            None, _post, drainer.port, "/v1/generate",
+            {"tokens": [row], "max_new_tokens": 4, "seed": 7},
+        )
+        assert seed[0] == 200
+        monkeypatch.setattr(
+            handoff_mod, "kv_transfer_plan", poisoned_plan
+        )
+        readmit_before = survivor.prefix_cache.spill.snapshot()[
+            "readmitted"
+        ]
+        summary = await drainer.migrate_sessions(
+            [("s", "127.0.0.1", survivor.port, frozenset())],
+            window_s=10.0,
+            authority=f"127.0.0.1:{drainer.port}",
+        )
+        assert summary["failed"] >= 1
+        assert summary["done"] == 0
+        assert summary["timeout"] == 0
+        assert drainer._migration_landed == {}  # noqa: SLF001
+        # nothing corrupt was adopted
+        after = survivor.prefix_cache.spill.snapshot()
+        assert after["readmitted"] == readmit_before
+        # and the fallback is invisible to clients: both still 200
+        monkeypatch.setattr(
+            handoff_mod, "kv_transfer_plan", real_plan
+        )
+        for port in (survivor.port, drainer.port):
+            ok = await loop.run_in_executor(
+                None, _post, port, "/v1/generate",
+                {"tokens": [row], "max_new_tokens": 4, "seed": 7},
+            )
+            assert ok[0] == 200
+        await survivor.stop()
+        await drainer.stop()
+
+    run(scenario(), timeout=600)
+
+
+def test_drain_answer_retry_after_tracks_progress_and_names_survivor(
+    run,
+):
+    """The drain 503's Retry-After extrapolates the migration's
+    observed pace (capped by the window's remainder, floored at 1),
+    and once this request's prefix has landed the answer names the
+    survivor in X-CP-Migrated-To."""
+    import time
+
+    (server,) = _build_servers(1)
+    row = list(range(1, 25))
+    fp = prefix_fingerprint(row)
+
+    async def scenario():
+        loop = asyncio.get_event_loop()
+        await server.run()
+        # no migration ever: the legacy fixed beat
+        assert server._drain_retry_after() == "1"  # noqa: SLF001
+        # mid-migration, half done after ~2s: pace says ~2s more
+        server.migration.update(
+            active=True, total=4, done=1, failed=1, timeout=0,
+            window_s=20.0, started_at=time.monotonic() - 2.0,
+        )
+        assert server._drain_retry_after() == "2"  # noqa: SLF001
+        # nothing settled yet: the whole window stands in, capped
+        server.migration.update(done=0, failed=0, window_s=3.0)
+        assert server._drain_retry_after() == "1"  # noqa: SLF001
+        server.migration["active"] = False
+        server._record_landing(fp, "survivor-1")  # noqa: SLF001
+        server.enter_maintenance()
+        resp = await loop.run_in_executor(
+            None, _post, server.port, "/v1/generate",
+            {"tokens": [row], "max_new_tokens": 4},
+        )
+        assert resp[0] == 503
+        headers = {k.lower(): v for k, v in resp[2].items()}
+        assert headers["x-cp-migrated-to"] == "survivor-1"
+        assert int(headers["retry-after"]) >= 1
+        # a different prefix has not landed: no header
+        other = await loop.run_in_executor(
+            None, _post, server.port, "/v1/generate",
+            {"tokens": [list(range(500, 524))], "max_new_tokens": 4},
+        )
+        assert other[0] == 503
+        assert "x-cp-migrated-to" not in {
+            k.lower() for k in other[2]
+        }
+        await server.stop()
+
+    run(scenario(), timeout=600)
