@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all build test test-fast test-workload integration fleet-smoke trace-smoke chaos chaos-smoke bench bench-host bench-gateway bench-reuse bench-goodput bench-coldstart bench-disagg bench-migrate lint lint-baseline clean image
+.PHONY: all build test test-fast test-workload integration fleet-smoke trace-smoke chaos chaos-smoke bench bench-host bench-gateway bench-reuse bench-goodput bench-coldstart bench-disagg bench-migrate lint lint-baseline lint-diff clean image
 
 all: build test
 
@@ -122,9 +122,16 @@ bench-coldstart:
 lint:
 	$(PYTHON) -m containerpilot_tpu.analysis
 
-# regenerate the committed baseline (shrink it, never grow it)
+# regenerate the committed baseline (shrink it, never grow it);
+# reports which entries were added/removed and why they went stale
 lint-baseline:
 	$(PYTHON) -m containerpilot_tpu.analysis --write-baseline
+
+# cpcheck findings for files changed since $(SINCE) (default HEAD:
+# staged + unstaged + untracked). Full call graph, findings filtered
+# to the diff — a few-seconds loop, not a substitute for `make lint`.
+lint-diff:
+	scripts/cpcheck_diff.sh --since $(or $(SINCE),HEAD)
 
 # release tarball (reference: makefile release target); VERSION expands
 # lazily so only the release target pays the interpreter startup

@@ -13,16 +13,27 @@ opt-in runtime lock-order/publish-discipline harness tests use, and
 leaked-task watchdog) that the gateway, replicas, and the chaos
 harness run in production paths.
 """
+from .callgraph import (
+    PROJECT_RULES,
+    PROJECT_RULES_BY_ID,
+    CallGraph,
+    ProjectContext,
+    build_project,
+    build_project_from_paths,
+    run_project_rules,
+)
 from .cpcheck import (
     ALL_RULES,
     Finding,
     RULES_BY_ID,
     baseline_path,
     diff_against_baseline,
+    explain_stale,
     hotpath,
     load_baseline,
     scan_file,
     scan_package,
+    scan_project,
     scan_source,
     write_baseline,
 )
@@ -34,14 +45,23 @@ __all__ = [
     "TaskWatchdog",
     "ALL_RULES",
     "RULES_BY_ID",
+    "PROJECT_RULES",
+    "PROJECT_RULES_BY_ID",
+    "CallGraph",
+    "ProjectContext",
+    "build_project",
+    "build_project_from_paths",
+    "run_project_rules",
     "Finding",
     "scan_source",
     "scan_file",
     "scan_package",
+    "scan_project",
     "baseline_path",
     "load_baseline",
     "write_baseline",
     "diff_against_baseline",
+    "explain_stale",
     "RaceCheck",
     "CheckedLock",
     "Violation",

@@ -8,10 +8,16 @@ Exit status:
 
 Modes:
     (default)          scan the whole package against the baseline
-    --files F [F ...]  scan only those files (scripts/cpcheck_diff.sh),
-                       still filtered through the baseline
+    --files F [F ...]  report findings for those files only, still
+                       filtered through the baseline. The call graph
+                       is always built over the FULL package (plus
+                       any listed out-of-package files), so the
+                       interprocedural rules see every edge — only
+                       the findings are filtered to the diff
+                       (scripts/cpcheck_diff.sh / `make lint-diff`)
     --write-baseline   regenerate analysis/baseline.json from a fresh
-                       full scan (the `make lint-baseline` body)
+                       full scan (the `make lint-baseline` body),
+                       reporting which entries were added or removed
     --list-rules       print the rule catalog (id + first doc line)
 """
 from __future__ import annotations
@@ -22,14 +28,17 @@ import os
 import sys
 from typing import List, Optional
 
+from .callgraph import PROJECT_RULES, build_project_from_paths
 from .cpcheck import (
     ALL_RULES,
     Finding,
     baseline_path,
     diff_against_baseline,
+    explain_stale,
+    iter_package_files,
     load_baseline,
-    scan_file,
     scan_package,
+    scan_project,
     write_baseline,
 )
 
@@ -45,7 +54,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     parser.add_argument(
         "--files", nargs="+", metavar="FILE",
-        help="scan only these files (default: the whole package)",
+        help="report findings for these files only (the call graph "
+             "still spans the whole package)",
     )
     parser.add_argument(
         "--baseline", default=None,
@@ -72,7 +82,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                      "drop --files")
 
     if args.list_rules:
-        for rule in ALL_RULES:
+        for rule in list(ALL_RULES) + list(PROJECT_RULES):
             doc = (rule.__doc__ or "").strip().splitlines()
             first = doc[0] if doc else ""
             print(f"{rule.rule_id}: {first}")
@@ -89,15 +99,37 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     try:
         if args.files:
-            findings: List[Finding] = []
-            for path in args.files:
-                findings.extend(scan_file(path, relative_to=repo))
-            findings.sort(key=lambda f: (f.file, f.line, f.rule))
+            # full-package forest + the listed files: the diff mode
+            # must see every call edge (a changed helper can create a
+            # reachability finding whose witness spans unchanged
+            # files), then report only on the files asked about
+            listed = [
+                os.path.normpath(os.path.abspath(p))
+                for p in args.files
+            ]
+            paths = list(dict.fromkeys(
+                [
+                    os.path.normpath(p)
+                    for p in iter_package_files(root)
+                ] + listed
+            ))
+            project = build_project_from_paths(paths, repo)
+            rel_listed = {
+                os.path.relpath(p, repo).replace(os.sep, "/")
+                for p in listed
+            }
+            findings: List[Finding] = [
+                f for f in scan_project(project)
+                if f.file in rel_listed
+            ]
         else:
             findings = scan_package(root, relative_to=repo)
     except SyntaxError as exc:
         print(f"cpcheck: parse failure: {exc}", file=sys.stderr)
         return 2
+
+    entries = load_baseline(args.baseline)
+    new, stale = diff_against_baseline(findings, entries)
 
     if args.write_baseline:
         path = write_baseline(findings, args.baseline)
@@ -105,10 +137,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"cpcheck: wrote {len(findings)} baseline entr"
             f"{'y' if len(findings) == 1 else 'ies'} to {path}"
         )
+        if new:
+            print(f"cpcheck: {len(new)} entr"
+                  f"{'y' if len(new) == 1 else 'ies'} added:")
+            for f in new:
+                print(f"    {f.file} [{f.scope}] {f.rule}")
+        if stale:
+            print(f"cpcheck: {len(stale)} stale entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} removed:")
+            for line in explain_stale(new, stale):
+                print(f"    {line}")
         return 0
-
-    entries = load_baseline(args.baseline)
-    new, stale = diff_against_baseline(findings, entries)
 
     scanned = (
         f"{len(args.files)} file(s)" if args.files else "package"
@@ -120,6 +159,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         for f in new:
             print(f.render())
+        if stale and not args.files:
+            # a 'new' finding paired with a stale entry usually means
+            # an edit moved a baselined line, not fresh debt — say so
+            print(f"\ncpcheck: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'}:")
+            for line in explain_stale(new, stale):
+                print(f"    {line}")
         print(
             "\ncpcheck: fix the finding, add an inline "
             "`# cpcheck: disable=<RULE>` with a justification, or — "
@@ -130,14 +176,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         # full scans know an entry is truly gone; partial scans don't
         print(
             f"cpcheck: warning: {len(stale)} stale baseline entr"
-            f"{'y' if len(stale) == 1 else 'ies'} (fixed? run "
-            "`make lint-baseline` to shrink the baseline):"
+            f"{'y' if len(stale) == 1 else 'ies'}:"
         )
-        for entry in stale:
-            print(
-                f"    {entry.get('file')} [{entry.get('scope')}] "
-                f"{entry.get('rule')}"
-            )
+        for line in explain_stale(new, stale):
+            print(f"    {line}")
     print(
         f"cpcheck: clean ({scanned}; {len(findings)} finding(s), "
         f"all baselined)"

@@ -1084,13 +1084,31 @@ RULES_BY_ID: Dict[str, Rule] = {r.rule_id: r for r in ALL_RULES}
 # -- drivers ---------------------------------------------------------------
 
 
+def _default_project_rules(
+    rules: Sequence[Rule], project_rules
+) -> Sequence:
+    """The interprocedural rules a scan runs: an explicit sequence
+    wins; by default they ride along only with the full lexical
+    catalog (a caller scanning with a hand-picked rule subset is
+    asking for exactly those rules, nothing extra)."""
+    if project_rules is not None:
+        return project_rules
+    if rules is ALL_RULES:
+        from .callgraph import PROJECT_RULES
+
+        return PROJECT_RULES
+    return ()
+
+
 def scan_source(
     source: str,
     path: str = "<string>",
     rules: Sequence[Rule] = ALL_RULES,
+    project_rules=None,
 ) -> List[Finding]:
     """Scan one module's source text; returns findings sorted by
-    (file, line, rule)."""
+    (file, line, rule). Interprocedural rules see a single-module
+    project — enough for same-file reachability fixtures."""
     tree = ast.parse(source, filename=path)
     ctx = ModuleContext(
         path=path,
@@ -1102,6 +1120,13 @@ def scan_source(
     findings: List[Finding] = []
     for rule in rules:
         findings.extend(rule.run(ctx))
+    project_rules = _default_project_rules(rules, project_rules)
+    if project_rules:
+        from .callgraph import ProjectContext, run_project_rules
+
+        findings.extend(
+            run_project_rules(ProjectContext([ctx]), project_rules)
+        )
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings
 
@@ -1110,13 +1135,14 @@ def scan_file(
     path: str,
     relative_to: Optional[str] = None,
     rules: Sequence[Rule] = ALL_RULES,
+    project_rules=None,
 ) -> List[Finding]:
     with open(path, "r", encoding="utf-8") as fh:
         source = fh.read()
     rel = (
         os.path.relpath(path, relative_to) if relative_to else path
     ).replace(os.sep, "/")
-    return scan_source(source, rel, rules)
+    return scan_source(source, rel, rules, project_rules)
 
 
 def iter_package_files(root: str) -> List[str]:
@@ -1131,19 +1157,41 @@ def iter_package_files(root: str) -> List[str]:
     return out
 
 
+def scan_project(
+    project,
+    rules: Sequence[Rule] = ALL_RULES,
+    project_rules=None,
+) -> List[Finding]:
+    """Run lexical rules over every module in a prebuilt
+    ProjectContext, then the interprocedural rules once over the
+    whole forest. The project's parsed ASTs are shared by every rule
+    — each file is parsed exactly once per scan."""
+    findings: List[Finding] = []
+    for ctx in project.contexts:
+        for rule in rules:
+            findings.extend(rule.run(ctx))
+    project_rules = _default_project_rules(rules, project_rules)
+    if project_rules:
+        from .callgraph import run_project_rules
+
+        findings.extend(run_project_rules(project, project_rules))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
 def scan_package(
     root: str,
     relative_to: Optional[str] = None,
     rules: Sequence[Rule] = ALL_RULES,
+    project_rules=None,
 ) -> List[Finding]:
     """Scan every .py under ``root``; paths are reported relative to
     ``relative_to`` (default: root's parent, so 'containerpilot_tpu/...')."""
+    from .callgraph import build_project_from_paths
+
     base = relative_to or os.path.dirname(os.path.abspath(root))
-    findings: List[Finding] = []
-    for path in iter_package_files(root):
-        findings.extend(scan_file(path, base, rules))
-    findings.sort(key=lambda f: (f.file, f.line, f.rule))
-    return findings
+    project = build_project_from_paths(iter_package_files(root), base)
+    return scan_project(project, rules, project_rules)
 
 
 # -- baseline --------------------------------------------------------------
@@ -1234,3 +1282,44 @@ def diff_against_baseline(
             budget[key] -= 1
             stale.append(entry)
     return new, stale
+
+
+def explain_stale(
+    new: Sequence[Finding], stale: Sequence[dict]
+) -> List[str]:
+    """One human-readable line per stale baseline entry, saying WHY
+    it went stale. The fingerprint includes line text, so an
+    unrelated edit to a baselined line silently drops its
+    suppression and the finding resurfaces as 'new' — pair each
+    stale entry with any new finding at the same (rule, file, scope)
+    so the failure tells the builder what actually happened instead
+    of presenting two disconnected lists."""
+    out: List[str] = []
+    for entry in stale:
+        match = next(
+            (
+                f for f in new
+                if f.rule == entry.get("rule")
+                and f.file == entry.get("file")
+                and f.scope == entry.get("scope")
+            ),
+            None,
+        )
+        where = (
+            f"{entry.get('file')} [{entry.get('scope')}] "
+            f"{entry.get('rule')}"
+        )
+        if match is not None:
+            out.append(
+                f"{where}: line text drifted — baseline pinned "
+                f"{entry.get('text')!r} but the scan now sees "
+                f"{match.text!r} (line {match.line}); an edit to a "
+                "baselined line drops its suppression — fix the "
+                "finding or re-run `make lint-baseline` after review"
+            )
+        else:
+            out.append(
+                f"{where}: finding no longer present — it was fixed;"
+                " run `make lint-baseline` to shrink the ledger"
+            )
+    return out

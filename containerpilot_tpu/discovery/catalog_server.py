@@ -92,7 +92,11 @@ class CatalogServer:
 
     async def run(self) -> None:
         if self.snapshot_path:
-            self._load_snapshot()
+            # disk read off-loop: nothing is serving yet, but a slow
+            # volume must not delay sibling tasks on this loop
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._load_snapshot
+            )
         await self._server.start_tcp(self.host, self.port)
         self._reaper = spawn(self._reap_loop(), name="catalog-reaper")
         log.info("catalog: serving Consul-compatible API on %s:%d",
@@ -105,7 +109,7 @@ class CatalogServer:
         # final write AFTER the listener is down: a mutation handled
         # during shutdown was acknowledged, so it must be journaled
         if self.snapshot_path:
-            self._write_snapshot()
+            await self._journal()
 
     # -- durability -------------------------------------------------------
 
@@ -143,21 +147,43 @@ class CatalogServer:
             log.info("catalog: restored %d entries from %s",
                      len(self._entries), self.snapshot_path)
 
-    def _write_snapshot(self) -> None:
+    def _snapshot_payload(self) -> dict:
+        """Freeze entry state for journaling. Runs ON the event loop —
+        the write happens off-loop, and ``_entries`` must not be
+        iterated there while request handlers keep mutating it."""
+        return {
+            "saved_at": time.time(),
+            "entries": [asdict(e) for e in
+                        sorted(self._entries.values(),
+                               key=lambda e: e.id)],
+        }
+
+    def _write_snapshot(self, payload: Optional[dict] = None) -> bool:
+        """Blocking file write; async callers go through _journal."""
+        if payload is None:
+            payload = self._snapshot_payload()
         tmp = f"{self.snapshot_path}.tmp"
         try:
             with open(tmp, "w") as fh:
-                json.dump(
-                    {"saved_at": time.time(),
-                     "entries": [asdict(e) for e in
-                                 sorted(self._entries.values(),
-                                        key=lambda e: e.id)]},
-                    fh,
-                )
+                json.dump(payload, fh)
             os.replace(tmp, self.snapshot_path)  # atomic on POSIX
-            self._dirty = False
+            return True
         except OSError as exc:
             log.warning("catalog: snapshot write failed: %s", exc)
+            return False
+
+    async def _journal(self) -> None:
+        """Snapshot to disk without stalling the loop: capture the
+        payload here, hand the file I/O to the default executor."""
+        payload = self._snapshot_payload()
+        # clear BEFORE the write so mutations landing during it
+        # re-dirty the journal and get picked up next cadence
+        self._dirty = False
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self._write_snapshot, payload
+        )
+        if not ok:
+            self._dirty = True
 
     async def _reap_loop(self) -> None:
         """Reap services critical longer than DeregisterCriticalServiceAfter;
@@ -173,7 +199,7 @@ class CatalogServer:
                     self.snapshot_path and self._dirty
                     and time.time() - last_snapshot >= self.snapshot_every
                 ):
-                    self._write_snapshot()
+                    await self._journal()
                     last_snapshot = time.time()
                 now = time.time()
                 for entry in list(self._entries.values()):

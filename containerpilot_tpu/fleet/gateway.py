@@ -71,14 +71,7 @@ from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, Iterable, List, Optional, Set, Tuple
 
 from ..discovery import Backend
-from ..kvtier import (
-    FP_TOKENS,
-    parse_digest,
-    parse_kv_counters,
-    parse_kv_note,
-    parse_migration_note,
-    prefix_fingerprint,
-)
+from ..kvtier import FP_TOKENS, prefix_fingerprint
 from ..analysis.loopcheck import LoopLagProbe
 from ..telemetry import goodput as goodput_mod
 from ..telemetry import tracing
@@ -96,6 +89,7 @@ from ..utils.prom import (
 )
 from ..utils.tasks import spawn
 from ..watches import poll_upstream
+from . import notes as notes_mod
 from .admission import (
     AdmissionController,
     AdmissionError,
@@ -1046,12 +1040,15 @@ class FleetGateway:
 
     def _apply_notes(self, replica: Replica, notes: str) -> None:
         """Decode a replica's heartbeat check output (``ok occ=0.50
-        kv=... pd=v3:...``) into its routing state. Tolerant: a torn
-        or digest-free note leaves the previous advertisement in
-        place rather than blanking a warm replica."""
-        fields = parse_kv_note(notes)
+        kv=... pd=v3:...``) into its routing state, field-by-field
+        through the note-wire registry (``fleet/notes.py``) — the
+        single schema both this consumer and the member's producer
+        are driven from. Tolerant: a torn or digest-free note leaves
+        the previous advertisement in place rather than blanking a
+        warm replica."""
+        fields = notes_mod.split_note(notes)
         if "kv" in fields:
-            parsed = parse_kv_counters(fields["kv"])
+            parsed = notes_mod.parse_field("kv", fields["kv"])
             # the counters are CUMULATIVE: a torn note's zero-filled
             # tail (or a truncated digit) must not regress them — a
             # regressed tokens_reused parked by a departure would
@@ -1068,10 +1065,11 @@ class FleetGateway:
             # discipline applies — a truncated note's zero-filled
             # tail must never regress a stage's known seconds
             replica.goodput = goodput_mod.merge_note_max(
-                replica.goodput, goodput_mod.parse_note(fields["gp"])
+                replica.goodput,
+                notes_mod.parse_field("gp", fields["gp"]),
             )
         if "pd" in fields:
-            version, fps = parse_digest(fields["pd"])
+            version, fps = notes_mod.parse_field("pd", fields["pd"])
             if version is not None and version != replica.digest_version:
                 replica.digest = fps
                 replica.digest_version = version
@@ -1082,7 +1080,9 @@ class FleetGateway:
             # deltas feed the fleet accounting, plus fp->target
             # landings — each NEW landing repoints the drainer's
             # matching sticky pins onto the survivor immediately
-            counters, landed = parse_migration_note(fields["mg"])
+            counters, landed = notes_mod.parse_field(
+                "mg", fields["mg"]
+            )
             prev = replica.migration
             merged = {
                 name: max(counters.get(name, 0), prev.get(name, 0))

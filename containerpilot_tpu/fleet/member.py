@@ -55,6 +55,7 @@ from ..events import (
     QUIT_BY_TEST,
 )
 from ..utils.tasks import spawn
+from . import notes
 
 log = logging.getLogger("containerpilot.fleet")
 
@@ -164,60 +165,18 @@ class FleetMember(EventHandler):
             return  # drained replicas stay out of the catalog
         if getattr(self.server, "ready", False):
             # lazy-register + TTL refresh; enqueued FIFO off-loop.
-            # The beat carries the replica's slot occupancy as the
-            # check output, so the catalog itself is a (coarse,
-            # TTL-fresh) load signal autoscalers and dashboards can
-            # read without touching the replica
-            occupancy = getattr(self.server, "occupancy", None)
-            output = (
-                f"ok occ={occupancy:.2f}"
-                if isinstance(occupancy, (int, float)) else "ok"
+            # The beat carries the replica's whole advertisement as
+            # the check output — occupancy, role, compile cache,
+            # KV-reuse counters, prefix digest, device-time ledger,
+            # migration progress — assembled field-by-field from the
+            # note-wire registry (``fleet/notes.py``), which owns
+            # every field name and its producer/parser pair. The
+            # registry duck-types the server surface the way this
+            # method always did: an accessor a server doesn't grow
+            # simply omits its field, costing zero note bytes.
+            self.service.send_heartbeat(
+                output=notes.member_note(self.server)
             )
-            # role advertisement: a warm STANDBY heartbeats (it is
-            # alive and promotable) but must never be routed to — the
-            # gateway reads this field to exclude it from _pick and
-            # admission capacity. Active replicas omit it, so the
-            # first post-promote beat flips the gateway's view back.
-            role = getattr(self.server, "role", "")
-            if role and role != "active":
-                output += f" role={role}"
-            # compile-cache advertisement (``cc=``): same-host
-            # launches adopt the dir and skip warm-marked buckets,
-            # collapsing their compile_warmup seconds
-            cc_note = getattr(self.server, "compile_cache_note", None)
-            if callable(cc_note):
-                extra = cc_note()
-                if extra:
-                    output += " " + extra
-            # KV-reuse advertisement (optional, duck-typed like the
-            # rest of the server surface): reuse counters + the
-            # prefix fingerprint digest ride the same check-output
-            # channel occupancy does, so cache-aware gateways learn
-            # what's warm from the catalog poll they already pay for
-            kv_note = getattr(self.server, "kv_note", None)
-            if callable(kv_note):
-                extra = kv_note()
-                if extra:
-                    output += " " + extra
-            # device-time ledger advertisement (``gp=`` — cumulative
-            # per-stage seconds + dispatches/tokens): the gateway's
-            # fleet goodput view is built entirely from this field,
-            # so fleets aggregate badput without a second RPC
-            gp_note = getattr(self.server, "goodput_note", None)
-            if callable(gp_note):
-                extra = gp_note()
-                if extra:
-                    output += " " + extra
-            # drain-migration advertisement (``mg=`` — cumulative
-            # counters + the latest fp->target landings): the channel
-            # the gateway repoints sticky pins off while this replica
-            # evacuates. Empty until a migration has ever run.
-            mg_note = getattr(self.server, "migrate_note", None)
-            if callable(mg_note):
-                extra = mg_note()
-                if extra:
-                    output += " " + extra
-            self.service.send_heartbeat(output=output)
         # not ready (warming, or wedged enough that ready regressed):
         # no beat — an existing record's TTL expiry flips it critical
 
@@ -304,12 +263,6 @@ class FleetMember(EventHandler):
         session's KV belongs where decode runs), and peers that are
         themselves mid-migration. Catalog errors return [] — the
         drain then falls back to a plain deregister."""
-        from ..kvtier.digest import (
-            parse_digest,
-            parse_kv_note,
-            parse_migration_note,
-        )
-
         loop = asyncio.get_event_loop()
         try:
             instances = await loop.run_in_executor(
@@ -325,13 +278,13 @@ class FleetMember(EventHandler):
         for inst in instances or []:
             if inst.id == self.instance_id:
                 continue
-            fields = parse_kv_note(getattr(inst, "notes", ""))
+            fields = notes.split_note(getattr(inst, "notes", ""))
             if fields.get("role", "") in ("standby", "prefill"):
                 continue
-            mg, _landed = parse_migration_note(fields.get("mg", ""))
+            mg, _landed = notes.parse_field("mg", fields.get("mg", ""))
             if mg["active"]:
                 continue
-            _ver, fps = parse_digest(fields.get("pd", ""))
+            _ver, fps = notes.parse_field("pd", fields.get("pd", ""))
             out.append(
                 (inst.id, inst.address, int(inst.port), fps)
             )

@@ -302,15 +302,16 @@ class DeviceTimeLedger:
         tokens_out: int = 0,
         now: Optional[float] = None,
     ) -> str:
-        """The ``gp=`` heartbeat field: seven cumulative stage seconds
-        (3 decimals — a small model's whole productive story can be
-        milliseconds) then the dispatch/token counters, positional
-        like ``kv=``."""
+        """The ``gp=`` heartbeat field's VALUE: seven cumulative
+        stage seconds (3 decimals — a small model's whole productive
+        story can be milliseconds) then the dispatch/token counters,
+        positional like ``kv=``. The ``gp=`` name itself is owned by
+        ``fleet/notes.py``, the wire-schema registry."""
         totals = self.totals(now)
         parts = [f"{totals[s]:.3f}" for s in STAGES]
         parts.append(str(int(dispatches)))
         parts.append(str(int(tokens_out)))
-        return "gp=" + ",".join(parts)
+        return ",".join(parts)
 
 
 # -- wire format -------------------------------------------------------

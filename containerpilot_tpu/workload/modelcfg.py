@@ -146,15 +146,17 @@ def mark_warm_buckets(
 
 
 def compile_cache_note(cache_dir: str) -> str:
-    """The heartbeat advertisement (``cc=<digest>:<quoted dir>``) a
-    FleetMember appends for a replica serving with a compile cache:
-    peers on the same host adopt the dir, and the digest (over the
-    warm-bucket marker) tells readers when the warm set moved.
-    Empty when no cache dir is configured."""
+    """The heartbeat advertisement VALUE (``<digest>:<quoted dir>``,
+    carried as the ``cc=`` field by ``fleet/notes.py``) a FleetMember
+    appends for a replica serving with a compile cache: peers on the
+    same host adopt the dir, and the digest (over the warm-bucket
+    marker) tells readers when the warm set moved. Empty when no
+    cache dir is configured."""
     import hashlib
     import json as json_mod
     import os
-    from urllib.parse import quote
+
+    from ..fleet.notes import encode_compile_cache
 
     if not cache_dir:
         return ""
@@ -166,21 +168,16 @@ def compile_cache_note(cache_dir: str) -> str:
     digest = hashlib.blake2b(
         marker_blob.encode(), digest_size=4
     ).hexdigest()
-    return f"cc={digest}:{quote(cache_dir, safe='')}"
+    return encode_compile_cache(digest, cache_dir)
 
 
 def parse_compile_cache_note(raw: object) -> Tuple[str, str]:
-    """Tolerant reader for the ``cc=`` field: (digest, dir); both
-    empty on garbage — never an exception on the routing path."""
-    from urllib.parse import unquote
+    """Tolerant reader for the ``cc=`` field's value: (digest, dir);
+    both empty on garbage — never an exception on the routing path.
+    Thin alias for the registry codec in ``fleet/notes.py``."""
+    from ..fleet.notes import parse_compile_cache
 
-    if not isinstance(raw, str) or ":" not in raw:
-        return "", ""
-    digest, _, quoted = raw.partition(":")
-    try:
-        return digest, unquote(quoted)
-    except (ValueError, TypeError):
-        return "", ""
+    return parse_compile_cache(raw)
 
 
 def _local_addresses() -> set:
@@ -217,7 +214,7 @@ def adopt_fleet_compile_cache(
     genuinely shared NFS dirs)."""
     import os
 
-    from ..kvtier import parse_kv_note
+    from ..fleet import notes as notes_mod
 
     try:
         instances = backend.instances(service_name)
@@ -227,8 +224,10 @@ def adopt_fleet_compile_cache(
     for inst in instances:
         if getattr(inst, "address", "") not in local:
             continue
-        fields = parse_kv_note(getattr(inst, "notes", ""))
-        _digest, cache_dir = parse_compile_cache_note(fields.get("cc"))
+        fields = notes_mod.split_note(getattr(inst, "notes", ""))
+        _digest, cache_dir = notes_mod.parse_field(
+            "cc", fields.get("cc", "")
+        )
         if cache_dir and os.path.isdir(cache_dir):
             return enable_compile_cache(cache_dir)
     return None

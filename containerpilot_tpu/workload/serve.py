@@ -1706,24 +1706,29 @@ class InferenceServer:
         return float(self._inflight)
 
     def kv_note(self) -> str:
-        """The KV-reuse fields a FleetMember appends to its TTL
-        heartbeat output (the same channel occupancy travels):
-        ``kv=hits,misses,tokens_reused,spilled,readmitted`` plus the
-        ``pd=``-prefixed fingerprint digest the gateway's cache-aware
-        routing scores against. Empty without a prefix cache, so
-        fleets that don't reuse pay zero note bytes."""
+        """The ``kv=`` heartbeat field's VALUE (the name is owned by
+        ``fleet/notes.py``): the prefix cache's reuse counters,
+        ``hits,misses,tokens_reused,spilled,readmitted``. Empty
+        without a prefix cache, so fleets that don't reuse pay zero
+        note bytes."""
         pc = self.prefix_cache
         if pc is None:
             return ""
         s = pc.stats
-        note = (
-            f"kv={s['hits']},{s['misses']},{s['tokens_reused']},"
+        return (
+            f"{s['hits']},{s['misses']},{s['tokens_reused']},"
             f"{s['spilled']},{s['readmitted']}"
         )
-        digest = pc.digest()
-        if digest:
-            note += f" pd={digest}"
-        return note
+
+    def prefix_digest_note(self) -> str:
+        """The ``pd=`` heartbeat field's value: the prefix
+        fingerprint digest the gateway's cache-aware routing scores
+        against. Empty without a prefix cache or before the first
+        digest build."""
+        pc = self.prefix_cache
+        if pc is None:
+            return ""
+        return pc.digest() or ""
 
     def goodput_note(self) -> str:
         """The device-time ledger's heartbeat field (``gp=`` —
@@ -1834,9 +1839,10 @@ class InferenceServer:
             landed.popitem(last=False)
 
     def migrate_note(self) -> str:
-        """The ``mg=`` heartbeat field: cumulative migration counters
-        plus the most recent fp -> target landings, which the gateway
-        uses to repoint sticky pins as sessions land. Empty until a
+        """The ``mg=`` heartbeat field's value (the name is owned by
+        ``fleet/notes.py``): cumulative migration counters plus the
+        most recent fp -> target landings, which the gateway uses to
+        repoint sticky pins as sessions land. Empty until a
         migration has ever run — replicas that never drain pay zero
         note bytes."""
         c = self._migration_counters
@@ -1846,7 +1852,7 @@ class InferenceServer:
 
         landed = list(self._migration_landed.items())
         landed.reverse()  # most-recent-first survives truncation
-        return "mg=" + encode_migration_note(
+        return encode_migration_note(
             c["done"], c["total"], c["failed"], c["timeout"],
             bool(self.migration["active"]), landed,
         )
@@ -1945,7 +1951,8 @@ class InferenceServer:
         )
 
     def compile_cache_note(self) -> str:
-        """The ``cc=`` heartbeat field: this replica's compile-cache
+        """The ``cc=`` heartbeat field's value (the name is owned by
+        ``fleet/notes.py``): this replica's compile-cache
         dir + warm-marker digest, so same-host launches adopt the dir
         and skip warm buckets. Computed ONCE at warmup end (the
         marker only changes there) and cached — a heartbeat must

@@ -481,7 +481,7 @@ class _SlotMirror:
         return np.asarray(jax.device_get(toks))  # cpcheck: disable=CP-HOTSYNC the per-round token fetch
 
 
-def _debug_round(mirror: _SlotMirror, payload, first, toks) -> None:
+def _debug_round(mirror: _SlotMirror, payload, first, toks) -> None:  # cpcheck: disable=CP-HOTREACH debug-only dump behind CONTAINERPILOT_POD_DEBUG; every sync here is the point
     """Dump one round's inputs and full device state
     (CONTAINERPILOT_POD_DEBUG only). Deliberately a separate,
     non-hot function: every fetch below is a host sync."""

@@ -1,21 +1,37 @@
 #!/usr/bin/env bash
-# cpcheck over ONLY the .py files your working tree changed — the fast
-# precommit-style loop (the full gate is `make lint`; CI runs it via
-# the tier-1 test_lint_gate test). The rule set is whatever
+# cpcheck findings for ONLY the .py files your working tree changed —
+# the fast precommit-style loop (the full gate is `make lint`; CI runs
+# it via the tier-1 test_lint_gate test). The rule set is whatever
 # `python -m containerpilot_tpu.analysis --list-rules` prints —
-# thread/JAX rules (CP-HOTSYNC..CP-TOPIC) and the asyncio-era rules
-# (CP-ASYNCBLOCK, CP-TASKLEAK, CP-AWAITHOLD, CP-RETRACE) alike.
+# lexical rules (CP-HOTSYNC..CP-RETRACE) and the interprocedural ones
+# (CP-ASYNCREACH, CP-HOTREACH, CP-LOCKORDER, CP-NOTEWIRE) alike. The
+# call graph is always built over the FULL package (a changed helper
+# can create a reachability finding whose witness spans unchanged
+# files); only the findings are filtered to the diff, so this stays a
+# few-seconds run (~4s for the whole package, AST forest parsed once).
 #
 # Usage:
-#   scripts/cpcheck_diff.sh            # changed vs HEAD (staged + unstaged + untracked)
-#   scripts/cpcheck_diff.sh origin/main  # changed vs a base ref
+#   scripts/cpcheck_diff.sh                 # changed vs HEAD (staged + unstaged + untracked)
+#   scripts/cpcheck_diff.sh origin/main     # changed vs a base ref
+#   scripts/cpcheck_diff.sh --since <ref>   # same, reads better in scripts (`make lint-diff SINCE=...`)
 #
 # Exits 0 when nothing relevant changed or every finding is baselined;
 # non-zero on any new finding (same contract as `make lint`).
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
-BASE="${1:-HEAD}"
+BASE="HEAD"
+case "${1:-}" in
+    --since)
+        [ $# -ge 2 ] || {
+            echo "cpcheck_diff: --since needs a ref" >&2
+            exit 2
+        }
+        BASE="$2"
+        ;;
+    "") ;;
+    *) BASE="$1" ;;
+esac
 
 # a typo'd ref must fail loudly, not scan nothing and exit 0 (process
 # substitution below would swallow git's error)

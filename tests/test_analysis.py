@@ -19,10 +19,15 @@ import pytest
 
 from containerpilot_tpu.analysis import (
     ALL_RULES,
+    PROJECT_RULES,
+    PROJECT_RULES_BY_ID,
     RULES_BY_ID,
     RaceCheck,
+    build_project,
     diff_against_baseline,
+    explain_stale,
     load_baseline,
+    run_project_rules,
     scan_package,
     scan_source,
     write_baseline,
@@ -51,6 +56,14 @@ def test_rule_catalog_complete():
     for rule in ALL_RULES:
         assert rule.__doc__, f"{rule.rule_id} must document itself"
         assert RULES_BY_ID[rule.rule_id] is rule
+    project_ids = {r.rule_id for r in PROJECT_RULES}
+    assert project_ids == {
+        "CP-ASYNCREACH", "CP-HOTREACH", "CP-LOCKORDER", "CP-NOTEWIRE",
+    }
+    assert ids.isdisjoint(project_ids)
+    for rule in PROJECT_RULES:
+        assert rule.__doc__, f"{rule.rule_id} must document itself"
+        assert PROJECT_RULES_BY_ID[rule.rule_id] is rule
 
 
 def test_hotsync_fires_in_marked_function():
@@ -505,6 +518,428 @@ def test_retrace_clean_on_stable_args_or_cold_path():
     assert findings_for(src, "CP-RETRACE") == []
 
 
+# ---------------------------------- interprocedural rules (callgraph)
+
+def project_findings(sources: dict, rule: str):
+    """Run the interprocedural rules over a multi-module fixture."""
+    project = build_project(
+        {p: textwrap.dedent(s) for p, s in sources.items()}
+    )
+    return [f for f in run_project_rules(project) if f.rule == rule]
+
+
+def test_asyncreach_fires_through_sync_hops():
+    src = """
+    import time
+
+    def inner():
+        time.sleep(1.0)
+
+    def middle():
+        inner()
+
+    async def handler():
+        middle()
+    """
+    found = findings_for(src, "CP-ASYNCREACH")
+    assert len(found) == 1
+    assert found[0].scope == "handler"
+    assert "time.sleep" in found[0].message
+    assert "inner" in found[0].message
+
+
+def test_asyncreach_respects_hop_bound():
+    """Four sync hops is beyond the documented bound of three — the
+    rule stays quiet rather than report ever-fuzzier chains."""
+    src = """
+    import time
+
+    def h4():
+        time.sleep(1.0)
+
+    def h3():
+        h4()
+
+    def h2():
+        h3()
+
+    def h1():
+        h2()
+
+    async def handler():
+        h1()
+    """
+    assert findings_for(src, "CP-ASYNCREACH") == []
+
+
+def test_asyncreach_executor_heal_at_any_hop():
+    src = """
+    import asyncio
+    import time
+
+    def inner():
+        time.sleep(1.0)
+
+    async def healed_at_root():
+        await asyncio.get_running_loop().run_in_executor(None, inner)
+
+    def middle():
+        loop.run_in_executor(None, inner)
+
+    async def healed_mid_chain():
+        middle()
+    """
+    assert findings_for(src, "CP-ASYNCREACH") == []
+
+
+def test_asyncreach_inline_disable_pragma():
+    src = """
+    import time
+
+    def inner():
+        time.sleep(1.0)
+
+    async def handler():
+        inner()  # cpcheck: disable=CP-ASYNCREACH intentional startup block
+    """
+    assert findings_for(src, "CP-ASYNCREACH") == []
+
+
+def test_asyncreach_cross_module():
+    found = project_findings({
+        "util.py": """
+            import time
+
+            def backoff():
+                time.sleep(0.5)
+        """,
+        "svc.py": """
+            from util import backoff
+
+            async def retry():
+                backoff()
+        """,
+    }, "CP-ASYNCREACH")
+    assert len(found) == 1
+    assert found[0].file == "svc.py"
+    assert "util.py" in found[0].message
+
+
+def test_hotreach_inherits_through_helpers():
+    src = """
+    import numpy as np
+
+    def fetch(x):
+        return np.asarray(x)
+
+    def relay(x):
+        return fetch(x)
+
+    # cpcheck: hotpath
+    def round(x):
+        return relay(x)
+    """
+    found = findings_for(src, "CP-HOTREACH")
+    assert len(found) == 1
+    assert found[0].scope == "fetch"
+    assert "relay" in found[0].message
+
+
+def test_hotreach_silent_without_hot_root():
+    src = """
+    import numpy as np
+
+    def fetch(x):
+        return np.asarray(x)
+
+    def round(x):
+        return fetch(x)
+    """
+    assert findings_for(src, "CP-HOTREACH") == []
+
+
+def test_hotreach_honors_twin_rule_pragma_and_def_optout():
+    """A helper's existing CP-HOTSYNC line pragma heals the inherited
+    check; a CP-HOTREACH pragma on the def line opts the whole
+    function out of heat inheritance (deliberately cold helpers)."""
+    line_pragma = """
+    import numpy as np
+
+    def fetch(x):
+        return np.asarray(x)  # cpcheck: disable=CP-HOTSYNC one-time fetch
+
+    # cpcheck: hotpath
+    def round(x):
+        return fetch(x)
+    """
+    assert findings_for(line_pragma, "CP-HOTREACH") == []
+
+    def_optout = """
+    import numpy as np
+
+    def dump(x):  # cpcheck: disable=CP-HOTREACH debug-only dump
+        print(x)
+        return np.asarray(x)
+
+    # cpcheck: hotpath
+    def round(x):
+        return dump(x)
+    """
+    assert findings_for(def_optout, "CP-HOTREACH") == []
+
+
+def test_hotreach_checks_retrace_in_inherited_helper():
+    src = """
+    import jax
+
+    step = jax.jit(_step)
+
+    def relay(self, batch):
+        return step(batch, len(batch))
+
+    # cpcheck: hotpath
+    def round(self, batch):
+        return relay(self, batch)
+    """
+    found = findings_for(src, "CP-HOTREACH")
+    assert len(found) == 1
+    assert "len(batch)" in found[0].text
+
+
+def test_lockorder_cycle_reports_both_witnesses():
+    src = """
+    import threading
+
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def take_b():
+        with lock_b:
+            pass
+
+    def a_then_b():
+        with lock_a:
+            take_b()
+
+    def take_a():
+        with lock_a:
+            pass
+
+    def b_then_a():
+        with lock_b:
+            take_a()
+    """
+    found = findings_for(src, "CP-LOCKORDER")
+    assert len(found) == 1
+    msg = found[0].message
+    assert "lock_a" in msg and "lock_b" in msg
+    assert "a_then_b" in msg and "b_then_a" in msg
+
+
+def test_lockorder_consistent_order_is_clean():
+    src = """
+    import threading
+
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def take_b():
+        with lock_b:
+            pass
+
+    def one():
+        with lock_a:
+            take_b()
+
+    def two():
+        with lock_a:
+            with lock_b:
+                pass
+    """
+    assert findings_for(src, "CP-LOCKORDER") == []
+
+
+def test_lockorder_reentry_is_not_a_cycle():
+    src = """
+    import threading
+
+    lock = threading.RLock()
+
+    def inner():
+        with lock:
+            pass
+
+    def outer():
+        with lock:
+            inner()
+    """
+    assert findings_for(src, "CP-LOCKORDER") == []
+
+
+def test_notewire_missing_parser_and_bypass():
+    found = project_findings({
+        "reg.py": """
+            from dataclasses import dataclass
+
+            @dataclass(frozen=True)
+            class NoteField:
+                name: str
+                produce: object
+                parse: object
+                doc: str = ""
+
+            def _ident(raw):
+                return raw
+
+            FIELDS = (
+                NoteField(name="kv", produce=_ident, parse=_ident),
+                NoteField(name="gp", produce=_ident, parse=None),
+            )
+        """,
+        "prod.py": """
+            def note(v):
+                return "kv=" + v
+        """,
+    }, "CP-NOTEWIRE")
+    messages = "\n".join(f.message for f in found)
+    assert any(f.file == "reg.py" for f in found), messages
+    assert "gp" in messages
+    assert any(f.file == "prod.py" for f in found), messages
+
+
+def test_notewire_unregistered_consumption():
+    found = project_findings({
+        "reg.py": """
+            from dataclasses import dataclass
+
+            @dataclass(frozen=True)
+            class NoteField:
+                name: str
+                produce: object
+                parse: object
+
+            def _ident(raw):
+                return raw
+
+            FIELDS = (
+                NoteField(name="kv", produce=_ident, parse=_ident),
+            )
+        """,
+        "gw.py": """
+            from notes import split_note
+
+            def apply(raw):
+                fields = split_note(raw)
+                good = fields.get("kv", "")
+                bad = fields.get("zz", "")
+                return good, bad
+        """,
+    }, "CP-NOTEWIRE")
+    assert len(found) == 1
+    assert found[0].file == "gw.py"
+    assert "zz" in found[0].message
+
+
+def test_notewire_silent_without_registry():
+    """Fixtures (and projects) with no NoteField FIELDS registry are
+    none of this rule's business."""
+    src = """
+    def note(v):
+        return "kv=" + v
+    """
+    assert findings_for(src, "CP-NOTEWIRE") == []
+
+
+# ----------------------------------------------- call graph internals
+
+def test_callgraph_resolves_self_methods_and_instances():
+    project = build_project({"mod.py": textwrap.dedent("""
+        class Engine:
+            def run(self):
+                self.step()
+
+            def step(self):
+                pass
+
+        engine = Engine()
+
+        def drive():
+            engine.step()
+    """)})
+    g = project.graph
+    run_edges = {e.callee for e in g.edges_from["mod:Engine.run"]}
+    assert "mod:Engine.step" in run_edges
+    drive_edges = {e.callee for e in g.edges_from["mod:drive"]}
+    assert "mod:Engine.step" in drive_edges
+
+
+def test_callgraph_partial_and_spawn_are_deferred():
+    """partial/spawn targets are recorded — but as deferred edge
+    kinds the sync-reachability walk must not traverse."""
+    project = build_project({"mod.py": textwrap.dedent("""
+        import asyncio
+        import functools
+        import time
+
+        def worker():
+            time.sleep(1.0)
+
+        def build():
+            return functools.partial(worker, 1)
+
+        async def kick():
+            asyncio.create_task(aworker())
+
+        async def aworker():
+            pass
+    """)})
+    g = project.graph
+    kinds = {
+        (e.callee, e.kind)
+        for edges in g.edges_from.values()
+        for e in edges
+    }
+    assert ("mod:worker", "partial") in kinds
+    assert ("mod:aworker", "spawn") in kinds
+    reached = {
+        info.scope for info, _ in g.sync_reachable("mod:build")
+    }
+    assert "worker" not in reached
+
+
+def test_callgraph_unknown_edges_are_recorded_not_guessed():
+    project = build_project({"mod.py": textwrap.dedent("""
+        def f(x):
+            x.frobnicate()
+    """)})
+    g = project.graph
+    assert g.edges_from.get("mod:f", []) == []
+    assert any(
+        u.caller == "mod:f" and "frobnicate" in u.name
+        for u in g.unknown
+    )
+    assert all(u.reason for u in g.unknown)
+
+
+def test_callgraph_sync_reachable_yields_witness_path():
+    project = build_project({"mod.py": textwrap.dedent("""
+        def c():
+            pass
+
+        def b():
+            c()
+
+        def a():
+            b()
+    """)})
+    g = project.graph
+    reached = {
+        info.scope: path for info, path in g.sync_reachable("mod:a")
+    }
+    assert set(reached) == {"b", "c"}
+    assert [e.callee for e in reached["c"]] == ["mod:b", "mod:c"]
+
+
 # ------------------------------------------------------------- baseline
 
 def test_baseline_matches_fresh_scan():
@@ -545,6 +980,64 @@ def test_baseline_multiset_semantics(tmp_path):
     write_baseline(findings[:1], path)
     new, stale = diff_against_baseline(findings, load_baseline(path))
     assert len(new) == 1 and stale == []
+
+
+def test_explain_stale_names_the_cause(tmp_path):
+    """`make lint-baseline` / the lint failure must say WHY an entry
+    went stale: edited line text (fingerprint drift) vs fixed debt."""
+    src = """
+    def a(self):
+        try:
+            self.x()
+        except Exception:
+            pass
+    """
+    findings = [
+        f for f in scan_source(textwrap.dedent(src), "m.py")
+        if f.rule == "CP-SWALLOW"
+    ]
+    path = str(tmp_path / "baseline.json")
+    write_baseline(findings, path)
+
+    # drift: the baselined line's text changed, same scope still fires
+    drifted = [
+        f for f in scan_source(
+            textwrap.dedent(src).replace(
+                "except Exception:", "except Exception:  # noqa"
+            ),
+            "m.py",
+        )
+        if f.rule == "CP-SWALLOW"
+    ]
+    new, stale = diff_against_baseline(drifted, load_baseline(path))
+    assert len(new) == 1 and len(stale) == 1
+    lines = explain_stale(new, stale)
+    assert len(lines) == 1
+    assert "line text drifted" in lines[0]
+    assert "m.py [a] CP-SWALLOW" in lines[0]
+
+    # fixed: the finding is gone entirely
+    new, stale = diff_against_baseline([], load_baseline(path))
+    lines = explain_stale(new, stale)
+    assert len(lines) == 1
+    assert "finding no longer present" in lines[0]
+    assert "make lint-baseline" in lines[0]
+
+
+def test_cli_reports_stale_entries_with_reason(tmp_path):
+    """End to end: a full scan against a baseline holding a bogus
+    entry warns (still exit 0) and explains the staleness."""
+    entries = load_baseline()
+    entries = entries + [{
+        "rule": "CP-SWALLOW", "file": "containerpilot_tpu/gone.py",
+        "scope": "f", "text": "pass",
+    }]
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"version": 1, "entries": entries}))
+    proc = _run_cli("--baseline", str(path), "--no-compileall")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "stale baseline entr" in proc.stdout
+    assert "finding no longer present" in proc.stdout
 
 
 def test_write_baseline_preserves_reasons(tmp_path):
@@ -626,6 +1119,73 @@ def test_lint_gate_fails_on_seeded_taskleak(tmp_path):
     assert "CP-TASKLEAK" in proc.stdout
 
 
+def test_lint_gate_fails_on_seeded_asyncreach(tmp_path):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(
+        "import time\n"
+        "def helper():\n"
+        "    time.sleep(1.0)\n"
+        "async def handler():\n"
+        "    helper()\n"
+    )
+    proc = _run_cli("--files", str(bad))
+    assert proc.returncode == 1
+    assert "CP-ASYNCREACH" in proc.stdout
+
+
+def test_lint_gate_fails_on_seeded_hotreach(tmp_path):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "def fetch(x):\n"
+        "    return np.asarray(x)\n"
+        "# cpcheck: hotpath\n"
+        "def round(x):\n"
+        "    return fetch(x)\n"
+    )
+    proc = _run_cli("--files", str(bad))
+    assert proc.returncode == 1
+    assert "CP-HOTREACH" in proc.stdout
+
+
+def test_lint_gate_fails_on_seeded_lockorder(tmp_path):
+    bad = tmp_path / "seeded.py"
+    bad.write_text(
+        "import threading\n"
+        "lock_a = threading.Lock()\n"
+        "lock_b = threading.Lock()\n"
+        "def take_b():\n"
+        "    with lock_b:\n"
+        "        pass\n"
+        "def ab():\n"
+        "    with lock_a:\n"
+        "        take_b()\n"
+        "def take_a():\n"
+        "    with lock_a:\n"
+        "        pass\n"
+        "def ba():\n"
+        "    with lock_b:\n"
+        "        take_a()\n"
+    )
+    proc = _run_cli("--files", str(bad))
+    assert proc.returncode == 1
+    assert "CP-LOCKORDER" in proc.stdout
+
+
+def test_lint_gate_fails_on_seeded_notewire(tmp_path):
+    """The real fleet/notes.py registry is in the project the --files
+    scan builds, so an ad-hoc `\"kv=\" +` concat in the seeded file is
+    a bypass of it."""
+    bad = tmp_path / "seeded.py"
+    bad.write_text(
+        "def note(v):\n"
+        "    return \"kv=\" + v\n"
+    )
+    proc = _run_cli("--files", str(bad))
+    assert proc.returncode == 1
+    assert "CP-NOTEWIRE" in proc.stdout
+
+
 def test_cli_rejects_partial_baseline_write(tmp_path):
     """--write-baseline over a partial --files scan would silently
     drop every other file's justified entries; it must be refused."""
@@ -638,7 +1198,7 @@ def test_cli_rejects_partial_baseline_write(tmp_path):
 def test_cli_list_rules():
     proc = _run_cli("--list-rules")
     assert proc.returncode == 0
-    for rule in ALL_RULES:
+    for rule in list(ALL_RULES) + list(PROJECT_RULES):
         assert rule.rule_id in proc.stdout
 
 

@@ -296,3 +296,58 @@ def test_backend_reuses_catalog_connection_per_thread(run):
     conns, reqs = run(scenario(), timeout=30)
     assert reqs == 12  # register + 5*(ttl+poll) + deregister
     assert conns == 1  # ... over a single dial
+
+
+def test_snapshot_journal_runs_off_loop_and_redirties_on_failure(
+    run, tmp_path
+):
+    """Regression for the CP-ASYNCREACH findings here: the journal's
+    file write must leave the event loop (payload captured on-loop,
+    I/O in the executor), and a failed write must re-dirty the
+    journal so the next reap cadence retries instead of dropping the
+    acknowledged mutations."""
+    import threading
+
+    snap = str(tmp_path / "snap.json")
+
+    async def scenario():
+        server = CatalogServer("127.0.0.1", PORT, snapshot_path=snap)
+        loop_thread = threading.current_thread()
+        writer_threads = []
+        real_write = server._write_snapshot
+
+        def spy(payload=None):
+            writer_threads.append(threading.current_thread())
+            return real_write(payload)
+
+        server._write_snapshot = spy
+        server._dirty = True
+        await server._journal()
+        assert server._dirty is False
+        assert writer_threads
+        assert all(t is not loop_thread for t in writer_threads)
+
+        # unwritable target: the write fails, the dirt must survive
+        server.snapshot_path = str(tmp_path / "no-such-dir" / "s.json")
+        server._dirty = True
+        await server._journal()
+        assert server._dirty is True
+
+        # the startup load leaves the loop the same way
+        loader_threads = []
+        reborn = CatalogServer("127.0.0.1", PORT, snapshot_path=snap)
+        real_load = reborn._load_snapshot
+
+        def load_spy():
+            loader_threads.append(threading.current_thread())
+            real_load()
+
+        reborn._load_snapshot = load_spy
+        await reborn.run()
+        try:
+            assert loader_threads
+            assert all(t is not loop_thread for t in loader_threads)
+        finally:
+            await reborn.stop()
+
+    run(scenario(), timeout=30)

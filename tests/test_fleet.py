@@ -315,7 +315,7 @@ def test_standby_member_note_and_gateway_capacity(run, tmp_path):
             self.role = "standby"
 
         def compile_cache_note(self):
-            return "cc=beef:%2Ftmp%2Fcc"
+            return "beef:%2Ftmp%2Fcc"
 
     async def scenario():
         active = _StubReplica()

@@ -187,8 +187,8 @@ def test_note_roundtrip_and_torn_note_merge():
     led.enter("compile_warmup", now=2.0)
     led.enter("idle", now=5.0)
     note = led.note(dispatches=12, tokens_out=340, now=6.0)
-    assert note.startswith("gp=")
-    parsed = parse_note(note[len("gp="):])
+    assert "=" not in note  # value-only: fleet/notes.py owns gp=
+    parsed = parse_note(note)
     assert parsed["boot"] == pytest.approx(2.0)
     assert parsed["compile_warmup"] == pytest.approx(3.0)
     assert parsed["idle"] == pytest.approx(1.0)
@@ -464,10 +464,9 @@ def test_server_goodput_surface_and_accounting(run):
             )
         assert "cp_decode_dispatches_total" in metrics
         assert "cp_tokens_out_total" in metrics
-        # heartbeat note face
+        # heartbeat note face (value-only: fleet/notes.py owns gp=)
         note = server.goodput_note()
-        assert note.startswith("gp=")
-        parsed = parse_note(note[len("gp="):])
+        parsed = parse_note(note)
         assert parsed["compile_warmup"] > 0.0
         assert parsed["tokens_out"] >= 8
         # drain attribution
@@ -503,7 +502,7 @@ def test_member_heartbeat_carries_gp_note(run, tmp_path):
         occupancy = 0.5
 
         def goodput_note(self):
-            return "gp=1.000,2.000,3.000,0.100,0.200,0.000,0.000,4,40"
+            return "1.000,2.000,3.000,0.100,0.200,0.000,0.000,4,40"
 
     async def scenario():
         member = FleetMember(

@@ -1,0 +1,155 @@
+"""fleet/notes.py: the heartbeat note-wire registry.
+
+One producer + one tolerant parser per field, registered in FIELDS —
+these tests pin the roundtrip (a member-emitted note decodes back
+field-for-field through the registered parsers), the duck-typed
+producer surface, and the tolerant-parser discipline. The static face
+of the same contract (no ad-hoc ``"x=" +`` bypasses, no unregistered
+consumption) is CP-NOTEWIRE in tests/test_analysis.py.
+"""
+import math
+
+import pytest
+
+from containerpilot_tpu.fleet import notes
+from containerpilot_tpu.fleet.notes import (
+    FIELDS,
+    ROLE_ACTIVE,
+    encode_compile_cache,
+    field_names,
+    member_note,
+    parse_compile_cache,
+    parse_field,
+    parse_occ,
+    split_note,
+)
+
+
+class _Server:
+    """The full duck-typed member surface, every field populated."""
+
+    occupancy = 0.5
+    role = "standby"
+
+    def compile_cache_note(self):
+        return encode_compile_cache("beef", "/tmp/cache dir")
+
+    def kv_note(self):
+        return "5,2,160,1,1"
+
+    def prefix_digest_note(self):
+        return "v7:" + "ab" * 16
+
+    def goodput_note(self):
+        return "1.000,2.000,3.000,0.100,0.200,0.000,0.000,4,40"
+
+    def migrate_note(self):
+        return "2,3,0,0,1"
+
+
+def test_registry_is_the_whole_vocabulary():
+    assert field_names() == {
+        "occ", "role", "cc", "kv", "pd", "gp", "mg",
+    }
+    for spec in FIELDS:
+        assert spec.doc, f"{spec.name} must document itself"
+        assert callable(spec.produce) and callable(spec.parse)
+
+
+def test_member_note_roundtrips_through_registered_parsers():
+    note = member_note(_Server())
+    assert note.startswith("ok ")
+    fields = split_note(note)
+    assert set(fields) == field_names()
+    assert parse_field("occ", fields["occ"]) == 0.5
+    assert parse_field("role", fields["role"]) == "standby"
+    digest, cache_dir = parse_field("cc", fields["cc"])
+    assert (digest, cache_dir) == ("beef", "/tmp/cache dir")
+    assert parse_field("kv", fields["kv"]) == {
+        "hits": 5, "misses": 2, "tokens_reused": 160,
+        "spilled": 1, "readmitted": 1,
+    }
+    version, fingerprints = parse_field("pd", fields["pd"])
+    assert version == 7 and len(fingerprints) == 1
+    gp = parse_field("gp", fields["gp"])
+    assert gp["dispatches"] == 4 and gp["tokens_out"] == 40
+    counters, landed = parse_field("mg", fields["mg"])
+    assert counters["done"] == 2 and counters["total"] == 3
+    assert landed == {}
+
+
+def test_member_note_emits_in_registry_order():
+    note = member_note(_Server())
+    emitted = [part.partition("=")[0] for part in note.split()[1:]]
+    assert emitted == [
+        spec.name for spec in FIELDS
+        if spec.produce(_Server())
+    ]
+
+
+def test_bare_server_emits_just_ok():
+    """Every producer duck-types: an object with none of the optional
+    accessors advertises nothing beyond liveness."""
+    assert member_note(object()) == "ok"
+
+
+def test_active_role_advertises_by_omission():
+    class _Active(_Server):
+        role = ROLE_ACTIVE
+
+    assert "role=" not in member_note(_Active())
+    # and absent role decodes to "" — caller defaults it to active
+    assert parse_field("role", split_note("ok").get("role", "")) == ""
+
+
+def test_parse_occ_is_tolerant():
+    assert parse_occ("0.50") == 0.5
+    assert parse_occ("2.5") == 1.0      # clamped
+    assert parse_occ("-1") == 0.0
+    assert parse_occ("nan") is None
+    assert parse_occ("inf") is None
+    assert parse_occ("bogus") is None
+    assert parse_occ("") is None
+    assert parse_occ(None) is None
+    assert parse_occ(math.pi) is None   # non-str input
+
+
+def test_compile_cache_codec_tolerance():
+    assert parse_compile_cache("beef:%2Ftmp%2Fcc") == ("beef", "/tmp/cc")
+    assert parse_compile_cache("no-colon") == ("", "")
+    assert parse_compile_cache(":/tmp/cc") == ("", "")
+    assert parse_compile_cache("beef:") == ("", "")
+    assert parse_compile_cache(None) == ("", "")
+    assert encode_compile_cache("beef", "") == ""
+
+
+def test_parse_field_rejects_unregistered_names():
+    with pytest.raises(KeyError):
+        parse_field("zz", "1")
+
+
+def test_producers_omit_empty_values():
+    class _Partial:
+        occupancy = 0.25
+
+        def kv_note(self):
+            return ""  # counters all zero -> producer yields empty
+
+    note = member_note(_Partial())
+    assert note == "ok occ=0.25"
+
+
+def test_import_does_not_pull_jax():
+    """notes is imported by the gateway, which must come up without
+    jax; the cc codec lives HERE (modelcfg delegates via lazy import)
+    for exactly that reason."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; import containerpilot_tpu.fleet.notes; "
+         "sys.exit(1 if 'jax' in sys.modules else 0)"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
